@@ -1,0 +1,15 @@
+"""redcliff_tpu — TPU-native (JAX/XLA/Pallas) framework with the capabilities of
+carlson-lab/redcliff-s-hypothesizing-dynamic-causal-graphs.
+
+REDCLIFF-S fits a generative factor model to multivariate time series: K per-factor
+cMLP Granger-causal forecasters whose one-step predictions are mixed by a factor-score
+embedder conditioned on the recent signal window; first-layer weight norms of each
+factor network are read out as per-state (dynamic) Granger-causal graphs.
+
+This package is a ground-up TPU-first redesign (not a port): pure functional models
+(param pytrees + apply fns), a single jit'd train step shared by every model family,
+vmap over the factor/series/config axes where the reference loops in Python, and
+jax.sharding/shard_map over a device mesh where the reference used SLURM job arrays.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,406 @@
+"""Synthetic sVAR benchmark generator — dataset tool and test oracle.
+
+Semantics-parity rebuild of /root/reference/data/data_utils.py: a 2-lag
+sinusoid-driven (optionally nonlinear) VAR whose per-state rollouts are superimposed
+with random linearly-interpolated activation weights, plus the random lagged-DAG
+factory with orthogonality/connected-component constraints
+(ref data_utils.py:47-240, 243-353).
+
+Two implementations share one parameterization:
+
+* ``rollout_np`` / ``generate_synthetic_data_np`` — host/numpy, loop-per-step,
+  mirroring the reference for golden tests and CPU curation.
+* ``rollout_scan`` / ``generate_synthetic_batch`` — the TPU path: the per-step
+  update is a dense (D, D, L) elementwise-activated contraction inside
+  ``jax.lax.scan``; whole batches are drawn with ``vmap`` from pre-split PRNG keys,
+  so curation of an entire dataset is one jit'd program instead of a SLURM array.
+
+Per-edge nonlinearities are encoded as an integer code tensor ``act_codes`` of shape
+(D, D, L): 0 = identity, 1 = min(x, 0), 2 = max(x, 0) — the three activations the
+reference curation driver uses (ref currate_...etNL.py:21,272).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from redcliff_tpu.utils.metrics import get_number_of_connected_components
+
+ACT_IDENTITY, ACT_MIN0, ACT_MAX0 = 0, 1, 2
+
+
+def _apply_act_np(x, codes):
+    out = np.where(codes == ACT_MIN0, np.minimum(x, 0.0), x)
+    out = np.where(codes == ACT_MAX0, np.maximum(x, 0.0), out)
+    return out
+
+
+def _apply_act(x, codes):
+    out = jnp.where(codes == ACT_MIN0, jnp.minimum(x, 0.0), x)
+    out = jnp.where(codes == ACT_MAX0, jnp.maximum(x, 0.0), out)
+    return out
+
+
+def _step_matrices(A, base_freqs):
+    """Fold the self-connection dynamics into per-lag dense matrices.
+
+    The reference treats diagonal entries specially (ref data_utils.py:69-78):
+    lag-1 self term is A[i,i,0] * 2cos(2*pi*f_i) * x_{t-1,i} and lag-2 self term is
+    -A[i,i,1] * x_{t-2,i}. Scaling the diagonal ahead of time makes the whole step
+    one elementwise-activated (D, D) product per lag.
+    """
+    A = np.asarray(A, dtype=np.float64)
+    D = A.shape[0]
+    f = np.asarray(base_freqs, dtype=np.float64).reshape(D)
+    M1 = A[:, :, 0].copy()
+    M1[np.arange(D), np.arange(D)] *= 2.0 * np.cos(2.0 * np.pi * f)
+    M2 = A[:, :, 1].copy() if A.shape[2] > 1 else np.zeros((D, D))
+    M2[np.arange(D), np.arange(D)] *= -1.0
+    return M1, M2
+
+
+def nvar_step_np(x_tm1, x_tm2, M1, M2, act_codes, innovation, num_lags=2):
+    """One step of the nonlinear VAR given pre-folded matrices (host version)."""
+    pre1 = M1 * x_tm1[None, :]
+    contrib = _apply_act_np(pre1, act_codes[:, :, 0]).sum(axis=1)
+    if num_lags > 1:
+        pre2 = M2 * x_tm2[None, :]
+        contrib = contrib + _apply_act_np(pre2, act_codes[:, :, 1]).sum(axis=1)
+    return contrib + innovation
+
+
+def rollout_np(A, act_codes, base_freqs, noise_mu, noise_var, innovation_amp,
+               recording_length, burnin_period, rng):
+    """Host rollout matching ref data_utils.py:88-125 step-for-step.
+
+    Innovations only enter through the self-connection branch, i.e. once per node
+    per step. Returns (D, recording_length).
+    """
+    A = np.asarray(A, dtype=np.float64)
+    D = A.shape[0]
+    M1, M2 = _step_matrices(A, base_freqs)
+    amp = np.asarray(innovation_amp, dtype=np.float64).reshape(D)
+    mu = np.asarray(noise_mu, dtype=np.float64).reshape(D)
+    var = np.asarray(noise_var, dtype=np.float64).reshape(D)
+    avg_amp = float(np.mean(amp))
+
+    x0 = rng.uniform(-avg_amp, avg_amp, D)
+    innov = amp * rng.normal(mu, var)
+    x1 = nvar_step_np(x0, x0, M1, M2, act_codes, innov, num_lags=1)
+    samp = [x0, x1]
+    for _ in range(recording_length + burnin_period):
+        innov = amp * rng.normal(mu, var)
+        samp.append(nvar_step_np(samp[-1], samp[-2], M1, M2, act_codes, innov))
+    return np.stack(samp[2 + burnin_period :], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Device (lax.scan) rollout
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("recording_length", "burnin_period"))
+def rollout_scan(key, M1, M2, act_codes, noise_mu, noise_var, innovation_amp,
+                 recording_length, burnin_period):
+    """lax.scan rollout of the 2-lag nonlinear VAR; returns (recording_length, D).
+
+    Same dynamics as ``rollout_np`` with jax-PRNG innovations. Pre-folded
+    (M1, M2) come from ``_step_matrices``.
+    """
+    D = M1.shape[0]
+    amp = innovation_amp.reshape(D)
+    mu = noise_mu.reshape(D)
+    var = noise_var.reshape(D)
+    avg_amp = jnp.mean(amp)
+    k0, k1, kseq = jax.random.split(key, 3)
+
+    x0 = jax.random.uniform(k0, (D,), minval=-avg_amp, maxval=avg_amp)
+    innov1 = amp * (mu + var * jax.random.normal(k1, (D,)))
+    pre1 = M1 * x0[None, :]
+    x1 = _apply_act(pre1, act_codes[:, :, 0]).sum(axis=1) + innov1
+
+    total = recording_length + burnin_period
+    noise = mu[None, :] + var[None, :] * jax.random.normal(kseq, (total, D))
+
+    def step(carry, eps):
+        x_tm1, x_tm2 = carry
+        c1 = _apply_act(M1 * x_tm1[None, :], act_codes[:, :, 0]).sum(axis=1)
+        c2 = _apply_act(M2 * x_tm2[None, :], act_codes[:, :, 1]).sum(axis=1)
+        x_t = c1 + c2 + amp * eps
+        return (x_t, x_tm1), x_t
+
+    _, xs = jax.lax.scan(step, (x1, x0), noise)
+    return xs[burnin_period:]
+
+
+@partial(jax.jit, static_argnames=("recording_length", "burnin_period", "label_type",
+                                   "num_labeled_sys_states", "noise_type"))
+def generate_synthetic_batch(key, M1_stack, M2_stack, act_codes_stack, base_params,
+                             recording_length, burnin_period, num_labeled_sys_states,
+                             label_type="Oracle", noise_type="white", noise_amp=0.1,
+                             batch_size_key=None):
+    """Draw one sample: superimpose every system state's rollout under random
+    linear activation ramps, label per-step, add measurement noise
+    (ref data_utils.py:137-240). vmap over split keys for a batch.
+
+    Args:
+      M1_stack, M2_stack, act_codes_stack: (S, D, D[, L]) stacked per-state systems.
+      base_params: dict with 'noise_mu', 'noise_var', 'innovation_amp' (each (D,)).
+    Returns:
+      x: (recording_length, D) float32, y: (num_labels, recording_length) float32
+      where num_labels = num_labeled_sys_states (+1 if unsupervised states exist).
+    """
+    S, D = M1_stack.shape[0], M1_stack.shape[1]
+    n_extra = S - num_labeled_sys_states
+    num_labels = num_labeled_sys_states + (1 if n_extra > 0 else 0)
+    keys = jax.random.split(key, S + 2)
+    amp = base_params["innovation_amp"].reshape(D)
+    avg_amp = jnp.mean(amp)
+
+    def one_state(i, carry):
+        x_acc, y_acc = carry
+        sig = rollout_scan(
+            keys[i], M1_stack[i], M2_stack[i], act_codes_stack[i],
+            base_params["noise_mu"], base_params["noise_var"], amp,
+            recording_length, burnin_period,
+        )  # (T, D)
+        kw = jax.random.fold_in(keys[i], 1)
+        w0, w1 = jax.random.uniform(kw, (2,))
+        ramp = jnp.linspace(w0, w1, recording_length)
+        x_acc = x_acc + sig * ramp[:, None]
+        sup = i < num_labeled_sys_states - (0 if n_extra == 0 else 0)
+        # supervised states write their own label row; the rest pool into the last row
+        row = jnp.where(i < num_labels - 1, i, num_labels - 1)
+        y_acc = y_acc.at[row].add(ramp)
+        return x_acc, y_acc
+
+    x = jnp.zeros((recording_length, D))
+    y = jnp.zeros((num_labels, recording_length))
+    x, y = jax.lax.fori_loop(0, S, one_state, (x, y))
+    if n_extra > 0:
+        y = y.at[num_labels - 1].multiply(1.0 / (S - (num_labels - 1)))
+
+    if label_type == "OneHot":
+        hot = jnp.argmax(y, axis=0)
+        y = jax.nn.one_hot(hot, num_labels, axis=0)
+    elif label_type != "Oracle":
+        raise ValueError(f"Unrecognized label_type={label_type}")
+
+    if noise_type == "white":
+        eps = jax.random.uniform(keys[-1], (recording_length, D),
+                                 minval=-avg_amp, maxval=avg_amp)
+    elif noise_type == "gaussian":
+        mu_c = jnp.mean(base_params["noise_mu"])
+        var_c = jnp.mean(base_params["noise_var"])
+        eps = mu_c + var_c * avg_amp * jax.random.normal(keys[-1], (recording_length, D))
+    else:
+        raise ValueError(f"Unrecognized noise_type={noise_type}")
+    x = x + noise_amp * eps
+    return x.astype(jnp.float32), y.astype(jnp.float32)
+
+
+def generate_synthetic_dataset(key, graphs, act_code_tensors, base_freqs, noise_mu,
+                               noise_var, innovation_amp, num_samples,
+                               recording_length, burnin_period,
+                               num_labeled_sys_states, label_type="Oracle",
+                               noise_type="white", noise_amp=0.1):
+    """Batched dataset curation on device: vmap of generate_synthetic_batch.
+
+    Returns (X, Y) numpy arrays with X: (N, T, D), Y: (N, num_labels, T) — the
+    (batch, time, channel) / label contract every model consumes (SURVEY.md §2.4).
+    """
+    S = len(graphs)
+    M1s, M2s = zip(*[_step_matrices(g, base_freqs) for g in graphs])
+    M1_stack = jnp.asarray(np.stack(M1s))
+    M2_stack = jnp.asarray(np.stack(M2s))
+    acts = jnp.asarray(np.stack(act_code_tensors).astype(np.int32))
+    base_params = {
+        "noise_mu": jnp.asarray(np.asarray(noise_mu, dtype=np.float32).reshape(-1)),
+        "noise_var": jnp.asarray(np.asarray(noise_var, dtype=np.float32).reshape(-1)),
+        "innovation_amp": jnp.asarray(np.asarray(innovation_amp, dtype=np.float32).reshape(-1)),
+    }
+    keys = jax.random.split(key, num_samples)
+    gen = jax.vmap(
+        lambda k: generate_synthetic_batch(
+            k, M1_stack, M2_stack, acts, base_params, recording_length,
+            burnin_period, num_labeled_sys_states, label_type, noise_type, noise_amp,
+        )
+    )
+    X, Y = gen(keys)
+    return np.asarray(X), np.asarray(Y)
+
+
+def generate_synthetic_data_np(rng, graphs, act_code_tensors, base_freqs, noise_mu,
+                               noise_var, innovation_amp, num_samples,
+                               recording_length, burnin_period,
+                               num_labeled_sys_states, label_type="Oracle",
+                               noise_type="white", noise_amp=0.1):
+    """Host/numpy twin of generate_synthetic_dataset (golden-test oracle)."""
+    S = len(graphs)
+    D = graphs[0].shape[0]
+    n_extra = S - num_labeled_sys_states
+    num_labels = num_labeled_sys_states + (1 if n_extra > 0 else 0)
+    amp = np.asarray(innovation_amp, dtype=np.float64).reshape(D)
+    avg_amp = float(np.mean(amp))
+    X = np.zeros((num_samples, recording_length, D), dtype=np.float32)
+    Y = np.zeros((num_samples, num_labels, recording_length), dtype=np.float32)
+    for s in range(num_samples):
+        x = np.zeros((D, recording_length))
+        y_true = np.zeros((num_labels, recording_length))
+        for state in range(S):
+            sig = rollout_np(graphs[state], act_code_tensors[state], base_freqs,
+                             noise_mu, noise_var, innovation_amp, recording_length,
+                             burnin_period, rng)
+            w0, w1 = rng.uniform(), rng.uniform()
+            ramp = np.linspace(w0, w1, recording_length)
+            x += sig * ramp[None, :]
+            row = state if state < num_labels - 1 else num_labels - 1
+            y_true[row] += ramp
+        if n_extra > 0:
+            y_true[-1] /= S - (num_labels - 1)
+        if label_type == "Oracle":
+            y = y_true
+        elif label_type == "OneHot":
+            y = np.zeros_like(y_true)
+            y[np.argmax(y_true, axis=0), np.arange(recording_length)] = 1.0
+        else:
+            raise ValueError(label_type)
+        if noise_type == "white":
+            eps = rng.uniform(-avg_amp, avg_amp, (D, recording_length))
+        elif noise_type == "gaussian":
+            eps = rng.normal(np.mean(noise_mu), np.mean(noise_var) * avg_amp,
+                             (D, recording_length))
+        else:
+            raise ValueError(noise_type)
+        X[s] = (x + noise_amp * eps).T
+        Y[s] = y
+    return X, Y
+
+
+def reference_curation_params(num_nodes):
+    """The sVAR coefficient recipe used by the reference curation driver
+    (ref currate_...etNL.py:72-75,277-281): per-node base frequencies
+    pi*(707*i + i%2)/120000, standard-normal innovations with unit amplitude,
+    off-diagonal edge strengths 0.3 at both lags, receiving-node damping 0.6,
+    sending-node damping 1.0."""
+    return {
+        "base_freqs": np.pi * np.array([i * 707 + i % 2 for i in range(num_nodes)]) / 120000.0,
+        "noise_mu": np.zeros(num_nodes),
+        "noise_var": np.ones(num_nodes),
+        "innovation_amp": np.ones(num_nodes),
+        "off_diag_edge_strengths": (0.3, 0.3),
+        "diag_receiving_node_forgetting_coeffs": (0.6, 0.6),
+        "diag_sending_node_forgetting_coeffs": (1.0, 1.0),
+        "recording_length": 100,
+        "burnin_period": 10,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Random lagged-DAG factory (host; curation-time only)
+# ---------------------------------------------------------------------------
+
+def generate_lagged_adjacency_graphs_for_factor_model(
+    num_nodes,
+    num_lags,
+    num_factors,
+    make_factors_orthogonal,
+    make_factors_singular_components,
+    rand_seed=0,
+    off_diag_edge_strengths=(0.1, 1.0),
+    diag_receiving_node_forgetting_coeffs=(0.1, 1.0),
+    diag_sending_node_forgetting_coeffs=(0.9, 1.0),
+    num_edges_per_graph=None,
+    max_formulation_attempts=100,
+    nonlinear_act_codes_per_factor=None,
+):
+    """Random per-factor lagged adjacency tensors (ref data_utils.py:243-353).
+
+    Each graph starts as lag-wise identity; off-diagonal edges (i, j, l) are drawn
+    without replacement, with the involved nodes' self-connections damped by the
+    forgetting coefficients. Graphs are re-drawn until the lag-summed graph has at
+    most the allowed number of connected components; orthogonal factors remove the
+    chosen (i, j) pairs (all lags) from the shared edge pool. Factor order is
+    shuffled before returning.
+
+    Returns (graphs, act_code_tensors, shuffled_factor_inds) where each graph is
+    (num_nodes, num_nodes, num_lags) and each act-code tensor is an int array of the
+    same shape (0 identity / 1 min0 / 2 max0).
+    """
+    _pyrandom.seed(rand_seed)
+    np.random.seed(rand_seed)
+
+    while True:  # restart_curration loop
+        graphs = [None] * num_factors
+        acts = [None] * num_factors
+        max_comps = 1 if make_factors_singular_components else num_nodes
+        n_edges = num_edges_per_graph or (num_nodes**2) // num_factors
+        if make_factors_singular_components:
+            assert n_edges >= num_nodes - 1
+
+        available = [
+            (i, j, k)
+            for i in range(num_nodes)
+            for j in range(num_nodes)
+            for k in range(num_lags)
+            if i != j
+        ]
+        available_ids = list(range(len(available)))
+        restart = False
+
+        for f_ind in range(num_factors):
+            attempts = 0
+            while True:
+                A = np.zeros((num_nodes, num_nodes, num_lags))
+                for l in range(num_lags):
+                    A[:, :, l] += np.eye(num_nodes)
+                A_codes = np.zeros((num_nodes, num_nodes, num_lags), dtype=np.int32)
+
+                _pyrandom.shuffle(available_ids)
+                chosen_ids = available_ids[:n_edges]
+                chosen = [available[i] for i in chosen_ids]
+                for x, y, z in chosen:
+                    A[x, y, z] = off_diag_edge_strengths[z]
+                    A[x, x, 0] *= diag_receiving_node_forgetting_coeffs[0]
+                    A[x, x, 1] *= diag_receiving_node_forgetting_coeffs[1]
+                    A[y, y, 0] *= diag_sending_node_forgetting_coeffs[0]
+                    A[y, y, 1] *= diag_sending_node_forgetting_coeffs[1]
+                    if (
+                        nonlinear_act_codes_per_factor is not None
+                        and nonlinear_act_codes_per_factor[f_ind] is not None
+                    ):
+                        A_codes[x, y, z] = nonlinear_act_codes_per_factor[f_ind][z]
+
+                n_comps = get_number_of_connected_components(
+                    A.sum(axis=2), add_self_connections=False
+                )
+                attempts += 1
+                if n_comps <= max_comps:
+                    break
+                if attempts == max_formulation_attempts:
+                    restart = True
+                    break
+            if restart:
+                break
+
+            graphs[f_ind] = A
+            acts[f_ind] = A_codes
+            if make_factors_orthogonal:
+                exclude = set(chosen_ids)
+                chosen_pairs = {(x, y) for (x, y, _) in chosen}
+                for eid in available_ids[n_edges:]:
+                    if (available[eid][0], available[eid][1]) in chosen_pairs:
+                        exclude.add(eid)
+                available_ids = [i for i in available_ids if i not in exclude]
+
+        if not restart:
+            break
+
+    inds = list(range(num_factors))
+    order = list(zip(graphs, acts, inds))
+    _pyrandom.shuffle(order)
+    graphs, acts, inds = map(list, zip(*order))
+    return graphs, acts, inds

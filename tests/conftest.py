@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax initializes.
+
+The reference has no test suite (SURVEY.md §4); this build creates one. Multi-device
+sharding paths are exercised on a virtual CPU mesh per jax's
+xla_force_host_platform_device_count escape hatch, so no TPU is needed to run tests.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

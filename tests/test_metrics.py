@@ -1,0 +1,179 @@
+"""Golden tests for the metric library against sklearn and hand-computed graphs.
+
+The reference scores everything with sklearn (precision_recall_curve, roc_auc_score,
+f1_score) — these tests pin our numpy implementations to sklearn outputs on random
+data, and pin the DeltaCon0 family to hand-checkable small graphs.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import f1_score as sk_f1
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_auc_score as sk_auc
+
+from redcliff_tpu.utils import metrics as M
+from redcliff_tpu.utils import misc as misc
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_precision_recall_curve_matches_sklearn(rng):
+    for _ in range(20):
+        n = int(rng.integers(5, 200))
+        labels = rng.integers(0, 2, n)
+        if labels.sum() == 0:
+            labels[0] = 1
+        scores = np.round(rng.normal(size=n), 2)  # rounding forces ties
+        p, r, t = M.precision_recall_curve(labels, scores)
+        sp, sr, st = sk_prc(labels, scores)
+        np.testing.assert_allclose(p, sp, atol=1e-12)
+        np.testing.assert_allclose(r, sr, atol=1e-12)
+        np.testing.assert_allclose(t, st, atol=1e-12)
+
+
+def test_compute_optimal_f1_matches_reference_formula(rng):
+    for _ in range(10):
+        n = int(rng.integers(10, 100))
+        labels = rng.integers(0, 2, n)
+        if labels.sum() == 0:
+            labels[0] = 1
+        scores = rng.normal(size=n)
+        thr, f1 = M.compute_optimal_f1(labels, scores)
+        # reference semantics: positive iff score >= threshold on the PR curve
+        preds = (scores >= thr).astype(int)
+        assert f1 == pytest.approx(sk_f1(labels, preds), abs=1e-9)
+
+
+def test_roc_auc_matches_sklearn(rng):
+    for _ in range(20):
+        n = int(rng.integers(5, 300))
+        labels = rng.integers(0, 2, n)
+        if labels.sum() == 0:
+            labels[0] = 1
+        if labels.sum() == n:
+            labels[0] = 0
+        scores = np.round(rng.normal(size=n), 1)
+        assert M.roc_auc(labels, scores) == pytest.approx(sk_auc(labels, scores), abs=1e-12)
+
+
+def test_compute_f1_fixed_cutoff(rng):
+    labels = rng.integers(0, 2, 50)
+    labels[0] = 1
+    scores = rng.normal(size=50)
+    f1 = M.compute_f1(labels, scores, 0.0)
+    assert f1 == pytest.approx(sk_f1(labels, (scores > 0.0).astype(int)), abs=1e-12)
+
+
+def test_deltacon0_identical_graphs_is_one():
+    A = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0], [1.0, 0.0, 0.0]])
+    assert M.deltacon0(A, A, eps=0.1) == pytest.approx(1.0)
+    assert M.deltacon0_with_directed_degrees(A, A, eps=0.1) == pytest.approx(1.0)
+    assert M.deltaffinity(A, A, eps=0.1) == pytest.approx(1.0)
+
+
+def test_deltacon0_decreases_with_perturbation():
+    A = np.array([[0.0, 1.0, 0.0], [0.0, 0.0, 1.0], [1.0, 0.0, 0.0]])
+    B = A.copy()
+    B[0, 1] = 0.0
+    C = np.zeros_like(A)
+    s_small = M.deltacon0(A, B, eps=0.1)
+    s_large = M.deltacon0(A, C, eps=0.1)
+    assert 0 < s_large < s_small < 1
+
+
+def test_deltacon0_hand_computed_two_node():
+    # two nodes, single directed edge vs empty graph, eps=0.5
+    A = np.array([[0.0, 1.0], [0.0, 0.0]])
+    B = np.zeros((2, 2))
+    eps = 0.5
+    S_A = np.linalg.inv(np.eye(2) + eps**2 * np.diag(A.sum(0)) - eps * A)
+    S_B = np.eye(2)
+    d = np.sqrt(np.sum((np.sqrt(S_A) - np.sqrt(S_B)) ** 2))
+    assert M.deltacon0(A, B, eps) == pytest.approx(1.0 / (1.0 + d))
+
+
+def test_path_length_mse():
+    A = np.array([[0.0, 1.0], [0.0, 0.0]])
+    B = np.zeros((2, 2))
+    total, per_k = M.path_length_mse(A, B)
+    # default max_path_length = n-1 = 1: A^1 differs by one entry (mse 1/4)
+    assert per_k == pytest.approx([0.25])
+    assert total == pytest.approx(0.25)
+    total2, per_k2 = M.path_length_mse(A, B, max_path_length=2)
+    # A^2 == 0 == B^2
+    assert per_k2 == pytest.approx([0.25, 0.0])
+    assert total2 == pytest.approx(0.25)
+
+
+def test_get_f1_score_positive_entries():
+    A_true = np.array([[0.0, 1.0], [0.0, 0.0]])
+    assert M.get_f1_score(A_true, A_true) == pytest.approx(1.0)
+    assert M.get_f1_score(np.zeros((2, 2)), A_true) == 0.0
+
+
+def test_hungarian_matching_recovers_permutation(rng):
+    truths = [rng.normal(size=(4, 4)) for _ in range(3)]
+    perm = [2, 0, 1]
+    ests = [truths[p] + 0.01 * rng.normal(size=(4, 4)) for p in perm]
+    # cost is cosine similarity and scipy minimizes => matched pairs are the
+    # MOST DISSIMILAR assignment (reference behavior, metrics.py:274-301)
+    rows, cols = M.solve_linear_sum_assignment_between_graph_options(ests, truths)
+    assert sorted(rows.tolist()) == [0, 1, 2]
+    assert sorted(cols.tolist()) == [0, 1, 2]
+
+
+def test_sort_unsupervised_estimates_roundtrip(rng):
+    truths = [rng.normal(size=(3, 3)) for _ in range(3)]
+    sorted_ests = misc.sort_unsupervised_estimates(list(truths), truths)
+    assert len(sorted_ests) == 3
+
+
+def test_dagness_penalty_zero_diag():
+    W = np.array([[0.0, 2.0], [3.0, 0.0]])
+    # elementwise exp: trace(exp(W*W)) = exp(0)+exp(0) = 2 = N
+    assert M.dagness_penalty(W) == pytest.approx(0.0)
+    W2 = np.array([[1.0, 0.0], [0.0, 0.0]])
+    assert M.dagness_penalty(W2) == pytest.approx((np.exp(1.0) - 1.0) ** 2)
+
+
+def test_flatten_unflatten_gc_roundtrip(rng):
+    GC = rng.normal(size=(5, 5, 3))
+    flat = misc.flatten_gc_with_lags(GC)
+    assert flat.shape == (5, 15)
+    np.testing.assert_allclose(misc.unflatten_gc_with_lags(flat), GC)
+    # lag-major block layout: block l holds GC[:, :, l]
+    np.testing.assert_allclose(flat[:, 5:10], GC[:, :, 1])
+
+
+def test_flatten_unflatten_dirspec_roundtrip(rng):
+    x = rng.normal(size=(4, 4, 3))
+    flat = misc.flatten_directed_spectrum_features(x)
+    assert flat.shape == (4, 3 * 7)
+    back = misc.unflatten_directed_spectrum_features(flat)
+    np.testing.assert_allclose(back, x)
+
+
+def test_top_k_filter():
+    A = np.array([[5.0, 1.0], [3.0, 2.0]])
+    out = misc.apply_top_k_filter_to_edges(A, k=2)
+    np.testing.assert_allclose(out, [[5.0, 0.0], [3.0, 0.0]])
+
+
+def test_connected_components():
+    A = np.zeros((4, 4))
+    A[0, 1] = 1.0
+    A[2, 3] = 1.0
+    assert M.get_number_of_connected_components(A) == 2
+
+
+def test_kfolds_cv_splits():
+    data = list(range(10))
+    labels = [i * 10 for i in range(10)]
+    folds = misc.make_kfolds_cv_splits(data, labels, num_folds=3)
+    assert set(folds) == {0, 1, 2}
+    sizes = [len(folds[i]["validation"]) for i in range(3)]
+    assert sum(sizes) >= 10 // 3 * 3
+    for i in range(3):
+        assert len(folds[i]["train"]) + len(folds[i]["validation"]) == 10

@@ -1,0 +1,145 @@
+"""Tests for the synthetic sVAR generator: graph factory invariants, host-vs-device
+rollout agreement, and basic statistical sanity of generated datasets."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from redcliff_tpu.data import synthetic as S
+
+
+def _simple_system(D=4, L=2, seed=0):
+    p = S.reference_curation_params(D)
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=D, num_lags=L, num_factors=2, make_factors_orthogonal=True,
+        make_factors_singular_components=False, rand_seed=seed,
+        off_diag_edge_strengths=p["off_diag_edge_strengths"],
+        diag_receiving_node_forgetting_coeffs=p["diag_receiving_node_forgetting_coeffs"],
+        diag_sending_node_forgetting_coeffs=p["diag_sending_node_forgetting_coeffs"],
+    )
+    return graphs, acts
+
+
+def test_graph_factory_shapes_and_diagonal():
+    graphs, acts, inds = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=5, num_lags=2, num_factors=3, make_factors_orthogonal=True,
+        make_factors_singular_components=False, rand_seed=1,
+    )
+    assert len(graphs) == 3 and sorted(inds) == [0, 1, 2]
+    for A in graphs:
+        assert A.shape == (5, 5, 2)
+        # self-connections exist at every lag (identity base, possibly damped)
+        for l in range(2):
+            assert np.all(np.diag(A[:, :, l]) > 0)
+
+
+def test_graph_factory_orthogonal_edges_disjoint():
+    graphs, _, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=6, num_lags=2, num_factors=2, make_factors_orthogonal=True,
+        make_factors_singular_components=False, rand_seed=2,
+    )
+    offdiag = []
+    for A in graphs:
+        mask = A.sum(axis=2) * (1 - np.eye(6)) > 0
+        offdiag.append({(i, j) for i, j in zip(*np.where(mask))})
+    assert offdiag[0].isdisjoint(offdiag[1])
+
+
+def test_rollout_np_shape_and_burnin():
+    graphs, acts = _simple_system()
+    rng = np.random.default_rng(0)
+    D = 4
+    sig = S.rollout_np(graphs[0], acts[0], base_freqs=S.reference_curation_params(D)["base_freqs"],
+                       noise_mu=np.zeros(D), noise_var=np.ones(D),
+                       innovation_amp=0.5 * np.ones(D), recording_length=50,
+                       burnin_period=10, rng=rng)
+    assert sig.shape == (4, 50)
+    assert np.all(np.isfinite(sig))
+
+
+def test_rollout_scan_matches_np_dynamics_zero_noise():
+    """With zero innovations the scan and numpy rollouts implement identical
+    deterministic dynamics from the same initial state."""
+    graphs, acts = _simple_system()
+    D = 4
+    A = graphs[0]
+    M1, M2 = S._step_matrices(A, np.full(D, np.pi))
+    codes = acts[0]
+    x0 = np.linspace(-0.3, 0.4, D)
+    # numpy trajectory
+    innov = np.zeros(D)
+    x1 = S.nvar_step_np(x0, x0, M1, M2, codes, innov, num_lags=1)
+    traj = [x0, x1]
+    for _ in range(20):
+        traj.append(S.nvar_step_np(traj[-1], traj[-2], M1, M2, codes, innov))
+    traj = np.stack(traj[2:], axis=0)  # (20, D)
+
+    # scan trajectory with identical carry and zero noise
+    def step(carry, _):
+        x_tm1, x_tm2 = carry
+        c1 = S._apply_act(jnp.asarray(M1) * x_tm1[None, :], jnp.asarray(codes)[:, :, 0]).sum(axis=1)
+        c2 = S._apply_act(jnp.asarray(M2) * x_tm2[None, :], jnp.asarray(codes)[:, :, 1]).sum(axis=1)
+        x_t = c1 + c2
+        return (x_t, x_tm1), x_t
+
+    _, xs = jax.lax.scan(step, (jnp.asarray(x1), jnp.asarray(x0)), None, length=20)
+    np.testing.assert_allclose(np.asarray(xs), traj, rtol=1e-5, atol=1e-6)
+
+
+def test_generate_synthetic_dataset_shapes_and_labels():
+    graphs, acts = _simple_system()
+    D = 4
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(0), graphs, acts, base_freqs=S.reference_curation_params(D)["base_freqs"],
+        noise_mu=np.zeros(D), noise_var=np.ones(D), innovation_amp=0.5 * np.ones(D),
+        num_samples=8, recording_length=30, burnin_period=5,
+        num_labeled_sys_states=2, label_type="Oracle",
+    )
+    assert X.shape == (8, 30, 4)
+    assert Y.shape == (8, 2, 30)
+    assert np.all(np.isfinite(X))
+    # oracle labels are activation ramps in [0, 1]
+    assert Y.min() >= 0.0 and Y.max() <= 1.0 + 1e-6
+
+
+def test_generate_synthetic_dataset_onehot():
+    graphs, acts = _simple_system()
+    D = 4
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(1), graphs, acts, base_freqs=S.reference_curation_params(D)["base_freqs"],
+        noise_mu=np.zeros(D), noise_var=np.ones(D), innovation_amp=0.5 * np.ones(D),
+        num_samples=4, recording_length=20, burnin_period=5,
+        num_labeled_sys_states=2, label_type="OneHot",
+    )
+    np.testing.assert_allclose(Y.sum(axis=1), 1.0)
+    assert set(np.unique(Y)) <= {0.0, 1.0}
+
+
+def test_unsupervised_state_pooled_into_extra_label():
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=4, num_lags=2, num_factors=3, make_factors_orthogonal=False,
+        make_factors_singular_components=False, rand_seed=3,
+    )
+    D = 4
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(2), graphs, acts, base_freqs=S.reference_curation_params(D)["base_freqs"],
+        noise_mu=np.zeros(D), noise_var=np.ones(D), innovation_amp=0.5 * np.ones(D),
+        num_samples=2, recording_length=10, burnin_period=2,
+        num_labeled_sys_states=2, label_type="Oracle",
+    )
+    # 2 supervised + 1 pooled 'UNKNOWN' row (ref data_utils.py:141-175)
+    assert Y.shape == (2, 3, 10)
+
+
+def test_np_and_device_datasets_statistically_close():
+    graphs, acts = _simple_system()
+    D = 4
+    common = dict(base_freqs=S.reference_curation_params(D)["base_freqs"], noise_mu=np.zeros(D),
+                  noise_var=np.ones(D), innovation_amp=0.5 * np.ones(D),
+                  num_samples=64, recording_length=40, burnin_period=5,
+                  num_labeled_sys_states=2, label_type="Oracle")
+    Xd, _ = S.generate_synthetic_dataset(jax.random.PRNGKey(3), graphs, acts, **common)
+    Xn, _ = S.generate_synthetic_data_np(np.random.default_rng(3), graphs, acts, **common)
+    # distributional agreement (same dynamics, different RNG streams)
+    assert abs(Xd.mean() - Xn.mean()) < 0.15
+    assert abs(Xd.std() - Xn.std()) / max(Xn.std(), 1e-6) < 0.5
