@@ -1,0 +1,92 @@
+"""Device-resident dataset containers.
+
+Replaces the reference's pickle-per-__getitem__ Dataset/DataLoader stack
+(ref data/synthetic_datasets.py:18-277, dream4_datasets.py:18-350,
+local_field_potential_datasets.py:18-301) with one-shot loads into (N, T, C)
+arrays. Per-channel z-score statistics are computed dataset-wide at construction
+exactly like NormalizedSyntheticWVARDataset (ref synthetic_datasets.py:89-118);
+the grid_search flag keeps only the first quarter of samples
+(ref synthetic_datasets.py:126-129).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ArrayDataset", "train_val_split"]
+
+
+class ArrayDataset:
+    """In-memory (N, T, C) signals + (N, ...) labels with channel normalization.
+
+    Batches are yielded as plain numpy slices; callers hand them to jit'd steps
+    (jax transfers once per batch — or pre-shard via parallel.grid for multi-chip).
+    """
+
+    def __init__(self, X, Y=None, normalize=True, stats=None, grid_search=False):
+        X = np.asarray(X, dtype=np.float32)
+        Y = None if Y is None else np.asarray(Y, dtype=np.float32)
+        if normalize:
+            # stats come from the FULL dataset even under grid_search subsetting,
+            # matching the reference's order of operations
+            # (ref synthetic_datasets.py:89-129: stats at init, slice after)
+            if stats is None:
+                mean = X.mean(axis=(0, 1))
+                std = X.std(axis=(0, 1))
+                std = np.where(std == 0.0, 1.0, std)
+                stats = (mean, std)
+            self.stats = stats
+        else:
+            self.stats = None
+        if grid_search:
+            keep = max(1, len(X) // 4)
+            X = X[:keep]
+            Y = None if Y is None else Y[:keep]
+        if normalize:
+            X = (X - self.stats[0]) / self.stats[1]
+        self.X = X
+        self.Y = Y
+
+    def __len__(self):
+        return len(self.X)
+
+    @property
+    def num_channels(self):
+        return self.X.shape[2]
+
+    @property
+    def num_timesteps(self):
+        return self.X.shape[1]
+
+    def batches(self, batch_size, rng=None, drop_remainder=False):
+        """Yield (X, Y) minibatches; shuffled when an np.random.Generator is given."""
+        n = len(self.X)
+        idx = np.arange(n)
+        if rng is not None:
+            rng.shuffle(idx)
+        stop = (n // batch_size) * batch_size if drop_remainder else n
+        for start in range(0, stop, batch_size):
+            sel = idx[start : start + batch_size]
+            if len(sel) == 0:
+                break
+            yield self.X[sel], (None if self.Y is None else self.Y[sel])
+
+    def num_batches(self, batch_size, drop_remainder=False):
+        n = len(self.X)
+        return n // batch_size if drop_remainder else int(np.ceil(n / batch_size))
+
+
+def train_val_split(X, Y, val_fraction=0.2, rng=None, normalize=True, grid_search=False):
+    """Split into normalized train/val ArrayDatasets; validation reuses the
+    training normalization statistics (train is the only stats source, matching
+    the reference's per-split dataset-wide stats usage)."""
+    n = len(X)
+    idx = np.arange(n)
+    if rng is not None:
+        rng.shuffle(idx)
+    n_val = int(round(n * val_fraction))
+    val_idx, train_idx = idx[:n_val], idx[n_val:]
+    train = ArrayDataset(X[train_idx], None if Y is None else Y[train_idx],
+                         normalize=normalize, grid_search=grid_search)
+    val = ArrayDataset(X[val_idx], None if Y is None else Y[val_idx],
+                       normalize=normalize, stats=train.stats)
+    return train, val

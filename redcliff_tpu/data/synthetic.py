@@ -171,7 +171,6 @@ def generate_synthetic_batch(key, M1_stack, M2_stack, act_codes_stack, base_para
         w0, w1 = jax.random.uniform(kw, (2,))
         ramp = jnp.linspace(w0, w1, recording_length)
         x_acc = x_acc + sig * ramp[:, None]
-        sup = i < num_labeled_sys_states - (0 if n_extra == 0 else 0)
         # supervised states write their own label row; the rest pool into the last row
         row = jnp.where(i < num_labels - 1, i, num_labels - 1)
         y_acc = y_acc.at[row].add(ramp)

@@ -1,0 +1,78 @@
+"""Differentiable loss terms shared by the model zoo.
+
+jit-side counterparts of the reference's in-loss computations
+(ref models/redcliff_s_cmlp.py:620-686, models/cmlp_fm.py:156-180,
+general_utils/metrics.py:342-381,433-443). All are pure jnp functions over
+batched tensors — no Python loops over factors or samples.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "channelwise_forecast_mse",
+    "lag_weighted_adjacency_l1",
+    "pairwise_cosine_penalty",
+    "factor_weight_l1",
+    "dagness_penalty",
+]
+
+
+def channelwise_forecast_mse(preds, targets):
+    """sum_c MSE(preds[:, :, c], targets[:, :, c]) — the reference's forecasting
+    loss sums per-channel means (ref redcliff_s_cmlp.py:625), equal to
+    C * mean over all entries."""
+    return preds.shape[-1] * jnp.mean((preds - targets) ** 2)
+
+
+def lag_weighted_adjacency_l1(gc_lagged):
+    """sum over leading axes and lags of log(l+2) * ||A[..., l]||_1
+    (ref redcliff_s_cmlp.py:663). gc_lagged: (..., C, C, L)."""
+    L = gc_lagged.shape[-1]
+    lag_w = jnp.log(jnp.arange(L, dtype=gc_lagged.dtype) + 2.0)
+    return jnp.sum(jnp.sum(jnp.abs(gc_lagged), axis=(-3, -2)) * lag_w)
+
+
+def _flatten_minus_eye(G):
+    """Subtract identity from each (C, C) slice then flatten trailing dims.
+
+    G: (..., K, C, C). Mirrors include_diag=False in the reference's cosine
+    penalty (ref metrics.py:342-369)."""
+    C = G.shape[-1]
+    return (G - jnp.eye(C, dtype=G.dtype)).reshape(G.shape[:-2] + (C * C,))
+
+
+def pairwise_cosine_penalty(G, include_diag=False, epsilon=1e-8):
+    """Sum of upper-triangle pairwise cosine similarities between factor graphs.
+
+    G: (..., K, C, C) — leading axes are batched (e.g. per-sample conditional
+    graphs). Matches compute_cosine_similarities_within_set_of_pytorch_tensors
+    summed over pairs i<j (ref redcliff_s_cmlp.py:660, metrics.py:372-381).
+    """
+    K = G.shape[-3]
+    if K <= 1:
+        return jnp.zeros(G.shape[:-3], dtype=G.dtype) if G.ndim > 3 else jnp.array(0.0, G.dtype)
+    flat = _flatten_minus_eye(G) if not include_diag else G.reshape(G.shape[:-2] + (-1,))
+    norms = jnp.linalg.norm(flat, axis=-1)  # (..., K)
+    gram = jnp.einsum("...kd,...jd->...kj", flat, flat)
+    denom = jnp.maximum(norms[..., :, None], epsilon) * jnp.maximum(norms[..., None, :], epsilon)
+    cos = gram / denom
+    iu = jnp.triu_indices(K, k=1)
+    return cos[..., iu[0], iu[1]].sum(axis=-1)
+
+
+def factor_weight_l1(scores):
+    """FACTOR_WEIGHT penalty ||s||_1 - 1 on the first-step factor scores
+    (ref redcliff_s_cmlp.py:653)."""
+    return jnp.sum(jnp.abs(scores)) - 1.0
+
+
+def dagness_penalty(W0):
+    """(tr(exp(W∘W)) - N)^2 with ELEMENTWISE exp, matching the reference's literal
+    computation (ref metrics.py:433-443). Defined for parity; the reference keeps
+    the corresponding loss terms disabled for numerical stability
+    (ref redcliff_s_cmlp.py:678,682) and so does the default config here."""
+    if W0.ndim == 3 and W0.shape[2] == 1:
+        W0 = W0[:, :, 0]
+    n = W0.shape[0]
+    return (jnp.trace(jnp.exp(W0 * W0)) - n) ** 2.0

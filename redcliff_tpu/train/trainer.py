@@ -1,0 +1,257 @@
+"""Generic trainer — the single fit loop shared by the model zoo.
+
+The reference embeds a bespoke fit()/batch_update()/validate_training() loop in
+every model class (SURVEY.md §1: redcliff_s_cmlp.py:1159-1628, cmlp_fm.py:264-416,
+dgcnn.py:122-199, ...). This build factors that into one functional trainer:
+
+* a model exposes ``init``, ``loss(params, X[, Y]) -> (combo, parts)``, ``gc``,
+  and optionally ``apply_prox`` and ``validation_criteria``;
+* the trainer owns the jit'd Adam step, epoch loop, early stopping with
+  lookback*check_every patience, per-epoch GC tracking vs oracle graphs, and
+  checkpointing in the reference's on-disk layout (final_best_model.bin +
+  training_meta_data_and_hyper_parameters.pkl).
+
+Checkpoints fix the reference's no-optimizer-resume gap
+(ref redcliff_s_cmlp.py:245): optimizer state is saved and restored exactly.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from redcliff_tpu.train.tracking import GCProgressTracker
+
+__all__ = ["TrainConfig", "Trainer", "FitResult", "save_model", "load_model"]
+
+
+@dataclass
+class TrainConfig:
+    learning_rate: float = 1e-3
+    max_iter: int = 100
+    lookback: int = 5
+    check_every: int = 50
+    batch_size: int = 32
+    seed: int = 0
+    prox_penalty: str | None = None  # "GL" | "GSGL" | "H"
+    prox_lam: float = 0.0
+    verbose: int = 0
+
+
+@dataclass
+class FitResult:
+    params: Any
+    best_it: int
+    best_loss: float
+    histories: dict
+    tracker: GCProgressTracker | None
+    final_val_loss: float
+
+
+def save_model(save_dir, model, params, extra=None):
+    """Persist {config, params} under the reference's artifact name."""
+    os.makedirs(save_dir, exist_ok=True)
+    payload = {
+        "model_class": type(model).__name__,
+        "config": model.config,
+        "params": jax.tree.map(np.asarray, params),
+    }
+    if extra:
+        payload.update(extra)
+    with open(os.path.join(save_dir, "final_best_model.bin"), "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_model(save_dir_or_file):
+    path = save_dir_or_file
+    if os.path.isdir(path):
+        path = os.path.join(path, "final_best_model.bin")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class Trainer:
+    def __init__(self, model, config: TrainConfig, has_labels=False):
+        self.model = model
+        self.config = config
+        self.has_labels = has_labels
+        self.optimizer = optax.adam(config.learning_rate)
+        self._build_steps()
+
+    def _build_steps(self):
+        model, cfg = self.model, self.config
+        use_labels = self.has_labels
+
+        def loss_fn(params, X, Y):
+            if use_labels:
+                return model.loss(params, X, Y)
+            return model.loss(params, X)
+
+        def train_step(params, opt_state, X, Y):
+            (combo, parts), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, X, Y)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            if cfg.prox_penalty is not None:
+                params = model.apply_prox(params, cfg.prox_lam, cfg.learning_rate,
+                                          cfg.prox_penalty)
+            return params, opt_state, combo, parts
+
+        def eval_step(params, X, Y):
+            return loss_fn(params, X, Y)
+
+        self._train_step = jax.jit(train_step)
+        self._eval_step = jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    def validate(self, params, val_ds):
+        """Average per-batch loss parts over the validation set, with loss-term
+        coefficients divided out for grid-search comparability
+        (ref redcliff_s_cmlp.py:1683-1703, cmlp_fm.py validate_training)."""
+        sums: dict[str, float] = {}
+        combo_sum = 0.0
+        n = 0
+        coeffs = getattr(self.model, "normalization_coeffs", lambda: {})()
+        for X, Y in val_ds.batches(self.config.batch_size):
+            combo, parts = self._eval_step(params, X, Y)
+            combo_sum += float(combo)
+            for k, v in parts.items():
+                c = coeffs.get(k, 1.0)
+                sums[k] = sums.get(k, 0.0) + float(v) / (c if c > 0 else 1.0)
+            n += 1
+        if n == 0:
+            raise ValueError(
+                "validation dataset yielded no batches — increase val_fraction or "
+                "dataset size (empty validation would make early stopping undefined)"
+            )
+        out = {k: v / n for k, v in sums.items()}
+        out["combo_loss"] = combo_sum / n
+        return out
+
+    def _epoch_gc_tracking(self, params, tracker, true_GC):
+        ests = [np.asarray(g) for g in self.model.gc(params, ignore_lag=False)]
+        ests_nolag = [np.asarray(g) for g in self.model.gc(params, ignore_lag=True)]
+        tracker.update(true_GC, [ests], est_by_sample_lagsummed=[ests_nolag])
+
+    def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
+            resume=True) -> FitResult:
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed)
+        opt_state = self.optimizer.init(params)
+        tracker = None
+        if true_GC is not None:
+            tracker = GCProgressTracker(
+                num_supervised_factors=len(true_GC),
+                num_chans=true_GC[0].shape[0],
+                num_factors=getattr(self.model.config, "num_factors", len(true_GC)),
+            )
+
+        histories = {
+            "avg_forecasting_loss": [], "avg_adj_penalty": [], "avg_combo_loss": [],
+        }
+        best_it = None
+        best_loss = np.inf
+        best_params = params
+        iter_start = 0
+
+        ckpt_path = os.path.join(save_dir, "trainer_checkpoint.pkl") if save_dir else None
+        if resume and ckpt_path and os.path.exists(ckpt_path):
+            with open(ckpt_path, "rb") as f:
+                ck = pickle.load(f)
+            params = jax.tree.map(jnp.asarray, ck["params"])
+            opt_state = jax.tree.map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+                ck["opt_state"],
+            )
+            histories = ck["histories"]
+            best_it, best_loss = ck["best_it"], ck["best_loss"]
+            best_params = jax.tree.map(jnp.asarray, ck["best_params"])
+            iter_start = ck["epoch"] + 1
+            if tracker is not None and ck.get("tracker_state") is not None:
+                tracker.__dict__.update(ck["tracker_state"])
+
+        last_it = iter_start - 1
+        for it in range(iter_start, cfg.max_iter):
+            last_it = it
+            for X, Y in train_ds.batches(cfg.batch_size, rng=rng):
+                params, opt_state, _, _ = self._train_step(params, opt_state, X, Y)
+
+            if tracker is not None:
+                self._epoch_gc_tracking(params, tracker, true_GC)
+
+            val = self.validate(params, val_ds)
+            histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))
+            histories["avg_adj_penalty"].append(val.get("adj_l1_penalty", 0.0))
+            histories["avg_combo_loss"].append(val["combo_loss"])
+
+            if hasattr(self.model, "validation_criteria"):
+                criteria = float(self.model.validation_criteria(params, val))
+            else:
+                criteria = val["combo_loss"]
+
+            if criteria < best_loss:
+                best_loss = criteria
+                best_it = it
+                best_params = params
+            elif best_it is not None and (it - best_it) == cfg.lookback * cfg.check_every:
+                if cfg.verbose:
+                    print("Stopping early")
+                break
+
+            if it % cfg.check_every == 0 and save_dir:
+                self._save_checkpoint(save_dir, it, best_params, opt_state, params,
+                                      histories, best_it, best_loss, tracker)
+            if cfg.verbose and it % max(1, cfg.check_every) == 0:
+                print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
+
+        final_val = self.validate(best_params, val_ds)
+        if save_dir:
+            # stamp the actual last trained epoch so a later resume with a larger
+            # max_iter continues from where training really stopped; the resumable
+            # state keeps the LAST iterate (params + its opt_state), while
+            # final_best_model.bin holds best_params
+            self._save_checkpoint(save_dir, last_it, best_params, opt_state,
+                                  params, histories, best_it, best_loss, tracker)
+        params = best_params
+        return FitResult(
+            params=params, best_it=best_it if best_it is not None else 0,
+            best_loss=float(best_loss), histories=histories, tracker=tracker,
+            final_val_loss=final_val["combo_loss"],
+        )
+
+    def _save_checkpoint(self, save_dir, it, best_params, opt_state, params,
+                         histories, best_it, best_loss, tracker):
+        os.makedirs(save_dir, exist_ok=True)
+        save_model(save_dir, self.model, best_params)
+        meta = {
+            "epoch": it,
+            "best_loss": float(best_loss),
+            "best_it": best_it,
+            **histories,
+        }
+        if tracker is not None:
+            meta.update(tracker.as_dict())
+        with open(os.path.join(save_dir, "training_meta_data_and_hyper_parameters.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+        with open(os.path.join(save_dir, "trainer_checkpoint.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "epoch": it,
+                    "params": jax.tree.map(np.asarray, params),
+                    "best_params": jax.tree.map(np.asarray, best_params),
+                    "opt_state": jax.tree.map(
+                        lambda x: np.asarray(x) if isinstance(x, jnp.ndarray) else x,
+                        opt_state,
+                    ),
+                    "histories": histories,
+                    "best_it": best_it,
+                    "best_loss": float(best_loss),
+                    "tracker_state": None if tracker is None else dict(tracker.__dict__),
+                },
+                f,
+            )

@@ -6,11 +6,14 @@ xla_force_host_platform_device_count escape hatch, so no TPU is needed to run te
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+# hard override via config (not env): the session sitecustomize registers the
+# axon TPU backend and wins over JAX_PLATFORMS env; tests must run on the
+# virtual CPU mesh for determinism and f32 matmul exactness
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,159 @@
+"""Parity tests: the tensorized cMLP must match an independently-written
+torch Conv1d per-series model (the reference architecture) given identical
+weights, and the prox/GC ops must match hand computations."""
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import jax
+import jax.numpy as jnp
+
+from redcliff_tpu.models import cmlp as C
+from redcliff_tpu.ops import prox as P
+
+
+class TorchPerSeriesMLP(nn.Module):
+    """Reference-architecture check model: one Conv1d(num_series->h, lag) + 1x1
+    convs per output series, outputs concatenated (written fresh for testing)."""
+
+    def __init__(self, num_series, lag, hidden):
+        super().__init__()
+        dims = list(hidden) + [1]
+        self.nets = nn.ModuleList()
+        for _ in range(num_series):
+            layers = [nn.Conv1d(num_series, dims[0], lag)]
+            for d_in, d_out in zip(dims[:-1], dims[1:]):
+                layers.append(nn.Conv1d(d_in, d_out, 1))
+            self.nets.append(nn.ModuleList(layers))
+
+    def forward(self, X):  # X: (B, T, C)
+        outs = []
+        for net in self.nets:
+            h = X.transpose(2, 1)
+            for i, conv in enumerate(net):
+                if i != 0:
+                    h = torch.relu(h)
+                h = conv(h)
+            outs.append(h.transpose(2, 1))
+        return torch.cat(outs, dim=2)
+
+
+def _copy_torch_into_jax(tmodel, num_series, lag, hidden):
+    dims = list(hidden) + [1]
+    layers = []
+    w0 = np.stack([net[0].weight.detach().numpy() for net in tmodel.nets])  # (C, H, C, L)
+    b0 = np.stack([net[0].bias.detach().numpy() for net in tmodel.nets])
+    layers.append({"w": jnp.asarray(w0), "b": jnp.asarray(b0)})
+    for li in range(1, len(dims)):
+        w = np.stack([net[li].weight.detach().numpy()[:, :, 0] for net in tmodel.nets])
+        b = np.stack([net[li].bias.detach().numpy() for net in tmodel.nets])
+        layers.append({"w": jnp.asarray(w), "b": jnp.asarray(b)})
+    return layers
+
+
+@pytest.mark.parametrize("hidden", [[8], [8, 6]])
+def test_cmlp_forward_matches_torch_reference_arch(hidden):
+    torch.manual_seed(0)
+    B, T, Cn, lag = 3, 12, 5, 4
+    tmodel = TorchPerSeriesMLP(Cn, lag, hidden)
+    params = _copy_torch_into_jax(tmodel, Cn, lag, hidden)
+    X = np.random.default_rng(0).normal(size=(B, T, Cn)).astype(np.float32)
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(X)).numpy()
+    j_out = np.asarray(C.cmlp_forward(params, jnp.asarray(X)))
+    assert j_out.shape == (B, T - lag + 1, Cn)
+    np.testing.assert_allclose(j_out, t_out, rtol=1e-4, atol=1e-5)
+
+
+def test_cmlp_gc_matches_torch_norms():
+    torch.manual_seed(1)
+    Cn, lag, hidden = 4, 3, [6]
+    tmodel = TorchPerSeriesMLP(Cn, lag, hidden)
+    params = _copy_torch_into_jax(tmodel, Cn, lag, hidden)
+    # torch: GC[i, j] = || net_i.layers[0].weight[:, j, :] || over (hidden, lag)
+    expected = np.stack([
+        torch.norm(net[0].weight, dim=(0, 2)).detach().numpy() for net in tmodel.nets
+    ])
+    got = np.asarray(C.cmlp_gc(params, ignore_lag=True))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+    got_lag = np.asarray(C.cmlp_gc(params, ignore_lag=False))
+    expected_lag = np.stack([
+        torch.norm(net[0].weight, dim=0).detach().numpy() for net in tmodel.nets
+    ])
+    np.testing.assert_allclose(got_lag, expected_lag, rtol=1e-5, atol=1e-6)
+
+
+def test_prox_gl_matches_manual_soft_threshold():
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.normal(size=(4, 6, 4, 3)))  # (C_out, H, C_in, L)
+    lam, lr = 0.7, 0.1
+    out = P.prox_update(W, lam, lr, penalty="GL")
+    W_np = np.asarray(W)
+    norm = np.sqrt((W_np**2).sum(axis=(1, 3), keepdims=True))
+    expected = (W_np / np.maximum(norm, lr * lam)) * np.maximum(norm - lr * lam, 0.0)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6, atol=1e-7)
+
+
+def test_prox_gl_zeroes_small_groups_keeps_large():
+    W = np.zeros((2, 3, 2, 2), dtype=np.float32)
+    W[0, :, 0, :] = 5.0   # large group survives
+    W[0, :, 1, :] = 0.01  # small group is zeroed
+    out = np.asarray(P.prox_update(jnp.asarray(W), lam=1.0, lr=0.1))
+    assert np.all(out[0, :, 1, :] == 0.0)
+    assert np.all(np.abs(out[0, :, 0, :]) > 0.0)
+    # shrinkage direction preserved
+    assert np.all(out[0, :, 0, :] < 5.0)
+
+
+def test_prox_h_hierarchical_prefix_structure():
+    rng = np.random.default_rng(1)
+    W = jnp.asarray(rng.normal(size=(2, 4, 2, 3)))
+    out = P.prox_update(W, lam=0.5, lr=0.2, penalty="H")
+    assert out.shape == W.shape
+    # H with large threshold kills the most-lagged entries first (lag index 0)
+    out_strong = np.asarray(P.prox_update(W, lam=20.0, lr=0.2, penalty="H"))
+    assert np.abs(out_strong[..., 0]).sum() <= np.abs(out_strong[..., -1]).sum() + 1e-6
+
+
+def test_prox_gsgl_composes():
+    rng = np.random.default_rng(2)
+    W = jnp.asarray(rng.normal(size=(2, 4, 2, 3)))
+    out = P.prox_update(W, lam=0.5, lr=0.2, penalty="GSGL")
+    W_np = np.asarray(W)
+    n1 = np.sqrt((W_np**2).sum(axis=1, keepdims=True))
+    step1 = (W_np / np.maximum(n1, 0.1)) * np.maximum(n1 - 0.1, 0.0)
+    n2 = np.sqrt((step1**2).sum(axis=(1, 3), keepdims=True))
+    expected = (step1 / np.maximum(n2, 0.1)) * np.maximum(n2 - 0.1, 0.0)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6, atol=1e-7)
+
+
+def test_vmap_over_factor_axis():
+    """The K-factor extension is literally a vmap of the single-factor model."""
+    key = jax.random.PRNGKey(0)
+    K, Cn, lag, hidden = 3, 4, 2, [5]
+    keys = jax.random.split(key, K)
+    params = jax.vmap(lambda k: C.init_cmlp_params(k, Cn, lag, hidden))(keys)
+    X = jax.random.normal(jax.random.PRNGKey(1), (2, 6, Cn))
+    out = jax.vmap(lambda p: C.cmlp_forward(p, X))(params)
+    assert out.shape == (K, 2, 5, Cn)
+    gc = jax.vmap(lambda p: C.cmlp_gc(p))(params)
+    assert gc.shape == (K, Cn, Cn)
+
+
+def test_wavelet_mask_values():
+    mask = np.asarray(C.build_wavelet_ranking_mask(8))
+    # mask[i, j] = 1.3^(2(1 - i%4)) * 1.3^(2(1 - j%4))
+    assert mask[0, 0] == pytest.approx(1.3**2 * 1.3**2)
+    assert mask[1, 1] == pytest.approx(1.0)
+    assert mask[3, 3] == pytest.approx(1.3**-4 * 1.3**-4)
+    assert mask[4, 0] == pytest.approx(mask[0, 0])  # periodic across channels
+
+
+def test_condense_wavelet_gc_blocks():
+    ns, nc = 8, 2
+    GC = jnp.asarray(np.arange(ns * ns, dtype=np.float32).reshape(ns, ns))
+    cond = np.asarray(C.condense_wavelet_gc(GC, nc))
+    assert cond.shape == (2, 2)
+    manual = np.asarray(GC).reshape(2, 4, 2, 4).sum(axis=(1, 3))
+    np.testing.assert_allclose(cond, manual)
