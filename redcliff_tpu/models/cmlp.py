@@ -29,6 +29,8 @@ __all__ = [
     "init_cmlp_params",
     "cmlp_forward",
     "cmlp_gc",
+    "init_mlp_params",
+    "mlp_forward",
     "build_wavelet_ranking_mask",
     "condense_wavelet_gc",
     "first_layer_weights",
@@ -72,6 +74,23 @@ def init_cmlp_params(key, num_series: int, lag: int, hidden: Sequence[int]):
         )
         d_in = d_out
     return layers
+
+
+def init_mlp_params(key, num_series: int, lag: int, hidden: Sequence[int]):
+    """Single MLP (one output stream): the reference's MLP unit (ref cmlp.py:12-35).
+    Delegates to init_cmlp_params with a one-entry output-series axis and strips
+    it, so the init scheme stays defined in exactly one place. The cMLP is C of
+    these batched; the cEmbedder is K of these batched."""
+    batched = init_cmlp_params(key, num_series, lag, hidden)
+    return [jax.tree.map(lambda leaf: leaf[0], layer) for layer in batched]
+
+
+def mlp_forward(params, X):
+    """Single-MLP forward: (B, T, C) -> (B, T-lag+1, 1). Delegates to the batched
+    cmlp_forward with a singleton output-series axis (which lands as the final
+    size-1 channel of the result)."""
+    batched = [jax.tree.map(lambda leaf: leaf[None], layer) for layer in params]
+    return cmlp_forward(batched, X)
 
 
 def lagged_windows(X, lag):

@@ -1,0 +1,333 @@
+"""Factor-score embedders: map a recent signal window to (factor weightings,
+optional class logits).
+
+JAX rebuild of /root/reference/models/redcliff_factor_score_embedders.py:
+
+* ``VanillaSingleObjective``  — MLPClassifierForSingleObjective (ref :51-100):
+  bias-free 2-D conv stack collapsing (series, time) to an embedding, then a
+  bias-free linear to K factor scores; optional sigmoid restriction with an
+  eccentricity coefficient.
+* ``VanillaMultiObjective``   — MLPClassifierForMultipleObjectives (ref :104-179):
+  same trunk; the FIRST num_out_classes embedding dims are simultaneously the
+  supervised factor scores and the class logits; remaining dims pass through a
+  linear to unsupervised scores.
+* ``CEmbedder``               — cEmbedder (ref :183-331): one cMLP-style network
+  per factor over the window; the first-layer weight norms expose a (K, C[, L])
+  "system" GC readout.
+* ``DGCNNEmbedder``           — wraps the DGCNN model (ref :335-392); its learned
+  adjacency is the embedder GC readout. Takes NODE-MAJOR input (B, C, T).
+
+All are pure functions over param pytrees; each class bundles init/apply/gc with
+a shared calling convention:  apply(params, X) -> (weightings (B, K),
+class_logits (B, n_classes) | None).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from redcliff_tpu.models import cmlp as cmlp_mod
+from redcliff_tpu.models import dgcnn as dgcnn_mod
+
+__all__ = [
+    "VanillaSingleObjective",
+    "VanillaMultiObjective",
+    "CEmbedder",
+    "DGCNNEmbedder",
+    "build_embedder",
+]
+
+
+def _uniform_fanin(key, shape, fan_in):
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, minval=-bound, maxval=bound)
+
+
+def _sigmoid_restrict(scores, ecc):
+    """Sigmoid restriction with eccentricity coefficient: squashes factor
+    weightings to (0, 1) while pushing activations away from the linear regime
+    (ref embedders :96-99)."""
+    return jax.nn.sigmoid(ecc * scores)
+
+
+def _trunk_shapes(num_series, num_in_timesteps):
+    """The bias-free conv trunk both Vanilla embedders share (ref :68-76,131-139):
+    Conv2d(1->h, (num_series, tkw), pad (0, tkw//2)) -> relu ->
+    Conv2d(h->h, (1, num_in_timesteps)) -> relu, yielding (B, h)."""
+    tkw = num_in_timesteps - ((num_in_timesteps - 1) % 2)
+    return tkw
+
+
+def _init_trunk(key, num_series, num_in_timesteps, hidden):
+    tkw = _trunk_shapes(num_series, num_in_timesteps)
+    k1, k2 = jax.random.split(key)
+    return {
+        # conv1: kernel (h, 1, num_series, tkw) in torch layout -> store (h, num_series, tkw)
+        "conv1": _uniform_fanin(k1, (hidden, num_series, tkw), fan_in=num_series * tkw),
+        # conv2: (h, h, 1, num_in_timesteps) -> (h, h, num_in_timesteps)
+        "conv2": _uniform_fanin(k2, (hidden, hidden, num_in_timesteps), fan_in=hidden * num_in_timesteps),
+    }
+
+
+def _apply_trunk(trunk, X, num_series, num_in_timesteps):
+    """X: (B, T, C) -> (B, hidden) embedding. Implements the two bias-free convs
+    with 'same'-ish padding on the first (pad tkw//2 both sides of time)."""
+    B, T, C = X.shape
+    assert T == num_in_timesteps and C == num_series
+    tkw = _trunk_shapes(num_series, num_in_timesteps)
+    x = jnp.transpose(X, (0, 2, 1))  # (B, C, T)
+    pad = tkw // 2
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad, pad)))
+    # conv over full series height: windows over time of width tkw
+    Tout = T + 2 * pad - tkw + 1
+    wins = jnp.stack([xp[:, :, t : t + tkw] for t in range(Tout)], axis=1)  # (B, Tout, C, tkw)
+    h = jax.nn.relu(jnp.einsum("btcw,hcw->bht", wins, trunk["conv1"]))  # (B, h, Tout)
+    # conv2 kernel width = num_in_timesteps exactly (Tout == T when tkw odd)
+    h2 = jax.nn.relu(jnp.einsum("bht,ght->bg", h, trunk["conv2"]))  # (B, h)
+    return h2
+
+
+@dataclass(frozen=True)
+class VanillaSingleObjective:
+    """Unsupervised factor weighting embedder (ref :51-100)."""
+
+    num_series: int
+    num_in_timesteps: int
+    num_factor_scores: int
+    hidden: int
+    use_sigmoid_restriction: bool = True
+    sigmoid_eccentricity_coeff: float = 10.0
+
+    def init(self, key):
+        kt, kw = jax.random.split(key)
+        return {
+            "trunk": _init_trunk(kt, self.num_series, self.num_in_timesteps, self.hidden),
+            "head": _uniform_fanin(kw, (self.hidden, self.num_factor_scores), fan_in=self.hidden),
+        }
+
+    def apply(self, params, X, use_final_activation=True):
+        emb = _apply_trunk(params["trunk"], X, self.num_series, self.num_in_timesteps)
+        scores = emb @ params["head"]
+        if self.use_sigmoid_restriction:
+            scores = _sigmoid_restrict(scores, self.sigmoid_eccentricity_coeff)
+        return scores, None
+
+
+@dataclass(frozen=True)
+class VanillaMultiObjective:
+    """Supervised+unsupervised embedder (ref :104-179): supervised scores are the
+    first num_out_classes embedding dims; class logits share those dims."""
+
+    num_series: int
+    num_in_timesteps: int
+    num_factor_scores: int
+    num_out_classes: int
+    hidden: int
+    use_sigmoid_restriction: bool = True
+    sigmoid_eccentricity_coeff: float = 10.0
+
+    def init(self, key):
+        kt, kw = jax.random.split(key)
+        p = {"trunk": _init_trunk(kt, self.num_series, self.num_in_timesteps, self.hidden)}
+        n_unsup = self.num_factor_scores - self.num_out_classes
+        if n_unsup > 0:
+            p["unsup_head"] = _uniform_fanin(
+                kw, (self.hidden - self.num_out_classes, n_unsup),
+                fan_in=self.hidden - self.num_out_classes,
+            )
+        return p
+
+    def apply(self, params, X, use_final_activation=True):
+        emb = _apply_trunk(params["trunk"], X, self.num_series, self.num_in_timesteps)
+        sup = emb[:, : self.num_out_classes]
+        if self.num_factor_scores - self.num_out_classes > 0:
+            unsup = emb[:, self.num_out_classes :] @ params["unsup_head"]
+            scores = jnp.concatenate([sup, unsup], axis=1)
+        else:
+            scores = sup
+        logits = emb[:, : self.num_out_classes]
+        if self.use_sigmoid_restriction:
+            scores = _sigmoid_restrict(scores, self.sigmoid_eccentricity_coeff)
+            if use_final_activation:
+                # class logits get a plain sigmoid without eccentricity (ref :176-177)
+                logits = jax.nn.sigmoid(logits)
+        return scores, logits
+
+
+@dataclass(frozen=True)
+class CEmbedder:
+    """One cMLP-style network per factor prediction (ref :183-331). Exposes a
+    (K, C[, L]) GC readout from first-layer norms so the embedder itself yields a
+    factor-to-channel causal map."""
+
+    num_chans: int
+    num_class_preds: int
+    num_factor_preds: int
+    use_sigmoid_restriction: bool
+    sigmoid_eccentricity_coeff: float
+    lag: int
+    hidden: tuple
+    wavelet_level: int | None = None
+
+    @property
+    def num_series(self):
+        if self.wavelet_level is not None:
+            return self.num_chans * (self.wavelet_level + 1)
+        return self.num_chans
+
+    def init(self, key):
+        keys = jax.random.split(key, self.num_factor_preds)
+        # one independent single-output MLP per factor (ref :240: one MLP unit per
+        # factor pred), K-batched
+        return {
+            "nets": jax.vmap(
+                lambda k: cmlp_mod.init_mlp_params(k, self.num_series, self.lag, list(self.hidden))
+            )(keys)
+        }
+
+    def apply(self, params, X, use_final_activation=True):
+        """X: (B, T, C) with T == lag: each factor's MLP emits one scalar, and the
+        concatenation is the weighting vector (ref :253-257 requires T' == 1)."""
+        out = jax.vmap(lambda p: cmlp_mod.mlp_forward(p, X))(params["nets"])  # (K, B, T', 1)
+        weightings = jnp.transpose(out[:, :, -1, 0], (1, 0))  # (B, K)
+        logits = None
+        if self.num_class_preds > 0:
+            logits = weightings[:, : self.num_class_preds]
+            if use_final_activation and self.use_sigmoid_restriction:
+                logits = jax.nn.sigmoid(logits)
+        if self.use_sigmoid_restriction:
+            weightings = _sigmoid_restrict(weightings, self.sigmoid_eccentricity_coeff)
+        return weightings, logits
+
+    def gc(self, params, threshold=False, ignore_lag=True,
+           combine_wavelet_representations=False, rank_wavelets=False):
+        """(K, C[, L]) first-layer norms per factor network (ref :275-331).
+        With wavelet decomposition, rank_wavelets applies the (K, num_series)
+        ranking mask (ref :209-228) and combine_wavelet_representations sums
+        each channel's wavelet-band block down to (K, num_chans[, L])."""
+        w0 = params["nets"][0]["w"]  # (K, H, C, L)
+        if ignore_lag:
+            G = jnp.sqrt(jnp.sum(w0 * w0, axis=(1, 3)))  # (K, C)
+        else:
+            G = jnp.sqrt(jnp.sum(w0 * w0, axis=1))  # (K, C, L)
+        if rank_wavelets:
+            assert self.wavelet_level is not None
+            mask = self._wavelet_mask()
+            G = mask * G if ignore_lag else mask[:, :, None] * G
+        if self.wavelet_level is not None and combine_wavelet_representations:
+            w = self.num_series // self.num_chans
+            if ignore_lag:
+                G = G.reshape(G.shape[0], self.num_chans, w).sum(axis=2)
+            else:
+                G = G.reshape(G.shape[0], self.num_chans, w, G.shape[-1]).sum(axis=2)
+        if threshold:
+            return (G > 0).astype(jnp.int32)
+        return G
+
+    def _wavelet_mask(self):
+        """(K, num_series) ranking mask: column factor 1.3^(2*(r - j%w)) per band,
+        rows uniform across factors (ref :209-228 builds the same outer product
+        with a single row of the channel-block mask)."""
+        import numpy as np
+
+        w = self.num_series // self.num_chans
+        assert w == 4, "reference supports 4 wavelets per channel"
+        rank_factor = w // 4
+        col = 1.3 ** (2.0 * (rank_factor - 1.0 * (np.arange(self.num_series) % w)))
+        row = np.full(self.num_factor_preds, 1.3 ** (2.0 * rank_factor))
+        return jnp.asarray(row[:, None] * col[None, :])
+
+
+@dataclass(frozen=True)
+class DGCNNEmbedder:
+    """DGCNN-backed embedder (ref :335-392). Input is node-major (B, C, T)."""
+
+    num_channels: int
+    num_wavelets_per_chan: int
+    num_features_per_node: int
+    num_graph_conv_layers: int
+    num_hidden_nodes: int
+    sigmoid_eccentricity_coeff: float
+    use_sigmoid_restriction: bool
+    num_factors: int
+    num_classes: int
+
+    def _cfg(self):
+        return dgcnn_mod.DGCNNConfig(
+            num_channels=self.num_channels,
+            num_wavelets_per_chan=self.num_wavelets_per_chan,
+            num_features_per_node=self.num_features_per_node,
+            num_graph_conv_layers=self.num_graph_conv_layers,
+            num_hidden_nodes=self.num_hidden_nodes,
+            num_classes=self.num_factors,
+        )
+
+    def init(self, key):
+        return dgcnn_mod.init_dgcnn_params(key, self._cfg())
+
+    def apply(self, params, X, use_final_activation=True):
+        """X: (B, N, F) node-major (the REDCLIFF forward transposes before calling,
+        ref redcliff_s_cmlp.py:287)."""
+        if X.shape[2] != self.num_features_per_node:
+            X = jnp.transpose(X, (0, 2, 1))
+        weightings = dgcnn_mod.dgcnn_forward(params, X)
+        logits = None
+        if self.num_classes > 0:
+            logits = weightings[:, : self.num_classes]
+            if use_final_activation and self.use_sigmoid_restriction:
+                logits = jax.nn.sigmoid(logits)
+        if self.use_sigmoid_restriction:
+            weightings = _sigmoid_restrict(weightings, self.sigmoid_eccentricity_coeff)
+        return weightings, logits
+
+    def gc(self, params, threshold=False, combine_node_feature_edges=False):
+        return dgcnn_mod.dgcnn_gc(params, self._cfg(), threshold=threshold,
+                                  combine_node_feature_edges=combine_node_feature_edges)
+
+
+def build_embedder(embedder_type, *, num_chans, num_series, embed_lag,
+                   embed_hidden_sizes, num_factors, num_supervised_factors,
+                   use_sigmoid_restriction, sigmoid_eccentricity_coeff=10.0,
+                   wavelet_level=None, dgcnn_args=None):
+    """Embedder factory mirroring the reference's constructor dispatch
+    (ref redcliff_s_cmlp.py:109-137)."""
+    if embedder_type == "Vanilla_Embedder":
+        if num_supervised_factors > 0:
+            return VanillaMultiObjective(
+                num_series=num_series, num_in_timesteps=embed_lag,
+                num_factor_scores=num_factors, num_out_classes=num_supervised_factors,
+                hidden=embed_hidden_sizes[0],
+                use_sigmoid_restriction=use_sigmoid_restriction,
+                sigmoid_eccentricity_coeff=sigmoid_eccentricity_coeff,
+            )
+        return VanillaSingleObjective(
+            num_series=num_series, num_in_timesteps=embed_lag,
+            num_factor_scores=num_factors, hidden=embed_hidden_sizes[0],
+            use_sigmoid_restriction=use_sigmoid_restriction,
+            sigmoid_eccentricity_coeff=sigmoid_eccentricity_coeff,
+        )
+    if embedder_type == "cEmbedder":
+        return CEmbedder(
+            num_chans=num_chans, num_class_preds=num_supervised_factors,
+            num_factor_preds=num_factors,
+            use_sigmoid_restriction=use_sigmoid_restriction,
+            sigmoid_eccentricity_coeff=sigmoid_eccentricity_coeff,
+            lag=embed_lag, hidden=tuple(embed_hidden_sizes),
+            wavelet_level=wavelet_level,
+        )
+    if embedder_type == "DGCNN":
+        args = dgcnn_args or {}
+        return DGCNNEmbedder(
+            num_channels=num_chans,
+            num_wavelets_per_chan=(wavelet_level + 1) if wavelet_level is not None else 1,
+            num_features_per_node=args.get("num_features_per_node", embed_lag),
+            num_graph_conv_layers=args.get("num_graph_conv_layers", 2),
+            num_hidden_nodes=args.get("num_hidden_nodes", 32),
+            sigmoid_eccentricity_coeff=sigmoid_eccentricity_coeff,
+            use_sigmoid_restriction=use_sigmoid_restriction,
+            num_factors=num_factors, num_classes=num_supervised_factors,
+        )
+    raise NotImplementedError(f"factor_score_embedder_type == {embedder_type}")

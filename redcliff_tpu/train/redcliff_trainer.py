@@ -1,0 +1,497 @@
+"""REDCLIFF-S training choreography.
+
+Rebuild of the reference's fit loop (ref models/redcliff_s_cmlp.py:1159-1628) as a
+functional trainer:
+
+* epoch-scheduled phases (pretrain embedder / pretrain+acclimate factors /
+  combined / post-train) select among jit'd step functions; two Adam optimizers
+  with torch-style coupled weight decay cover the embedder and factor groups
+  (ref general_utils/model_utils.py:749-762);
+* the Freeze-by-epoch/batch accept-revert choreography (ref :866-885,
+  1116-1156, 1469-1515) becomes a two-pytree candidate-vs-accepted pattern with
+  per-factor jnp.where swaps — no deepcopies;
+* Hungarian factor alignment at the pretrain->train transition
+  (initialize_factors_with_prior, ref :147-202);
+* early stopping on the weighted (factor, forecast, cosSim) criteria
+  (ref :1466-1538), with histories and checkpoints in the reference's on-disk
+  layout, plus exact optimizer-state resume (the reference warns it has none,
+  ref :245).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from redcliff_tpu.models.redcliff import RedcliffSCMLP
+from redcliff_tpu.train.tracking import GCProgressTracker
+from redcliff_tpu.utils.misc import sort_unsupervised_estimates
+
+__all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
+
+
+@dataclass
+class RedcliffTrainConfig:
+    embed_lr: float = 1e-3
+    embed_eps: float = 1e-8
+    embed_weight_decay: float = 0.0
+    gen_lr: float = 1e-3
+    gen_eps: float = 1e-8
+    gen_weight_decay: float = 0.0
+    max_iter: int = 100
+    lookback: int = 5
+    check_every: int = 50
+    batch_size: int = 32
+    seed: int = 0
+    verbose: int = 0
+    stopping_criteria_forecast_coeff: float = 1.0
+    stopping_criteria_factor_coeff: float = 1.0
+    stopping_criteria_cosSim_coeff: float = 1.0
+    max_factor_prior_batches: int = 10
+    unsupervised_start_index: int = 0
+    max_samples_for_gc_tracking: int = 40  # ref MAX_NUM_SAMPS_FOR_GC_PROGRESS_TRACKING
+
+
+@dataclass
+class RedcliffFitResult:
+    params: dict
+    best_it: int
+    best_loss: float
+    histories: dict
+    tracker: GCProgressTracker
+    final_val_loss: float
+
+
+def _torch_style_adam(lr, eps, weight_decay):
+    """torch.optim.Adam semantics: weight decay added to the gradient BEFORE the
+    moment updates (coupled, not AdamW)."""
+    chain = []
+    if weight_decay > 0:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.adam(lr, b1=0.9, b2=0.999, eps=eps))
+    return optax.chain(*chain)
+
+
+class RedcliffTrainer:
+    def __init__(self, model: RedcliffSCMLP, config: RedcliffTrainConfig):
+        self.model = model
+        self.config = config
+        self.optA = _torch_style_adam(config.embed_lr, config.embed_eps,
+                                      config.embed_weight_decay)
+        self.optB = _torch_style_adam(config.gen_lr, config.gen_eps,
+                                      config.gen_weight_decay)
+        self._steps = {}
+        self._build_steps()
+
+    # ------------------------------------------------------------------ phases
+    def phase_for_epoch(self, epoch):
+        """Epoch -> phase name (ref batch_update :696-714)."""
+        cfg = self.model.config
+        mode = cfg.training_mode
+        if epoch <= cfg.num_pretrain_epochs - 1:
+            phases = []
+            if "pretrain_embedder" in mode:
+                phases.append("embedder_pretrain")
+            if "pretrain_factor" in mode:
+                phases.append("factor_pretrain")
+            return tuple(phases)
+        if ("acclimate_factors" in mode
+                and epoch <= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs - 1):
+            return ("factor_pretrain",)
+        if "combined" in mode:
+            return ("combined",)
+        if "post_train_factor" in mode:
+            return ("post_train",)
+        raise NotImplementedError(mode)
+
+    def _build_steps(self):
+        model = self.model
+
+        def make_step(phase):
+            def step(params, optA_state, optB_state, X, Y):
+                (combo, parts), grads = jax.value_and_grad(
+                    lambda p: model.loss_for_phase(p, X, Y, phase), has_aux=True
+                )(params)
+                if phase == "embedder_pretrain":
+                    upd, optA_state = self.optA.update(
+                        grads["embedder"], optA_state, params["embedder"])
+                    params = dict(params,
+                                  embedder=optax.apply_updates(params["embedder"], upd))
+                elif phase in ("factor_pretrain", "post_train"):
+                    upd, optB_state = self.optB.update(
+                        grads["factors"], optB_state, params["factors"])
+                    params = dict(params,
+                                  factors=optax.apply_updates(params["factors"], upd))
+                else:  # combined
+                    updA, optA_state = self.optA.update(
+                        grads["embedder"], optA_state, params["embedder"])
+                    updB, optB_state = self.optB.update(
+                        grads["factors"], optB_state, params["factors"])
+                    params = dict(
+                        params,
+                        embedder=optax.apply_updates(params["embedder"], updA),
+                        factors=optax.apply_updates(params["factors"], updB),
+                    )
+                return params, optA_state, optB_state, combo, parts
+
+            return jax.jit(step)
+
+        for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
+            self._steps[phase] = make_step(phase)
+
+        def eval_loss(params, X, Y):
+            return model.loss_for_phase(params, X, Y, "combined")
+
+        self._eval_loss = jax.jit(eval_loss)
+
+        def label_preds_fn(params, X):
+            W = model.config.max_lag
+            _, _, _, label_preds = model.forward(params, X[:, :W, :])
+            return label_preds[0]
+
+        self._label_preds = jax.jit(label_preds_fn)
+
+        def factor_decision_stats(params):
+            """Per-factor (normalized L1, pairwise-cosine-mean) of the unlagged
+            factor GC estimates (ref determine_which_factors_need_updates
+            :1116-1156)."""
+            G = model.factor_gc(params, ignore_lag=True)  # (K, C, C)
+            G = G / jnp.maximum(jnp.max(jnp.abs(G), axis=(1, 2), keepdims=True), 1e-12)
+            l1 = jnp.sum(jnp.abs(G), axis=(1, 2))  # (K,)
+            flat = G.reshape(G.shape[0], -1)
+            norms = jnp.maximum(jnp.linalg.norm(flat, axis=1), 1e-8)
+            cos = (flat @ flat.T) / (norms[:, None] * norms[None, :])
+            K = G.shape[0]
+            mask = 1.0 - jnp.eye(K)
+            avg_cos = jnp.sum(cos * mask, axis=1) / jnp.maximum(K - 1.0, 1.0)
+            return l1, avg_cos
+
+        self._factor_decision_stats = jax.jit(factor_decision_stats)
+
+        def swap_factors(candidate, accepted, accept_vec):
+            """accept_vec: (K,) bool — True takes the candidate factor into the
+            accepted tree AND keeps it in the candidate; False reverts the
+            candidate factor to the accepted one."""
+
+            def pick(c_leaf, a_leaf):
+                shape = (-1,) + (1,) * (c_leaf.ndim - 1)
+                m = accept_vec.reshape(shape)
+                merged = jnp.where(m, c_leaf, a_leaf)
+                return merged
+
+            merged_factors = jax.tree.map(pick, candidate["factors"], accepted["factors"])
+            new_candidate = dict(candidate, factors=merged_factors)
+            new_accepted = dict(accepted, factors=merged_factors,
+                                embedder=candidate["embedder"])
+            return new_candidate, new_accepted
+
+        self._swap_factors = jax.jit(swap_factors)
+
+    # --------------------------------------------------------------- alignment
+    def align_factors_with_labels(self, params, train_ds):
+        """Hungarian-align factor indices to supervised labels using the first
+        predicted factor weighting on up to max_factor_prior_batches batches
+        (ref initialize_factors_with_prior :147-202)."""
+        cfg = self.model.config
+        tc = self.config
+        preds, labels = [], []
+        for b, (X, Y) in enumerate(train_ds.batches(tc.batch_size)):
+            if b >= tc.max_factor_prior_batches:
+                break
+            _, _, fw, _ = self.model.forward(
+                jax.tree.map(jnp.asarray, params), jnp.asarray(X[:, : cfg.max_lag, :]))
+            preds.append(np.asarray(fw[0]))
+            if Y.ndim == 3:
+                col = cfg.max_lag if Y.shape[2] > cfg.max_lag else 0
+                labels.append(np.asarray(Y[:, :, col]))
+            else:
+                labels.append(np.asarray(Y))
+        preds = np.vstack(preds)
+        labels = np.vstack(labels)
+        est_series = [preds[:, i] for i in range(preds.shape[1])]
+        true_series = [labels[:, i] for i in range(labels.shape[1])]
+        usi = tc.unsupervised_start_index
+        _, matched_est, matched_gt = sort_unsupervised_estimates(
+            est_series, true_series, unsupervised_start_index=usi,
+            return_sorting_inds=True)
+        K = cfg.num_factors
+        tail = list(range(usi, K))
+        order_tail = [None] * len(matched_gt)
+        for e, g in zip(matched_est, matched_gt):
+            order_tail[g] = tail[e]
+        unmatched = [tail[i] for i in range(len(tail)) if i not in list(matched_est)]
+        order = list(range(usi)) + [o for o in order_tail if o is not None] + unmatched
+        order = order + [k for k in range(K) if k not in order]
+        return self.model.permute_factors(params, order[:K])
+
+    # --------------------------------------------------------------------- fit
+    def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
+            resume=True) -> RedcliffFitResult:
+        model, cfg = self.model, self.model.config
+        tc = self.config
+        self._true_GC = true_GC
+        rng = np.random.default_rng(tc.seed)
+        optA_state = self.optA.init(params["embedder"])
+        optB_state = self.optB.init(params["factors"])
+        mode = cfg.training_mode
+        freeze_by_batch = "FreezeByBatch" in mode
+        freeze = "Freeze" in mode
+
+        tracker = GCProgressTracker(
+            num_supervised_factors=cfg.num_supervised_factors,
+            num_chans=cfg.num_chans, num_factors=cfg.num_factors,
+        ) if true_GC is not None else None
+
+        histories = {
+            "avg_forecasting_loss": [], "avg_factor_loss": [],
+            "avg_factor_cos_sim_penalty": [], "avg_fw_l1_penalty": [],
+            "avg_adj_penalty": [], "avg_fw_smoothing_penalty": [],
+            "avg_combo_loss": [],
+            "factor_score_train_acc_history": [], "factor_score_train_tpr_history": [],
+            "factor_score_train_tnr_history": [], "factor_score_train_fpr_history": [],
+            "factor_score_train_fnr_history": [],
+            "factor_score_val_acc_history": [], "factor_score_val_tpr_history": [],
+            "factor_score_val_tnr_history": [], "factor_score_val_fpr_history": [],
+            "factor_score_val_fnr_history": [],
+        }
+        best_it = None
+        best_loss = np.inf
+        best_params = params
+        accepted = params  # Freeze-mode accepted tree ("best_model" analog)
+        iter_start = 0
+        aligned = False
+
+        ckpt_path = os.path.join(save_dir, "trainer_checkpoint.pkl") if save_dir else None
+        if resume and ckpt_path and os.path.exists(ckpt_path):
+            with open(ckpt_path, "rb") as f:
+                ck = pickle.load(f)
+            params = jax.tree.map(jnp.asarray, ck["params"])
+            best_params = jax.tree.map(jnp.asarray, ck["best_params"])
+            accepted = jax.tree.map(jnp.asarray, ck["accepted"])
+            optA_state = jax.tree.map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, ck["optA_state"])
+            optB_state = jax.tree.map(
+                lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, ck["optB_state"])
+            histories = ck["histories"]
+            best_it, best_loss = ck["best_it"], ck["best_loss"]
+            iter_start = ck["epoch"] + 1
+            aligned = ck.get("aligned", False)
+            if tracker is not None and ck.get("tracker_state") is not None:
+                tracker.__dict__.update(ck["tracker_state"])
+
+        last_it = iter_start - 1
+        for it in range(iter_start, tc.max_iter):
+            last_it = it
+            # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
+            if (not aligned and "pretrain_factor" in mode
+                    and it == cfg.num_pretrain_epochs and cfg.num_supervised_factors > 0):
+                params = self.align_factors_with_labels(params, train_ds)
+                aligned = True
+
+            phases = self.phase_for_epoch(it)
+            conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
+                        if cfg.num_supervised_factors > 0 else None)
+
+            for X, Y in train_ds.batches(tc.batch_size, rng=rng):
+                for phase in phases:
+                    params, optA_state, optB_state, _, _ = self._steps[phase](
+                        params, optA_state, optB_state, X, Y)
+                    if conf_mat is not None and phase in ("embedder_pretrain", "combined"):
+                        conf_mat += self._confusion(params, X, Y)
+                if freeze_by_batch:
+                    params, accepted = self._apply_freeze(params, accepted)
+
+            if conf_mat is not None and conf_mat.sum() > 0:
+                self._append_conf_stats(conf_mat, histories, "train")
+
+            # per-epoch GC tracking on the first val batch (ref :1349-1403)
+            if tracker is not None:
+                self._epoch_gc_tracking(params, val_ds, tracker)
+
+            val = self.validate(params, val_ds, histories)
+            histories["avg_forecasting_loss"].append(val["forecasting_loss"])
+            histories["avg_factor_loss"].append(val["factor_loss"])
+            histories["avg_factor_cos_sim_penalty"].append(val["factor_cos_sim_penalty"])
+            histories["avg_fw_l1_penalty"].append(val["fw_l1_penalty"])
+            histories["avg_adj_penalty"].append(val["adj_l1_penalty"])
+            histories["avg_fw_smoothing_penalty"].append(val.get("fw_smoothing_penalty", 0.0))
+            histories["avg_combo_loss"].append(val["combo_loss"])
+
+            # early stopping (ref :1466-1538)
+            if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
+                cos_mean = tracker.latest_mean_supervised_cosine() if tracker else 0.0
+                if cfg.num_supervised_factors > 1:
+                    criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
+                                + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
+                                + tc.stopping_criteria_cosSim_coeff * cos_mean)
+                elif cfg.num_supervised_factors == 1:
+                    criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
+                                + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"])
+                else:
+                    criteria = tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
+
+                if freeze:
+                    params, accepted = self._apply_freeze(params, accepted)
+                    if criteria < best_loss:
+                        best_loss = criteria
+                        best_it = it
+                    best_params = accepted
+                else:
+                    if criteria < best_loss:
+                        best_loss = criteria
+                        best_it = it
+                        best_params = params
+                    elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
+                        if tc.verbose:
+                            print("Stopping early")
+                        break
+            else:
+                best_it = it
+                best_params = params
+
+            if it % tc.check_every == 0 and save_dir:
+                self._save_checkpoint(save_dir, it, best_params, accepted, params,
+                                      optA_state, optB_state, histories, best_it,
+                                      best_loss, tracker, aligned)
+            if tc.verbose and it % max(1, tc.check_every) == 0:
+                print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
+
+        final_val = self.validate(best_params, val_ds, None)
+        if save_dir:
+            self._save_checkpoint(save_dir, last_it, best_params, accepted, params,
+                                  optA_state, optB_state, histories, best_it,
+                                  best_loss, tracker, aligned)
+        return RedcliffFitResult(
+            params=best_params, best_it=best_it if best_it is not None else 0,
+            best_loss=float(best_loss), histories=histories, tracker=tracker,
+            final_val_loss=final_val["combo_loss"],
+        )
+
+    # ----------------------------------------------------------------- helpers
+    def _apply_freeze(self, candidate, accepted):
+        """Accept/revert per-factor updates (ref :866-885, 1469-1515)."""
+        mode = self.model.config.training_mode
+        l1_new, cos_new = self._factor_decision_stats(candidate)
+        l1_old, cos_old = self._factor_decision_stats(accepted)
+        if "withComboCosSimL1" in mode:
+            accept = (cos_new * l1_new) < (cos_old * l1_old)
+        elif "withL1" in mode:
+            accept = l1_new < l1_old
+        else:
+            raise NotImplementedError(mode)
+        return self._swap_factors(candidate, accepted, accept)
+
+    def _confusion(self, params, X, Y):
+        cfg = self.model.config
+        S = cfg.num_supervised_factors
+        preds = np.asarray(self._label_preds(params, X))
+        Y = np.asarray(Y)
+        if Y.ndim == 3:
+            col = cfg.max_lag if Y.shape[2] > cfg.max_lag else 0
+            y = Y[:, :S, col]
+        else:
+            y = Y[:, :S]
+        pred_cls = preds[:, :S].argmax(axis=1)
+        true_cls = y.argmax(axis=1)
+        cm = np.zeros((S, S))
+        for t, p in zip(true_cls, pred_cls):
+            cm[t, p] += 1
+        return cm
+
+    @staticmethod
+    def _append_conf_stats(cm, histories, split):
+        """Multi-class TPR/TNR/FPR/FNR/ACC from a confusion matrix
+        (ref :1327-1346)."""
+        TP = np.diag(cm)
+        FP = cm.sum(axis=0) - TP
+        FN = cm.sum(axis=1) - TP
+        TN = cm.sum() - (FP + FN + TP)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            histories[f"factor_score_{split}_acc_history"].append((TP + TN) / (TP + FP + FN + TN))
+            histories[f"factor_score_{split}_tpr_history"].append(TP / (TP + FN))
+            histories[f"factor_score_{split}_tnr_history"].append(TN / (TN + FP))
+            histories[f"factor_score_{split}_fpr_history"].append(FP / (FP + TN))
+            histories[f"factor_score_{split}_fnr_history"].append(FN / (TP + FN))
+
+    def _epoch_gc_tracking(self, params, val_ds, tracker):
+        cfg = self.model.config
+        tc = self.config
+        for X, _ in val_ds.batches(tc.batch_size):
+            Xw = jnp.asarray(X[: tc.max_samples_for_gc_tracking, : cfg.max_lag, :])
+            lagged = np.asarray(self.model.gc(params, cfg.primary_gc_est_mode, X=Xw,
+                                              threshold=False, ignore_lag=False))
+            nolag = np.asarray(self.model.gc(params, cfg.primary_gc_est_mode, X=Xw,
+                                             threshold=False, ignore_lag=True))[..., 0]
+            est_lagged = [[lagged[s, k] for k in range(lagged.shape[1])]
+                          for s in range(lagged.shape[0])]
+            est_nolag = [[nolag[s, k] for k in range(nolag.shape[1])]
+                         for s in range(nolag.shape[0])]
+            tracker.update(true_GC=self._true_GC, est_by_sample=est_lagged,
+                           est_by_sample_lagsummed=est_nolag)
+            break  # only the first batch (ref :1403)
+
+    def validate(self, params, val_ds, histories):
+        cfg = self.model.config
+        tc = self.config
+        coeffs = self.model.normalization_coeffs()
+        sums = {}
+        combo_sum = 0.0
+        n = 0
+        conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
+                    if cfg.num_supervised_factors > 0 and histories is not None else None)
+        for X, Y in val_ds.batches(tc.batch_size):
+            combo, parts = self._eval_loss(params, X, Y)
+            combo_sum += float(combo)
+            for k, v in parts.items():
+                c = coeffs.get(k, 1.0)
+                sums[k] = sums.get(k, 0.0) + float(v) / (c if c > 0 else 1.0)
+            if conf_mat is not None:
+                conf_mat += self._confusion(params, X, Y)
+            n += 1
+        if n == 0:
+            raise ValueError("validation dataset yielded no batches")
+        out = {k: v / n for k, v in sums.items()}
+        out["combo_loss"] = combo_sum / n
+        if conf_mat is not None and conf_mat.sum() > 0:
+            self._append_conf_stats(conf_mat, histories, "val")
+        return out
+
+    _true_GC = None
+
+    def _save_checkpoint(self, save_dir, it, best_params, accepted, params,
+                         optA_state, optB_state, histories, best_it, best_loss,
+                         tracker, aligned):
+        os.makedirs(save_dir, exist_ok=True)
+        with open(os.path.join(save_dir, "final_best_model.bin"), "wb") as f:
+            pickle.dump({
+                "model_class": "RedcliffSCMLP",
+                "config": self.model.config,
+                "params": jax.tree.map(np.asarray, best_params),
+            }, f)
+        meta = {"epoch": it, "best_loss": float(best_loss), "best_it": best_it,
+                **histories}
+        if tracker is not None:
+            meta.update(tracker.as_dict())
+        with open(os.path.join(save_dir, "training_meta_data_and_hyper_parameters.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+        to_np = lambda t: jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, jnp.ndarray) else x, t)
+        with open(os.path.join(save_dir, "trainer_checkpoint.pkl"), "wb") as f:
+            pickle.dump({
+                "epoch": it,
+                "params": to_np(params),
+                "best_params": to_np(best_params),
+                "accepted": to_np(accepted),
+                "optA_state": to_np(optA_state),
+                "optB_state": to_np(optB_state),
+                "histories": histories,
+                "best_it": best_it,
+                "best_loss": float(best_loss),
+                "aligned": aligned,
+                "tracker_state": None if tracker is None else dict(tracker.__dict__),
+            }, f)

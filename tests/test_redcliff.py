@@ -1,0 +1,266 @@
+"""REDCLIFF-S core tests: forward modes, GC readout modes, loss terms, training
+phases, freeze choreography, and an end-to-end multi-factor recovery run."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from redcliff_tpu.data import synthetic as S
+from redcliff_tpu.data.datasets import train_val_split
+from redcliff_tpu.models.redcliff import (GC_EST_MODES, RedcliffSCMLP,
+                                          RedcliffSCMLPConfig)
+from redcliff_tpu.train.redcliff_trainer import (RedcliffTrainConfig,
+                                                 RedcliffTrainer)
+
+
+def _cfg(**kw):
+    base = dict(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(12,), num_factors=3, num_supervised_factors=2,
+        forecast_coeff=1.0, factor_score_coeff=1.0, factor_cos_sim_coeff=0.1,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.01,
+        use_sigmoid_restriction=True,
+        primary_gc_est_mode="conditional_factor_fixed_embedder",
+        forward_pass_mode="apply_factor_weights_at_each_sim_step",
+        num_sims=2, training_mode="combined",
+        factor_score_embedder_type="cEmbedder",
+    )
+    base.update(kw)
+    return RedcliffSCMLPConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = _cfg()
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_forward_stepwise_shapes(model_and_params):
+    model, params = model_and_params
+    cfg = model.config
+    B = 3
+    X = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.max_lag, cfg.num_chans))
+    x_sims, factor_preds, fw, labels = model.forward(params, X)
+    assert x_sims.shape == (B, cfg.num_sims, cfg.num_chans)
+    assert len(factor_preds) == cfg.num_sims
+    assert factor_preds[0].shape == (cfg.num_factors, B, 1, cfg.num_chans)
+    assert fw[0].shape == (B, cfg.num_factors)
+    assert len(labels) == cfg.num_sims
+
+
+def test_forward_post_weighted_shapes():
+    cfg = _cfg(forward_pass_mode="apply_factor_weights_after_sim_completion")
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    B = 3
+    X = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.max_lag, cfg.num_chans))
+    x_sims, _, fw, labels = model.forward(params, X)
+    assert x_sims.shape == (B, cfg.num_sims, cfg.num_chans)
+    assert len(fw) == 1 and fw[0].shape == (B, cfg.num_factors)
+    # post-weighted mode replicates the single logit set across sims
+    assert len(labels) == cfg.num_sims
+
+
+def test_forward_mixture_is_weighted_sum(model_and_params):
+    """combined prediction must equal sum_k w_k * factor_k prediction."""
+    model, params = model_and_params
+    cfg = model.config
+    X = jax.random.normal(jax.random.PRNGKey(2), (2, cfg.max_lag, cfg.num_chans))
+    x_sims, factor_preds, fw, _ = model.forward(params, X)
+    manual = np.einsum("bk,kbtc->btc", np.asarray(fw[0]), np.asarray(factor_preds[0]))
+    np.testing.assert_allclose(np.asarray(x_sims[:, :1, :]), manual, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", [m for m in GC_EST_MODES])
+def test_all_gc_modes_shapes(mode):
+    cfg = _cfg()
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    B, K, C = 2, cfg.num_factors, cfg.num_chans
+    X = jax.random.normal(jax.random.PRNGKey(3), (B, cfg.max_lag, cfg.num_chans))
+    G = model.gc(params, mode, X=X, ignore_lag=False)
+    G = np.asarray(G)
+    if mode == "fixed_factor_exclusive":
+        assert G.shape == (1, K, C, C, cfg.gen_lag)
+    elif mode == "raw_embedder":
+        assert G.shape[:2] == (1, 1) and G.shape[2] == K
+    elif mode == "fixed_embedder_exclusive":
+        assert G.shape[:4] == (1, 1, C, C)
+    elif "conditional" in mode:
+        assert G.shape[0] == B
+    else:
+        assert G.shape[0] == 1
+    assert np.all(np.isfinite(G))
+
+
+def test_gc_lag_clipping_in_combo_modes():
+    cfg = _cfg()
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jax.random.normal(jax.random.PRNGKey(3), (2, cfg.max_lag, cfg.num_chans))
+    G = model.gc(params, "conditional_factor_fixed_embedder", X=X, ignore_lag=False)
+    # lag axis clipped to min(gen_lag, embed_lag) (ref redcliff_s_cmlp.py:558,575)
+    assert G.shape[-1] == min(cfg.gen_lag, cfg.embed_lag)
+
+
+def test_loss_parts_and_phases(model_and_params):
+    model, params = model_and_params
+    cfg = model.config
+    B, T = 4, cfg.max_lag + cfg.num_sims
+    X = jax.random.normal(jax.random.PRNGKey(4), (B, T, cfg.num_chans))
+    Y = jax.random.uniform(jax.random.PRNGKey(5), (B, cfg.num_supervised_factors + 1, 1))
+    combo, parts = model.loss_for_phase(params, X, Y, "combined")
+    assert jnp.isfinite(combo)
+    for key in ("forecasting_loss", "factor_loss", "factor_cos_sim_penalty",
+                "fw_l1_penalty", "adj_l1_penalty"):
+        assert jnp.isfinite(parts[key]), key
+    # embedder-pretrain loss excludes forecasting
+    combo_e, parts_e = model.loss_for_phase(params, X, Y, "embedder_pretrain")
+    np.testing.assert_allclose(
+        np.asarray(combo_e),
+        np.asarray(parts_e["factor_loss"] + parts_e["fw_l1_penalty"]
+                   + parts_e["fw_smoothing_penalty"]), rtol=1e-6)
+    # factor-pretrain loss excludes the supervised factor term
+    combo_f, parts_f = model.loss_for_phase(params, X, Y, "factor_pretrain")
+    np.testing.assert_allclose(
+        np.asarray(combo_f),
+        np.asarray(parts_f["forecasting_loss"] + parts_f["fw_l1_penalty"]
+                   + parts_f["fw_smoothing_penalty"] + parts_f["adj_l1_penalty"]
+                   + parts_f["factor_cos_sim_penalty"]), rtol=1e-6)
+
+
+def test_label_shape_dispatch(model_and_params):
+    model, params = model_and_params
+    cfg = model.config
+    B, T = 4, cfg.max_lag + cfg.num_sims
+    X = jax.random.normal(jax.random.PRNGKey(6), (B, T, cfg.num_chans))
+    S_ = cfg.num_supervised_factors
+    # (B, S, T_long) oracle traces
+    Y3 = jax.random.uniform(jax.random.PRNGKey(7), (B, S_ + 1, cfg.max_lag + 5))
+    c3, _ = model.loss_for_phase(params, X, Y3, "combined")
+    # (B, S, 1) static labels
+    Y1 = jax.random.uniform(jax.random.PRNGKey(8), (B, S_ + 1, 1))
+    c1, _ = model.loss_for_phase(params, X, Y1, "combined")
+    # (B, S) DREAM4-orig labels
+    Y2 = jax.random.uniform(jax.random.PRNGKey(9), (B, S_ + 1))
+    c2, _ = model.loss_for_phase(params, X, Y2, "combined")
+    assert all(jnp.isfinite(v) for v in (c3, c1, c2))
+
+
+def test_smoothing_penalty_active_only_in_smooth_variant():
+    X = jax.random.normal(jax.random.PRNGKey(10), (4, 8, 4))
+    Y = jax.random.uniform(jax.random.PRNGKey(11), (4, 3, 1))
+    base = RedcliffSCMLP(_cfg(num_sims=3))
+    p = base.init(jax.random.PRNGKey(0))
+    _, parts = base.loss_for_phase(p, X, Y, "combined")
+    assert float(parts["fw_smoothing_penalty"]) == 0.0
+    smooth = RedcliffSCMLP(_cfg(num_sims=3, factor_weight_smoothing_penalty_coeff=0.5))
+    _, parts_s = smooth.loss_for_phase(p, X, Y, "combined")
+    assert float(parts_s["fw_smoothing_penalty"]) >= 0.0
+
+
+def test_phase_schedule():
+    cfg = _cfg(training_mode="pretrain_embedder_and_pretrain_factor_then_combined",
+               num_pretrain_epochs=2)
+    trainer = RedcliffTrainer(RedcliffSCMLP(cfg), RedcliffTrainConfig(max_iter=5))
+    assert trainer.phase_for_epoch(0) == ("embedder_pretrain", "factor_pretrain")
+    assert trainer.phase_for_epoch(1) == ("embedder_pretrain", "factor_pretrain")
+    assert trainer.phase_for_epoch(2) == ("combined",)
+    cfg2 = _cfg(training_mode="pretrain_embedder_then_acclimate_factors_then_combined",
+                num_pretrain_epochs=1, num_acclimation_epochs=2)
+    t2 = RedcliffTrainer(RedcliffSCMLP(cfg2), RedcliffTrainConfig(max_iter=5))
+    assert t2.phase_for_epoch(0) == ("embedder_pretrain",)
+    assert t2.phase_for_epoch(1) == ("factor_pretrain",)
+    assert t2.phase_for_epoch(2) == ("factor_pretrain",)
+    assert t2.phase_for_epoch(3) == ("combined",)
+    cfg3 = _cfg(training_mode="pretrain_embedder_then_post_train_factor",
+                num_pretrain_epochs=1)
+    t3 = RedcliffTrainer(RedcliffSCMLP(cfg3), RedcliffTrainConfig(max_iter=5))
+    assert t3.phase_for_epoch(1) == ("post_train",)
+
+
+def test_permute_factors_roundtrip(model_and_params):
+    model, params = model_and_params
+    g_before = np.asarray(model.factor_gc(params))
+    permuted = model.permute_factors(params, [2, 0, 1])
+    g_after = np.asarray(model.factor_gc(permuted))
+    np.testing.assert_allclose(g_after[0], g_before[2])
+    np.testing.assert_allclose(g_after[1], g_before[0])
+
+
+def test_freeze_swap_accept_and_revert():
+    cfg = _cfg(training_mode="pretrain_embedder_then_post_train_factor_withL1FreezeByEpoch",
+               num_pretrain_epochs=1)
+    model = RedcliffSCMLP(cfg)
+    trainer = RedcliffTrainer(model, RedcliffTrainConfig())
+    accepted = model.init(jax.random.PRNGKey(0))
+    # the decision compares L1 of max-normalized GC estimates: sparsify factor 0
+    # (normalized L1 drops -> accept) and flatten factor 1 to all-equal weights
+    # (normalized L1 becomes maximal -> revert)
+    candidate = jax.tree.map(lambda x: x, accepted)
+    w = candidate["factors"][0]["w"]  # (K, C_out, H, C_in, L)
+    w = w.at[0, :, :, : w.shape[3] // 2, :].set(0.0)
+    w = w.at[1].set(jnp.ones_like(w[1]))
+    candidate["factors"][0] = dict(candidate["factors"][0], w=w)
+    new_cand, new_acc = trainer._apply_freeze(candidate, accepted)
+    # factor 0: candidate kept (accepted updated to candidate's shrunk weights)
+    np.testing.assert_allclose(np.asarray(new_acc["factors"][0]["w"][0]),
+                               np.asarray(candidate["factors"][0]["w"][0]))
+    # factor 1: candidate reverted to accepted
+    np.testing.assert_allclose(np.asarray(new_cand["factors"][0]["w"][1]),
+                               np.asarray(accepted["factors"][0]["w"][1]))
+
+
+@pytest.fixture(scope="module")
+def two_state_data():
+    D = 4
+    p = S.reference_curation_params(D)
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=D, num_lags=2, num_factors=2, make_factors_orthogonal=True,
+        make_factors_singular_components=False, rand_seed=21,
+        off_diag_edge_strengths=p["off_diag_edge_strengths"],
+        diag_receiving_node_forgetting_coeffs=p["diag_receiving_node_forgetting_coeffs"],
+        diag_sending_node_forgetting_coeffs=p["diag_sending_node_forgetting_coeffs"],
+        num_edges_per_graph=4,
+    )
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(42), graphs, acts, p["base_freqs"], p["noise_mu"],
+        p["noise_var"], p["innovation_amp"], num_samples=192,
+        recording_length=30, burnin_period=10, num_labeled_sys_states=2,
+        label_type="Oracle", noise_type="gaussian", noise_amp=0.0,
+    )
+    return graphs, X, Y
+
+
+def test_redcliff_end_to_end_training(two_state_data, tmp_path):
+    graphs, X, Y = two_state_data
+    D = X.shape[2]
+    train_ds, val_ds = train_val_split(X, Y, val_fraction=0.2,
+                                       rng=np.random.default_rng(0))
+    cfg = RedcliffSCMLPConfig(
+        num_chans=D, gen_lag=2, gen_hidden=(12,), embed_lag=4,
+        embed_hidden_sizes=(16,), num_factors=2, num_supervised_factors=2,
+        forecast_coeff=1.0, factor_score_coeff=2.0, factor_cos_sim_coeff=0.05,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        use_sigmoid_restriction=True, factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive",
+        forward_pass_mode="apply_factor_weights_at_each_sim_step", num_sims=1,
+        training_mode="pretrain_embedder_and_pretrain_factor_then_combined",
+        num_pretrain_epochs=2,
+    )
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    trainer = RedcliffTrainer(model, RedcliffTrainConfig(
+        embed_lr=2e-3, gen_lr=5e-3, max_iter=15, batch_size=64, check_every=5,
+        seed=0))
+    res = trainer.fit(params, train_ds, val_ds, true_GC=graphs,
+                      save_dir=str(tmp_path / "redcliff_run"))
+    fl = res.histories["avg_forecasting_loss"]
+    assert fl[-1] < fl[0] * 1.05
+    assert np.isfinite(res.final_val_loss)
+    assert len(res.tracker.f1score_histories[0.0][0]) == len(fl)
+    # confusion-matrix histories populated in combined epochs
+    assert len(res.histories["factor_score_val_acc_history"]) > 0
+    assert (tmp_path / "redcliff_run" / "final_best_model.bin").exists()
