@@ -1,0 +1,122 @@
+"""Benchmark: REDCLIFF-S grid-training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value        — training-window throughput (windows/sec/chip) of the vmapped
+               hyperparameter-grid REDCLIFF-S train step (G grid points trained
+               simultaneously — this framework's execution model).
+vs_baseline  — speedup over the reference's execution pattern on the SAME chip:
+               one jit'd train step per grid point, stepped sequentially
+               (the SLURM-array one-process-per-point pattern of
+               ref train/REDCLIFF_S_CMLP_d4IC_BSCgs1.py:66-108, with each
+               point's compute already tensorized — i.e. this understates the
+               true advantage over the reference's per-factor Python loops).
+
+The reference repository publishes no benchmark numbers (BASELINE.md), so the
+sequential-vs-grid ratio on identical hardware is the honest comparable.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    # D4IC-like shapes: 10 channels, gen_lag 4, embed_lag 16 (ref cached args)
+    cfg = RedcliffSCMLPConfig(
+        num_chans=10, gen_lag=4, gen_hidden=(32,), embed_lag=16,
+        embed_hidden_sizes=(0,), num_factors=5, num_supervised_factors=5,
+        factor_score_coeff=2.0, factor_cos_sim_coeff=0.05,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_score_embedder_type="DGCNN", dgcnn_num_graph_conv_layers=3,
+        dgcnn_num_hidden_nodes=100,
+        primary_gc_est_mode="conditional_factor_fixed_embedder",
+        num_sims=2, training_mode="combined",
+    )
+    model = RedcliffSCMLP(cfg)
+    G = 16
+    B = 64
+    steps = 30
+    spec = GridSpec(points=[
+        {"gen_lr": 1e-3 * (1 + (i % 4)), "adj_l1_reg_coeff": 1e-3 * (i % 2),
+         "factor_cos_sim_coeff": 0.05 * (i % 3)}
+        for i in range(G)
+    ])
+    tc = RedcliffTrainConfig(batch_size=B)
+    runner = RedcliffGridRunner(model, tc, spec, mesh=None)
+
+    rng = np.random.default_rng(0)
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(B, cfg.num_supervised_factors, 1)).astype(np.float32)
+    Xd, Yd = jax.device_put(X), jax.device_put(Y)
+
+    params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
+    coeffs = runner.coeffs
+    step = runner._steps["combined"]
+
+    # --- grid-vmapped path ------------------------------------------------
+    p, a, b, _ = step(params, optA, optB, coeffs, Xd, Yd)  # compile
+    jax.block_until_ready(p)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, a, b, _ = step(p, a, b, coeffs, Xd, Yd)
+    jax.block_until_ready(p)
+    grid_time = time.perf_counter() - t0
+    grid_wps = G * B * steps / grid_time
+
+    # --- sequential per-point path (reference execution pattern) ----------
+    point_params = jax.tree.map(lambda x: x[0], params)
+    point_optA = jax.tree.map(lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optA)
+    point_optB = jax.tree.map(lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optB)
+    point_coeffs = {k: v[0] for k, v in coeffs.items()}
+
+    import optax
+
+    def single_step(params, a_state, b_state, coeffs, X, Y):
+        def loss_fn(pp):
+            return model.loss_for_phase(pp, X, Y, "combined", coeffs=coeffs,
+                                        need_gc=True, need_gc_lagged=True)
+        (_, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updA, a_state = runner.optA.update(grads["embedder"], a_state)
+        updB, b_state = runner.optB.update(grads["factors"], b_state)
+        params = dict(
+            params,
+            embedder=optax.apply_updates(
+                params["embedder"],
+                jax.tree.map(lambda u: -coeffs["embed_lr"] * u, updA)),
+            factors=optax.apply_updates(
+                params["factors"],
+                jax.tree.map(lambda u: -coeffs["gen_lr"] * u, updB)),
+        )
+        return params, a_state, b_state
+
+    sstep = jax.jit(single_step)
+    pp, aa, bb = sstep(point_params, point_optA, point_optB, point_coeffs, Xd, Yd)
+    jax.block_until_ready(pp)
+    seq_steps = max(steps // 3, 5)
+    t0 = time.perf_counter()
+    for _ in range(seq_steps):
+        for _ in range(G):  # one sequential step per grid point, like a job array
+            pp, aa, bb = sstep(pp, aa, bb, point_coeffs, Xd, Yd)
+    jax.block_until_ready(pp)
+    seq_time = time.perf_counter() - t0
+    seq_wps = G * B * seq_steps / seq_time
+
+    print(json.dumps({
+        "metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
+        "value": round(grid_wps, 1),
+        "unit": "windows/s/chip",
+        "vs_baseline": round(grid_wps / seq_wps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -114,8 +114,12 @@ class CMLPFM:
         return combo, {"forecasting_loss": forecasting, "adj_l1_penalty": adj_l1}
 
     def apply_prox(self, params, lam, lr, penalty="GL"):
-        """Optional GISTA prox on the first-layer block (ref cmlp.py:117-144)."""
-        new_w = prox_mod.prox_update(params["factor"][0]["w"], lam, lr, penalty)
+        """Optional GISTA prox on the first-layer block (ref cmlp.py:117-144).
+        GL dispatches through the fused Pallas TPU kernel (jnp fallback off-TPU
+        and for GSGL/H)."""
+        from redcliff_tpu.ops.pallas_prox import gl_prox
+
+        new_w = gl_prox(params["factor"][0]["w"], lam, lr, penalty)
         factor = [dict(params["factor"][0], w=new_w)] + list(params["factor"][1:])
         return dict(params, factor=factor)
 

@@ -29,7 +29,8 @@ from redcliff_tpu.models import cmlp as cmlp_mod
 from redcliff_tpu.models.embedders import build_embedder, CEmbedder, DGCNNEmbedder
 from redcliff_tpu.ops import losses as L
 
-__all__ = ["RedcliffSCMLPConfig", "RedcliffSCMLP", "TRAINING_MODES", "GC_EST_MODES"]
+__all__ = ["RedcliffSCMLPConfig", "RedcliffSCMLP", "TRAINING_MODES", "GC_EST_MODES",
+           "phase_schedule"]
 
 TRAINING_MODES = (
     "pretrain_embedder_then_acclimate_factors_then_combined",
@@ -60,6 +61,36 @@ FORWARD_PASS_MODES = (
     "apply_factor_weights_at_each_sim_step",
     "apply_factor_weights_after_sim_completion",
 )
+
+
+def phase_schedule(cfg, epoch):
+    """Epoch -> tuple of phase names under cfg.training_mode (ref batch_update
+    :696-714). Shared by the per-point trainer and the grid runner."""
+    mode = cfg.training_mode
+    if epoch <= cfg.num_pretrain_epochs - 1:
+        phases = []
+        if "pretrain_embedder" in mode:
+            phases.append("embedder_pretrain")
+        if "pretrain_factor" in mode:
+            phases.append("factor_pretrain")
+        return tuple(phases)
+    if ("acclimate_factors" in mode
+            and epoch <= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs - 1):
+        return ("factor_pretrain",)
+    if "combined" in mode:
+        return ("combined",)
+    if "post_train_factor" in mode:
+        return ("post_train",)
+    raise NotImplementedError(mode)
+
+
+def _smooth_on(coeff):
+    """True when the smoothing penalty should be computed: statically decidable
+    for concrete coefficients; always-on for traced (grid-axis) coefficients."""
+    try:
+        return float(coeff) > 0.0
+    except Exception:  # traced value — must include the term in the graph
+        return True
 
 
 @dataclass(frozen=True)
@@ -373,25 +404,39 @@ class RedcliffSCMLP:
     # -------------------------------------------------------------------- loss
     def compute_loss(self, params, conditioning_X, preds, targets, factor_scores,
                      factor_labels, gc_est_mode=None, embedder_pretrain_loss=False,
-                     factor_pretrain_loss=False):
+                     factor_pretrain_loss=False, coeffs=None, need_gc=None,
+                     need_gc_lagged=None):
         """Multi-term loss (ref :620-686 + smoothing variant :667-727).
 
         factor_scores: list (num_sims) of (B, n) state-label predictions.
         factor_labels: Y with shape (B, S, T) | (B, S, 1) | (B, S).
+        coeffs: optional dict of per-call coefficient overrides — may hold traced
+        scalars, which is how the grid runner vmaps one compiled step over a
+        hyperparameter axis. When traced coefficients are in play the static
+        need_gc/need_gc_lagged flags must be supplied by the caller (derived from
+        the grid's max coefficient).
         """
         cfg = self.config
         mode = gc_est_mode or cfg.primary_gc_est_mode
+
+        def C(name):
+            if coeffs is not None and name in coeffs:
+                return coeffs[name]
+            return getattr(cfg, name)
+
         # GC readouts feed only the cosine and adjacency penalties; skip them
         # entirely when the static coefficients are zero (XLA cannot eliminate
         # 0*x for floats, so guarding here removes real hot-path work)
-        need_gc = cfg.factor_cos_sim_coeff > 0.0
-        need_gc_lagged = cfg.adj_l1_reg_coeff > 0.0
+        if need_gc is None:
+            need_gc = _smooth_on(C("factor_cos_sim_coeff"))
+        if need_gc_lagged is None:
+            need_gc_lagged = _smooth_on(C("adj_l1_reg_coeff"))
         gc = (self.gc(params, mode, X=conditioning_X, threshold=False,
                       ignore_lag=True) if need_gc else None)
         gc_lagged = (self.gc(params, mode, X=conditioning_X, threshold=False,
                              ignore_lag=False) if need_gc_lagged else None)
 
-        forecasting_loss = cfg.forecast_coeff * L.channelwise_forecast_mse(preds, targets)
+        forecasting_loss = C("forecast_coeff") * L.channelwise_forecast_mse(preds, targets)
 
         factor_loss = jnp.array(0.0)
         S = cfg.num_supervised_factors
@@ -405,28 +450,28 @@ class RedcliffSCMLP:
                         if cfg.max_lag + l >= Y.shape[2]:
                             break
                         y = Y[:, :, cfg.max_lag + l]
-                        factor_loss = factor_loss + cfg.factor_score_coeff * jnp.mean(
+                        factor_loss = factor_loss + C("factor_score_coeff") * jnp.mean(
                             (yhat[:, :S] - y[:, :S]) ** 2)
                 else:
                     # static-label datasets (D4IC): average all sim scores
                     # (ref :635-641)
                     y = Y[:, :, 0]
                     yhat = sum(factor_scores) / float(len(factor_scores))
-                    factor_loss = factor_loss + cfg.factor_score_coeff * jnp.mean(
+                    factor_loss = factor_loss + C("factor_score_coeff") * jnp.mean(
                         (yhat[:, :S] - y[:, :S]) ** 2)
             elif Y.ndim == 2:
                 y = Y
                 yhat = sum(factor_scores) / float(len(factor_scores))
-                factor_loss = factor_loss + cfg.factor_score_coeff * jnp.mean(
+                factor_loss = factor_loss + C("factor_score_coeff") * jnp.mean(
                     (yhat[:, :S] - y[:, :S]) ** 2)
             else:
                 raise NotImplementedError(f"labels with ndim {Y.ndim}")
 
-        fw_l1_penalty = cfg.factor_weight_l1_coeff * L.factor_weight_l1(factor_scores[0])
+        fw_l1_penalty = C("factor_weight_l1_coeff") * L.factor_weight_l1(factor_scores[0])
 
         # smoothing penalty on factor scores across sim steps (Smooth variant)
         fw_smoothing_penalty = jnp.array(0.0)
-        if cfg.factor_weight_smoothing_penalty_coeff > 0.0 and cfg.num_sims >= 2:
+        if _smooth_on(C("factor_weight_smoothing_penalty_coeff")) and cfg.num_sims >= 2:
             if cfg.num_sims == 2:
                 diff = factor_scores[0] - factor_scores[1]
                 mask = jax.lax.stop_gradient(
@@ -444,19 +489,19 @@ class RedcliffSCMLP:
                         m10 = jax.lax.stop_gradient(jnp.abs(d10) > jnp.abs(full))
                         fw_smoothing_penalty = fw_smoothing_penalty + jnp.sum((d10 * m10) ** 2)
             fw_smoothing_penalty = (
-                cfg.factor_weight_smoothing_penalty_coeff * fw_smoothing_penalty)
+                C("factor_weight_smoothing_penalty_coeff") * fw_smoothing_penalty)
 
         # cosine-similarity penalty between factor graphs, summed over samples
         # (ref :657-670); lag axis of the unlagged readout is size 1
         factor_cos_sim_penalty = jnp.array(0.0)
         if need_gc and gc.shape[1] > 1:
             G2 = gc[..., 0] if gc.ndim == 5 else gc
-            factor_cos_sim_penalty = cfg.factor_cos_sim_coeff * jnp.sum(
+            factor_cos_sim_penalty = C("factor_cos_sim_coeff") * jnp.sum(
                 L.pairwise_cosine_penalty(G2, include_diag=False))
 
         adj_l1_penalty = jnp.array(0.0)
         if need_gc_lagged:
-            adj_l1_penalty = cfg.adj_l1_reg_coeff * L.lag_weighted_adjacency_l1(gc_lagged)
+            adj_l1_penalty = C("adj_l1_reg_coeff") * L.lag_weighted_adjacency_l1(gc_lagged)
 
         if embedder_pretrain_loss:
             assert not factor_pretrain_loss
@@ -478,7 +523,8 @@ class RedcliffSCMLP:
         }
         return combo, parts
 
-    def loss_for_phase(self, params, X, Y, phase):
+    def loss_for_phase(self, params, X, Y, phase, coeffs=None, need_gc=None,
+                       need_gc_lagged=None):
         """One batch's loss under a training phase (ref batch_update :689-890):
         phase in {'embedder_pretrain', 'factor_pretrain', 'combined', 'post_train'}.
         Factor-pretrain and post-train run the forward WITHOUT regenerating
@@ -494,6 +540,7 @@ class RedcliffSCMLP:
             params, conditioning, x_sims, targets, label_preds, Y,
             embedder_pretrain_loss=(phase == "embedder_pretrain"),
             factor_pretrain_loss=(phase in ("factor_pretrain", "post_train")),
+            coeffs=coeffs, need_gc=need_gc, need_gc_lagged=need_gc_lagged,
         )
 
     # -------------------------------------------------------- factor alignment

@@ -1,0 +1,293 @@
+"""Grid-search execution engine: many hyperparameter points as one sharded program.
+
+Replaces the reference's SLURM-array pattern (itertools.product over hparam
+lists + SLURM_ARRAY_TASK_ID, one process per grid point — ref
+train/REDCLIFF_S_CMLP_d4IC_BSCgs1.py:66-108) with a vmapped train step over a
+stacked parameter/coefficient axis, sharded across the device mesh. One TPU
+slice trains dozens of grid points concurrently; multi-host meshes extend the
+same axis over DCN.
+
+Shape-changing hyperparameters (hidden sizes, lags, factor counts) cannot share
+a compiled program; callers group points by shape and run one GridRun per group
+— the grouping helper below does this from a list of config dicts.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from redcliff_tpu.models.redcliff import phase_schedule
+from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
+
+__all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
+
+COEFF_AXES = (
+    "forecast_coeff", "factor_score_coeff", "factor_cos_sim_coeff",
+    "factor_weight_l1_coeff", "adj_l1_reg_coeff",
+    "factor_weight_smoothing_penalty_coeff",
+)
+OPT_AXES = ("embed_lr", "gen_lr", "embed_weight_decay", "gen_weight_decay")
+
+
+@dataclass
+class GridSpec:
+    """G hyperparameter points sharing one model shape. Each entry of ``points``
+    maps coefficient/optimizer axis names (COEFF_AXES + OPT_AXES) to floats;
+    unspecified axes fall back to the base config / train config values."""
+
+    points: Sequence[dict]
+
+    def stacked(self, base_cfg, train_cfg):
+        G = len(self.points)
+        out = {}
+        for name in COEFF_AXES:
+            out[name] = jnp.asarray(
+                [p.get(name, getattr(base_cfg, name)) for p in self.points],
+                dtype=jnp.float32)
+        for name in OPT_AXES:
+            out[name] = jnp.asarray(
+                [p.get(name, getattr(train_cfg, name)) for p in self.points],
+                dtype=jnp.float32)
+        return out
+
+    def needs_gc(self, base_cfg):
+        return any(p.get("factor_cos_sim_coeff", base_cfg.factor_cos_sim_coeff) > 0
+                   for p in self.points)
+
+    def needs_gc_lagged(self, base_cfg):
+        return any(p.get("adj_l1_reg_coeff", base_cfg.adj_l1_reg_coeff) > 0
+                   for p in self.points)
+
+
+@dataclass
+class GridResult:
+    best_params: Any          # pytree with leading G axis
+    best_criteria: np.ndarray  # (G,)
+    best_epoch: np.ndarray     # (G,)
+    val_history: np.ndarray    # (epochs, G) validation combo loss
+    coeffs: dict
+
+
+def group_configs_by_shape(config_dicts, shape_keys):
+    """Partition config dicts into shape-compatible groups (one compiled program
+    each). Returns {shape_tuple: [indices]}."""
+    groups = {}
+    for i, cd in enumerate(config_dicts):
+        key = tuple(cd.get(k) for k in shape_keys)
+        groups.setdefault(key, []).append(i)
+    return groups
+
+
+class RedcliffGridRunner:
+    """Trains G REDCLIFF-S configurations simultaneously.
+
+    The per-point training step is the same phase-scheduled two-optimizer update
+    as RedcliffTrainer, vmapped over (params, opt states, coefficients) with the
+    batch broadcast, then jit'd with the G axis sharded over the mesh. Optimizer
+    hyperparameters (lr, weight decay) vary per point by scaling raw
+    scale_by_adam updates with the per-point learning rate and adding coupled
+    weight decay to the gradients — torch.optim.Adam semantics
+    (ref model_utils.py:749-762).
+    """
+
+    def __init__(self, model, train_config, spec: GridSpec, mesh=None):
+        self.model = model
+        self.tc = train_config
+        self.spec = spec
+        self.mesh = mesh
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            if len(spec.points) % n_dev != 0:
+                raise ValueError(
+                    f"grid size {len(spec.points)} must be a multiple of the mesh "
+                    f"device count {n_dev} (pad the grid with duplicate points or "
+                    f"shrink the mesh)")
+        self.coeffs = spec.stacked(model.config, train_config)
+        self._need_gc = spec.needs_gc(model.config)
+        self._need_gc_lagged = spec.needs_gc_lagged(model.config)
+        # lr/eps handled per-point; scale_by_adam is shared
+        self.optA = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.embed_eps)
+        self.optB = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.gen_eps)
+        self._build()
+
+    # ------------------------------------------------------------------
+    def init_grid(self, key):
+        """G independently-seeded parameter sets, stacked on axis 0."""
+        G = len(self.spec.points)
+        keys = jax.random.split(key, G)
+        params = jax.vmap(self.model.init)(keys)
+        optA_state = jax.vmap(lambda p: self.optA.init(p["embedder"]))(params)
+        optB_state = jax.vmap(lambda p: self.optB.init(p["factors"]))(params)
+        return params, optA_state, optB_state
+
+    def _build(self):
+        model = self.model
+        need_gc, need_gc_lagged = self._need_gc, self._need_gc_lagged
+
+        def point_step(params, optA_state, optB_state, coeffs, X, Y, phase):
+            def loss_fn(p):
+                return model.loss_for_phase(
+                    p, X, Y, phase, coeffs=coeffs,
+                    need_gc=need_gc, need_gc_lagged=need_gc_lagged)
+
+            (combo, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+            def apply_group(group, grads_g, opt, opt_state, lr, wd):
+                g = jax.tree.map(lambda gr, pa: gr + wd * pa, grads_g, params[group])
+                upd, opt_state = opt.update(g, opt_state)
+                upd = jax.tree.map(lambda u: -lr * u, upd)
+                return optax.apply_updates(params[group], upd), opt_state
+
+            new = dict(params)
+            if phase in ("embedder_pretrain", "combined"):
+                new["embedder"], optA_state = apply_group(
+                    "embedder", grads["embedder"], self.optA, optA_state,
+                    coeffs["embed_lr"], coeffs["embed_weight_decay"])
+            if phase in ("factor_pretrain", "post_train", "combined"):
+                new["factors"], optB_state = apply_group(
+                    "factors", grads["factors"], self.optB, optB_state,
+                    coeffs["gen_lr"], coeffs["gen_weight_decay"])
+            return new, optA_state, optB_state, combo
+
+        def point_val(params, coeffs, X, Y):
+            combo, parts = model.loss_for_phase(
+                params, X, Y, "combined", coeffs=coeffs,
+                need_gc=need_gc, need_gc_lagged=need_gc_lagged)
+            # stopping criteria: factor + forecast terms with coefficients divided
+            # out (ref :1683-1703, :1466-1538)
+            f = parts["forecasting_loss"] / jnp.maximum(coeffs["forecast_coeff"], 1e-12)
+            fa = parts["factor_loss"] / jnp.maximum(coeffs["factor_score_coeff"], 1e-12)
+            return combo, f + fa
+
+        self._steps = {}
+        for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
+            vstep = jax.vmap(
+                lambda p, a, b, c, X, Y, ph=phase: point_step(p, a, b, c, X, Y, ph),
+                in_axes=(0, 0, 0, 0, None, None))
+            self._steps[phase] = jax.jit(vstep)
+        self._val = jax.jit(jax.vmap(point_val, in_axes=(0, 0, None, None)))
+
+        def select_best(best_params, best_crit, best_epoch, params, crit, epoch):
+            better = crit < best_crit
+            new_best = jax.tree.map(
+                lambda b, c: jnp.where(
+                    better.reshape((-1,) + (1,) * (c.ndim - 1)), c, b),
+                best_params, params)
+            return (new_best, jnp.where(better, crit, best_crit),
+                    jnp.where(better, epoch, best_epoch))
+
+        self._select_best = jax.jit(select_best)
+
+    # ------------------------------------------------------------------
+    def _shard(self, tree):
+        if self.mesh is None:
+            return tree
+        sh = shard_leading_axis(self.mesh)
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+    def phase_for_epoch(self, epoch):
+        return phase_schedule(self.model.config, epoch)
+
+    def _align_all_points(self, params, train_ds):
+        """Per-point Hungarian alignment of factors to supervised labels at the
+        pretrain->train transition (ref initialize_factors_with_prior :147-202),
+        vectorized: one vmapped forward gathers every point's first factor
+        weightings, then each point's permutation is solved on host and applied
+        as a per-point gather along the factor axis."""
+        cfg = self.model.config
+        tc = self.tc
+        G = len(self.spec.points)
+        preds, labels = [], []
+        fw_fn = jax.jit(jax.vmap(
+            lambda p, X: self.model.forward(p, X)[2][0], in_axes=(0, None)))
+        for b, (X, Y) in enumerate(train_ds.batches(tc.batch_size)):
+            if b >= tc.max_factor_prior_batches:
+                break
+            preds.append(np.asarray(fw_fn(params, jnp.asarray(X[:, : cfg.max_lag, :]))))
+            if Y.ndim == 3:
+                col = cfg.max_lag if Y.shape[2] > cfg.max_lag else 0
+                labels.append(np.asarray(Y[:, :, col]))
+            else:
+                labels.append(np.asarray(Y))
+        preds = np.concatenate(preds, axis=1)  # (G, N, K)
+        lab = np.vstack(labels)  # (N, S)
+        from redcliff_tpu.utils.misc import sort_unsupervised_estimates
+
+        K = cfg.num_factors
+        orders = np.zeros((G, K), dtype=np.int32)
+        for g in range(G):
+            est_series = [preds[g, :, i] for i in range(K)]
+            true_series = [lab[:, i] for i in range(lab.shape[1])]
+            _, m_est, m_gt = sort_unsupervised_estimates(
+                est_series, true_series, return_sorting_inds=True)
+            order = [None] * len(m_gt)
+            for e, t in zip(m_est, m_gt):
+                order[t] = e
+            chosen = [o for o in order if o is not None]
+            rest = [k for k in range(K) if k not in chosen]
+            orders[g] = np.array(chosen + rest, dtype=np.int32)[:K]
+        idx = jnp.asarray(orders)
+        factors = jax.tree.map(
+            lambda leaf: jnp.take_along_axis(
+                leaf, idx.reshape(idx.shape + (1,) * (leaf.ndim - 2)), axis=1),
+            params["factors"])
+        return dict(params, factors=factors)
+
+    def fit(self, key, train_ds, val_ds, max_iter=None) -> GridResult:
+        tc = self.tc
+        max_iter = max_iter if max_iter is not None else tc.max_iter
+        rng = np.random.default_rng(tc.seed)
+        params, optA_state, optB_state = self.init_grid(key)
+        coeffs = self._shard(self.coeffs)
+        params = self._shard(params)
+        optA_state = self._shard(optA_state)
+        optB_state = self._shard(optB_state)
+
+        G = len(self.spec.points)
+        best_crit = jnp.full((G,), jnp.inf)
+        best_epoch = jnp.zeros((G,), dtype=jnp.int32)
+        best_params = params
+        val_history = []
+        aligned = False
+        for it in range(max_iter):
+            cfg0 = self.model.config
+            if (not aligned and "pretrain_factor" in cfg0.training_mode
+                    and it == cfg0.num_pretrain_epochs
+                    and cfg0.num_supervised_factors > 0):
+                params = self._align_all_points(params, train_ds)
+                params = self._shard(params)
+                aligned = True
+            phases = self.phase_for_epoch(it)
+            for X, Y in train_ds.batches(tc.batch_size, rng=rng):
+                for phase in phases:
+                    params, optA_state, optB_state, _ = self._steps[phase](
+                        params, optA_state, optB_state, coeffs, X, Y)
+            combo_sum = 0.0
+            crit_sum = 0.0
+            n = 0
+            for X, Y in val_ds.batches(tc.batch_size):
+                combo, crit = self._val(params, coeffs, X, Y)
+                combo_sum = combo_sum + combo
+                crit_sum = crit_sum + crit
+                n += 1
+            val_history.append(np.asarray(combo_sum) / n)
+            cfg = self.model.config
+            if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
+                best_params, best_crit, best_epoch = self._select_best(
+                    best_params, best_crit, best_epoch, params, crit_sum / n,
+                    jnp.int32(it))
+            else:
+                best_params, best_epoch = params, jnp.full((G,), it, jnp.int32)
+
+        return GridResult(
+            best_params=best_params,
+            best_criteria=np.asarray(best_crit),
+            best_epoch=np.asarray(best_epoch),
+            val_history=np.stack(val_history),
+            coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
+        )

@@ -1,0 +1,32 @@
+"""Device-mesh helpers for grid / data parallelism.
+
+The reference's only scale-out mechanism is SLURM job arrays — one process per
+hyperparameter point, filesystem as the communication medium (SURVEY.md §2.8).
+Here the grid is a sharded array axis on a jax Mesh: grid points ride ICI within
+a slice, and the same code spans hosts over DCN via jax.distributed
+initialization (the mesh just gets bigger).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["grid_mesh", "shard_leading_axis", "replicated", "P", "Mesh"]
+
+
+def grid_mesh(n_devices=None, axis_name="grid", devices=None):
+    """1-D mesh over all (or the first n) devices for grid-axis sharding."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def shard_leading_axis(mesh, axis_name="grid"):
+    """NamedSharding that splits axis 0 across the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())

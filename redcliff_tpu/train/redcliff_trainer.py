@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from redcliff_tpu.models.redcliff import RedcliffSCMLP
+from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import sort_unsupervised_estimates
 
@@ -90,24 +90,8 @@ class RedcliffTrainer:
 
     # ------------------------------------------------------------------ phases
     def phase_for_epoch(self, epoch):
-        """Epoch -> phase name (ref batch_update :696-714)."""
-        cfg = self.model.config
-        mode = cfg.training_mode
-        if epoch <= cfg.num_pretrain_epochs - 1:
-            phases = []
-            if "pretrain_embedder" in mode:
-                phases.append("embedder_pretrain")
-            if "pretrain_factor" in mode:
-                phases.append("factor_pretrain")
-            return tuple(phases)
-        if ("acclimate_factors" in mode
-                and epoch <= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs - 1):
-            return ("factor_pretrain",)
-        if "combined" in mode:
-            return ("combined",)
-        if "post_train_factor" in mode:
-            return ("post_train",)
-        raise NotImplementedError(mode)
+        """Epoch -> phase names (shared schedule, ref batch_update :696-714)."""
+        return phase_schedule(self.model.config, epoch)
 
     def _build_steps(self):
         model = self.model
