@@ -1,0 +1,125 @@
+"""Tensorized cLSTM Granger-causal forecaster.
+
+The reference keeps one single-layer LSTM + 1x1-conv head per output series and
+loops over them in Python (ref models/clstm.py:12-112: ``nn.LSTM(num_series,
+hidden)`` per series, predictions concatenated). Here the C per-series LSTMs are
+one stacked weight block scanned over time:
+
+    w_ih: (S, 4H, C)   input->gate weights, torch gate order (i, f, g, o)
+    w_hh: (S, 4H, H)   hidden->gate weights
+    b:    (S, 4H)      merged input+hidden bias
+    head: w (S, H), b (S,)   the reference's Conv1d(hidden, 1, 1) readout
+
+The input projection for every series and timestep is one einsum hoisted out of
+the ``lax.scan`` (it has no sequential dependence), so the scan body is just the
+small recurrent matmul + gate math — the XLA-friendly shape of an LSTM.
+
+The Granger-causal readout is the column norm of ``w_ih`` over the gate axis
+(ref clstm.py:126-156: ``torch.norm(net.lstm.weight_ih_l0, dim=0)``), one
+reduction for all series at once; the proximal update soft-thresholds the same
+column groups (ref clstm.py:114-123).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from redcliff_tpu.models import cmlp as cmlp_mod
+from redcliff_tpu.ops.prox import soft_threshold_by_group_norm
+
+__all__ = [
+    "init_clstm_params",
+    "clstm_forward",
+    "clstm_gc",
+    "clstm_prox_update",
+]
+
+
+def init_clstm_params(key, num_series: int, hidden: int):
+    """Parameters for C per-series LSTMs as one batched pytree.
+
+    All LSTM weights/biases follow torch's LSTM default U(±1/sqrt(hidden)); the
+    head follows torch's Conv1d default U(±1/sqrt(fan_in=hidden)).
+    """
+    S = num_series
+    bound = 1.0 / math.sqrt(hidden)
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+
+    def u(k, shape, b):
+        return jax.random.uniform(k, shape, minval=-b, maxval=b)
+
+    return {
+        "w_ih": u(k1, (S, 4 * hidden, num_series), bound),
+        "w_hh": u(k2, (S, 4 * hidden, hidden), bound),
+        # torch keeps separate b_ih/b_hh, each U(±1/sqrt(H)); their sum enters
+        # the gates, so one merged bias drawn twice and summed is equivalent
+        "b": u(k3, (S, 4 * hidden), bound) + u(k4, (S, 4 * hidden), bound),
+        "head": {
+            "w": u(k5, (S, hidden), bound),
+            "b": u(jax.random.split(k5)[1], (S,), bound),
+        },
+    }
+
+
+def clstm_forward(params, X, hidden=None):
+    """Forward pass over every output series at once.
+
+    Args:
+      params: pytree from init_clstm_params (leading axes may be added by vmap).
+      X: (B, T, C) input signal.
+      hidden: optional (h, c) carry, each (B, S, H), to continue a sequence.
+    Returns:
+      (preds (B, T, S), (h, c)) matching the reference's concatenated per-net
+      outputs + hidden states (ref clstm.py:100-112).
+    """
+    w_ih, w_hh, b = params["w_ih"], params["w_hh"], params["b"]
+    S, H4, _ = w_ih.shape
+    H = H4 // 4
+    B = X.shape[0]
+
+    # input contributions for all series/timesteps at once: (T, B, S, 4H)
+    zx = jnp.einsum("btc,sgc->tbsg", X, w_ih) + b
+
+    if hidden is None:
+        h0 = jnp.zeros((B, S, H), dtype=X.dtype)
+        c0 = jnp.zeros((B, S, H), dtype=X.dtype)
+    else:
+        h0, c0 = hidden
+
+    def step(carry, zx_t):
+        h, c = carry
+        z = zx_t + jnp.einsum("bsh,sgh->bsg", h, w_hh)
+        zi, zf, zg, zo = jnp.split(z, 4, axis=-1)  # torch gate order i,f,g,o
+        c = jax.nn.sigmoid(zf) * c + jax.nn.sigmoid(zi) * jnp.tanh(zg)
+        h = jax.nn.sigmoid(zo) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), hs = jax.lax.scan(step, (h0, c0), zx)  # hs: (T, B, S, H)
+    preds = jnp.einsum("tbsh,sh->bts", hs, params["head"]["w"]) + params["head"]["b"]
+    return preds, (h, c)
+
+
+def clstm_gc(params, threshold=False, wavelet_mask=None, rank_wavelets=False,
+             num_chans=None, combine_wavelet_representations=False):
+    """Granger-causal readout: column norms of the input-hidden block over the
+    gate axis (ref clstm.py:126-156). Returns (C_out, C_in); entry (i, j) scores
+    series j driving series i."""
+    GC = jnp.sqrt(jnp.sum(params["w_ih"] ** 2, axis=1))
+    if rank_wavelets:
+        assert wavelet_mask is not None
+        GC = wavelet_mask * GC
+    if combine_wavelet_representations and num_chans is not None and GC.shape[0] != num_chans:
+        GC = cmlp_mod.condense_wavelet_gc(GC, num_chans)
+    if threshold:
+        return (GC > 0).astype(jnp.int32)
+    return GC
+
+
+def clstm_prox_update(params, lam, lr):
+    """Proximal group soft-threshold on the input-hidden columns
+    (ref clstm.py:114-123) — functional, one fused op for all series."""
+    W = params["w_ih"]
+    norm = jnp.sqrt(jnp.sum(W * W, axis=1, keepdims=True))
+    return dict(params, w_ih=soft_threshold_by_group_norm(W, norm, lam * lr))

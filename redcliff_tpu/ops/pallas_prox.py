@@ -73,7 +73,9 @@ def gl_prox_pallas(W1, lam, lr, block_rows=512, interpret=None):
 
 
 def gl_prox(W1, lam, lr, penalty="GL", use_pallas=True):
-    """Dispatch: Pallas kernel for GL on TPU, jnp fallback otherwise."""
-    if penalty == "GL" and use_pallas:
+    """Dispatch: Pallas kernel for GL on real TPU hardware; the fused jnp prox
+    everywhere else (interpret-mode Pallas is for kernel tests only — it would
+    run an emulated kernel inside every CPU/GPU train step)."""
+    if penalty == "GL" and use_pallas and jax.default_backend() == "tpu":
         return gl_prox_pallas(W1, lam, lr)
     return _jnp_prox_update(W1, lam, lr, penalty)

@@ -42,6 +42,15 @@ class GridSpec:
 
     points: Sequence[dict]
 
+    def __post_init__(self):
+        valid = set(COEFF_AXES) | set(OPT_AXES)
+        for i, p in enumerate(self.points):
+            unknown = set(p) - valid
+            if unknown:
+                raise ValueError(
+                    f"grid point {i} has unknown hyperparameter axes "
+                    f"{sorted(unknown)}; valid axes: {sorted(valid)}")
+
     def stacked(self, base_cfg, train_cfg):
         G = len(self.points)
         out = {}
@@ -216,21 +225,16 @@ class RedcliffGridRunner:
                 labels.append(np.asarray(Y))
         preds = np.concatenate(preds, axis=1)  # (G, N, K)
         lab = np.vstack(labels)  # (N, S)
-        from redcliff_tpu.utils.misc import sort_unsupervised_estimates
+        from redcliff_tpu.utils.misc import factor_alignment_order
 
         K = cfg.num_factors
         orders = np.zeros((G, K), dtype=np.int32)
         for g in range(G):
-            est_series = [preds[g, :, i] for i in range(K)]
-            true_series = [lab[:, i] for i in range(lab.shape[1])]
-            _, m_est, m_gt = sort_unsupervised_estimates(
-                est_series, true_series, return_sorting_inds=True)
-            order = [None] * len(m_gt)
-            for e, t in zip(m_est, m_gt):
-                order[t] = e
-            chosen = [o for o in order if o is not None]
-            rest = [k for k in range(K) if k not in chosen]
-            orders[g] = np.array(chosen + rest, dtype=np.int32)[:K]
+            orders[g] = np.asarray(
+                factor_alignment_order(
+                    preds[g], lab, K,
+                    unsupervised_start_index=tc.unsupervised_start_index),
+                dtype=np.int32)
         idx = jnp.asarray(orders)
         factors = jax.tree.map(
             lambda leaf: jnp.take_along_axis(
@@ -275,6 +279,10 @@ class RedcliffGridRunner:
                 combo_sum = combo_sum + combo
                 crit_sum = crit_sum + crit
                 n += 1
+            if n == 0:
+                raise ValueError(
+                    "validation dataset yielded no batches — increase "
+                    "val_fraction or dataset size")
             val_history.append(np.asarray(combo_sum) / n)
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:

@@ -30,7 +30,7 @@ import optax
 
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.train.tracking import GCProgressTracker
-from redcliff_tpu.utils.misc import sort_unsupervised_estimates
+from redcliff_tpu.utils.misc import factor_alignment_order
 
 __all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
 
@@ -197,21 +197,10 @@ class RedcliffTrainer:
                 labels.append(np.asarray(Y))
         preds = np.vstack(preds)
         labels = np.vstack(labels)
-        est_series = [preds[:, i] for i in range(preds.shape[1])]
-        true_series = [labels[:, i] for i in range(labels.shape[1])]
-        usi = tc.unsupervised_start_index
-        _, matched_est, matched_gt = sort_unsupervised_estimates(
-            est_series, true_series, unsupervised_start_index=usi,
-            return_sorting_inds=True)
-        K = cfg.num_factors
-        tail = list(range(usi, K))
-        order_tail = [None] * len(matched_gt)
-        for e, g in zip(matched_est, matched_gt):
-            order_tail[g] = tail[e]
-        unmatched = [tail[i] for i in range(len(tail)) if i not in list(matched_est)]
-        order = list(range(usi)) + [o for o in order_tail if o is not None] + unmatched
-        order = order + [k for k in range(K) if k not in order]
-        return self.model.permute_factors(params, order[:K])
+        order = factor_alignment_order(
+            preds, labels, cfg.num_factors,
+            unsupervised_start_index=tc.unsupervised_start_index)
+        return self.model.permute_factors(params, order)
 
     # --------------------------------------------------------------------- fit
     def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,

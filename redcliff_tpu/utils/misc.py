@@ -20,6 +20,7 @@ __all__ = [
     "mask_diag_elements",
     "place_on_zero_to_one_scale",
     "sort_unsupervised_estimates",
+    "factor_alignment_order",
     "get_avg_cosine_similarity_between_combos",
     "get_topk_graph_mask",
     "get_preds_from_masked_normalized_matrix",
@@ -88,6 +89,35 @@ def sort_unsupervised_estimates(
     if return_sorting_inds:
         return result, matched_est, matched_true
     return result
+
+
+def factor_alignment_order(preds, labels, num_factors, unsupervised_start_index=0):
+    """Permutation of range(num_factors) aligning factor indices to supervised
+    labels via Hungarian assignment on the predicted factor-weighting series
+    (ref redcliff_s_cmlp.py:147-202 initialize_factors_with_prior).
+
+    preds: (N, K) factor-weighting predictions; labels: (N, S) label traces.
+    Factors before unsupervised_start_index keep their position. The matched-slot
+    list is sized by the LABEL count (S may exceed the match count when labels
+    carry more columns than factors), so no index can overflow it.
+    """
+    preds = np.asarray(preds)
+    labels = np.asarray(labels)
+    usi = unsupervised_start_index
+    est_series = [preds[:, i] for i in range(preds.shape[1])]
+    true_series = [labels[:, i] for i in range(labels.shape[1])]
+    _, matched_est, matched_gt = sort_unsupervised_estimates(
+        est_series, true_series, unsupervised_start_index=usi,
+        return_sorting_inds=True)
+    K = num_factors
+    tail = list(range(usi, K))
+    order_tail = [None] * (len(true_series) - usi)
+    for e, g in zip(matched_est, matched_gt):
+        order_tail[g] = tail[e]
+    unmatched = [tail[i] for i in range(len(tail)) if i not in list(matched_est)]
+    order = list(range(usi)) + [o for o in order_tail if o is not None] + unmatched
+    order = order + [k for k in range(K) if k not in order]
+    return order[:K]
 
 
 def get_avg_cosine_similarity_between_combos(elements):
