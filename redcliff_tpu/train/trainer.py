@@ -87,14 +87,18 @@ class Trainer:
     def _build_steps(self):
         model, cfg = self.model, self.config
         use_labels = self.has_labels
+        wants_rng = bool(getattr(model, "wants_rng", False))
 
-        def loss_fn(params, X, Y):
+        def loss_fn(params, X, Y, rng):
             if use_labels:
                 return model.loss(params, X, Y)
+            if wants_rng:
+                return model.loss(params, X, rng=rng)
             return model.loss(params, X)
 
-        def train_step(params, opt_state, X, Y):
-            (combo, parts), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, X, Y)
+        def train_step(params, opt_state, X, Y, rng):
+            (combo, parts), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, X, Y, rng)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if cfg.prox_penalty is not None:
@@ -103,8 +107,9 @@ class Trainer:
             return params, opt_state, combo, parts
 
         def eval_step(params, X, Y):
-            return loss_fn(params, X, Y)
+            return loss_fn(params, X, Y, None)
 
+        self._wants_rng = wants_rng
         self._train_step = jax.jit(train_step)
         self._eval_step = jax.jit(eval_step)
 
@@ -133,9 +138,13 @@ class Trainer:
         out["combo_loss"] = combo_sum / n
         return out
 
-    def _epoch_gc_tracking(self, params, tracker, true_GC):
-        ests = [np.asarray(g) for g in self.model.gc(params, ignore_lag=False)]
-        ests_nolag = [np.asarray(g) for g in self.model.gc(params, ignore_lag=True)]
+    def _epoch_gc_tracking(self, params, tracker, true_GC, track_X=None):
+        if getattr(self.model, "gc_requires_data", False):
+            kw = {"X": track_X}
+        else:
+            kw = {}
+        ests = [np.asarray(g) for g in self.model.gc(params, ignore_lag=False, **kw)]
+        ests_nolag = [np.asarray(g) for g in self.model.gc(params, ignore_lag=True, **kw)]
         tracker.update(true_GC, [ests], est_by_sample_lagsummed=[ests_nolag])
 
     def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
@@ -175,14 +184,29 @@ class Trainer:
             if tracker is not None and ck.get("tracker_state") is not None:
                 tracker.__dict__.update(ck["tracker_state"])
 
+        track_X = None
+        if tracker is not None and getattr(self.model, "gc_requires_data", False):
+            # data-dependent GC estimates (e.g. NAVAR contribution stds) are
+            # tracked on the first validation batch, like the reference's
+            # per-epoch eval (ref redcliff_s_cmlp.py:1403)
+            for X, _ in val_ds.batches(cfg.batch_size):
+                track_X = jnp.asarray(X)
+                break
+
+        step_key = jax.random.PRNGKey(cfg.seed) if self._wants_rng else None
+        step_counter = 0
         last_it = iter_start - 1
         for it in range(iter_start, cfg.max_iter):
             last_it = it
             for X, Y in train_ds.batches(cfg.batch_size, rng=rng):
-                params, opt_state, _, _ = self._train_step(params, opt_state, X, Y)
+                step_rng = (jax.random.fold_in(step_key, step_counter)
+                            if self._wants_rng else None)
+                step_counter += 1
+                params, opt_state, _, _ = self._train_step(params, opt_state, X, Y,
+                                                           step_rng)
 
             if tracker is not None:
-                self._epoch_gc_tracking(params, tracker, true_GC)
+                self._epoch_gc_tracking(params, tracker, true_GC, track_X)
 
             val = self.validate(params, val_ds)
             histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))

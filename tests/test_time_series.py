@@ -1,0 +1,196 @@
+"""Signal-processing layer: SWT tight-frame properties, triangular packing,
+CSD/directed-spectrum features, Wilson factorization, filters, outliers."""
+import numpy as np
+import pytest
+
+from redcliff_tpu.utils import time_series as TS
+from redcliff_tpu.utils.directed_spectrum import get_directed_spectrum, wilson_factorize
+
+
+# --------------------------------------------------------------- wavelets
+
+def test_swt_is_tight_frame_and_invertible():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(3, 64))
+    for wavelet in ("db1", "db2", "db4"):
+        bands = TS.swt(x, wavelet, level=3)
+        assert len(bands) == 4
+        energy = sum(np.sum(b ** 2) for b in bands)
+        np.testing.assert_allclose(energy, np.sum(x ** 2), rtol=1e-10)
+        np.testing.assert_allclose(TS.iswt(bands, wavelet), x, atol=1e-10)
+
+
+def test_swt_haar_additive_reconstruction_exact():
+    """For haar the band sum reconstructs the signal exactly — the property the
+    reference's 'additive' approximation relies on (ref time_series.py:29-43)."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 32))
+    bands = TS.swt(x, "haar", level=2)
+    np.testing.assert_allclose(sum(bands), x, atol=1e-12)
+
+
+def test_swt_shift_invariance():
+    """Stationarity: decomposing a circularly shifted signal equals shifting the
+    decomposition (the property DWT lacks and SWT provides)."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(16,))
+    b1 = TS.swt(np.roll(x, 5), "db2", level=2)
+    b2 = [np.roll(b, 5) for b in TS.swt(x, "db2", level=2)]
+    for a, b in zip(b1, b2):
+        np.testing.assert_allclose(a, b, atol=1e-10)
+
+
+def test_perform_wavelet_decomposition_layout_and_approx():
+    rng = np.random.default_rng(3)
+    sig = rng.normal(size=(1, 64, 3))
+    level = 2
+    out = TS.perform_wavelet_decomposition(sig, "haar", level, "swt")
+    assert out.shape == (1, 64, 3 * (level + 1))
+    # channel c's bands occupy columns [c*(level+1), (c+1)*(level+1))
+    approx = TS.construct_signal_approx_from_wavelet_coeffs(out, level)
+    np.testing.assert_allclose(approx, sig[0], atol=1e-10)
+    with pytest.raises(NotImplementedError):
+        TS.perform_wavelet_decomposition(sig, "haar", level, "wavedec")
+
+
+# ---------------------------------------------------- triangular packing
+
+def test_triangular_squeeze_unsqueeze_roundtrip():
+    rng = np.random.default_rng(4)
+    n = 5
+    sym = rng.normal(size=(2, n, n, 7))
+    sym = sym + np.swapaxes(sym, 1, 2)
+    packed = TS.squeeze_triangular_array(sym, dims=(1, 2))
+    assert packed.shape == (2, n * (n + 1) // 2, 7)
+    # condensed layout: entry (i, j<=i) at i(i+1)/2 + j
+    np.testing.assert_allclose(packed[:, 0], sym[:, 0, 0])
+    np.testing.assert_allclose(packed[:, 2], sym[:, 1, 1])
+    np.testing.assert_allclose(packed[:, 4], sym[:, 2, 1])
+    restored = TS.unsqueeze_triangular_array(packed, dim=1)
+    np.testing.assert_allclose(restored, sym)
+
+
+# ------------------------------------------------------ spectral features
+
+def _coupled_ar_windows(rng, W=4, T=2048, coupling=0.9):
+    """2-channel AR process where channel 0 drives channel 1."""
+    X = np.zeros((W, 2, T))
+    for w in range(W):
+        e = rng.normal(size=(2, T))
+        for t in range(2, T):
+            X[w, 0, t] = 0.55 * X[w, 0, t - 1] - 0.8 * X[w, 0, t - 2] + e[0, t]
+            X[w, 1, t] = coupling * X[w, 0, t - 1] + 0.2 * X[w, 1, t - 1] + e[1, t]
+    return X
+
+
+def test_wilson_factorization_reconstructs_cpsd():
+    from scipy.signal import csd
+
+    rng = np.random.default_rng(5)
+    X = _coupled_ar_windows(rng, W=2, T=4096)
+    params = dict(TS.DEFAULT_CSD_PARAMS, nperseg=256, noverlap=128)
+    f, cpsd = csd(X[:, np.newaxis], X[:, :, np.newaxis], fs=1000,
+                  return_onesided=False, **params)
+    cpsd = np.moveaxis(cpsd, 3, 1)
+    H, Sigma = wilson_factorize(cpsd, max_iter=1000, tol=1e-7)
+    recon = H @ Sigma[:, None] @ H.conj().swapaxes(-1, -2)
+    err = np.abs(recon - cpsd).max() / np.abs(cpsd).max()
+    assert err < 1e-4, f"factorization residual {err}"
+
+
+def test_directed_spectrum_identifies_direction():
+    rng = np.random.default_rng(6)
+    X = _coupled_ar_windows(rng, W=3, T=4096)
+    f, ds = get_directed_spectrum(X, fs=1000,
+                                  csd_params={"nperseg": 256, "noverlap": 128})
+    assert ds.shape[2:] == (2, 2)
+    # channel 0 drives channel 1: ds[0 -> 1] must dominate ds[1 -> 0]
+    fwd = ds[:, :, 0, 1].sum()
+    bwd = ds[:, :, 1, 0].sum()
+    assert fwd > 3.0 * bwd, f"forward {fwd} not >> backward {bwd}"
+
+
+def test_make_high_level_signal_features_shapes_and_nan():
+    rng = np.random.default_rng(7)
+    T, C = 1024, 3
+    X = rng.normal(size=(T, C))
+    res = TS.make_high_level_signal_features(X, fs=1000, max_freq=55.0,
+                                             directed_spectrum=True)
+    Fn = len(res["freq"])
+    assert res["power"].shape == (1, C * (C + 1) // 2, Fn)
+    assert res["dir_spec"].shape == (1, C, C, Fn)
+    assert np.all(np.isfinite(res["power"]))
+    assert np.all(res["freq"] < 55.0)
+    # a NaN anywhere marks the whole window's features NaN (ref :177-190)
+    Xn = X.copy()
+    Xn[5, 0] = np.nan
+    res_n = TS.make_high_level_signal_features(Xn, fs=1000,
+                                               rng=np.random.default_rng(0))
+    assert np.all(np.isnan(res_n["power"]))
+
+
+# ----------------------------------------------------------------- filters
+
+def test_bandpass_filter_attenuates_out_of_band():
+    fs = 1000.0
+    t = np.arange(4096) / fs
+    in_band = np.sin(2 * np.pi * 40.0 * t)
+    out_band = np.sin(2 * np.pi * 5.0 * t)
+    y_in = TS.filter_signal(in_band, fs, filter_type="bandpass",
+                            apply_notch_filters=False)
+    y_out = TS.filter_signal(out_band, fs, filter_type="bandpass",
+                             apply_notch_filters=False)
+    assert np.std(y_in[500:]) > 10 * np.std(y_out[500:])
+
+
+def test_notch_filter_removes_line_noise():
+    fs = 1000.0
+    t = np.arange(8192) / fs
+    line = np.sin(2 * np.pi * 60.0 * t)
+    y = TS.filter_signal(line, fs, filter_type="lowpass", cutoff=100.0,
+                         apply_notch_filters=True)
+    assert np.std(y[2000:]) < 0.25 * np.std(line)
+
+
+def test_filters_preserve_nan_mask():
+    fs = 1000.0
+    x = np.sin(np.arange(2048) / 10.0)
+    x[100:110] = np.nan
+    y = TS.filter_signal(x, fs, filter_type="lowpass")
+    assert np.all(np.isnan(y[100:110]))
+    assert np.isfinite(y[:100]).all()
+
+
+def test_mark_outliers_flags_artifacts():
+    rng = np.random.default_rng(8)
+    fs = 1000.0
+    t = np.arange(8192) / fs
+    clean = np.sin(2 * np.pi * 40.0 * t) + 0.1 * rng.normal(size=t.size)
+    sig = clean.copy()
+    sig[4000:4010] += 50.0  # artifact inside the passband
+    marked = TS.mark_outliers({"roi": sig}, fs)["roi"]
+    # the causal Butterworth's group delay shifts the flagged region a few
+    # tens of samples past the artifact (same with the reference's lfilter)
+    assert np.isnan(marked[4000:4060]).any()
+    assert np.isfinite(marked[:3000]).all()
+
+
+# ------------------------------------------------------------ window draws
+
+def test_draw_timesteps_avoids_nans():
+    rng = np.random.default_rng(9)
+    nan_locs = [50, 51, 52]
+    starts = TS.draw_timesteps_to_sample_from(
+        0, 200, window_size=10, num_samples=20, nan_locations=nan_locs, rng=rng)
+    for s in starts:
+        assert not any(s <= loc <= s + 10 for loc in nan_locs)
+
+
+def test_draw_timesteps_with_label_reference():
+    rng = np.random.default_rng(10)
+    labels = np.zeros(300, dtype=int)
+    labels[100:200] = 1
+    starts = TS.draw_timesteps_to_sample_from_using_label_reference(
+        labels, window_size=20, num_samples=10, nan_locations=[], rng=rng)
+    for s in starts:
+        assert labels[s: s + 20].sum() == 20
