@@ -1,0 +1,17 @@
+"""tidybench — score-based causal-discovery baselines (Table 2 stack).
+
+TPU-framework equivalents of /root/reference/tidybench/: SLARAC, QRBS and
+LASAR in vectorized numpy, and SELVAR with a native C++ core (the reference's
+only in-repo native component was selvarF.f, Fortran 77 + LAPACK).
+
+All algorithms take a (T timepoints × N variables) array and return an N×N
+score matrix whose (i, j) entry scores the link X_i → X_j, and accept the
+common pre/post-processing switches documented in
+redcliff_tpu.tidybench.utils.common_pre_post_processing.
+"""
+from redcliff_tpu.tidybench.lasar import lasar
+from redcliff_tpu.tidybench.qrbs import qrbs
+from redcliff_tpu.tidybench.selvar import gtcoef, gtstat, selvar, slvar
+from redcliff_tpu.tidybench.slarac import slarac
+
+__all__ = ["slarac", "qrbs", "lasar", "selvar", "slvar", "gtcoef", "gtstat"]

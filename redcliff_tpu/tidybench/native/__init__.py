@@ -1,0 +1,122 @@
+"""Native (C++) core for SELVAR — build-on-demand via g++, loaded with ctypes.
+
+The reference shipped its native component as Fortran 77 compiled through
+``f2py -llapack`` (/root/reference/tidybench/selvar.py:8-10). Here the
+equivalent C++ (selvar.cpp) is compiled once into a shared library next to
+this file and bound with ctypes, so the framework needs no build step at
+install time and no LAPACK.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "selvar.cpp")
+_LIB = os.path.join(_DIR, "libselvar.so")
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _compile():
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++14", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def load_native():
+    """Return the bound ctypes library, building it if needed; None if the
+    toolchain is unavailable (callers fall back to the numpy implementation)."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or (
+                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _compile()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.CalledProcessError) as e:  # no g++ / bad build
+            _build_error = e
+            return None
+
+        dptr = ctypes.POINTER(ctypes.c_double)
+        iptr = ctypes.POINTER(ctypes.c_int)
+        lib.selvar_slvar.restype = ctypes.c_int
+        lib.selvar_slvar.argtypes = [ctypes.c_int, ctypes.c_int, dptr,
+                                     ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                     dptr, iptr]
+        lib.selvar_gtcoef.restype = ctypes.c_int
+        lib.selvar_gtcoef.argtypes = [ctypes.c_int, ctypes.c_int, dptr,
+                                      ctypes.c_int, ctypes.c_int, iptr,
+                                      ctypes.c_int, ctypes.c_int, dptr]
+        lib.selvar_gtrss.restype = ctypes.c_double
+        lib.selvar_gtrss.argtypes = [ctypes.c_int, ctypes.c_int, dptr,
+                                     ctypes.c_int, ctypes.c_int, iptr,
+                                     ctypes.c_int]
+        lib.selvar_gtstat.restype = ctypes.c_int
+        lib.selvar_gtstat.argtypes = [ctypes.c_int, ctypes.c_int, dptr,
+                                      ctypes.c_int, ctypes.c_int, iptr,
+                                      ctypes.c_int, dptr, iptr]
+        _lib = lib
+        return _lib
+
+
+def _as_c(X):
+    return np.ascontiguousarray(X, dtype=np.float64)
+
+
+def slvar_native(X, batchsize, maxlags, mxitr):
+    """(scores, lags, info) via the C++ core, or None if it cannot be built."""
+    lib = load_native()
+    if lib is None:
+        return None
+    X = _as_c(X)
+    T, N = X.shape
+    B = np.zeros((N, N), dtype=np.float64)
+    A = np.zeros((N, N), dtype=np.int32)
+    dptr = ctypes.POINTER(ctypes.c_double)
+    iptr = ctypes.POINTER(ctypes.c_int)
+    info = lib.selvar_slvar(T, N, X.ctypes.data_as(dptr), int(batchsize),
+                            int(maxlags), int(mxitr),
+                            B.ctypes.data_as(dptr), A.ctypes.data_as(iptr))
+    return B, A, info
+
+
+def gtcoef_native(X, maxlags, batchsize, A, job="ABS", nrm=0):
+    lib = load_native()
+    if lib is None:
+        return None
+    X = _as_c(X)
+    T, N = X.shape
+    A = np.ascontiguousarray(A, dtype=np.int32)
+    B = np.zeros((N, N), dtype=np.float64)
+    jobcode = {"RAW": 0, "ABS": 1, "SQR": 2}[job]
+    dptr = ctypes.POINTER(ctypes.c_double)
+    iptr = ctypes.POINTER(ctypes.c_int)
+    lib.selvar_gtcoef(T, N, X.ctypes.data_as(dptr), int(maxlags),
+                      int(batchsize), A.ctypes.data_as(iptr), jobcode,
+                      int(nrm), B.ctypes.data_as(dptr))
+    return B
+
+
+def gtstat_native(X, maxlags, batchsize, A, job="DF"):
+    lib = load_native()
+    if lib is None:
+        return None
+    X = _as_c(X)
+    T, N = X.shape
+    A = np.ascontiguousarray(A, dtype=np.int32)
+    B = np.zeros((N, N), dtype=np.float64)
+    DF = np.zeros((N, 2), dtype=np.int32)
+    jobcode = {"DF": 0, "LR": 1, "FS": 2}[job]
+    dptr = ctypes.POINTER(ctypes.c_double)
+    iptr = ctypes.POINTER(ctypes.c_int)
+    lib.selvar_gtstat(T, N, X.ctypes.data_as(dptr), int(maxlags),
+                      int(batchsize), A.ctypes.data_as(iptr), jobcode,
+                      B.ctypes.data_as(dptr), DF.ctypes.data_as(iptr))
+    return B, DF
