@@ -15,9 +15,14 @@
 //
 // Where the Fortran ran LAPACK DGELS (QR least squares) + DORGQR (explicit Q
 // for leverages), this uses normal equations with a Cholesky factorization:
-// beta = (D'D)^-1 D'y and leverage h_t = d_t' (D'D)^-1 d_t — the same
-// quantities for full-rank designs, with no LAPACK link dependency. Singular
-// designs score as -1 (infeasible) instead of returning a partial score.
+// beta = (D'D)^-1 D'y and leverage h_t = d_t' (D'D)^-1 d_t. Deliberate
+// divergence: the Fortran called DORGQR with M=NV, forming only the first NV
+// rows of Q, then read all BS rows of the workspace (selvarF.f:193-204) — so
+// its h_t for rows beyond NV were Householder-workspace remnants, not
+// leverages. This implementation computes the true PRESS leverage for every
+// row; selected structures can therefore differ from the Fortran's on
+// borderline candidates (in favor of the correct statistic). Singular designs
+// score as -1 (infeasible) instead of returning a partial score.
 //
 // Matrix conventions: X is row-major (T, N); A and B are row-major (N, N) with
 // A[i*N + j] = the lag of edge i -> j (0 = edge absent).
@@ -91,6 +96,8 @@ Design active_set(const int* A, int N, int j) {
 // adaptive max-lag grows. bs is therefore in-out here too.
 int clamp_bs(int* bs, int T, int ML) {
   if (*bs < 0) *bs = (T - ML) / (-*bs);
+  if (*bs == 0) *bs = T - ML;  // guard: the Fortran documented but never
+                               // handled BS == 0 (integer division SIGFPE)
   if (*bs > T - ML) *bs = T - ML;
   return *bs;
 }
@@ -224,6 +231,9 @@ int selvar_gtcoef(int T, int N, const double* X, int ML, int BS, const int* A,
 // Mean residual sum of squares for target j (GTRSS equivalent).
 double selvar_gtrss(int T, int N, const double* X, int ML, int BS,
                     const int* A, int j) {
+  // guard for direct callers: a lag in A larger than ML would index before
+  // the series start (no-op when the caller already raised ML, as gtstat does
+  // before computing its NF/BS normalization)
   for (int idx = 0; idx < N * N; ++idx) ML = std::max(ML, A[idx]);
   ML = clamp_ml(ML, T);
   clamp_bs(&BS, T, ML);
@@ -242,8 +252,10 @@ double selvar_gtrss(int T, int N, const double* X, int ML, int BS,
 // likelihood ratio, 2 = F statistic. DF is (N, 2) row-major.
 int selvar_gtstat(int T, int N, const double* X, int ML, int BS, int* A,
                   int job, double* B, int* DF) {
-  if (ML < 1)
-    for (int idx = 0; idx < N * N; ++idx) ML = std::max(ML, A[idx]);
+  // one consistent lag ceiling for the whole statistic: at least every lag in
+  // A (a smaller explicit ML would index before the series start), inferred
+  // entirely from A when ML < 1 as in the Fortran
+  for (int idx = 0; idx < N * N; ++idx) ML = std::max(ML, A[idx]);
   ML = clamp_ml(ML, T);
   clamp_bs(&BS, T, ML);
   int NF = (T - ML) / BS;

@@ -11,6 +11,13 @@ assignments A[i, j] ∈ {0..maxlags} (0 = no edge), scored by the leave-one-out
 PRESS statistic Σ_t (e_t / (1 − h_t))² accumulated over batches of consecutive
 time points; report batch-averaged absolute OLS coefficients of the selected
 model as edge scores.
+
+Deliberate divergence from the Fortran: its DORGQR call formed only the first
+NV rows of Q yet read all BS workspace rows as leverages (selvarF.f:193-204),
+so most rows' h_t were Householder remnants; both backends here compute the
+true PRESS leverage h_t = d_tᵀ(DᵀD)⁻¹d_t for every row, which can select
+different structures on borderline candidates (in favor of the correct
+statistic).
 """
 from __future__ import annotations
 
@@ -34,6 +41,8 @@ def _clamp_bs(bs_box, T, ml):
     one-element list emulating that in-out argument."""
     if bs_box[0] < 0:
         bs_box[0] = (T - ml) // (-bs_box[0])
+    if bs_box[0] == 0:  # documented-but-unhandled case in the Fortran
+        bs_box[0] = T - ml
     if bs_box[0] > T - ml:
         bs_box[0] = T - ml
     return bs_box[0]
@@ -109,6 +118,8 @@ def _gtcoef_np(X, ml, bs, A, job="ABS", nrm=0):
 
 def _gtrss_np(X, ml, bs, A, j):
     T, N = X.shape
+    # guard for direct callers (no-op when the caller already raised ml, as
+    # the gtstat frontend does before computing its nf/bs normalization)
     ml = max(ml, int(np.max(A)) if np.size(A) else 0)
     ml = _clamp_ml(ml, T)
     bs_box = [bs]
@@ -210,7 +221,9 @@ def gtstat(data, A, maxlags=-1, batchsize=-1, job="DF", backend="auto"):
         if backend == "native":
             raise RuntimeError("native SELVAR library could not be built")
     T, N = X.shape
-    ml = int(A.max()) if maxlags < 1 else maxlags
+    # one consistent lag ceiling for the whole statistic: at least every lag
+    # in A (a smaller explicit maxlags would index before the series start)
+    ml = max(maxlags, int(A.max()) if A.size else 0)
     ml = _clamp_ml(ml, T)
     bs_box = [batchsize]
     bs = _clamp_bs(bs_box, T, ml)

@@ -33,17 +33,24 @@ def _lagged_design(data, maxlags):
     return data[maxlags:], np.concatenate(blocks, axis=1)
 
 
-def _var_abs_coeffs(Y, Z, N, maxlags, rng, bootstrap_rows=None):
+def _var_abs_coeffs(Y, Z, N, maxlags, rng, bootstrap_rows=None,
+                    missing_values=None):
     """One (optionally bootstrapped) VAR fit → (N, 1+L·N) coefficient matrix.
 
     Matches the reference's quirks deliberately: a feasibility heuristic caps
     the lag when the sample is short, a random *effective* lag ≤ max(maxlags,
     feasible) is drawn per fit, and only the first ``1 + efflag·N`` design
     columns enter the regression (the rest of the coefficient row stays 0).
+    Rows containing the ``missing_values`` sentinel in either target or design
+    are dropped after subsampling, as in the reference.
     """
     if bootstrap_rows is not None:
         idx = rng.integers(0, Y.shape[0], size=bootstrap_rows)
         Y, Z = Y[idx], Z[idx]
+    if missing_values is not None:
+        keep = ~(np.any(Y == missing_values, axis=1)
+                 | np.any(Z == missing_values, axis=1))
+        Y, Z = Y[keep], Z[keep]
     rows, cols = Z.shape[0], Z.shape[1]
     feasible = maxlags
     if rows / cols < INV_GOLDEN_RATIO:
@@ -59,10 +66,11 @@ def _var_abs_coeffs(Y, Z, N, maxlags, rng, bootstrap_rows=None):
 
 @common_pre_post_processing
 def slarac(data, maxlags=1, n_subsamples=200, subsample_sizes=_DEFAULT_FRACTIONS,
-           aggregate_lags=None, rng=None):
+           missing_values=None, aggregate_lags=None, rng=None):
     """Score lagged links of a linear VAR via subsampled absolute coefficients.
 
-    Parameters mirror the reference; ``aggregate_lags`` maps the
+    Parameters mirror the reference; ``missing_values`` marks a sentinel whose
+    rows are excluded from each fit; ``aggregate_lags`` maps the
     (N_to, maxlags, N_from) lag-resolved score stack to N×N (default: max over
     lags, transposed so (i, j) reads X_i → X_j). ``rng`` is a numpy Generator
     (or seed) for the subsample draws.
@@ -74,11 +82,14 @@ def slarac(data, maxlags=1, n_subsamples=200, subsample_sizes=_DEFAULT_FRACTIONS
     T, N = data.shape
     Y, Z = _lagged_design(data, maxlags)
 
-    scores = np.abs(_var_abs_coeffs(Y, Z, N, maxlags, rng))
+    scores = np.abs(_var_abs_coeffs(Y, Z, N, maxlags, rng,
+                                    missing_values=missing_values))
     fractions = rng.choice(np.asarray(subsample_sizes), size=n_subsamples)
     for frac in fractions:
         rows = int(np.round(frac * T))
-        scores += np.abs(_var_abs_coeffs(Y, Z, N, maxlags, rng, bootstrap_rows=rows))
+        scores += np.abs(_var_abs_coeffs(Y, Z, N, maxlags, rng,
+                                         bootstrap_rows=rows,
+                                         missing_values=missing_values))
 
     scores = scores[:, 1:] / (n_subsamples + 1)  # drop intercepts, average
     return aggregate_lags(scores.reshape(N, maxlags, N))
