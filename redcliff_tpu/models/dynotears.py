@@ -329,7 +329,12 @@ class DynotearsModel:
                 best_loss, best_it = cur, it
                 best_state = DynotearsState(**vars(self.state))
                 best_shape = (self.d_vars, self.p_orders, self.n)
-            elif (it - best_it) == num_iters_prior_to_stop:
+            elif best_it is not None and \
+                    (it - best_it) == num_iters_prior_to_stop:
+                break
+            elif best_it is None and it + 1 >= num_iters_prior_to_stop:
+                # validation objective never became finite (NaN data or a
+                # diverged fit): stop instead of crashing on best_it - None
                 break
             if save_dir is not None and it % check_every == 0:
                 self.save_checkpoint(save_dir, it, val_history, best_loss,

@@ -48,9 +48,12 @@ def _var_abs_coeffs(Y, Z, N, maxlags, rng, bootstrap_rows=None,
         idx = rng.integers(0, Y.shape[0], size=bootstrap_rows)
         Y, Z = Y[idx], Z[idx]
     if missing_values is not None:
-        keep = ~(np.any(Y == missing_values, axis=1)
-                 | np.any(Z == missing_values, axis=1))
-        Y, Z = Y[keep], Z[keep]
+        if isinstance(missing_values, float) and np.isnan(missing_values):
+            bad = np.any(np.isnan(Y), axis=1) | np.any(np.isnan(Z), axis=1)
+        else:
+            bad = (np.any(Y == missing_values, axis=1)
+                   | np.any(Z == missing_values, axis=1))
+        Y, Z = Y[~bad], Z[~bad]
     rows, cols = Z.shape[0], Z.shape[1]
     feasible = maxlags
     if rows / cols < INV_GOLDEN_RATIO:

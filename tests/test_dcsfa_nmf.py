@@ -1,0 +1,188 @@
+"""Tests for the dCSFA-NMF family (ref models/dcsfa_nmf.py,
+dcsfa_nmf_vanillaDirSpec.py)."""
+import numpy as np
+import jax
+import pytest
+
+from redcliff_tpu.models.dcsfa_nmf import (
+    DcsfaNmf,
+    DcsfaNmfConfig,
+    FullDCSFAModel,
+    mann_whitney_auc,
+    nmf_fit,
+    nndsvd_init,
+)
+from redcliff_tpu.utils.misc import flatten_directed_spectrum_features
+
+
+def _lowrank_nonneg(rng, n=80, d=30, k=3):
+    W = rng.uniform(0.0, 1.0, size=(n, k))
+    H = rng.uniform(0.0, 1.0, size=(k, d))
+    return (W @ H).astype(np.float32)
+
+
+def test_nndsvd_nonnegative():
+    rng = np.random.default_rng(0)
+    X = _lowrank_nonneg(rng)
+    W, H = nndsvd_init(X, 3)
+    assert (W >= 0).all() and (H >= 0).all()
+    assert W.shape == (80, 3) and H.shape == (3, 30)
+
+
+def test_nmf_fit_reduces_error():
+    rng = np.random.default_rng(1)
+    X = _lowrank_nonneg(rng) + 0.01
+    S0, H0 = nmf_fit(X, 3, max_iter=0)
+    S, H = nmf_fit(X, 3, max_iter=200)
+    err0 = np.mean((X - S0 @ H0) ** 2)
+    err = np.mean((X - S @ H) ** 2)
+    assert (S >= 0).all() and (H >= 0).all()
+    assert err < err0
+    assert err < 1e-2 * np.mean(X**2)
+
+
+def test_nmf_fit_is_loss_runs():
+    rng = np.random.default_rng(2)
+    X = _lowrank_nonneg(rng) + 0.05
+    S, H = nmf_fit(X, 3, max_iter=50, loss="IS")
+    assert np.isfinite(S).all() and np.isfinite(H).all()
+    assert (S >= 0).all() and (H >= 0).all()
+
+
+def test_mann_whitney_auc_matches_scipy():
+    from scipy.stats import mannwhitneyu
+
+    rng = np.random.default_rng(3)
+    pos = rng.normal(1.0, 1.0, size=40)
+    neg = rng.normal(0.0, 1.0, size=55)
+    U, _ = mannwhitneyu(pos, neg)
+    expected = U / (len(pos) * len(neg))
+    assert mann_whitney_auc(pos, neg) == pytest.approx(expected)
+
+
+def test_mann_whitney_auc_separable():
+    assert mann_whitney_auc([3.0, 4.0], [1.0, 2.0]) == 1.0
+    assert mann_whitney_auc([1.0, 2.0], [3.0, 4.0]) == 0.0
+
+
+def _toy_supervised(rng, n=120, d=24, n_sup=2):
+    """Two supervised latent factors, each driving a disjoint feature block
+    and a binary label."""
+    y = (rng.uniform(size=(n, n_sup)) > 0.5).astype(np.float32)
+    scores = y * rng.uniform(1.0, 2.0, size=(n, n_sup)) + 0.05
+    basis = np.zeros((n_sup, d), dtype=np.float32)
+    basis[0, : d // 2] = rng.uniform(0.5, 1.0, size=d // 2)
+    basis[1, d // 2 :] = rng.uniform(0.5, 1.0, size=d - d // 2)
+    X = scores @ basis + 0.01 * rng.uniform(size=(n, d)).astype(np.float32)
+    return X.astype(np.float32), y
+
+
+def test_dcsfa_fit_learns_labels():
+    rng = np.random.default_rng(4)
+    X, y = _toy_supervised(rng)
+    cfg = DcsfaNmfConfig(n_components=4, n_sup_networks=2, h=16,
+                         use_deep_encoder=True, lr=1e-2)
+    model = DcsfaNmf(cfg)
+    params, state, hist = model.fit(
+        jax.random.PRNGKey(0), X, y, n_epochs=40, n_pre_epochs=10,
+        nmf_max_iter=50, batch_size=32)
+    aucs = model.score(params, state, X, y)
+    assert aucs.shape == (2,)
+    assert np.mean(aucs) > 0.8
+    assert len(hist["training"]) == 40
+    # training loss should drop
+    assert hist["training"][-1] < hist["training"][0]
+
+
+def test_dcsfa_validation_checkpointing():
+    rng = np.random.default_rng(5)
+    X, y = _toy_supervised(rng)
+    cfg = DcsfaNmfConfig(n_components=3, n_sup_networks=2, h=8)
+    model = DcsfaNmf(cfg)
+    params, state, hist = model.fit(
+        jax.random.PRNGKey(1), X[:90], y[:90], X_val=X[90:], y_val=y[90:],
+        n_epochs=8, n_pre_epochs=2, nmf_max_iter=20, batch_size=32)
+    assert "best_epoch" in hist and 0 <= hist["best_epoch"] < 8
+    assert len(hist["val_recon"]) == 8
+
+
+def test_dcsfa_linear_encoder_and_transform_shapes():
+    rng = np.random.default_rng(6)
+    X, y = _toy_supervised(rng, n=60)
+    cfg = DcsfaNmfConfig(n_components=3, n_sup_networks=2,
+                         use_deep_encoder=False)
+    model = DcsfaNmf(cfg)
+    params, state, _ = model.fit(jax.random.PRNGKey(2), X, y, n_epochs=3,
+                                 n_pre_epochs=1, nmf_max_iter=10,
+                                 batch_size=16)
+    X_recon, y_pred, s = model.transform(params, state, X)
+    assert X_recon.shape == X.shape
+    assert y_pred.shape == (60, 2)
+    assert s.shape == (60, 3)
+    assert (s >= 0).all()
+    preds = model.predict(params, state, X)
+    assert preds.dtype == bool and preds.shape == (60, 2)
+
+
+def test_fixed_corr_constraints():
+    cfg = DcsfaNmfConfig(n_components=3, n_sup_networks=2,
+                         fixed_corr=("positive", "negative"))
+    model = DcsfaNmf(cfg)
+    params, _ = model.init(jax.random.PRNGKey(0), 10)
+    phi = np.asarray(model.get_phi(params))
+    assert phi[0] > 0 and phi[1] < 0
+    with pytest.raises(ValueError):
+        DcsfaNmfConfig(n_sup_networks=1, fixed_corr="bogus")
+
+
+def test_full_dcsfa_gc_dirspec_layout():
+    n_nodes, F = 3, 4
+    model = FullDCSFAModel(num_nodes=n_nodes, num_high_level_node_features=F,
+                           n_components=2, n_sup_networks=1, h=8)
+    params, state = model.init(jax.random.PRNGKey(0), model.dim_in)
+    graphs = model.gc(params, threshold=False)
+    assert len(graphs) == 2
+    assert graphs[0].shape == (n_nodes, n_nodes)
+    assert (graphs[0] >= 0).all()
+    binary = model.gc(params, threshold=True)
+    assert set(np.unique(binary[0])).issubset({0, 1})
+
+
+def test_full_dcsfa_gc_recovers_planted_tensor():
+    """A W_nmf row built by flattening a known dirspec tensor must unflatten
+    back to (elementwise square, summed over features) of that tensor."""
+    n_nodes, F = 3, 2
+    rng = np.random.default_rng(7)
+    planted = rng.uniform(0.1, 1.0, size=(n_nodes, n_nodes, F))
+    flat = flatten_directed_spectrum_features(planted)  # (n, F*(2n-1))
+    model = FullDCSFAModel(num_nodes=n_nodes, num_high_level_node_features=F,
+                           n_components=1, n_sup_networks=1, h=8)
+    gc = model.get_factor_gc(flat.reshape(1, -1), threshold=False,
+                             ignore_features=True)
+    np.testing.assert_allclose(gc, (planted**2).sum(axis=2), rtol=1e-6)
+
+
+def test_full_dcsfa_vanilla_layout():
+    n_nodes, F = 4, 3
+    model = FullDCSFAModel(num_nodes=n_nodes, num_high_level_node_features=F,
+                           gc_feature_layout="vanilla", n_components=2,
+                           n_sup_networks=1, h=8)
+    assert model.dim_in == n_nodes * n_nodes * F
+    vec = np.arange(model.dim_in, dtype=np.float32)
+    gc = model.get_factor_gc(vec, threshold=False, ignore_features=True)
+    expected = (vec.reshape(n_nodes, n_nodes, F) ** 2).sum(axis=2)
+    np.testing.assert_allclose(gc, expected, rtol=1e-6)
+
+
+def test_full_dcsfa_evaluate_summary():
+    n_nodes, F = 3, 2
+    rng = np.random.default_rng(8)
+    model = FullDCSFAModel(num_nodes=n_nodes, num_high_level_node_features=F,
+                           n_components=2, n_sup_networks=1, h=8)
+    params, state = model.init(jax.random.PRNGKey(1), model.dim_in)
+    X = rng.uniform(size=(20, model.dim_in)).astype(np.float32)
+    y = (rng.uniform(size=(20, 1)) > 0.5).astype(np.float32)
+    GC_true = [rng.uniform(size=(n_nodes, n_nodes))]
+    summary = model.evaluate(params, state, X, y, GC_true)
+    assert {"gc_mse", "recon_mse", "score_mse"} <= set(summary)
+    assert np.isfinite(summary["recon_mse"])
