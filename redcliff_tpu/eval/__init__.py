@@ -1,0 +1,40 @@
+"""Evaluation layer: graph statistics, causal distances, GC-estimate
+dispatch, cross-algorithm comparison, and grid-search selection
+(rebuilds /root/reference/evaluate/, SURVEY.md §2.7)."""
+from .causal_distances import ancestor_aid, oset_aid, parent_aid, shd
+from .cross_alg import (
+    ALL_POSSIBLE_ALGORITHMS,
+    evaluate_algorithm_on_fold,
+    find_run_directory,
+    run_cross_algorithm_comparison,
+)
+from .gc_estimates import get_model_gc_estimates, get_model_gc_score_estimates
+from .grid_selection import (
+    average_factor_histories,
+    filter_incomplete_runs,
+    load_grid_summaries,
+    rank_runs,
+    select_best_models,
+)
+from .model_io import load_artifact, load_model_for_eval
+from .stats import (
+    compute_fixed_f1_stats,
+    compute_graph_comparison_stats,
+    compute_key_stats,
+    compute_optimal_f1_stats,
+    summarize_values,
+    three_view_optimal_f1_stats,
+)
+
+__all__ = [
+    "ancestor_aid", "oset_aid", "parent_aid", "shd",
+    "ALL_POSSIBLE_ALGORITHMS", "evaluate_algorithm_on_fold",
+    "find_run_directory", "run_cross_algorithm_comparison",
+    "get_model_gc_estimates", "get_model_gc_score_estimates",
+    "average_factor_histories", "filter_incomplete_runs",
+    "load_grid_summaries", "rank_runs", "select_best_models",
+    "load_artifact", "load_model_for_eval",
+    "compute_fixed_f1_stats", "compute_graph_comparison_stats",
+    "compute_key_stats", "compute_optimal_f1_stats", "summarize_values",
+    "three_view_optimal_f1_stats",
+]

@@ -198,7 +198,10 @@ class DcsfaNmfConfig:
             if fc.lower() not in ("positive", "negative", "n/a"):
                 raise ValueError(
                     "fixed corr must be a list or in {positive,negative,n/a}")
-            fc = (fc.lower(),)
+            # replicate across all supervised networks (the reference keeps a
+            # length-1 list here, ref :92-100, which breaks for
+            # n_sup_networks > 1 — deliberate fix)
+            fc = tuple(fc.lower() for _ in range(self.n_sup_networks))
         else:
             fc = tuple(str(c).lower() for c in fc)
             assert len(fc) == self.n_sup_networks
@@ -569,9 +572,8 @@ class DcsfaNmf:
                     if save_folder:
                         with open(os.path.join(save_folder, best_model_name),
                                   "wb") as f:
-                            pickle.dump({"params": jax.device_get(params),
-                                         "state": jax.device_get(state),
-                                         "config": cfg}, f)
+                            pickle.dump(self._artifact_payload(params, state),
+                                        f)
             if verbose:
                 print(f"dCSFA-NMF epoch {epoch}: loss "
                       f"{histories['training'][-1]:.6f}", flush=True)
@@ -592,6 +594,19 @@ class DcsfaNmf:
             else:
                 params, state = best["params"], best["state"]
         return params, state, histories
+
+    def _artifact_payload(self, params, state):
+        """Self-describing artifact so eval.model_io can reconstruct the
+        exact class (incl. FullDCSFAModel graph-shape metadata)."""
+        payload = {"model_class": type(self).__name__,
+                   "config": self.config,
+                   "params": jax.device_get(params),
+                   "state": jax.device_get(state)}
+        for attr in ("num_nodes", "num_high_level_node_features",
+                     "gc_feature_layout"):
+            if hasattr(self, attr):
+                payload[attr] = getattr(self, attr)
+        return payload
 
     # -- inference ----------------------------------------------------------
 

@@ -1,0 +1,221 @@
+"""Tests for native SHD / AID causal distances (gadjid-parity module)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.eval.causal_distances import (
+    _d_separated,
+    _reachability,
+    _to_row_to_col,
+    ancestor_aid,
+    oset_aid,
+    parent_aid,
+    shd,
+)
+
+
+def _dag(n, edges):
+    A = np.zeros((n, n), dtype=int)
+    for i, j in edges:
+        A[i, j] = 1
+    return A
+
+
+# ---------------------------------------------------------------- SHD
+
+def test_shd_identical_zero():
+    A = _dag(4, [(0, 1), (1, 2), (2, 3)])
+    assert shd(A, A) == (0.0, 0)
+
+
+def test_shd_counts_reversal_once():
+    A = _dag(3, [(0, 1)])
+    B = _dag(3, [(1, 0)])
+    norm, count = shd(A, B)
+    assert count == 1
+    assert norm == pytest.approx(1 / 3)
+
+
+def test_shd_missing_and_extra():
+    A = _dag(3, [(0, 1), (1, 2)])
+    B = _dag(3, [(0, 1), (0, 2)])
+    # {1,2} differs (missing), {0,2} differs (extra) -> 2 mistakes
+    assert shd(A, B)[1] == 2
+
+
+def test_shd_column_to_row_convention():
+    A = _dag(3, [(0, 1)])
+    assert shd(A.T, A.T, edge_direction="from column to row") == (0.0, 0)
+    assert shd(A, A.T, edge_direction="from column to row")[1] == 1
+
+
+# ------------------------------------------------------- d-separation
+
+def _all_paths(adj_und, x, y):
+    """All simple paths x..y in an undirected-representation for the oracle."""
+    n = adj_und.shape[0]
+    paths = []
+
+    def extend(path):
+        last = path[-1]
+        if last == y:
+            paths.append(list(path))
+            return
+        for nxt in range(n):
+            if adj_und[last, nxt] and nxt not in path:
+                path.append(nxt)
+                extend(path)
+                path.pop()
+
+    extend([x])
+    return paths
+
+
+def _path_blocked(B, path, Z):
+    """Classic d-separation path blocking: for each interior node decide
+    collider/non-collider from edge orientations in DAG B."""
+    R = _reachability(B)
+    for k in range(1, len(path) - 1):
+        prev, node, nxt = path[k - 1], path[k], path[k + 1]
+        into_prev = B[prev, node]   # prev -> node
+        into_next = B[nxt, node]    # nxt -> node
+        collider = into_prev and into_next
+        if collider:
+            # blocked unless node or a descendant of node is in Z
+            desc = R[node].copy()
+            desc[node] = True
+            if not np.any(desc & Z):
+                return True
+        else:
+            if Z[node]:
+                return True
+    return False
+
+
+def _d_separated_oracle(B, x, y, Z):
+    und = B | B.T
+    for path in _all_paths(und, x, y):
+        if not _path_blocked(B, path, Z):
+            return False
+    return True
+
+
+def test_d_separation_matches_bruteforce_on_random_dags():
+    rng = np.random.default_rng(0)
+    n = 5
+    for trial in range(30):
+        # random DAG via upper-triangular mask over a random permutation
+        perm = rng.permutation(n)
+        A = np.zeros((n, n), dtype=bool)
+        for i in range(n):
+            for j in range(i + 1, n):
+                if rng.uniform() < 0.4:
+                    A[perm[i], perm[j]] = True
+        for x, y in itertools.permutations(range(n), 2):
+            for zbits in range(2 ** n):
+                Z = np.array([(zbits >> k) & 1 for k in range(n)], dtype=bool)
+                if Z[x] or Z[y]:
+                    continue
+                fast = _d_separated(A, x, y, Z)
+                slow = _d_separated_oracle(A, x, y, Z)
+                assert fast == slow, (trial, x, y, Z.nonzero())
+        if trial >= 5:  # 6 full graphs is plenty; keep runtime bounded
+            break
+
+
+# ------------------------------------------------------------- AID
+
+def test_aid_identical_graphs_zero():
+    A = _dag(5, [(0, 1), (1, 2), (0, 3), (3, 4), (2, 4)])
+    for fn in (parent_aid, ancestor_aid, oset_aid):
+        assert fn(A, A) == (0.0, 0)
+
+
+def test_aid_missing_confounder_is_mistake():
+    # true: z -> x, z -> y, x -> y ; guess omits z -> x
+    true = _dag(3, [(2, 0), (2, 1), (0, 1)])
+    guess = _dag(3, [(2, 1), (0, 1)])
+    # guess proposes Pa(x)=∅ for (x=0, y=1); backdoor 0 <- 2 -> 1 is open
+    norm, count = parent_aid(true, guess)
+    assert count >= 1
+    # with the confounder present in the guess, parent adjustment is valid
+    assert parent_aid(true, true) == (0.0, 0)
+
+
+def test_aid_empty_guess_counts_true_effects():
+    true = _dag(4, [(0, 1), (1, 2), (2, 3)])
+    guess = np.zeros((4, 4), dtype=int)
+    R = _reachability(_to_row_to_col(true, "from row to column"))
+    expected = int(R.sum())  # every true effect is claimed away
+    for fn in (parent_aid, ancestor_aid, oset_aid):
+        assert fn(true, guess)[1] == expected
+
+
+def test_aid_extra_edge_claims_effect_where_none():
+    true = np.zeros((3, 3), dtype=int)
+    guess = _dag(3, [(0, 1)])
+    # guess claims an effect 0->1 with Z=∅; in the true graph the effect is
+    # zero and ∅ is a valid adjustment set (no open paths), so NOT a mistake
+    assert parent_aid(true, guess) == (0.0, 0)
+
+
+def test_aid_reversed_edge_mistakes():
+    true = _dag(2, [(0, 1)])
+    guess = _dag(2, [(1, 0)])
+    # pair (0,1): guess claims no effect but truth has one -> mistake
+    # pair (1,0): guess claims effect with Z=Pa(1)=∅; truth: effect of 1 on 0
+    #   is zero and the path 1 <- 0 is blocked? path 1 <- 0 is non-causal,
+    #   with no conditioning it is open 0 -> 1 ... x=1,y=0: path 1 <- 0 has no
+    #   interior nodes, unblockable -> mistake
+    norm, count = parent_aid(true, guess)
+    assert count == 2
+    assert norm == pytest.approx(1.0)
+
+
+def test_aid_cycle_raises():
+    cyc = _dag(3, [(0, 1), (1, 2), (2, 0)])
+    ok = _dag(3, [(0, 1)])
+    for fn in (parent_aid, ancestor_aid, oset_aid):
+        with pytest.raises(ValueError):
+            fn(cyc, ok)
+        with pytest.raises(ValueError):
+            fn(ok, cyc)
+
+
+def test_aid_column_to_row_convention():
+    true = _dag(3, [(2, 0), (2, 1), (0, 1)])
+    guess = _dag(3, [(2, 1), (0, 1)])
+    a = parent_aid(true, guess)
+    b = parent_aid(true.T, guess.T, edge_direction="from column to row")
+    assert a == b
+
+
+def test_oset_vs_parent_on_mediator_graph():
+    # x -> m -> y with confounder c: c -> x, c -> y
+    true = _dag(4, [(0, 1), (1, 2), (3, 0), (3, 2)])
+    # guess identical: all strategies valid
+    for fn in (parent_aid, ancestor_aid, oset_aid):
+        assert fn(true, true) == (0.0, 0)
+
+
+def test_aid_strategies_differ_in_general():
+    rng = np.random.default_rng(3)
+    n = 6
+    diffs = 0
+    for _ in range(20):
+        def rand_dag():
+            A = np.zeros((n, n), dtype=int)
+            perm = rng.permutation(n)
+            for i in range(n):
+                for j in range(i + 1, n):
+                    if rng.uniform() < 0.35:
+                        A[perm[i], perm[j]] = 1
+            return A
+
+        t, g = rand_dag(), rand_dag()
+        res = {fn.__name__: fn(t, g)[1]
+               for fn in (parent_aid, ancestor_aid, oset_aid)}
+        if len(set(res.values())) > 1:
+            diffs += 1
+    assert diffs > 0  # the three flavors are genuinely different metrics

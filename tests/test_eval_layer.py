@@ -1,0 +1,274 @@
+"""Tests for the evaluation layer (stats, dispatch, cross-alg driver,
+grid-search selection)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.eval.cross_alg import (
+    evaluate_algorithm_on_fold,
+    find_run_directory,
+    run_cross_algorithm_comparison,
+    select_algorithm_root,
+)
+from redcliff_tpu.eval.gc_estimates import (
+    get_model_gc_estimates,
+    get_model_gc_score_estimates,
+)
+from redcliff_tpu.eval.grid_selection import (
+    average_factor_histories,
+    filter_incomplete_runs,
+    rank_runs,
+    select_best_models,
+)
+from redcliff_tpu.eval.model_io import load_model_for_eval
+from redcliff_tpu.eval.stats import (
+    compute_fixed_f1_stats,
+    compute_key_stats,
+    compute_optimal_f1_stats,
+    summarize_values,
+    three_view_optimal_f1_stats,
+)
+
+
+# ------------------------------------------------------------------- stats
+
+def test_optimal_f1_stats_perfect_estimate():
+    true = np.array([[0, 1], [1, 0]], dtype=float)
+    est = np.array([[0.1, 0.9], [0.8, 0.2]])
+    out = compute_optimal_f1_stats(est, true)
+    assert out["f1"] == pytest.approx(1.0)
+    assert 0.2 < out["decision_threshold"] <= 0.8
+
+
+def test_optimal_f1_stats_gates_degenerate_inputs(capsys):
+    true = np.array([[0, 1], [1, 0]], dtype=float)
+    assert compute_optimal_f1_stats(np.ones((2, 2)), true) == {}
+    assert compute_optimal_f1_stats(np.full((2, 2), np.nan), true) == {}
+    assert compute_optimal_f1_stats(np.array([[0.1, 0.9], [0.8, 0.2]]),
+                                    np.ones((2, 2))) == {}
+
+
+def test_fixed_f1_and_key_stats_keys():
+    rng = np.random.default_rng(0)
+    true = (rng.uniform(size=(4, 4)) > 0.5).astype(float)
+    est = true * 0.9 + rng.uniform(0, 0.05, size=(4, 4))
+    f1s = compute_fixed_f1_stats(est, true)
+    assert "f1_pc0.5" in f1s and f1s["f1_pc0.5"] == pytest.approx(1.0)
+    ks = compute_key_stats(est, true)
+    assert ks["roc_auc"] == pytest.approx(1.0)
+    assert "sensitivity_pc0.5" in ks and "NLR_pc0.9" in ks
+
+
+def test_three_view_stats_paradigm_keys():
+    rng = np.random.default_rng(1)
+    true = (rng.uniform(size=(5, 5, 2)) > 0.6).astype(float)
+    est = true + 0.1 * rng.uniform(size=true.shape)
+    out = three_view_optimal_f1_stats(est, true)
+    assert set(out) == {
+        "key_stats_estGC_norm_vs_trueGC_norm",
+        "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag",
+        "key_stats_estGC_normOffDiagTransposed_vs_trueGC_normOffDiag",
+    }
+    assert out["key_stats_estGC_norm_vs_trueGC_norm"]["f1"] > 0.9
+
+
+def test_summarize_values():
+    s = summarize_values([1.0, 2.0, 3.0, None])
+    assert s["mean"] == pytest.approx(2.0)
+    assert s["median"] == pytest.approx(2.0)
+    assert s["mean_std_err"] == pytest.approx(np.std([1, 2, 3]) / np.sqrt(3))
+    assert summarize_values([None])["mean"] is None
+
+
+# ------------------------------------------------------- gc dispatch
+
+class _FakeGraphModel:
+    """Duck-typed single-graph baseline (cMLP/cLSTM/DGCNN signature)."""
+
+    def __init__(self, g):
+        self._g = g
+
+    def gc(self, params, threshold=False, ignore_lag=True,
+           combine_wavelet_representations=False, rank_wavelets=False,
+           combine_node_feature_edges=False):
+        return [self._g]
+
+
+class _FakeDynotears:
+    def __init__(self, g):
+        self._g = g
+
+    def gc(self):
+        return self._g
+
+
+def test_gc_dispatch_replicates_single_graph():
+    g = np.arange(9.0).reshape(3, 3)
+    ests = get_model_gc_estimates(_FakeGraphModel(g), None, "CMLP", 4)
+    assert len(ests) == 4
+    np.testing.assert_array_equal(ests[0], g)
+    ests[0][0, 0] = 99.0  # copies, not views
+    assert ests[1][0, 0] == 0.0
+
+
+def test_gc_dispatch_dynotears_and_scores():
+    g = np.eye(3)
+    ests = get_model_gc_estimates(_FakeDynotears(g), None,
+                                  "DYNOTEARS_Vanilla", 2)
+    assert len(ests) == 2
+    scores = get_model_gc_score_estimates(_FakeDynotears(g), None,
+                                          "DYNOTEARS_Vanilla", 2)
+    np.testing.assert_array_equal(scores, np.ones(2))
+
+
+def test_gc_dispatch_unknown_raises():
+    with pytest.raises(NotImplementedError):
+        get_model_gc_estimates(None, None, "MYSTERY_ALG", 2)
+
+
+# ------------------------------------------- cross-alg driver end-to-end
+
+def _make_dynotears_artifact(run_dir, a_est):
+    from redcliff_tpu.models.dynotears import DynotearsConfig
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "final_best_model.bin"), "wb") as f:
+        pickle.dump({"model_class": "DynotearsVanillaModel",
+                     "config": DynotearsConfig(lag_size=1),
+                     "a_est": a_est}, f)
+
+
+def test_cross_algorithm_comparison_end_to_end(tmp_path):
+    rng = np.random.default_rng(2)
+    true_g = (rng.uniform(size=(4, 4, 1)) > 0.5).astype(float)
+    dset = "toy_dset"
+    num_folds = 2
+    alg_root = tmp_path / "DYNOTEARS_Vanilla_models"
+    for fold in range(num_folds):
+        run = alg_root / f"{dset}_fold{fold}_run"
+        # estimate = truth + small noise so optimal F1 is 1.0
+        est = true_g[:, :, 0] + 0.05 * rng.uniform(size=(4, 4))
+        _make_dynotears_artifact(str(run), est)
+    true_graphs = {dset: {f: [true_g, true_g] for f in range(num_folds)}}
+    out_root = tmp_path / "eval_out"
+    summary = run_cross_algorithm_comparison(
+        [str(alg_root)], true_graphs, str(out_root), num_folds)
+    assert (out_root / "full_comparrisson_summary.pkl").exists()
+    cv = summary[dset]
+    para = cv["key_stats_estGC_norm_vs_trueGC_norm"]["DYNOTEARS_Vanilla"]
+    assert para["f1_mean_across_factors"] == pytest.approx(1.0)
+    # 2 factors x 2 folds accumulated
+    assert len(para["f1_vals_across_factors"]) == 4
+
+
+def test_find_run_directory_requires_unique(tmp_path):
+    root = tmp_path / "alg"
+    os.makedirs(root / "dsetA_fold0_x")
+    os.makedirs(root / "dsetA_fold0_y")
+    with pytest.raises(ValueError):
+        find_run_directory(str(root), "dsetA", 0)
+
+
+def test_select_algorithm_root_alias_rules():
+    roots = ["/runs/REDCLIFF_S_CMLP_models", "/runs/CMLP_models",
+             "/runs/NAVAR_CMLP_models"]
+    assert select_algorithm_root("CMLP", roots) == "/runs/CMLP_models"
+    assert select_algorithm_root("REDCLIFF_S_CMLP", roots) == \
+        "/runs/REDCLIFF_S_CMLP_models"
+    assert select_algorithm_root("NAVAR_CMLP", roots) == \
+        "/runs/NAVAR_CMLP_models"
+
+
+# ------------------------------------------------- grid selection
+
+def _write_meta(root, name, meta):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "training_meta_data_and_hyper_parameters.pkl"),
+              "wb") as f:
+        pickle.dump(meta, f)
+
+
+def _toy_meta(forecast, factor, cos):
+    n = len(forecast)
+    return {
+        "avg_forecasting_loss": forecast,
+        "avg_factor_loss": factor,
+        "gc_factor_cosine_sim_histories": {"01": cos, "10": cos},
+        "roc_auc_histories": {0.0: [[0.5, 0.6]] * n},
+        "roc_auc_OffDiag_histories": {0.0: [[0.5, 0.6]] * n},
+        "avg_fw_l1_penalty": [0.1] * n,
+        "gc_factor_l1_loss_histories": [[1.0, 2.0]] * n,
+        "deltacon0_histories": [[0.9, 0.8]] * n,
+        "deltacon0_with_directed_degrees_histories": [[0.9, 0.8]] * n,
+        "deltaffinity_histories": [[0.9, 0.8]] * n,
+        "path_length_mse_histories": {1: [[0.2, 0.3]] * n},
+    }
+
+
+def test_grid_selection_ranks_and_combines(tmp_path):
+    root = str(tmp_path)
+    _write_meta(root, "runA", _toy_meta([3.0, 2.0, 1.0], [0.5, 0.4, 0.3],
+                                        [0.2, 0.2, 0.2]))
+    _write_meta(root, "runB", _toy_meta([2.0, 1.5, 0.2], [0.9, 0.8, 0.7],
+                                        [0.3, 0.3, 0.3]))
+    _write_meta(root, "runC_incomplete", {"avg_forecasting_loss": []})
+    res = select_best_models(root)
+    assert res["forecasting_loss"]["best_run"] == "runB"
+    assert res["forecasting_loss"]["best_epoch"] == 2
+    assert res["factor_loss"]["best_run"] == "runA"
+    combo = res["forecasting_loss_and_factor_loss_and_gc_cosine_sim_history"]
+    # runA combo: 1.0+0.3+0.2=1.5 ; runB combo: 0.2+0.7+0.3=1.2
+    assert combo["best_run"] == "runB"
+    # incomplete run dropped everywhere
+    for crit in res.values():
+        assert all(r[0] != "runC_incomplete" for r in crit["ranking"])
+
+
+def test_average_factor_histories_shapes():
+    # histories are factor-major (outer list = factor, inner = epoch), as in
+    # the reference tracker and train.tracking
+    meta = _toy_meta([1.0, 2.0], [0.1, 0.2], [0.5, 0.6])
+    out = average_factor_histories(meta)
+    # two factors with per-epoch values [0.5, 0.6] each -> per-epoch means
+    assert out["avg_roc_auc_score_history"] == [
+        pytest.approx(0.5), pytest.approx(0.6)]
+    assert out["avg_gc_factor_cos_sim_history"] == [
+        pytest.approx(0.5), pytest.approx(0.6)]
+    assert out["avg_gc_factor_l1_history"] == [
+        pytest.approx(1.0), pytest.approx(2.0)]
+
+
+def test_rank_runs_max_direction(tmp_path):
+    s = {
+        "a": {"avg_roc_auc_score_history": [0.5, 0.9]},
+        "b": {"avg_roc_auc_score_history": [0.7, 0.6]},
+    }
+    rows = rank_runs(s, "roc_auc")
+    assert rows[0] == ("a", pytest.approx(0.9), 1)
+
+
+def test_dcsfa_artifact_roundtrip(tmp_path):
+    import jax
+    from redcliff_tpu.models.dcsfa_nmf import FullDCSFAModel
+
+    model = FullDCSFAModel(num_nodes=3, num_high_level_node_features=2,
+                           n_components=2, n_sup_networks=1, h=8)
+    params, state = model.init(jax.random.PRNGKey(0), model.dim_in)
+    run = tmp_path / "DCSFA_run"
+    os.makedirs(run)
+    with open(run / "dCSFA-NMF-best-model.pkl", "wb") as f:
+        pickle.dump(model._artifact_payload(params, state), f)
+    loaded_model, loaded_params, loaded_state = load_model_for_eval(str(run))
+    assert type(loaded_model).__name__ == "FullDCSFAModel"
+    assert loaded_model.num_nodes == 3
+    ests = get_model_gc_estimates(loaded_model, loaded_params, "DCSFA", 2)
+    assert len(ests) == 2 and ests[0].shape == (3, 3)
+
+
+def test_fixed_corr_string_replicates():
+    from redcliff_tpu.models.dcsfa_nmf import DcsfaNmfConfig
+
+    cfg = DcsfaNmfConfig(n_sup_networks=3, fixed_corr="positive")
+    assert cfg.fixed_corr == ("positive", "positive", "positive")
