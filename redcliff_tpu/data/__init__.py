@@ -1,0 +1,40 @@
+"""Data layer: synthetic sVAR generation, DREAM4/D4IC curation, LFP
+preprocessing, and device-resident dataset containers
+(rebuilds /root/reference/data/, SURVEY.md §2.4)."""
+from .datasets import ArrayDataset, train_val_split
+from .dream4 import (
+    D4IC_SNR_TIERS,
+    make_d4ic_fold,
+    make_dream4_combo_dataset,
+    make_dream4_individual_dataset,
+    make_dream4_single_dominant_superpositional_dataset,
+    parse_dream4_timeseries,
+)
+from .lfp import (
+    determine_keys_of_interest,
+    extract_epoch_windows,
+    load_lfp_data_matrix,
+    preprocess_socpref_raw_lfps_for_windowed_training,
+    preprocess_tst_raw_lfps_for_windowed_training,
+)
+from .shards import (
+    apply_signal_format,
+    load_normalized_split_datasets,
+    load_shard_samples,
+    samples_to_arrays,
+    save_cv_split,
+)
+
+__all__ = [
+    "ArrayDataset", "train_val_split",
+    "D4IC_SNR_TIERS", "make_d4ic_fold", "make_dream4_combo_dataset",
+    "make_dream4_individual_dataset",
+    "make_dream4_single_dominant_superpositional_dataset",
+    "parse_dream4_timeseries",
+    "determine_keys_of_interest", "extract_epoch_windows",
+    "load_lfp_data_matrix",
+    "preprocess_socpref_raw_lfps_for_windowed_training",
+    "preprocess_tst_raw_lfps_for_windowed_training",
+    "apply_signal_format", "load_normalized_split_datasets",
+    "load_shard_samples", "samples_to_arrays", "save_cv_split",
+]

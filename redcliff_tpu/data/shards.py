@@ -1,0 +1,153 @@
+"""Shared on-disk shard layout + signal-format feature transforms.
+
+The reference's datasets live as ``fold_<k>/{train,validation}/subset_*.pkl``
+shards holding ``[[x (T, C), y], ...]`` pairs (written by
+general_utils/misc.py:222-238 save_cv_split, read back by every
+Normalized*Dataset).  This build keeps that layout as the cross-process results
+contract (SURVEY.md §7) but loads shards once into dense arrays instead of
+re-unpickling per sample (ref synthetic_datasets.py:140-141 re-opens the shard
+on every __getitem__).
+
+Signal formats follow NormalizedDREAM4Dataset.__getitem__
+(ref dream4_datasets.py:120-151): "original" (T, C) windows, "flattened"
+feature vectors, and "directed_spectrum" / "directed_spectrum_vanilla"
+high-level spectral features.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..utils.misc import flatten_directed_spectrum_features
+from ..utils.time_series import make_high_level_signal_features
+from .datasets import ArrayDataset
+
+__all__ = [
+    "save_cv_split",
+    "load_shard_samples",
+    "samples_to_arrays",
+    "apply_signal_format",
+    "load_normalized_split_datasets",
+]
+
+
+def save_cv_split(train_data, val_data, cv_id, save_path):
+    """Write one CV fold in the reference layout (ref misc.py:222-238)."""
+    root = os.path.join(save_path, f"fold_{cv_id}")
+    os.makedirs(os.path.join(root, "train"))
+    os.makedirs(os.path.join(root, "validation"))
+    with open(os.path.join(root, "train", "subset_0.pkl"), "wb") as f:
+        pickle.dump(train_data, f)
+    with open(os.path.join(root, "validation", "subset_0.pkl"), "wb") as f:
+        pickle.dump(val_data, f)
+
+
+def load_shard_samples(data_path, drop_nan=True):
+    """Load every ``subset_*.pkl`` under a split dir into a [[x, y], ...] list,
+    skipping NaN-contaminated samples like the reference loaders
+    (ref dream4_datasets.py:50-70)."""
+    files = sorted(x for x in os.listdir(data_path)
+                   if "subset_" in x and x.endswith(".pkl")
+                   and "metadata" not in x)
+    samples = []
+    skipped = 0
+    for name in files:
+        with open(os.path.join(data_path, name), "rb") as f:
+            for pair in pickle.load(f):
+                x = np.asarray(pair[0], dtype=np.float32)
+                if drop_nan and np.isnan(x).any():
+                    skipped += 1
+                    continue
+                samples.append([x, np.asarray(pair[1], dtype=np.float32)])
+    if skipped:
+        print(f"load_shard_samples: skipped {skipped} NaN samples under "
+              f"{data_path}", flush=True)
+    return samples
+
+
+def samples_to_arrays(samples):
+    """[[x, y], ...] -> (X (N, T, C), Y (N, ...)) dense arrays.
+
+    x is squeezed like the reference __getitem__ (a leading singleton batch
+    axis may be present); y keeps its stored shape — the label-shape branch
+    dispatch downstream depends on it (e.g. D4IC labels are (S, 1),
+    ref dream4_datasets.py:153 applies no squeeze to y)."""
+    X = np.stack([np.squeeze(s[0]) for s in samples]).astype(np.float32)
+    Y = np.stack([np.atleast_1d(np.asarray(s[1]))
+                  for s in samples]).astype(np.float32)
+    return X, Y
+
+
+def apply_signal_format(X, signal_format, max_num_features_per_series=None,
+                        dirspec_params=None):
+    """Transform normalized (N, T, C) windows per the signal_format switch
+    (ref dream4_datasets.py:120-151). Returns (N, F) features for flattened /
+    dirspec formats, or X unchanged for "original"."""
+    if signal_format == "original":
+        return X
+    if "directed_spectrum" in signal_format:
+        assert dirspec_params is not None
+        feats = []
+        for i in range(X.shape[0]):
+            x = X[i]
+            if max_num_features_per_series is not None:
+                x = x[:max_num_features_per_series, :]
+            hl = make_high_level_signal_features(
+                x, fs=dirspec_params["fs"],
+                min_freq=dirspec_params["min_freq"],
+                max_freq=dirspec_params["max_freq"],
+                directed_spectrum=dirspec_params["directed_spectrum"],
+                csd_params=dirspec_params["csd_params"])
+            ds = np.asarray(hl["dir_spec"])[0]
+            if "vanilla" in signal_format:
+                feats.append(ds.reshape(-1))
+            else:
+                feats.append(flatten_directed_spectrum_features(ds).reshape(-1))
+        return np.stack(feats).astype(np.float32)
+    if "power_features" in signal_format:
+        raise NotImplementedError(
+            "power_features format is declared but unimplemented in the "
+            "reference as well (ref dream4_datasets.py:146)")
+    if "flattened" in signal_format:
+        assert max_num_features_per_series is not None
+        assert max_num_features_per_series > 0
+        return X[:, :max_num_features_per_series, :].reshape(
+            X.shape[0], -1).astype(np.float32)
+    raise ValueError(f"unknown signal_format: {signal_format!r}")
+
+
+def load_normalized_split_datasets(data_root_path, signal_format="original",
+                                   shuffle=True, shuffle_seed=0,
+                                   max_num_features_per_series=None,
+                                   dirspec_params=None, grid_search=True,
+                                   average_region_map=None):
+    """(train, validation) ArrayDatasets from a fold directory, z-scored with
+    per-split dataset-wide channel statistics like the reference loaders
+    (ref dream4_datasets.py:168-190, local_field_potential_datasets.py:198-220).
+
+    average_region_map ({region: [channel indices]}) averages channel groups
+    before normalization (ref local_field_potential_datasets.py:118-133).
+    """
+    out = []
+    for split in ("train", "validation"):
+        split_dir = os.path.join(data_root_path, split)
+        samples = load_shard_samples(split_dir)
+        X, Y = samples_to_arrays(samples)
+        if average_region_map is not None:
+            X = np.stack([X[:, :, idxs].mean(axis=2)
+                          for idxs in average_region_map.values()], axis=2)
+        if shuffle:
+            rng = np.random.default_rng(shuffle_seed)
+            order = rng.permutation(len(X))
+            X, Y = X[order], Y[order]
+        ds = ArrayDataset(X, Y, normalize=True, grid_search=grid_search)
+        if signal_format != "original":
+            feats = apply_signal_format(
+                ds.X, signal_format,
+                max_num_features_per_series=max_num_features_per_series,
+                dirspec_params=dirspec_params)
+            ds.X_features = feats
+        out.append(ds)
+    return tuple(out)

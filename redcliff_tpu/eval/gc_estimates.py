@@ -31,9 +31,9 @@ def get_model_gc_estimates(model, params, model_type, num_ests_required,
     mt = model_type
     if "REDCLIFF" in mt:
         mode = model.config.primary_gc_est_mode
-        if X is None and "conditional" in mode:
-            # system-level eval forces sample-independent readout
-            # (ref eval_sysOptF1...py:172-175)
+        if "conditional" in mode:
+            # system-level eval always forces the sample-independent readout
+            # (ref eval_sysOptF1...py:172-175 overrides unconditionally)
             mode = "fixed_factor_exclusive"
         ests_by_sample = model.gc_as_lists(params, gc_est_mode=mode, X=X,
                                            threshold=False, ignore_lag=False,

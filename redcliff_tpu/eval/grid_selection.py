@@ -139,6 +139,7 @@ def rank_runs(summaries, criterion):
     """[(run_name, best_value, best_epoch)] sorted best-first under the
     criterion."""
     rows = []
+    direction = "min"
     for name, meta in summaries.items():
         hist, direction = _criterion_history(meta, criterion)
         if not hist:
@@ -146,10 +147,7 @@ def rank_runs(summaries, criterion):
         arr = np.asarray(hist, dtype=np.float64)
         idx = int(np.argmax(arr)) if direction == "max" else int(np.argmin(arr))
         rows.append((name, float(arr[idx]), idx))
-    reverse = _criterion_history(
-        next(iter(summaries.values())), criterion)[1] == "max" \
-        if summaries else False
-    rows.sort(key=lambda r: r[1], reverse=reverse)
+    rows.sort(key=lambda r: r[1], reverse=(direction == "max"))
     return rows
 
 

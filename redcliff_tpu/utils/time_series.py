@@ -349,7 +349,12 @@ def mark_outliers(lfps, fs, cutoff=LOW_PASS_CUTOFF, lowcut=LOWCUT,
 # ------------------------------------------------------------ window draws
 
 def _window_hits_nan(start, window_size, nan_locations):
-    return any(start <= loc <= start + window_size for loc in nan_locations)
+    nan_locations = np.asarray(nan_locations)
+    if nan_locations.size == 0:
+        return False
+    # sorted-array range check (nan locations come from flatnonzero, sorted)
+    lo = np.searchsorted(nan_locations, start, side="left")
+    return lo < nan_locations.size and nan_locations[lo] <= start + window_size
 
 
 def draw_timesteps_to_sample_from(interval_start, interval_stop, window_size,

@@ -1,0 +1,334 @@
+"""Tests for the DREAM4/D4IC + LFP data layer."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+import scipy.io as scio
+
+from redcliff_tpu.data.dream4 import (
+    D4IC_SNR_TIERS,
+    make_d4ic_fold,
+    make_dream4_individual_dataset,
+    make_dream4_single_dominant_superpositional_dataset,
+    parse_dream4_timeseries,
+)
+from redcliff_tpu.data.lfp import (
+    determine_keys_of_interest,
+    extract_epoch_windows,
+    load_lfp_data_matrix,
+    preprocess_tst_raw_lfps_for_windowed_training,
+)
+from redcliff_tpu.data.shards import (
+    apply_signal_format,
+    load_normalized_split_datasets,
+    load_shard_samples,
+    samples_to_arrays,
+    save_cv_split,
+)
+
+
+# ----------------------------------------------------------- DREAM4 TSV
+
+def _write_dream4_tsv(path, num_recordings=5, num_channels=10, rng=None):
+    rng = rng or np.random.default_rng(0)
+    lines = ["\t".join(['"Time"'] + [f'"G{i+1}"' for i in range(num_channels)])]
+    values = []
+    for r in range(num_recordings):
+        rec = rng.uniform(size=(21, num_channels))
+        values.append(rec)
+        for t in range(21):
+            row = [str(t * 50)] + [f"{v:.6f}" for v in rec[t]]
+            lines.append("\t".join(row))
+        lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return values
+
+
+def test_parse_dream4_whole_recordings(tmp_path):
+    p = str(tmp_path / "insilico_size10_1_timeseries.tsv")
+    vals = _write_dream4_tsv(p)
+    ts, labels, meta = parse_dream4_timeseries(p, apply_state_perspective=False)
+    assert len(ts) == 5 and len(labels) == 5
+    assert meta["num_channels"] == 10
+    assert meta["num_time_points"] == 21
+    np.testing.assert_allclose(ts[0], vals[0], atol=1e-6)
+    np.testing.assert_array_equal(labels[0], [1, 0])
+
+
+def test_parse_dream4_state_perspective(tmp_path):
+    p = str(tmp_path / "insilico_size10_1_timeseries.tsv")
+    vals = _write_dream4_tsv(p)
+    ts, labels, meta = parse_dream4_timeseries(p, apply_state_perspective=True)
+    assert len(ts) == 10
+    # halves: first 11 steps (perturbed), last 10 (relaxed)
+    assert ts[0].shape == (11, 10)
+    assert ts[1].shape == (10, 10)
+    np.testing.assert_array_equal(labels[0], [1, 0])
+    np.testing.assert_array_equal(labels[1], [0, 1])
+    np.testing.assert_allclose(np.vstack([ts[0], ts[1]]), vals[0], atol=1e-6)
+
+
+def test_individual_dataset_folds(tmp_path):
+    p = str(tmp_path / "ts.tsv")
+    _write_dream4_tsv(p)
+    save = str(tmp_path / "size10_out")
+    os.makedirs(save)
+    make_dream4_individual_dataset(p, save, state_label_setting=False)
+    assert sorted(os.listdir(save)) == [f"fold_{i}" for i in range(5)]
+    train = load_shard_samples(os.path.join(save, "fold_0", "train"))
+    val = load_shard_samples(os.path.join(save, "fold_0", "validation"))
+    assert len(train) == 4 and len(val) == 1
+
+
+def _build_network_dirs(tmp_path, num_nets=3, rng=None):
+    rng = rng or np.random.default_rng(1)
+    orig = tmp_path / "orig"
+    for n in range(num_nets):
+        d = orig / f"insilico_size10_{n+1}"
+        os.makedirs(d)
+        _write_dream4_tsv(str(d / f"insilico_size10_{n+1}_timeseries.tsv"),
+                          rng=rng)
+    return str(orig)
+
+
+def test_superpositional_dataset(tmp_path):
+    orig = _build_network_dirs(tmp_path)
+    save = str(tmp_path / "size10_super")
+    os.makedirs(save)
+    make_dream4_single_dominant_superpositional_dataset(
+        orig, save, state_label_setting=False,
+        dominant_net_coeff=5.0, background_net_coeff=0.1)
+    nets = sorted(x for x in os.listdir(save) if x != "meta_data.pkl")
+    assert len(nets) == 3
+    # verify the mix: dominant*5 + 0.1*others, fold-aligned (kfolds are
+    # unshuffled so train sample i maps to recording i+1 for fold_0)
+    per_net_recs = []
+    for net in nets:
+        ts, _, _ = parse_dream4_timeseries(
+            os.path.join(orig, net, f"{net}_timeseries.tsv"))
+        per_net_recs.append(ts)
+    t0 = load_shard_samples(os.path.join(save, nets[0], "fold_0", "train"))
+    expected = (5.0 * per_net_recs[0][1] + 0.1 * per_net_recs[1][1]
+                + 0.1 * per_net_recs[2][1])
+    np.testing.assert_allclose(t0[0][0], expected, atol=1e-5)
+
+
+def test_d4ic_fold_mixing_and_labels(tmp_path):
+    orig = _build_network_dirs(tmp_path)
+    pre = str(tmp_path / "size10_pre")
+    os.makedirs(pre)
+    make_dream4_single_dominant_superpositional_dataset(
+        orig, pre, state_label_setting=False,
+        dominant_net_coeff=1.0, background_net_coeff=0.0)
+    d4ic = str(tmp_path / "d4ic_HSNR_fold0")
+    combined = make_d4ic_fold(pre, d4ic, fold_id=0, num_factors=3,
+                              snr_tier="HSNR")
+    train = load_shard_samples(os.path.join(d4ic, "train"))
+    # 3 factors x 4 train samples each
+    assert len(train) == 12
+    x, y = train[0]
+    assert x.shape == (21, 10)
+    assert y.shape == (3, 1)
+    dom, bg = D4IC_SNR_TIERS["HSNR"]
+    assert set(np.unique(y)) <= {dom, bg}
+    assert np.sum(y == dom) == 1
+
+
+def test_d4ic_label_coefficients_msnr(tmp_path):
+    orig = _build_network_dirs(tmp_path)
+    pre = str(tmp_path / "size10_pre")
+    os.makedirs(pre)
+    make_dream4_single_dominant_superpositional_dataset(
+        orig, pre, state_label_setting=False,
+        dominant_net_coeff=1.0, background_net_coeff=0.0)
+    d4ic = str(tmp_path / "d4ic_MSNR_fold1")
+    make_d4ic_fold(pre, d4ic, fold_id=1, num_factors=3, snr_tier="MSNR")
+    val = load_shard_samples(os.path.join(d4ic, "validation"))
+    _, y = val[0]
+    assert sorted(np.unique(y)) == [0.1, 10.0]
+
+
+# ----------------------------------------------------------- shards
+
+def test_shard_roundtrip_and_arrays(tmp_path):
+    rng = np.random.default_rng(3)
+    data = [[rng.uniform(size=(8, 4)).astype(np.float32),
+             np.array([1.0, 0.0])] for _ in range(6)]
+    save_cv_split(data[:5], data[5:], 0, str(tmp_path))
+    train = load_shard_samples(str(tmp_path / "fold_0" / "train"))
+    X, Y = samples_to_arrays(train)
+    assert X.shape == (5, 8, 4)
+    assert Y.shape == (5, 2)
+
+
+def test_load_shard_skips_nan(tmp_path):
+    good = [np.ones((4, 2), np.float32), np.array([1.0])]
+    bad = [np.full((4, 2), np.nan, np.float32), np.array([0.0])]
+    os.makedirs(tmp_path / "split")
+    with open(tmp_path / "split" / "subset_0.pkl", "wb") as f:
+        pickle.dump([good, bad], f)
+    samples = load_shard_samples(str(tmp_path / "split"))
+    assert len(samples) == 1
+
+
+def test_normalized_split_datasets(tmp_path):
+    rng = np.random.default_rng(4)
+    data = [[rng.uniform(1.0, 3.0, size=(10, 3)).astype(np.float32),
+             np.array([1.0, 0.0])] for _ in range(8)]
+    save_cv_split(data[:6], data[6:], 0, str(tmp_path))
+    train, val = load_normalized_split_datasets(
+        str(tmp_path / "fold_0"), grid_search=False)
+    assert train.X.shape == (6, 10, 3)
+    # z-scored per channel
+    assert np.abs(train.X.mean(axis=(0, 1))).max() < 1e-5
+    assert val.X.shape == (2, 10, 3)
+
+
+def test_apply_signal_format_flattened_and_vanilla_dirspec():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(3, 64, 4)).astype(np.float32)
+    flat = apply_signal_format(X, "flattened", max_num_features_per_series=32)
+    assert flat.shape == (3, 32 * 4)
+    ds_params = {"fs": 100, "min_freq": 0.0, "max_freq": 40.0,
+                 "directed_spectrum": True,
+                 "csd_params": {"detrend": "constant", "window": "hann",
+                                "nperseg": 32, "noverlap": 16, "nfft": None}}
+    feats = apply_signal_format(X, "directed_spectrum_vanilla",
+                                dirspec_params=ds_params)
+    assert feats.shape[0] == 3 and feats.ndim == 2
+    feats2 = apply_signal_format(X, "directed_spectrum",
+                                 dirspec_params=ds_params)
+    # dirspec row layout: n*(2n-1)*F features vs vanilla n*n*F
+    n = 4
+    assert feats2.shape[1] * n == feats.shape[1] * (2 * n - 1)
+
+
+def test_region_map_averaging(tmp_path):
+    rng = np.random.default_rng(6)
+    data = [[rng.uniform(size=(10, 4)).astype(np.float32),
+             np.array([1.0])] for _ in range(4)]
+    save_cv_split(data[:3], data[3:], 0, str(tmp_path))
+    region_map = {"A": [0, 1], "B": [2, 3]}
+    train, _ = load_normalized_split_datasets(
+        str(tmp_path / "fold_0"), grid_search=False, shuffle=False,
+        average_region_map=region_map)
+    assert train.X.shape == (3, 10, 2)
+
+
+# ----------------------------------------------------------- LFP curation
+
+def _write_lfp_mat(path, channels, T, rng, spike_at=None):
+    data = {}
+    for c in channels:
+        sig = rng.normal(0.0, 1.0, size=T)
+        if spike_at is not None:
+            sig[spike_at] = 500.0  # extreme outlier for MAD masking
+        data[c] = sig.reshape(1, -1)
+    scio.savemat(path, data)
+
+
+def test_load_lfp_data_matrix_and_keys(tmp_path):
+    rng = np.random.default_rng(7)
+    chans = ["Amy_01", "Cortex_01", "Hipp_01"]
+    _write_lfp_mat(str(tmp_path / "m1_d1_LFP.mat"), chans, 4000, rng)
+    _write_lfp_mat(str(tmp_path / "m2_d1_LFP.mat"), chans + ["Extra"], 4000,
+                   rng)
+    keys = determine_keys_of_interest(["m1_d1_LFP.mat", "m2_d1_LFP.mat"],
+                                      str(tmp_path))
+    assert keys == sorted(chans)  # Extra not shared
+    mat = load_lfp_data_matrix(str(tmp_path), "m1_d1_LFP.mat", keys, 3,
+                               sample_freq=1000)
+    assert mat.shape == (3, 4000)
+    assert np.isfinite(mat[~np.isnan(mat)]).all()
+
+
+def test_extract_epoch_windows_shapes():
+    rng = np.random.default_rng(8)
+    raw = rng.normal(size=(3, 5000))
+    epochs = [(0, 2000, [1.0, 0.0]), (2000, 5000, [0.0, 1.0])]
+    wins = extract_epoch_windows(raw, epochs, window_size=500,
+                                 num_samples_per_label_type=3,
+                                 downsampling_step_size=10,
+                                 rng=np.random.default_rng(0))
+    assert len(wins[0]) == 3 and len(wins[1]) == 3
+    w, lab = wins[0][0]
+    assert w.shape == (50, 3)
+    np.testing.assert_array_equal(lab, [1.0, 0.0])
+
+
+def test_tst_preprocessing_end_to_end(tmp_path):
+    rng = np.random.default_rng(9)
+    lfp_dir = tmp_path / "lfp"
+    lab_dir = tmp_path / "labels"
+    out_dir = tmp_path / "out"
+    os.makedirs(lfp_dir)
+    os.makedirs(lab_dir)
+    chans = ["Amy_01", "Cortex_01"]
+    T = 700 * 1000  # 700 s at 1 kHz
+    # 23-char aligned prefixes for LFP/TIME pairing
+    name = "MouseA_2020_01_01_run01"
+    _write_lfp_mat(str(lfp_dir / f"{name}_LFP.mat"), chans, T, rng)
+    scio.savemat(str(lab_dir / f"{name}_TIME.mat"),
+                 {"INT_TIME": np.array([[320, 120, 500, 120]])})
+    preprocess_tst_raw_lfps_for_windowed_training(
+        str(lfp_dir), str(lab_dir), str(out_dir),
+        post_processing_sample_freq=100, num_processed_samples=18,
+        sample_temp_window_size=1000, sample_freq=1000,
+        rng=np.random.default_rng(0))
+    files = sorted(os.listdir(out_dir))
+    assert any("homeCage" in f for f in files)
+    assert any("openField" in f for f in files)
+    assert any("tailSuspension" in f for f in files)
+    with open(out_dir / files[0], "rb") as f:
+        samples = pickle.load(f)
+    x, y = samples[0]
+    assert x.shape == (100, 2)  # 1000-step window decimated 10x
+    assert y.shape == (3,)
+
+
+def test_socpref_windows_aligned_with_start_time(tmp_path):
+    """Signal is a ramp equal to the absolute timestep index, so window
+    contents reveal which absolute steps were sampled; behavior is active
+    only in a known absolute interval after StartTime."""
+    from redcliff_tpu.data.lfp import (
+        preprocess_socpref_raw_lfps_for_windowed_training,
+    )
+
+    lfp_dir = tmp_path / "lfp"
+    lab_dir = tmp_path / "labels"
+    out_dir = tmp_path / "out"
+    os.makedirs(lfp_dir)
+    os.makedirs(lab_dir)
+    T, fs = 20000, 1000
+    name = "MouseB_2020_02_02_run01"
+    ramp = np.arange(T, dtype=float)
+    scio.savemat(str(lfp_dir / f"{name}_LFP.mat"),
+                 {"Amy_01": ramp.reshape(1, -1),
+                  "Ctx_01": ramp.reshape(1, -1)})
+    start_time_sec = 5
+    s_class = np.zeros(T)
+    s_class[6000:9000] = 1.0  # absolute steps; relative [1000, 4000)
+    o_class = np.zeros(T)
+    o_class[11000:14000] = 1.0
+    scio.savemat(str(lab_dir / f"{name}_Class.mat"),
+                 {"StartTime": np.array([[start_time_sec]]),
+                  "S_Class": s_class.reshape(1, -1),
+                  "O_Class": o_class.reshape(1, -1)})
+    preprocess_socpref_raw_lfps_for_windowed_training(
+        str(lfp_dir), str(lab_dir), str(out_dir),
+        post_processing_sample_freq=100, num_processed_samples=8,
+        sample_temp_window_size=500, sample_freq=fs,
+        rng=np.random.default_rng(0), recording_duration_sec=15)
+    files = sorted(os.listdir(out_dir))
+    soc_files = [f for f in files if "social" in f]
+    assert soc_files
+    with open(out_dir / soc_files[0], "rb") as f:
+        samples = pickle.load(f)
+    for win, label in samples:
+        np.testing.assert_array_equal(label, [1.0, 0.0])
+        # window values are ~absolute timestep indices; they must sit inside
+        # the labeled interval [6000, 9000) (filter edge effects aside)
+        mean_abs_step = float(win[:, 0].mean())
+        assert 5800 < mean_abs_step < 9200, mean_abs_step
