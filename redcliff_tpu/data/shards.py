@@ -85,7 +85,10 @@ def apply_signal_format(X, signal_format, max_num_features_per_series=None,
     """Transform normalized (N, T, C) windows per the signal_format switch
     (ref dream4_datasets.py:120-151). Returns (N, F) features for flattened /
     dirspec formats, or X unchanged for "original"."""
-    if signal_format == "original":
+    if signal_format in ("original", "wavelet_decomp"):
+        # wavelet decomposition happens inside the models via their
+        # config.wavelet_level (utils.time_series.swt), so the loader hands
+        # over raw windows for both formats
         return X
     if "directed_spectrum" in signal_format:
         assert dirspec_params is not None

@@ -1,0 +1,226 @@
+"""Tests for the legacy cached-args config compatibility layer."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.utils.config import (
+    parse_input_list_of_ints,
+    parse_input_list_of_strs,
+    parse_tensor_string_representation,
+    read_in_data_args,
+    read_in_model_args,
+    serialize_tensor_to_string,
+)
+
+REF_TRAIN = "/root/reference/train"
+
+
+def test_parse_int_list():
+    assert parse_input_list_of_ints("[]") == []
+    assert parse_input_list_of_ints("[25]") == [25]
+    assert parse_input_list_of_ints("[1,2,3]") == [1, 2, 3]
+
+
+def test_parse_str_list():
+    assert parse_input_list_of_strs("[]") == []
+    assert parse_input_list_of_strs("[a,b]") == ["a", "b"]
+
+
+def test_tensor_string_roundtrip():
+    rng = np.random.default_rng(0)
+    t = rng.uniform(size=(4, 4, 3))
+    s = serialize_tensor_to_string(t)
+    parsed = parse_tensor_string_representation(s)[:, :, ::-1]
+    np.testing.assert_allclose(parsed, t, rtol=1e-12)
+
+
+def test_tensor_string_single_element():
+    s = "[[[0.5,],],]"
+    t = parse_tensor_string_representation(s)
+    assert t.shape == (1, 1, 1)
+    assert t[0, 0, 0] == 0.5
+
+
+def test_tensor_string_lag_major_transpose():
+    # two 3x3 lag slices; parsed result must be (3, 3, 2) with slice order
+    # preserved along the last axis
+    sl0 = [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0], [7.0, 8.0, 9.0]]
+    sl1 = [[10.0, 11.0, 12.0], [13.0, 14.0, 15.0], [16.0, 17.0, 18.0]]
+    s = repr([sl0, sl1])
+    t = parse_tensor_string_representation(s)
+    assert t.shape == (3, 3, 2)
+    np.testing.assert_array_equal(t[:, :, 0], sl0)
+    np.testing.assert_array_equal(t[:, :, 1], sl1)
+
+
+@pytest.mark.parametrize("fname,model_type", [
+    ("REDCLIFF_S_CMLP_d4IC_BSCgs1_cached_args.txt", "REDCLIFF_S_CMLP"),
+    ("cMLP_d4IC_BLgs1_cached_args.txt", "cMLP"),
+    ("cLSTM_d4IC_BLgs1Parsim_cached_args.txt", "cLSTM"),
+    ("DGCNN_d4IC_BLgs1Parsim_cached_args.txt", "DGCNN"),
+    ("DCSFANMF_d4IC_OBPgs1_cached_args.txt", "DCSFA"),
+    ("DYNOTEARS_Vanilla_d4IC_BCNIBCHVgs1Parsim_cached_args.txt",
+     "DYNOTEARS_Vanilla"),
+    ("NAVAR_CMLP_d4IC_BCTVgs1Parsim_cached_args.txt", "NAVAR_CMLP"),
+    ("REDCLIFF_S_CMLP_Smooth_d4IC_BSCgs4ParsimSmo0_cached_args.txt",
+     "REDCLIFF_S_CMLP_WithSmoothing"),
+])
+def test_read_reference_model_cached_args(fname, model_type):
+    """Parity check: every published reference cached-args file parses under
+    the family schema without error and yields typed values."""
+    path = os.path.join(REF_TRAIN, fname)
+    if not os.path.isfile(path):
+        pytest.skip(f"reference file absent: {fname}")
+    args = {"model_type": model_type, "model_cached_args_file": path}
+    out = read_in_model_args(args)
+    assert out is args
+    if model_type == "REDCLIFF_S_CMLP":
+        assert out["num_factors"] == 5
+        assert out["coeff_dict"]["FORECAST_COEFF"] == 10.0
+        assert out["coeff_dict"]["FACTOR_SCORE_COEFF"] == 100.0
+        assert out["gen_lag"] == 4
+        assert out["factor_score_embedder_type"] == "DGCNN"
+        assert out["primary_gc_est_mode"] == \
+            "conditional_factor_fixed_embedder"
+        assert isinstance(out["gen_hidden"], list)
+    if model_type == "DCSFA":
+        assert isinstance(out["n_components"], int)
+        assert "dirspec_params" in out
+    if model_type == "DYNOTEARS_Vanilla":
+        assert isinstance(out["lambda_w"], float)
+        assert out["X_train"] is None
+
+
+def test_read_data_args_with_adjacency_tensors(tmp_path):
+    rng = np.random.default_rng(1)
+    g1 = (rng.uniform(size=(4, 4, 2)) > 0.5).astype(float)
+    g2 = (rng.uniform(size=(4, 4, 2)) > 0.5).astype(float)
+    cached = {
+        "data_root_path": "/data/toy",
+        "num_channels": "4",
+        "net1_adjacency_tensor": serialize_tensor_to_string(g1),
+        "net2_adjacency_tensor": serialize_tensor_to_string(g2),
+    }
+    p = tmp_path / "toy_cached_args.txt"
+    with open(p, "w") as f:
+        json.dump(cached, f)
+
+    args = {"model_type": "REDCLIFF_S_CMLP", "data_cached_args_file": str(p)}
+    out = read_in_data_args(args, read_in_gc_factors_for_eval=True)
+    assert out["num_channels"] == 4
+    assert len(out["true_GC_factors"]) == 2
+    np.testing.assert_allclose(out["true_GC_factors"][0], g1)
+    np.testing.assert_allclose(out["true_GC_factors"][1], g2)
+    np.testing.assert_allclose(out["true_GC_tensor"][0], g1 + g2)
+
+    # lag-collapsing families get the summed nontemporal view
+    args2 = {"model_type": "DCSFA", "data_cached_args_file": str(p)}
+    out2 = read_in_data_args(args2)
+    np.testing.assert_allclose(out2["true_GC_tensor"][0],
+                               (g1 + g2).sum(axis=2))
+
+
+def test_read_reference_data_cached_args():
+    """The reference repo ships dataset cached-args with stringified tensors;
+    they must parse end-to-end."""
+    root = "/root/reference/cached_dataset_args"
+    if not os.path.isdir(root):
+        pytest.skip("no reference cached_dataset_args dir")
+    cands = [x for x in sorted(os.listdir(root)) if x.endswith(".txt")]
+    if not cands:
+        pytest.skip("no cached dataset args published")
+    path = os.path.join(root, cands[0])
+    with open(path) as f:
+        raw = json.load(f)
+    if not any("adjacency_tensor" in k for k in raw):
+        pytest.skip("first cached-args file carries no adjacency tensors")
+    args = {"model_type": "REDCLIFF_S_CMLP", "data_cached_args_file": path}
+    out = read_in_data_args(args, read_in_gc_factors_for_eval=True)
+    assert out["true_GC_factors"]
+    for t in out["true_GC_factors"]:
+        assert t.ndim == 3 and t.shape[0] == t.shape[1]
+
+
+def test_curate_synthetic_fold_roundtrip(tmp_path):
+    """Curation writes shards + cached-args; the config reader must recover
+    the exact ground-truth graphs and the shard loader the samples."""
+    from redcliff_tpu.data.curation import curate_synthetic_fold
+    from redcliff_tpu.data.shards import load_shard_samples
+
+    fold_dir, graphs = curate_synthetic_fold(
+        str(tmp_path), fold_id=0, num_nodes=5, num_factors=2,
+        num_samples_in_train_set=6, num_samples_in_val_set=2,
+        sample_recording_len=50, burnin_period=5)
+    train = load_shard_samples(os.path.join(fold_dir, "train"))
+    assert len(train) == 6
+    assert train[0][0].shape == (50, 5)
+
+    cached = [x for x in os.listdir(fold_dir) if "cached_args" in x]
+    assert len(cached) == 1
+    args = {"model_type": "REDCLIFF_S_CMLP",
+            "data_cached_args_file": os.path.join(fold_dir, cached[0])}
+    out = read_in_data_args(args, read_in_gc_factors_for_eval=True)
+    assert out["num_channels"] == 5
+    assert len(out["true_GC_factors"]) == 2
+    for est, true in zip(out["true_GC_factors"], graphs):
+        np.testing.assert_allclose(est, true, rtol=1e-10)
+
+
+def test_clean_and_aggregate(tmp_path):
+    from redcliff_tpu.data.curation import (
+        aggregate_synthetic_systems_datasets,
+        clean_incomplete_experiment_folders,
+        curate_synthetic_fold,
+    )
+
+    root = tmp_path / "curated"
+    os.makedirs(root)
+    curate_synthetic_fold(str(root), fold_id=0, num_nodes=5, num_factors=2,
+                          num_samples_in_train_set=2, num_samples_in_val_set=1,
+                          sample_recording_len=30, folder_name="sysA")
+    # incomplete experiment: fold dir without cached args
+    os.makedirs(root / "sysB" / "fold_0")
+    kept = clean_incomplete_experiment_folders(str(root), num_folds=1)
+    assert len(kept) == 1 and "sysA" in kept[0]
+    assert not os.path.exists(root / "sysB")
+
+    dest = aggregate_synthetic_systems_datasets(
+        [str(root / "sysA")], str(tmp_path), "SynSys-bench")
+    assert os.path.isdir(os.path.join(dest, "sysA", "fold_0"))
+
+
+def test_dcsfa_dirspec_params_match_reference():
+    path = os.path.join(REF_TRAIN, "DCSFANMF_d4IC_OBPgs1_cached_args.txt")
+    if not os.path.isfile(path):
+        pytest.skip("reference cached-args absent")
+    args = {"model_type": "DCSFA", "model_cached_args_file": path}
+    read_in_model_args(args)
+    dp = args["dirspec_params"]
+    assert dp["fs"] == 1000 and dp["max_freq"] == 250.0
+    assert dp["csd_params"]["nperseg"] == args["num_node_features"]
+    assert args["max_num_features_per_series"] == args["num_node_features"]
+
+
+def test_include_gc_views_for_eval(tmp_path):
+    rng = np.random.default_rng(2)
+    g1 = (rng.uniform(size=(4, 4, 2)) > 0.5).astype(float)
+    cached = {"data_root_path": "/d", "num_channels": "4",
+              "net1_adjacency_tensor": serialize_tensor_to_string(g1)}
+    p = tmp_path / "c.txt"
+    with open(p, "w") as f:
+        json.dump(cached, f)
+    args = {"model_type": "DCSFA", "data_cached_args_file": str(p)}
+    out = read_in_data_args(args, include_gc_views_for_eval=True)
+    np.testing.assert_allclose(out["true_lagged_GC_tensor_factors"][0], g1)
+    np.testing.assert_allclose(out["true_nontemporal_GC_tensor"],
+                               g1.sum(axis=2))
+
+
+def test_wavelet_signal_format_passthrough():
+    from redcliff_tpu.data.shards import apply_signal_format
+
+    X = np.ones((2, 8, 3), np.float32)
+    out = apply_signal_format(X, "wavelet_decomp")
+    np.testing.assert_array_equal(out, X)
