@@ -50,6 +50,8 @@ def save_cached_args_file_for_data(data_root_path, num_channels,
     """Write the data cached-args JSON with stringified ground-truth tensors
     (ref data_utils.py:32-45).  Tensors are stored reverse-lag-major so the
     readers' lag reversal restores them."""
+    import json
+
     entries = {
         "data_root_path": data_root_path,
         "num_channels": str(num_channels),
@@ -57,9 +59,8 @@ def save_cached_args_file_for_data(data_root_path, num_channels,
     for i, tensor in enumerate(adjacency_tensors):
         entries[f"net{i + 1}_adjacency_tensor"] = \
             serialize_tensor_to_string(np.asarray(tensor, dtype=np.float64))
-    parts = ", ".join(f'"{k}": "{v}"' for k, v in entries.items())
     with open(os.path.join(data_root_path, final_file_name), "w") as f:
-        f.write("{" + parts + "}")
+        json.dump(entries, f)
 
 
 def experiment_folder_name(num_factors, num_supervised_factors, num_nodes,

@@ -1,0 +1,27 @@
+"""Training layer: generic and REDCLIFF trainers, orchestration dispatch,
+and experiment drivers (rebuilds /root/reference/train/ + the fit/dispatch
+half of general_utils/model_utils.py)."""
+from .driver import (
+    kick_off_model_training_experiment,
+    rescale_dataset_dependent_coefficients,
+    run_coefficient_grid,
+    run_folder_name,
+    set_up_and_run_experiments,
+)
+from .orchestration import (
+    call_model_fit_method,
+    create_model_instance,
+    get_data_for_model_training,
+)
+from .redcliff_trainer import RedcliffTrainConfig, RedcliffTrainer
+from .trainer import FitResult, TrainConfig, Trainer, load_model, save_model
+
+__all__ = [
+    "kick_off_model_training_experiment",
+    "rescale_dataset_dependent_coefficients",
+    "run_coefficient_grid", "run_folder_name", "set_up_and_run_experiments",
+    "call_model_fit_method", "create_model_instance",
+    "get_data_for_model_training",
+    "RedcliffTrainConfig", "RedcliffTrainer",
+    "FitResult", "TrainConfig", "Trainer", "load_model", "save_model",
+]

@@ -1,0 +1,164 @@
+"""L5 experiment drivers: array-task grid search and vmapped grid execution.
+
+Rebuilds the reference's train-script template (canonical:
+/root/reference/train/REDCLIFF_S_CMLP_d4IC_BSCgs1.py): hyperparameter
+cartesian products indexed by an array-task id (SLURM-launchable per host),
+hparam-encoded run-folder names the eval layer parses back, auto-resume on
+existing artifacts, and the dataset-dependent coefficient rescaling done in
+every driver (ref :98-105).
+
+TPU-first addition: ``run_coefficient_grid`` trains many coefficient
+variations of one REDCLIFF model concurrently — the train step vmapped over
+the grid axis and sharded over the device mesh (parallel.grid), replacing
+one-process-per-grid-point SLURM arrays (SURVEY.md §2.8, §7 delta 3).
+"""
+from __future__ import annotations
+
+import os
+import random
+from itertools import product
+
+import numpy as np
+
+from ..utils.config import read_in_data_args, read_in_model_args
+from .orchestration import (
+    call_model_fit_method,
+    create_model_instance,
+    get_data_for_model_training,
+)
+
+__all__ = [
+    "run_folder_name",
+    "rescale_dataset_dependent_coefficients",
+    "kick_off_model_training_experiment",
+    "set_up_and_run_experiments",
+    "run_coefficient_grid",
+]
+
+
+def run_folder_name(args_dict):
+    """Hyperparameter-encoded run folder (ref :19-30); the eval layer locates
+    runs by dataset/fold substrings of this name."""
+    cd = args_dict.get("coeff_dict", {})
+
+    def fmt(v, clip=None):
+        s = str(v).replace(".", "-")
+        return s[:clip] if clip else s
+
+    parts = [str(args_dict["model_type"]), str(args_dict["data_set_name"])]
+    if "FORECAST_COEFF" in cd:
+        parts.append("fc" + fmt(cd["FORECAST_COEFF"]))
+    if "FACTOR_SCORE_COEFF" in cd:
+        parts.append("fsc" + fmt(cd["FACTOR_SCORE_COEFF"]))
+    if "FACTOR_COS_SIM_COEFF" in cd:
+        parts.append("fcsc" + fmt(cd["FACTOR_COS_SIM_COEFF"], 8))
+    if "FACTOR_WEIGHT_L1_COEFF" in cd:
+        parts.append("fwl1c" + fmt(cd["FACTOR_WEIGHT_L1_COEFF"]))
+    if "ADJ_L1_REG_COEFF" in cd:
+        parts.append("al1c" + fmt(cd["ADJ_L1_REG_COEFF"], 8))
+    return "_".join(parts)
+
+
+def rescale_dataset_dependent_coefficients(args_dict):
+    """The per-driver coefficient normalization (ref :98-105):
+    FACTOR_COS_SIM_COEFF is divided by the number of factor pairs' triangular
+    sum, ADJ_L1_REG_COEFF by K*sqrt(C^2 - 1), and the stopping-criteria
+    coefficients mirror the loss coefficients."""
+    cd = args_dict["coeff_dict"]
+    K = args_dict["num_factors"]
+    C = args_dict["num_channels"]
+    if "FACTOR_COS_SIM_COEFF" in cd and K > 1:
+        cd["FACTOR_COS_SIM_COEFF"] = (
+            cd["FACTOR_COS_SIM_COEFF"] / sum(1.0 * i for i in range(1, K)))
+    if "ADJ_L1_REG_COEFF" in cd:
+        cd["ADJ_L1_REG_COEFF"] = (
+            cd["ADJ_L1_REG_COEFF"] * (1.0 / K)
+            * (1.0 / np.sqrt(C ** 2.0 - 1.0)))
+    args_dict["stopping_criteria_forecast_coeff"] = cd.get(
+        "FORECAST_COEFF", 1.0)
+    args_dict["stopping_criteria_factor_coeff"] = cd.get(
+        "FACTOR_SCORE_COEFF", 1.0)
+    args_dict["stopping_criteria_cosSim_coeff"] = cd.get(
+        "FACTOR_COS_SIM_COEFF", 1.0)
+    return args_dict
+
+
+def kick_off_model_training_experiment(args_dict, resume_training=False,
+                                       grid_search=False, seed=0):
+    """One training run end-to-end (ref :17-63): resolve/clean the run dir,
+    auto-resume when artifacts exist, load data, build the model, fit."""
+    save_dir = os.path.join(args_dict["save_root_path"],
+                            run_folder_name(args_dict))
+    args_dict["save_path"] = save_dir
+    if not os.path.exists(save_dir):
+        os.makedirs(save_dir)
+    elif "final_best_model.bin" in os.listdir(save_dir):
+        resume_training = True
+    else:
+        for f in os.listdir(save_dir):
+            path = os.path.join(save_dir, f)
+            if os.path.isfile(path):
+                os.remove(path)
+
+    train_ds, val_ds = get_data_for_model_training(args_dict,
+                                                   grid_search=grid_search)
+    model = create_model_instance(
+        args_dict,
+        employ_version_with_smoothing_loss="Smooth" in
+        args_dict["model_type"] or "WithSmoothing" in args_dict["model_type"])
+    params, result = call_model_fit_method(
+        model, args_dict, train_ds, val_ds, save_dir=save_dir, seed=seed)
+    return model, params, result
+
+
+def set_up_and_run_experiments(args_dict, files_of_cached_model_args,
+                               files_of_cached_data_args,
+                               possible_model_types, possible_data_sets,
+                               shuffle_seed=0, task_id=None,
+                               grid_search=False):
+    """Array-task entry point (ref :66-110): pick one (model_type, dataset)
+    from the shuffled cartesian product by task id (1-based, from
+    SLURM_ARRAY_TASK_ID when not given), read its cached-args, rescale
+    coefficients, and run."""
+    combos = list(product(possible_model_types, possible_data_sets))
+    random.Random(shuffle_seed).shuffle(combos)
+    if task_id is None:
+        task_id = int(os.environ["SLURM_ARRAY_TASK_ID"])
+    model_type, data_set_name = combos[task_id - 1]
+
+    args_dict["model_type"] = model_type
+    matches = [x for x in files_of_cached_model_args if model_type in x]
+    assert len(matches) == 1, (model_type, matches)
+    args_dict["model_cached_args_file"] = matches[0]
+
+    args_dict["data_set_name"] = data_set_name
+    matches = [x for x in files_of_cached_data_args if data_set_name in x]
+    assert len(matches) == 1, (data_set_name, matches)
+    args_dict["data_cached_args_file"] = matches[0]
+
+    read_in_model_args(args_dict)
+    read_in_data_args(args_dict)
+    if "coeff_dict" in args_dict and "REDCLIFF" in model_type:
+        rescale_dataset_dependent_coefficients(args_dict)
+
+    kick_off_model_training_experiment(args_dict, grid_search=grid_search)
+    return task_id
+
+
+def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
+                         key=None, mesh=None, max_iter=None):
+    """Train G coefficient/optimizer variations of one REDCLIFF model
+    concurrently on the device mesh (see parallel.grid.RedcliffGridRunner).
+
+    grid_points: list of dicts over the grid axes (e.g. {"gen_lr": ...,
+    "factor_cos_sim_coeff": ...}).  Returns the GridResult with per-point
+    best params/criteria.
+    """
+    import jax
+
+    from ..parallel.grid import GridSpec, RedcliffGridRunner
+
+    spec = GridSpec(points=list(grid_points))
+    runner = RedcliffGridRunner(model, train_config, spec, mesh=mesh)
+    key = key if key is not None else jax.random.PRNGKey(train_config.seed)
+    return runner.fit(key, train_ds, val_ds, max_iter=max_iter)

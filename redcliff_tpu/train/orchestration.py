@@ -41,6 +41,12 @@ def create_model_instance(args_dict, employ_version_with_smoothing_loss=False):
             "published; see SURVEY.md §2.2")
 
     if "REDCLIFF" in model_type and "CMLP" in model_type:
+        if "_S_" not in model_type:
+            # the reference factory raises here too (model_utils.py:414)
+            raise NotImplementedError(
+                "only the supervised REDCLIFF_S_CMLP variant exists; the "
+                "unsupervised REDCLIFF_CMLP is unimplemented in the "
+                "reference as well")
         from ..models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
 
         emb_args = dict(args_dict.get("factor_score_embedder_args", []))

@@ -75,25 +75,27 @@ def serialize_tensor_to_string(tensor, reverse_lags=True):
     return repr([[list(map(float, row)) for row in sl] for sl in lag_major])
 
 
-def read_in_data_adjacency_matrices(args_dict, cached_args_file_path):
-    """Load per-factor true GC tensors from a data cached-args file
-    (ref :51-93, minus the plotting side effects).  Lagged tensors are stored
-    reverse-lag-major and corrected here (ref :62)."""
-    with open(cached_args_file_path, "r") as f:
-        data_args = json.load(f)
+def _factor_index(key):
+    """'net<i>_adjacency_tensor' -> i-1, parsing the full integer (the
+    reference reads only key[3], breaking at 10+ factors — ref :65)."""
+    assert key.startswith("net"), key
+    return int(key[3 : key.index("_")]) - 1
+
+
+def _fill_gc_views(args_dict, lagged_by_index):
+    """Per-factor lagged + nontemporal views and their sums
+    (ref :51-93 read_in_data_adjacency_matrices semantics).  The factor list
+    keeps the reference's minimum of 4 slots but grows with the data."""
+    n_slots = max(4, max(lagged_by_index, default=-1) + 1)
     args_dict["true_lagged_GC_tensor"] = None
     args_dict["true_nontemporal_GC_tensor"] = None
-    args_dict["true_lagged_GC_tensor_factors"] = [None, None, None, None]
-    args_dict["true_nontemporal_GC_tensor_factors"] = [None, None, None, None]
-    for key, val in data_args.items():
-        if "adjacency_tensor" not in key:
-            continue
-        lagged = parse_tensor_string_representation(val)[:, :, ::-1].copy()
+    args_dict["true_lagged_GC_tensor_factors"] = [None] * n_slots
+    args_dict["true_nontemporal_GC_tensor_factors"] = [None] * n_slots
+    for idx in sorted(lagged_by_index):
+        lagged = lagged_by_index[idx]
         nontemporal = lagged.sum(axis=2)
-        factor_ind = int(key[3]) - 1  # keys follow "net<i>_..." convention
-        args_dict["true_lagged_GC_tensor_factors"][factor_ind] = lagged
-        args_dict["true_nontemporal_GC_tensor_factors"][factor_ind] = \
-            nontemporal
+        args_dict["true_lagged_GC_tensor_factors"][idx] = lagged
+        args_dict["true_nontemporal_GC_tensor_factors"][idx] = nontemporal
         if args_dict["true_lagged_GC_tensor"] is None:
             args_dict["true_lagged_GC_tensor"] = lagged
             args_dict["true_nontemporal_GC_tensor"] = nontemporal
@@ -103,6 +105,20 @@ def read_in_data_adjacency_matrices(args_dict, cached_args_file_path):
             args_dict["true_nontemporal_GC_tensor"] = \
                 args_dict["true_nontemporal_GC_tensor"] + nontemporal
     return args_dict
+
+
+def read_in_data_adjacency_matrices(args_dict, cached_args_file_path):
+    """Load per-factor true GC tensors from a data cached-args file
+    (ref :51-93, minus the plotting side effects).  Lagged tensors are stored
+    reverse-lag-major and corrected here (ref :62)."""
+    with open(cached_args_file_path, "r") as f:
+        data_args = json.load(f)
+    lagged_by_index = {
+        _factor_index(key):
+            parse_tensor_string_representation(val)[:, :, ::-1].copy()
+        for key, val in data_args.items() if "adjacency_tensor" in key
+    }
+    return _fill_gc_views(args_dict, lagged_by_index)
 
 
 def _opt(value, cast=str):
@@ -402,7 +418,8 @@ def read_in_data_args(args_dict, include_gc_views_for_eval=False,
             t = parse_tensor_string_representation(val)
             lagged_tensors[key] = t[:, :, ::-1].copy()
 
-    keys_sorted = sorted(lagged_tensors)
+    # order by the parsed factor index, not lexicographically (net10 < net2)
+    keys_sorted = sorted(lagged_tensors, key=_factor_index)
     if read_in_gc_factors_for_eval:
         args_dict["true_GC_factors"] = [lagged_tensors[k]
                                         for k in keys_sorted]
@@ -426,10 +443,10 @@ def read_in_data_args(args_dict, include_gc_views_for_eval=False,
         args_dict["true_GC_tensor"] = [total] if total is not None else None
 
     if include_gc_views_for_eval:
-        # lagged + nontemporal per-factor views (ref :644-660, implemented by
-        # read_in_data_adjacency_matrices)
-        read_in_data_adjacency_matrices(args_dict,
-                                        args_dict["data_cached_args_file"])
+        # lagged + nontemporal per-factor views (ref :644-660), derived from
+        # the tensors already parsed above
+        _fill_gc_views(args_dict, {_factor_index(k): lagged_tensors[k]
+                                   for k in keys_sorted})
 
     for extra in ("num_samples", "num_folds", "num_states",
                   "sample_recording_len"):

@@ -1,0 +1,249 @@
+"""Matplotlib visualization helpers.
+
+Rebuilds the plotting surface of /root/reference/general_utils/plotting.py
+(~25 helpers called from every fit()/save_checkpoint() and the eval drivers):
+GC est-vs-true heatmap comparisons (:291-398), scatter + standard-error
+overlays (:128-258), metric-history curves, signal-channel and wavelet plots
+(:399-580), state-score traces (:582-634), and cross-experiment summary grids
+(:14-126).  All helpers write a PNG and close their figure (the reference's
+fit loops emit dozens of figures per checkpoint; leaking them OOMs long runs).
+"""
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+__all__ = [
+    "plot_heatmap",
+    "plot_gc_est_comparison",
+    "plot_gc_est_comparisons_by_factor",
+    "make_scatter_and_std_err_of_mean_plot_overlay",
+    "plot_metric_histories",
+    "plot_all_signal_channels",
+    "plot_x_wavelet_comparison",
+    "plot_state_score_traces",
+    "plot_reconstruction_comparison",
+    "plot_cross_experiment_summary_grid",
+]
+
+# reference-name aliases (the reference spells it "comparisson")
+def _save(fig, save_path):
+    fig.tight_layout()
+    fig.savefig(save_path)
+    plt.close(fig)
+
+
+def plot_heatmap(A, save_path, title="", xlabel="source", ylabel="target",
+                 cmap="viridis"):
+    """Single-matrix heatmap (ref plotting.py:259-289)."""
+    A = np.asarray(A)
+    fig, ax = plt.subplots(figsize=(5, 4))
+    im = ax.imshow(A, cmap=cmap, aspect="auto")
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    fig.colorbar(im, ax=ax)
+    _save(fig, save_path)
+
+
+def plot_gc_est_comparison(true_gc, est_gc, save_path, include_lags=False):
+    """Side-by-side true vs estimated GC heatmaps; with ``include_lags`` each
+    lag slice gets its own column pair (ref plotting.py:291-381)."""
+    est_gc = None if est_gc is None else np.asarray(est_gc)
+    true_gc = None if true_gc is None else np.asarray(true_gc)
+    mats = []
+    if include_lags:
+        for name, M in (("true", true_gc), ("est", est_gc)):
+            if M is None:
+                continue
+            M = M[:, :, None] if M.ndim == 2 else M
+            for l in range(M.shape[2]):
+                mats.append((f"{name} lag {l + 1}", M[:, :, l]))
+    else:
+        for name, M in (("true", true_gc), ("est", est_gc)):
+            if M is None:
+                continue
+            if M.ndim == 3:
+                M = M.sum(axis=2)
+            mats.append((name, M))
+    if not mats:
+        return
+    fig, axes = plt.subplots(1, len(mats),
+                             figsize=(3.2 * len(mats), 3), squeeze=False)
+    for ax, (title, M) in zip(axes[0], mats):
+        im = ax.imshow(M, cmap="viridis", aspect="auto")
+        ax.set_title(title)
+        fig.colorbar(im, ax=ax, fraction=0.046)
+    _save(fig, save_path)
+
+
+def plot_gc_est_comparisons_by_factor(true_gcs, est_gcs, save_path,
+                                      include_lags=False):
+    """One row per factor of true/est GC heatmaps (ref plotting.py:383-398;
+    either list may be None, matching the curation-time usage that plots
+    ground truth alone)."""
+    n_true = 0 if true_gcs is None else len(true_gcs)
+    n_est = 0 if est_gcs is None else len(est_gcs)
+    K = max(n_true, n_est)
+    if K == 0:
+        return
+    fig, axes = plt.subplots(K, 2, figsize=(7, 3 * K), squeeze=False)
+    for k in range(K):
+        for col, (name, src) in enumerate((("true", true_gcs),
+                                           ("est", est_gcs))):
+            ax = axes[k][col]
+            if src is None or k >= len(src):
+                ax.axis("off")
+                continue
+            M = np.asarray(src[k])
+            if M.ndim == 3:
+                M = M.sum(axis=2) if not include_lags else M[:, :, 0]
+            im = ax.imshow(M, cmap="viridis", aspect="auto")
+            ax.set_title(f"factor {k} {name}")
+            fig.colorbar(im, ax=ax, fraction=0.046)
+    _save(fig, save_path)
+
+
+def make_scatter_and_std_err_of_mean_plot_overlay(results_by_group, save_path,
+                                                  title, xlabel, ylabel,
+                                                  alpha=0.5):
+    """Per-group value scatter with mean ± SEM overlay — the cross-algorithm
+    comparison figure (ref plotting.py:128-258)."""
+    groups = list(results_by_group.keys())
+    fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(groups)), 4))
+    rng = np.random.default_rng(0)
+    for i, g in enumerate(groups):
+        vals = np.asarray([v for v in results_by_group[g] if v is not None],
+                          dtype=np.float64)
+        if vals.size == 0:
+            continue
+        jitter = rng.uniform(-0.15, 0.15, size=vals.size)
+        ax.scatter(np.full(vals.size, i) + jitter, vals, alpha=alpha, s=18)
+        mean = vals.mean()
+        sem = vals.std() / np.sqrt(vals.size)
+        ax.errorbar([i], [mean], yerr=[sem], fmt="o", color="black",
+                    capsize=4, markersize=6, zorder=3)
+    ax.set_xticks(range(len(groups)))
+    ax.set_xticklabels(groups, rotation=30, ha="right", fontsize=8)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    _save(fig, save_path)
+
+
+def plot_metric_histories(histories, save_path, title="training histories",
+                          ylog=False):
+    """Overlayed per-epoch metric curves from a {name: [values]} dict — the
+    loss-curve panels every checkpoint writes (ref save_checkpoint usage,
+    redcliff_s_cmlp.py:942-1112)."""
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for name, vals in histories.items():
+        vals = [v for v in vals if v is not None and np.isscalar(v)
+                or isinstance(v, (int, float, np.floating))]
+        if not vals:
+            continue
+        ax.plot(range(len(vals)), vals, label=str(name), linewidth=1.2)
+    if ylog:
+        ax.set_yscale("log")
+    ax.set_xlabel("epoch")
+    ax.legend(fontsize=7)
+    ax.set_title(title)
+    _save(fig, save_path)
+
+
+def plot_all_signal_channels(X, save_path, title="signal", fs=None):
+    """Stacked per-channel traces of one (T, C) recording
+    (ref plotting.py:399-460)."""
+    X = np.asarray(X)
+    T, C = X.shape
+    t = np.arange(T) / fs if fs else np.arange(T)
+    fig, axes = plt.subplots(C, 1, figsize=(8, 1.2 * C), sharex=True,
+                             squeeze=False)
+    for c in range(C):
+        axes[c][0].plot(t, X[:, c], linewidth=0.7)
+        axes[c][0].set_ylabel(f"ch{c}", fontsize=7)
+    axes[-1][0].set_xlabel("time (s)" if fs else "step")
+    axes[0][0].set_title(title)
+    _save(fig, save_path)
+
+
+def plot_x_wavelet_comparison(X, X_wavelet, save_path):
+    """Original signal vs its wavelet-band decomposition
+    (ref plotting.py:462-520)."""
+    X = np.asarray(X)
+    X_wavelet = np.asarray(X_wavelet)
+    fig, axes = plt.subplots(2, 1, figsize=(8, 5), sharex=True)
+    axes[0].plot(X, linewidth=0.7)
+    axes[0].set_title("original channels")
+    axes[1].plot(X_wavelet.reshape(X_wavelet.shape[0], -1), linewidth=0.5)
+    axes[1].set_title("wavelet bands")
+    _save(fig, save_path)
+
+
+def plot_state_score_traces(scores, save_path, labels=None,
+                            title="state scores"):
+    """Per-state factor-score traces over time (ref plotting.py:582-634)."""
+    scores = np.asarray(scores)  # (num_states, T)
+    fig, ax = plt.subplots(figsize=(8, 3.5))
+    for s in range(scores.shape[0]):
+        name = labels[s] if labels else f"state {s}"
+        ax.plot(scores[s], label=name, linewidth=1.0)
+    ax.set_xlabel("step")
+    ax.set_ylabel("score")
+    ax.set_title(title)
+    ax.legend(fontsize=8)
+    _save(fig, save_path)
+
+
+def plot_reconstruction_comparison(x_orig, x_recon, save_path):
+    """Flattened original vs reconstruction overlay (the DCSFA evaluate
+    figure, ref models/dcsfa_nmf.py:1346)."""
+    fig, ax = plt.subplots(figsize=(8, 3.5))
+    ax.plot(np.asarray(x_orig).ravel(), label="original", linewidth=0.7)
+    ax.plot(np.asarray(x_recon).ravel(), label="reconstruction",
+            linewidth=0.7, alpha=0.8)
+    ax.legend(fontsize=8)
+    ax.set_title("reconstruction comparison")
+    _save(fig, save_path)
+
+
+def plot_cross_experiment_summary_grid(summary, save_path, metric_key,
+                                       title=None):
+    """Grid of per-(dataset, algorithm) metric means — the cross-experiment
+    summary figure (ref plotting.py:14-126).  ``summary`` maps
+    {dataset: {algorithm: value}}."""
+    datasets = list(summary.keys())
+    algs = sorted({a for d in summary.values() for a in d})
+    M = np.full((len(datasets), len(algs)), np.nan)
+    for i, d in enumerate(datasets):
+        for j, a in enumerate(algs):
+            v = summary[d].get(a)
+            if v is not None:
+                M[i, j] = v
+    fig, ax = plt.subplots(figsize=(1.2 * len(algs) + 2,
+                                    0.6 * len(datasets) + 2))
+    im = ax.imshow(M, cmap="viridis", aspect="auto")
+    ax.set_xticks(range(len(algs)))
+    ax.set_xticklabels(algs, rotation=30, ha="right", fontsize=8)
+    ax.set_yticks(range(len(datasets)))
+    ax.set_yticklabels(datasets, fontsize=8)
+    for i in range(len(datasets)):
+        for j in range(len(algs)):
+            if np.isfinite(M[i, j]):
+                ax.text(j, i, f"{M[i, j]:.3f}", ha="center", va="center",
+                        fontsize=7, color="white")
+    fig.colorbar(im, ax=ax)
+    ax.set_title(title or metric_key)
+    _save(fig, save_path)
+
+
+# aliases matching the reference's spelling for drop-in compatibility
+plot_gc_est_comparisson = plot_gc_est_comparison
+plot_gc_est_comparissons_by_factor = plot_gc_est_comparisons_by_factor
+make_scatter_and_stdErrOfMean_plot_overlay_vis = \
+    make_scatter_and_std_err_of_mean_plot_overlay
+plot_reconstruction_comparisson = plot_reconstruction_comparison

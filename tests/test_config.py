@@ -224,3 +224,23 @@ def test_wavelet_signal_format_passthrough():
     X = np.ones((2, 8, 3), np.float32)
     out = apply_signal_format(X, "wavelet_decomp")
     np.testing.assert_array_equal(out, X)
+
+
+def test_many_factor_ordering_and_gc_views(tmp_path):
+    """10+ factors must keep numeric order and fill grown gc-view slots."""
+    rng = np.random.default_rng(3)
+    graphs = [np.full((3, 3, 1), float(i + 1)) for i in range(11)]
+    cached = {"data_root_path": "/d", "num_channels": "3"}
+    for i, g in enumerate(graphs):
+        cached[f"net{i+1}_adjacency_tensor"] = serialize_tensor_to_string(g)
+    p = tmp_path / "many.txt"
+    with open(p, "w") as f:
+        json.dump(cached, f)
+    args = {"model_type": "REDCLIFF_S_CMLP", "data_cached_args_file": str(p)}
+    out = read_in_data_args(args, read_in_gc_factors_for_eval=True,
+                            include_gc_views_for_eval=True)
+    assert len(out["true_GC_factors"]) == 11
+    for i, t in enumerate(out["true_GC_factors"]):
+        assert t[0, 0, 0] == float(i + 1), i
+    assert len(out["true_lagged_GC_tensor_factors"]) == 11
+    assert out["true_lagged_GC_tensor_factors"][10][0, 0, 0] == 11.0

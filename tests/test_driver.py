@@ -1,0 +1,122 @@
+"""Tests for the L5 experiment driver layer."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.data.curation import curate_synthetic_fold
+from redcliff_tpu.train.driver import (
+    kick_off_model_training_experiment,
+    rescale_dataset_dependent_coefficients,
+    run_coefficient_grid,
+    run_folder_name,
+    set_up_and_run_experiments,
+)
+
+
+def test_run_folder_name_encoding():
+    args = {"model_type": "REDCLIFF_S_CMLP", "data_set_name": "d4IC_HSNR",
+            "coeff_dict": {"FORECAST_COEFF": 10.0,
+                           "FACTOR_SCORE_COEFF": 100.0,
+                           "FACTOR_COS_SIM_COEFF": 0.123456789,
+                           "FACTOR_WEIGHT_L1_COEFF": 0.001,
+                           "ADJ_L1_REG_COEFF": 1.0}}
+    name = run_folder_name(args)
+    assert name.startswith("REDCLIFF_S_CMLP_d4IC_HSNR_fc10-0")
+    assert "fsc100-0" in name
+    assert "fcsc0-123456"[:8] in name  # clipped to 8 chars
+    assert "." not in name
+
+
+def test_coefficient_rescaling():
+    args = {"num_factors": 5, "num_channels": 10,
+            "coeff_dict": {"FORECAST_COEFF": 10.0,
+                           "FACTOR_SCORE_COEFF": 100.0,
+                           "FACTOR_COS_SIM_COEFF": 1.0,
+                           "ADJ_L1_REG_COEFF": 1.0}}
+    rescale_dataset_dependent_coefficients(args)
+    cd = args["coeff_dict"]
+    assert cd["FACTOR_COS_SIM_COEFF"] == pytest.approx(1.0 / 10.0)  # sum 1..4
+    assert cd["ADJ_L1_REG_COEFF"] == pytest.approx(
+        (1.0 / 5.0) / np.sqrt(99.0))
+    assert args["stopping_criteria_forecast_coeff"] == 10.0
+    assert args["stopping_criteria_factor_coeff"] == 100.0
+    assert args["stopping_criteria_cosSim_coeff"] == cd[
+        "FACTOR_COS_SIM_COEFF"]
+
+
+def _write_cmlp_model_args(path):
+    model_args = {
+        "num_sims": "1", "embed_hidden_sizes": "[8]", "batch_size": "4",
+        "gen_eps": "0.0001", "gen_weight_decay": "0.0", "max_iter": "2",
+        "lookback": "2", "check_every": "2", "verbose": "0",
+        "output_length": "1", "wavelet_level": "None", "gen_hidden": "[8]",
+        "gen_lr": "0.01", "gen_lag_and_input_len": "3",
+        "FORECAST_COEFF": "1.0", "ADJ_L1_REG_COEFF": "0.01",
+        "DAGNESS_REG_COEFF": "0.0", "DAGNESS_LAG_COEFF": "0.0",
+        "DAGNESS_NODE_COEFF": "0.0",
+    }
+    with open(path, "w") as f:
+        json.dump(model_args, f)
+
+
+def test_set_up_and_run_experiments_array_task(tmp_path):
+    fold_dir, _ = curate_synthetic_fold(
+        str(tmp_path / "data"), fold_id=0, num_nodes=5, num_factors=2,
+        num_samples_in_train_set=6, num_samples_in_val_set=3,
+        sample_recording_len=30, folder_name="toySys")
+    margs = tmp_path / "cMLP_toy_cached_args.txt"
+    _write_cmlp_model_args(str(margs))
+    data_args_file = os.path.join(fold_dir, "data_fold0_cached_args.txt")
+
+    save_root = tmp_path / "runs"
+    os.makedirs(save_root)
+    args = {"save_root_path": str(save_root)}
+    task_id = set_up_and_run_experiments(
+        args, [str(margs)], [data_args_file],
+        possible_model_types=["cMLP"],
+        possible_data_sets=["data_fold0"], task_id=1)
+    assert task_id == 1
+    runs = os.listdir(save_root)
+    assert len(runs) == 1 and runs[0].startswith("cMLP_data_fold0")
+    run_dir = save_root / runs[0]
+    assert (run_dir / "final_best_model.bin").exists()
+
+    # rerun with existing artifacts flips into resume mode without error
+    set_up_and_run_experiments(
+        args, [str(margs)], [data_args_file],
+        possible_model_types=["cMLP"],
+        possible_data_sets=["data_fold0"], task_id=1)
+
+
+def test_run_coefficient_grid_over_mesh(tmp_path):
+    """TPU-first grid execution: several coefficient variants trained at once
+    over the virtual 8-device CPU mesh."""
+    import jax
+
+    from redcliff_tpu.models.redcliff import (
+        RedcliffSCMLP,
+        RedcliffSCMLPConfig,
+    )
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    from redcliff_tpu.data.datasets import ArrayDataset
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, 20, 4)).astype(np.float32)
+    Y = rng.uniform(size=(16, 2, 20)).astype(np.float32)
+    train = ArrayDataset(X[:12], Y[:12])
+    val = ArrayDataset(X[12:], Y[12:], stats=train.stats)
+
+    cfg = RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(6,), embed_lag=4,
+        embed_hidden_sizes=(6,), num_factors=2, num_supervised_factors=2,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive",
+        training_mode="combined", num_pretrain_epochs=0)
+    model = RedcliffSCMLP(cfg)
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=4, check_every=2)
+    points = [{"gen_lr": 1e-3 * (i + 1)} for i in range(4)]
+    result = run_coefficient_grid(model, tc, points, train, val)
+    assert len(result.best_criteria) == 4
+    assert np.isfinite(np.asarray(result.best_criteria)).all()

@@ -1,0 +1,121 @@
+"""Smoke tests for the plotting helpers: every figure writes a nonempty PNG."""
+import os
+
+import numpy as np
+
+from redcliff_tpu.utils.plotting import (
+    make_scatter_and_std_err_of_mean_plot_overlay,
+    plot_all_signal_channels,
+    plot_cross_experiment_summary_grid,
+    plot_gc_est_comparison,
+    plot_gc_est_comparisons_by_factor,
+    plot_heatmap,
+    plot_metric_histories,
+    plot_reconstruction_comparison,
+    plot_state_score_traces,
+    plot_x_wavelet_comparison,
+)
+
+
+def _written(path):
+    return os.path.isfile(path) and os.path.getsize(path) > 0
+
+
+def test_heatmap_and_gc_comparisons(tmp_path):
+    rng = np.random.default_rng(0)
+    A = rng.uniform(size=(5, 5))
+    p1 = str(tmp_path / "hm.png")
+    plot_heatmap(A, p1, title="t")
+    assert _written(p1)
+
+    true_gc = rng.uniform(size=(5, 5, 2))
+    est_gc = rng.uniform(size=(5, 5, 2))
+    p2 = str(tmp_path / "cmp.png")
+    plot_gc_est_comparison(true_gc, est_gc, p2, include_lags=True)
+    assert _written(p2)
+    p3 = str(tmp_path / "cmp_nolag.png")
+    plot_gc_est_comparison(true_gc, est_gc, p3, include_lags=False)
+    assert _written(p3)
+
+    p4 = str(tmp_path / "byfac.png")
+    plot_gc_est_comparisons_by_factor([true_gc, true_gc], [est_gc, est_gc],
+                                      p4)
+    assert _written(p4)
+    # curation-time usage: truth only, no estimates
+    p5 = str(tmp_path / "truthonly.png")
+    plot_gc_est_comparisons_by_factor([true_gc], None, p5, include_lags=True)
+    assert _written(p5)
+
+
+def test_scatter_sem_and_histories(tmp_path):
+    results = {"algA": [0.8, 0.9, 0.85], "algB": [0.6, 0.7, None],
+               "empty": []}
+    p = str(tmp_path / "scatter.png")
+    make_scatter_and_std_err_of_mean_plot_overlay(
+        results, p, "title", "alg", "f1", alpha=0.5)
+    assert _written(p)
+
+    p2 = str(tmp_path / "hist.png")
+    plot_metric_histories({"loss": [3.0, 2.0, 1.5], "val": [3.1, 2.4, 2.0]},
+                          p2)
+    assert _written(p2)
+
+
+def test_signal_wavelet_state_recon(tmp_path):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(50, 3))
+    p1 = str(tmp_path / "sig.png")
+    plot_all_signal_channels(X, p1, fs=100)
+    assert _written(p1)
+
+    p2 = str(tmp_path / "wav.png")
+    plot_x_wavelet_comparison(X, rng.normal(size=(50, 3, 2)), p2)
+    assert _written(p2)
+
+    p3 = str(tmp_path / "scores.png")
+    plot_state_score_traces(rng.uniform(size=(3, 40)), p3,
+                            labels=["HC", "OF", "TS"])
+    assert _written(p3)
+
+    p4 = str(tmp_path / "recon.png")
+    plot_reconstruction_comparison(X, X + 0.1, p4)
+    assert _written(p4)
+
+
+def test_cross_experiment_grid_and_aliases(tmp_path):
+    summary = {"dsetA": {"algA": 0.9, "algB": 0.7},
+               "dsetB": {"algA": 0.85}}
+    p = str(tmp_path / "grid.png")
+    plot_cross_experiment_summary_grid(summary, p, "optimal_f1")
+    assert _written(p)
+
+    # reference-spelling aliases resolve to the same callables
+    from redcliff_tpu.utils import plotting as P
+
+    assert P.plot_gc_est_comparisson is P.plot_gc_est_comparison
+    assert P.make_scatter_and_stdErrOfMean_plot_overlay_vis is \
+        P.make_scatter_and_std_err_of_mean_plot_overlay
+
+
+def test_cross_alg_plot_integration(tmp_path):
+    """run_cross_algorithm_comparison(plot=True) emits the per-paradigm
+    scatter figures now that utils.plotting exists."""
+    import pickle
+
+    from redcliff_tpu.eval.cross_alg import run_cross_algorithm_comparison
+    from redcliff_tpu.models.dynotears import DynotearsConfig
+
+    rng = np.random.default_rng(2)
+    true_g = (rng.uniform(size=(4, 4, 1)) > 0.5).astype(float)
+    alg_root = tmp_path / "DYNOTEARS_Vanilla_models"
+    run = alg_root / "dset_fold0_run"
+    os.makedirs(run)
+    with open(run / "final_best_model.bin", "wb") as f:
+        pickle.dump({"model_class": "DynotearsVanillaModel",
+                     "config": DynotearsConfig(lag_size=1),
+                     "a_est": true_g[:, :, 0] + 0.01}, f)
+    out = tmp_path / "out"
+    run_cross_algorithm_comparison(
+        [str(alg_root)], {"dset": {0: [true_g]}}, str(out), 1, plot=True)
+    pngs = [x for x in os.listdir(out / "cv_dset") if x.endswith(".png")]
+    assert pngs
