@@ -17,6 +17,12 @@ from .grid_selection import (
     select_best_models,
 )
 from .model_io import load_artifact, load_model_for_eval
+from .supervised_discovery import (
+    prepare_data_for_modeling,
+    run_discovery_algorithm,
+    run_supervised_discovery_evaluation,
+    score_discovery_predictions,
+)
 from .stats import (
     compute_fixed_f1_stats,
     compute_graph_comparison_stats,
@@ -34,6 +40,8 @@ __all__ = [
     "average_factor_histories", "filter_incomplete_runs",
     "load_grid_summaries", "rank_runs", "select_best_models",
     "load_artifact", "load_model_for_eval",
+    "prepare_data_for_modeling", "run_discovery_algorithm",
+    "run_supervised_discovery_evaluation", "score_discovery_predictions",
     "compute_fixed_f1_stats", "compute_graph_comparison_stats",
     "compute_key_stats", "compute_optimal_f1_stats", "summarize_values",
     "three_view_optimal_f1_stats",

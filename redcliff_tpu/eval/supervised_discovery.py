@@ -1,0 +1,241 @@
+"""Supervised-discovery evaluation: tidybench + PCMCI scored against
+regime-resolved ground truth.
+
+Rebuilds the eval_algsT flow
+(/root/reference/evaluate/eval_algsT_by_expSynSys12112_forF1RocAucCausalDistStats.py):
+windowed recordings concatenate into one long multivariate series with
+per-regime step masks (prepare_data_for_modeling :45-80); each discovery
+algorithm runs once per regime on the regime-masked data; predictions are
+standardized off-diagonal scores; and each regime-factor prediction is scored
+with optimal-F1 (+threshold), ROC-AUC on raw and thresholded predictions, and
+the causal distances (ancestor/oset/parent AID and SHD) on the thresholded
+masks plus their upper/lower-triangular restrictions (:313-400) — using the
+native eval.causal_distances in place of the gadjid Rust wheel.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..models.pcmci import pcmci, pcmci_val_graph
+from ..tidybench.lasar import lasar
+from ..tidybench.qrbs import qrbs
+from ..tidybench.selvar import selvar
+from ..tidybench.slarac import slarac
+from ..utils.metrics import compute_optimal_f1, roc_auc
+from .causal_distances import ancestor_aid, oset_aid, parent_aid, shd
+
+__all__ = [
+    "prepare_data_for_modeling",
+    "standardized_off_diagonal_predictions",
+    "run_discovery_algorithm",
+    "score_discovery_predictions",
+    "run_supervised_discovery_evaluation",
+]
+
+SUPPORTED_ALGORITHMS = ("slarac", "qrbs", "lasar", "selvar", "PCMCI")
+
+
+def _window_labels(x, y):
+    """Normalize one window's labels to a (T, R) trace (1-D labels repeat
+    over the window)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if y.ndim == 1:
+        y = np.repeat(y[:, None], x.shape[0], axis=1)
+    return x, y.T
+
+
+def _dominant_regime(x, y):
+    """Per-step dominant regime (argmax of the label trace)."""
+    x, labels = _window_labels(x, y)
+    return x, np.argmax(labels, axis=1)
+
+
+def prepare_data_for_modeling(samples):
+    """Concatenate [(x (T, C), y (R, T)), ...] windows into one series with
+    per-regime binary masks (ref :45-80): each step's dominant regime
+    (argmax of the label trace) owns that step.
+
+    Returns (data (T_total, N), labels (T_total, R), masks {r: (T_total, N)},
+    T_window, T_total, N, num_regimes).
+    """
+    data_parts, label_parts = [], []
+    T_window = None
+    for x, y in samples:
+        x, labels = _window_labels(x, y)
+        if T_window is None:
+            T_window = x.shape[0]
+        data_parts.append(x)
+        label_parts.append(labels)
+    data = np.concatenate(data_parts)
+    labels = np.concatenate(label_parts)
+    T_total, N = data.shape
+    num_regimes = labels.shape[1]
+    masks = {r: np.zeros((T_total, N)) for r in range(num_regimes)}
+    dominant = np.argmax(labels, axis=1)
+    for r in range(num_regimes):
+        masks[r][dominant == r, :] = 1.0
+    return data, labels, masks, T_window, T_total, N, num_regimes
+
+
+def standardized_off_diagonal_predictions(A, transpose=False):
+    """Collapse lags (abs-sum) if present, optionally transpose to the
+    columns-drive-rows convention, and zero the diagonal
+    (ref get_standardized_off_diagonal_relation_predictions[_for_rpcmci]
+    :82-100)."""
+    A = np.asarray(A, dtype=np.float64)
+    if A.ndim == 3:
+        A = np.abs(A).sum(axis=2)
+    if transpose:
+        A = A.T
+    return A * (1.0 - np.eye(A.shape[0]))
+
+
+def _regime_segments(samples, regime, min_len):
+    """Contiguous per-window step runs where ``regime`` dominates, as
+    separate recordings (PCMCI's lag structure must not cross regime
+    boundaries)."""
+    segments = []
+    for x, y in samples:
+        x, dominant = _dominant_regime(x, y)
+        start = None
+        for t in range(len(dominant) + 1):
+            active = t < len(dominant) and dominant[t] == regime
+            if active and start is None:
+                start = t
+            elif not active and start is not None:
+                if t - start > min_len:
+                    segments.append(x[start:t])
+                start = None
+    return segments
+
+
+def run_discovery_algorithm(samples, alg_name, maxlags=1, pcmci_kwargs=None,
+                            prepared=None):
+    """Per-regime GC score matrices from one discovery algorithm
+    (ref run_tidybench_experiment :197-214).  Returns [pred (N, N)] indexed
+    by regime.  ``prepared`` accepts a prepare_data_for_modeling result so
+    multi-algorithm sweeps concatenate the windows once."""
+    if prepared is None:
+        prepared = prepare_data_for_modeling(samples)
+    data, _, masks, _, _, N, num_regimes = prepared
+    preds = []
+    for r in range(num_regimes):
+        if alg_name == "slarac":
+            raw = slarac(data * masks[r], maxlags=maxlags,
+                         post_standardise=True)
+        elif alg_name == "qrbs":
+            raw = qrbs(data * masks[r], lags=maxlags, post_standardise=True)
+        elif alg_name == "lasar":
+            raw = lasar(data * masks[r], maxlags=maxlags,
+                        post_standardise=True)
+        elif alg_name == "selvar":
+            raw = selvar(data * masks[r], maxlags=maxlags)
+        elif alg_name == "PCMCI":
+            kw = dict(tau_max=maxlags)
+            kw.update(pcmci_kwargs or {})
+            graph_alpha = kw.get("alpha_level", 0.05)
+            segs = _regime_segments(samples, r, min_len=kw["tau_max"])
+            if not segs:
+                preds.append(np.zeros((N, N)))
+                continue
+            res = pcmci(segs, **kw)
+            raw = pcmci_val_graph(res, alpha_level=graph_alpha)
+        else:
+            raise ValueError(f"unsupported algorithm: {alg_name!r}")
+        preds.append(standardized_off_diagonal_predictions(raw))
+    return preds
+
+
+def _aid_stats(true_graph, pred_mask):
+    """AID/SHD battery on the full graph and its triangular restrictions,
+    NaN on incompatible (cyclic) inputs (ref :338-400)."""
+    out = {}
+    views = {
+        "": (true_graph, pred_mask),
+        "upper_": (np.triu(true_graph), np.triu(pred_mask)),
+        "lower_": (np.tril(true_graph), np.tril(pred_mask)),
+    }
+    for prefix, (tg, pm) in views.items():
+        for name, fn in (("ancestor_aid", ancestor_aid),
+                         ("oset_aid", oset_aid),
+                         ("parent_aid", parent_aid), ("shd", shd)):
+            key = f"{prefix}optF1Thresh_{name}"
+            try:
+                out[key] = fn(tg, pm, edge_direction="from column to row")
+            except Exception:
+                out[key] = np.nan
+    return out
+
+
+def score_discovery_predictions(preds_by_regime, true_graphs,
+                                transpose_predictions=True):
+    """Per-regime-factor scoring (ref :313-400).  ``true_graphs`` are the
+    binarized, diagonal-masked per-factor ground truths; ``preds_by_regime``
+    aligns with them by index.  Returns {"rf_<k>": stats dict}."""
+    stats = {}
+    for rf, true_graph in enumerate(true_graphs):
+        true_graph = np.asarray(true_graph).astype(np.int8)
+        labels = true_graph.ravel().astype(int)
+        pred = np.asarray(preds_by_regime[rf], dtype=np.float64)
+        if transpose_predictions:
+            pred = pred.T
+        entry = {}
+        thresh, f1 = compute_optimal_f1(labels, pred.ravel())
+        entry["optF1_thresh"] = thresh
+        entry["optF1_score"] = f1
+        mask = (pred > thresh).astype(np.float64)
+        mask = mask * (1.0 - np.eye(mask.shape[0]))
+        mask = mask.astype(np.int8)
+        try:
+            entry["roc_auc"] = roc_auc(labels, pred.ravel())
+        except ValueError:
+            entry["roc_auc"] = np.nan
+        try:
+            entry["optF1Thresh_roc_auc"] = roc_auc(
+                labels, mask.ravel().astype(np.float64))
+        except ValueError:
+            entry["optF1Thresh_roc_auc"] = np.nan
+        entry.update(_aid_stats(true_graph, mask))
+        stats[f"rf_{rf}"] = entry
+    return stats
+
+
+def run_supervised_discovery_evaluation(samples, true_gc_factors,
+                                        algorithms=("slarac", "qrbs",
+                                                    "lasar", "selvar",
+                                                    "PCMCI"),
+                                        maxlags=1, save_path=None,
+                                        transpose_predictions=True,
+                                        pcmci_kwargs=None):
+    """End-to-end Table-2 evaluation: binarize/diag-mask the true factor
+    graphs (ref :250-258), run every algorithm per regime, score.  Returns
+    {alg: {"preds": [...], "stats": {...}}} and optionally pickles it."""
+    true_graphs = []
+    for g in true_gc_factors:
+        g = np.asarray(g, dtype=np.float64)
+        if g.ndim == 3:
+            g = g.sum(axis=2)
+        g = (g > 0).astype(int)
+        np.fill_diagonal(g, 0)
+        true_graphs.append(g)
+
+    results = {}
+    prepared = prepare_data_for_modeling(samples)
+    for alg in algorithms:
+        preds = run_discovery_algorithm(samples, alg, maxlags=maxlags,
+                                        pcmci_kwargs=pcmci_kwargs,
+                                        prepared=prepared)
+        stats = score_discovery_predictions(
+            preds, true_graphs, transpose_predictions=transpose_predictions)
+        results[alg] = {"preds": preds, "stats": stats}
+    if save_path:
+        os.makedirs(save_path, exist_ok=True)
+        with open(os.path.join(save_path,
+                               "supervised_discovery_summary.pkl"),
+                  "wb") as f:
+            pickle.dump(results, f)
+    return results

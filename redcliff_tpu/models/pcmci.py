@@ -1,0 +1,214 @@
+"""PCMCI causal discovery with linear partial-correlation tests.
+
+Native replacement for the external ``tigramite`` dependency the reference
+uses for its Table-2 supervised-discovery comparisons (PCMCI / R-PCMCI with
+the ParCorr test, imported at
+/root/reference/evaluate/eval_algsT_by_expSynSys12112_forF1RocAucCausalDistStats.py:13-40;
+the R-PCMCI usage there masks recording windows by regime and runs per-regime
+discovery).
+
+Implements the two-phase PCMCI algorithm of Runge et al. (Science Advances
+2019): a per-target PC1 condition-selection phase over lagged candidates,
+then the momentary-conditional-independence (MCI) phase conditioning on both
+the target's and the source's selected parents.  The conditional-independence
+primitive is ParCorr — partial correlation via OLS residualization with a
+two-sided t-test.
+
+Data enters as one (T, N) recording or a list of recordings (lagged samples
+never span recording boundaries, which is how the reference feeds its
+windowed datasets).
+"""
+from __future__ import annotations
+
+import numpy as np
+from scipy import stats
+
+__all__ = ["parcorr_test", "pcmci", "pcmci_val_graph", "rpcmci_by_regime"]
+
+
+def parcorr_test(x, y, Z=None):
+    """Partial correlation of x and y given the columns of Z.
+
+    Returns (r, p_value): Pearson correlation of the OLS residuals of x and y
+    on [1, Z], with the two-sided t-test p-value at n - 2 - dim(Z) degrees of
+    freedom (tigramite ParCorr semantics)."""
+    x = np.asarray(x, dtype=np.float64).ravel()
+    y = np.asarray(y, dtype=np.float64).ravel()
+    n = len(x)
+    dim_z = 0
+    if Z is not None and np.size(Z) > 0:
+        Z = np.asarray(Z, dtype=np.float64)
+        Z = Z.reshape(n, -1)
+        dim_z = Z.shape[1]
+        design = np.column_stack([np.ones(n), Z])
+        x = x - design @ np.linalg.lstsq(design, x, rcond=None)[0]
+        y = y - design @ np.linalg.lstsq(design, y, rcond=None)[0]
+    else:
+        x = x - x.mean()
+        y = y - y.mean()
+    denom = np.sqrt(np.sum(x * x) * np.sum(y * y))
+    if denom <= 0:
+        return 0.0, 1.0
+    r = float(np.clip(np.sum(x * y) / denom, -0.9999999, 0.9999999))
+    df = n - 2 - dim_z
+    if df <= 0:
+        return r, 1.0
+    t = r * np.sqrt(df / (1.0 - r * r))
+    p = 2.0 * stats.t.sf(abs(t), df)
+    return r, float(p)
+
+
+def _lagged_samples(recordings, tau_max):
+    """Stack (X_t, {X_{t-tau}}) rows from each recording without crossing
+    boundaries.  Returns (present (M, N), lagged (M, N, tau_max))."""
+    present, lagged = [], []
+    for rec in recordings:
+        rec = np.asarray(rec, dtype=np.float64)
+        T = rec.shape[0]
+        if T <= tau_max:
+            continue
+        present.append(rec[tau_max:])
+        lagged.append(np.stack([rec[tau_max - tau : T - tau]
+                                for tau in range(1, tau_max + 1)], axis=2))
+    if not present:
+        raise ValueError("no recording longer than tau_max")
+    return np.concatenate(present), np.concatenate(lagged)
+
+
+def _cand_series(lagged, i, tau):
+    return lagged[:, i, tau - 1]
+
+
+def pcmci(data, tau_max=1, pc_alpha=0.2, alpha_level=0.05,
+          max_conds_dim=None, max_combinations=1):
+    """Run PCMCI over lagged links (tau in 1..tau_max).
+
+    Args:
+      data: (T, N) array or list of (T_k, N) recordings.
+      pc_alpha: removal threshold in the condition-selection phase.
+      alpha_level: significance level defining the returned parent sets.
+      max_conds_dim: cap on condition-set size in phase 1.
+      max_combinations: number of strongest-condition subsets tried per size
+        (1 = tigramite's default behavior of testing the top conditions).
+
+    Returns dict with "val_matrix" and "p_matrix" of shape
+    (N, N, tau_max + 1) — entry [i, j, tau] is the MCI statistic/p-value for
+    X_i(t-tau) -> X_j(t) (tau = 0 slice kept zero/one for tigramite shape
+    parity) — and "parents": {j: [(i, tau), ...] sorted by strength}.
+    """
+    if isinstance(data, np.ndarray) and data.ndim == 2:
+        recordings = [data]
+    else:
+        recordings = list(data)
+    N = np.asarray(recordings[0]).shape[1]
+    present, lagged = _lagged_samples(recordings, tau_max)
+    # phase 2 conditions on source parents shifted by tau, reaching lags up
+    # to 2*tau_max; build the extended window when the data allows it
+    try:
+        present_ext, lagged_ext = _lagged_samples(recordings, 2 * tau_max)
+        ext_tau_max = 2 * tau_max
+    except ValueError:
+        present_ext, lagged_ext = present, lagged
+        ext_tau_max = tau_max
+
+    candidates = [(i, tau) for i in range(N) for tau in range(1, tau_max + 1)]
+    if max_conds_dim is None:
+        max_conds_dim = len(candidates) - 1
+
+    # ---- phase 1: PC1 condition selection per target -----------------------
+    parents = {}
+    for j in range(N):
+        remaining = list(candidates)
+        strength = {c: abs(parcorr_test(present[:, j],
+                                        _cand_series(lagged, *c))[0])
+                    for c in remaining}
+        p_dim = 0
+        while p_dim <= max_conds_dim and p_dim < len(remaining):
+            removed_any = False
+            # strongest-first ordering stabilizes the selection; one sort
+            # per round, candidates iterate over a snapshot of it
+            ordering = sorted(remaining, key=lambda c: -strength[c])
+            for cand in ordering:
+                if cand not in remaining:
+                    continue
+                others = [c for c in ordering
+                          if c != cand and c in remaining]
+                if len(others) < p_dim:
+                    continue
+                for start in range(max_combinations):
+                    conds = others[start : start + p_dim]
+                    if len(conds) < p_dim:
+                        break
+                    Z = np.column_stack(
+                        [_cand_series(lagged, *c) for c in conds]) \
+                        if conds else None
+                    r, p = parcorr_test(present[:, j],
+                                        _cand_series(lagged, *cand), Z)
+                    strength[cand] = min(strength[cand], abs(r))
+                    if p > pc_alpha:
+                        remaining.remove(cand)
+                        removed_any = True
+                        break
+            p_dim += 1
+            if not removed_any and p_dim > 1:
+                break
+        parents[j] = sorted(remaining, key=lambda c: -strength[c])
+
+    # ---- phase 2: MCI ------------------------------------------------------
+    val = np.zeros((N, N, tau_max + 1))
+    pmat = np.ones((N, N, tau_max + 1))
+    for j in range(N):
+        for (i, tau) in candidates:
+            conds = [c for c in parents[j] if c != (i, tau)]
+            # source parents shifted by tau (momentary conditioning); the
+            # extended lag window makes lags up to 2*tau_max addressable
+            for (k, ktau) in parents[i]:
+                if ktau + tau <= ext_tau_max:
+                    shifted = (k, ktau + tau)
+                    if shifted not in conds and shifted != (i, tau):
+                        conds.append(shifted)
+            Z = np.column_stack(
+                [_cand_series(lagged_ext, *c) for c in conds]) \
+                if conds else None
+            r, p = parcorr_test(present_ext[:, j],
+                                _cand_series(lagged_ext, i, tau), Z)
+            val[i, j, tau] = r
+            pmat[i, j, tau] = p
+
+    sig_parents = {
+        j: sorted([(i, tau) for (i, tau) in candidates
+                   if pmat[i, j, tau] <= alpha_level],
+                  key=lambda c: -abs(val[c[0], j, c[1]]))
+        for j in range(N)
+    }
+    return {"val_matrix": val, "p_matrix": pmat, "parents": sig_parents}
+
+
+def pcmci_val_graph(result, alpha_level=0.05, ignore_lag=True):
+    """Collapse a pcmci() result into a scored adjacency: entry (i, j) is the
+    max |MCI value| over significant lags of X_i -> X_j (the graph the
+    supervised-discovery scoring consumes)."""
+    val = np.abs(result["val_matrix"]).copy()
+    val[result["p_matrix"] > alpha_level] = 0.0
+    if ignore_lag:
+        return val[:, :, 1:].max(axis=2)
+    return val[:, :, 1:]
+
+
+def rpcmci_by_regime(recordings, regime_labels, num_regimes, tau_max=1,
+                     pc_alpha=0.2, alpha_level=0.05):
+    """Regime-resolved PCMCI: split recordings by their regime label and run
+    discovery per regime (the reference's R-PCMCI data prep masks windows by
+    regime, eval_algsT...py:45+).  Returns {regime: pcmci result}."""
+    regime_labels = np.asarray(regime_labels).astype(int)
+    assert len(regime_labels) == len(recordings)
+    out = {}
+    for regime in range(num_regimes):
+        regs = [rec for rec, lab in zip(recordings, regime_labels)
+                if lab == regime]
+        if not regs:
+            out[regime] = None
+            continue
+        out[regime] = pcmci(regs, tau_max=tau_max, pc_alpha=pc_alpha,
+                            alpha_level=alpha_level)
+    return out

@@ -1,0 +1,234 @@
+"""Time-series transformer encoder (mvts_transformer capability).
+
+JAX rebuild of /root/reference/models/ts_transformer.py (vendored
+mvts_transformer): TSTransformerEncoder (:145, masked-reconstruction head)
+and TSTransformerEncoderClassiregressor (:192, flattened masked pooling into
+a linear class/regression head).  The reference imports this surface into the
+factor-score embedders (redcliff_factor_score_embedders.py:7) but never
+instantiates it; this build keeps it a first-class usable module.
+
+Architecture: input projection × sqrt(d_model) + fixed-sinusoid or learnable
+positional encoding, N pre-activation-free encoder layers (multi-head
+attention + FFN) with either LayerNorm or the mvts "BatchNorm" variant
+(normalizing each feature over batch×time; functional batch statistics here,
+matching the DGCNN deviation note), gelu/relu activation.  Attention is one
+batched einsum per layer — MXU-shaped, no per-head Python loops.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "TSTransformerConfig",
+    "TSTransformerEncoder",
+    "TSTransformerEncoderClassiregressor",
+    "init_ts_transformer_params",
+    "ts_transformer_encode",
+]
+
+
+@dataclass(frozen=True)
+class TSTransformerConfig:
+    feat_dim: int
+    max_len: int
+    d_model: int
+    n_heads: int
+    num_layers: int
+    dim_feedforward: int
+    num_classes: int = 0          # 0 -> reconstruction head (encoder)
+    pos_encoding: str = "fixed"   # {"fixed", "learnable"}
+    activation: str = "gelu"      # {"gelu", "relu"}
+    norm: str = "BatchNorm"       # {"BatchNorm", "LayerNorm"}
+
+    def __post_init__(self):
+        assert self.d_model % self.n_heads == 0
+        assert self.pos_encoding in ("fixed", "learnable")
+        assert self.activation in ("gelu", "relu")
+        assert self.norm in ("BatchNorm", "LayerNorm")
+
+
+def _act(cfg):
+    return jax.nn.gelu if cfg.activation == "gelu" else jax.nn.relu
+
+
+def _dense_init(key, d_in, d_out):
+    bound = 1.0 / math.sqrt(d_in)
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.uniform(kw, (d_in, d_out), minval=-bound,
+                                maxval=bound),
+        "b": jax.random.uniform(kb, (d_out,), minval=-bound, maxval=bound),
+    }
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _fixed_pos_encoding_np(max_len, d_model):
+    """Sinusoidal table (ref FixedPositionalEncoding :37-60), memoized as
+    numpy (a cached jnp array created under jit would leak a tracer)."""
+    pe = np.zeros((max_len, d_model), dtype=np.float32)
+    position = np.arange(max_len)[:, None].astype(np.float32)
+    div = np.exp(np.arange(0, d_model, 2) * (-math.log(10000.0) / d_model))
+    pe[:, 0::2] = np.sin(position * div)
+    pe[:, 1::2] = np.cos(position * div[: pe[:, 1::2].shape[1]])
+    return pe
+
+
+def _fixed_pos_encoding(max_len, d_model):
+    return jnp.asarray(_fixed_pos_encoding_np(max_len, d_model))
+
+
+def init_ts_transformer_params(key, cfg: TSTransformerConfig):
+    keys = jax.random.split(key, 3 + 6 * cfg.num_layers)
+    params = {"project_inp": _dense_init(keys[0], cfg.feat_dim, cfg.d_model)}
+    if cfg.pos_encoding == "learnable":
+        params["pos"] = 0.02 * jax.random.normal(
+            keys[1], (cfg.max_len, cfg.d_model))
+    layers = []
+    k_idx = 2
+    for _ in range(cfg.num_layers):
+        layers.append({
+            # attention projections carry no bias (the reference disables
+            # bias in its BatchNorm layer "to mitigate numerical
+            # instabilities", ts_transformer.py:102)
+            "wq": _dense_init(keys[k_idx], cfg.d_model, cfg.d_model)["w"],
+            "wk": _dense_init(keys[k_idx + 1], cfg.d_model, cfg.d_model)["w"],
+            "wv": _dense_init(keys[k_idx + 2], cfg.d_model, cfg.d_model)["w"],
+            "wo": _dense_init(keys[k_idx + 3], cfg.d_model, cfg.d_model)["w"],
+            "ff1": _dense_init(keys[k_idx + 4], cfg.d_model,
+                               cfg.dim_feedforward),
+            "ff2": _dense_init(keys[k_idx + 5], cfg.dim_feedforward,
+                               cfg.d_model),
+            "norm1_scale": jnp.ones((cfg.d_model,)),
+            "norm1_shift": jnp.zeros((cfg.d_model,)),
+            "norm2_scale": jnp.ones((cfg.d_model,)),
+            "norm2_shift": jnp.zeros((cfg.d_model,)),
+        })
+        k_idx += 6
+    params["layers"] = layers
+    if cfg.num_classes > 0:
+        params["output"] = _dense_init(keys[-1],
+                                       cfg.d_model * cfg.max_len,
+                                       cfg.num_classes)
+    else:
+        params["output"] = _dense_init(keys[-1], cfg.d_model, cfg.feat_dim)
+    return params
+
+
+def _norm(x, scale, shift, kind, eps=1e-5):
+    if kind == "LayerNorm":
+        mean = x.mean(axis=-1, keepdims=True)
+        var = x.var(axis=-1, keepdims=True)
+    else:
+        # mvts "BatchNorm": each feature normalized over batch and time
+        mean = x.mean(axis=(0, 1), keepdims=True)
+        var = x.var(axis=(0, 1), keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * scale + shift
+
+
+def _attention(layer, x, pad_mask, n_heads):
+    """Batched multi-head self-attention. x: (B, T, D); pad_mask: (B, T)
+    True = keep."""
+    B, T, D = x.shape
+    H, hd = n_heads, D // n_heads
+    q = (x @ layer["wq"]).reshape(B, T, H, hd)
+    k = (x @ layer["wk"]).reshape(B, T, H, hd)
+    v = (x @ layer["wv"]).reshape(B, T, H, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    if pad_mask is not None:
+        neg = jnp.finfo(x.dtype).min
+        logits = jnp.where(pad_mask[:, None, None, :], logits, neg)
+    attn = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, T, D)
+    return out @ layer["wo"]
+
+
+def ts_transformer_encode(params, cfg: TSTransformerConfig, X,
+                          padding_masks=None):
+    """(B, T, feat_dim) -> (B, T, d_model) encoder embeddings
+    (ref TSTransformerEncoder.forward :169-190 up to the output head)."""
+    B, T, _ = X.shape
+    x = (X @ params["project_inp"]["w"] + params["project_inp"]["b"]) \
+        * math.sqrt(cfg.d_model)
+    if cfg.pos_encoding == "learnable":
+        x = x + params["pos"][None, :T]
+    else:
+        x = x + _fixed_pos_encoding(cfg.max_len, cfg.d_model)[None, :T]
+    for layer in params["layers"]:
+        a = _attention(layer, x, padding_masks, cfg.n_heads)
+        x = _norm(x + a, layer["norm1_scale"], layer["norm1_shift"], cfg.norm)
+        h = _act(cfg)(x @ layer["ff1"]["w"] + layer["ff1"]["b"])
+        h = h @ layer["ff2"]["w"] + layer["ff2"]["b"]
+        x = _norm(x + h, layer["norm2_scale"], layer["norm2_shift"], cfg.norm)
+    return _act(cfg)(x)
+
+
+class TSTransformerEncoder:
+    """Masked-reconstruction transformer (ref TSTransformerEncoder :145-190):
+    embeddings project back to feat_dim per step."""
+
+    def __init__(self, config: TSTransformerConfig):
+        assert config.num_classes == 0, \
+            "use TSTransformerEncoderClassiregressor for a class head"
+        self.config = config
+
+    def init(self, key):
+        return init_ts_transformer_params(key, self.config)
+
+    def forward(self, params, X, padding_masks=None):
+        z = ts_transformer_encode(params, self.config, X, padding_masks)
+        return z @ params["output"]["w"] + params["output"]["b"]
+
+    def loss(self, params, X, Y=None, padding_masks=None):
+        """Masked reconstruction MSE (the mvts pretraining objective)."""
+        recon = self.forward(params, X, padding_masks)
+        target = X if Y is None else Y
+        err = (recon - target) ** 2
+        if padding_masks is not None:
+            err = err * padding_masks[:, :, None]
+            denom = jnp.maximum(padding_masks.sum() * X.shape[2], 1)
+            loss = err.sum() / denom
+        else:
+            loss = err.mean()
+        return loss, {"recon_loss": loss}
+
+
+class TSTransformerEncoderClassiregressor:
+    """Classifier/regressor head over flattened masked embeddings
+    (ref :192-250): padding embeddings are zeroed before the flattened linear
+    output layer; no softmax (loss applies it)."""
+
+    def __init__(self, config: TSTransformerConfig):
+        assert config.num_classes > 0
+        self.config = config
+
+    def init(self, key):
+        return init_ts_transformer_params(key, self.config)
+
+    def forward(self, params, X, padding_masks=None):
+        cfg = self.config
+        z = ts_transformer_encode(params, cfg, X, padding_masks)
+        if padding_masks is not None:
+            z = z * padding_masks[:, :, None]
+        # pad the time axis to max_len so the flattened head is static-shape
+        T = z.shape[1]
+        if T < cfg.max_len:
+            z = jnp.pad(z, ((0, 0), (0, cfg.max_len - T), (0, 0)))
+        flat = z.reshape(z.shape[0], -1)
+        return flat @ params["output"]["w"] + params["output"]["b"]
+
+    def loss(self, params, X, Y, padding_masks=None):
+        """Softmax cross-entropy on integer or one-hot labels."""
+        logits = self.forward(params, X, padding_masks)
+        if Y.ndim == 1:
+            Y = jax.nn.one_hot(Y, self.config.num_classes)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(jnp.sum(Y * logp, axis=-1))
+        return loss, {"class_loss": loss}

@@ -1,0 +1,186 @@
+"""Tests for PCMCI (ParCorr) and the TS-transformer."""
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu.models.pcmci import (
+    parcorr_test,
+    pcmci,
+    pcmci_val_graph,
+    rpcmci_by_regime,
+)
+from redcliff_tpu.models.ts_transformer import (
+    TSTransformerConfig,
+    TSTransformerEncoder,
+    TSTransformerEncoderClassiregressor,
+)
+
+
+# ------------------------------------------------------------- ParCorr
+
+def test_parcorr_direct_dependence():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=400)
+    y = 0.8 * x + 0.3 * rng.normal(size=400)
+    r, p = parcorr_test(x, y)
+    assert r > 0.8 and p < 1e-6
+
+
+def test_parcorr_conditioning_removes_confounder():
+    rng = np.random.default_rng(1)
+    z = rng.normal(size=500)
+    x = z + 0.3 * rng.normal(size=500)
+    y = z + 0.3 * rng.normal(size=500)
+    r_raw, p_raw = parcorr_test(x, y)
+    assert p_raw < 1e-6  # confounded: strongly correlated
+    r_cond, p_cond = parcorr_test(x, y, z)
+    assert abs(r_cond) < 0.2 and p_cond > 0.01
+
+
+def test_parcorr_matches_scipy_pearson_when_unconditioned():
+    from scipy.stats import pearsonr
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=120)
+    y = 0.5 * x + rng.normal(size=120)
+    r, p = parcorr_test(x, y)
+    r_ref, p_ref = pearsonr(x, y)
+    assert r == pytest.approx(r_ref, rel=1e-6)
+    assert p == pytest.approx(p_ref, rel=1e-3)
+
+
+# ------------------------------------------------------------- PCMCI
+
+def _var_system(rng, T=800, noise=0.3):
+    """3-var linear VAR(1): 0 -> 1, 1 -> 2, plus self-memory."""
+    X = np.zeros((T, 3))
+    for t in range(1, T):
+        X[t, 0] = 0.5 * X[t - 1, 0] + rng.normal(scale=noise)
+        X[t, 1] = 0.5 * X[t - 1, 1] + 0.6 * X[t - 1, 0] \
+            + rng.normal(scale=noise)
+        X[t, 2] = 0.5 * X[t - 1, 2] + 0.6 * X[t - 1, 1] \
+            + rng.normal(scale=noise)
+    return X
+
+
+def test_pcmci_recovers_var_structure():
+    rng = np.random.default_rng(3)
+    X = _var_system(rng)
+    res = pcmci(X, tau_max=2, pc_alpha=0.2, alpha_level=0.01)
+    g = pcmci_val_graph(res, alpha_level=0.01)
+    # true cross links present...
+    assert g[0, 1] > 0.3
+    assert g[1, 2] > 0.3
+    # ...and the spurious two-hop 0 -> 2 link screened off by conditioning
+    assert g[0, 2] < g[0, 1] / 2
+    # no reverse causation
+    assert g[1, 0] < 0.15 and g[2, 1] < 0.15
+    # self links dominated by memory
+    assert g[0, 0] > 0.3
+
+
+def test_pcmci_multiple_recordings_no_boundary_leak():
+    rng = np.random.default_rng(4)
+    recs = [_var_system(rng, T=150) for _ in range(5)]
+    res = pcmci(recs, tau_max=1, alpha_level=0.01)
+    g = pcmci_val_graph(res, alpha_level=0.01)
+    assert g[0, 1] > 0.3 and g[1, 2] > 0.3
+
+
+def test_pcmci_output_shapes():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(100, 4))
+    res = pcmci(X, tau_max=3)
+    assert res["val_matrix"].shape == (4, 4, 4)
+    assert res["p_matrix"].shape == (4, 4, 4)
+    # tau=0 slice kept for tigramite shape parity
+    assert np.all(res["p_matrix"][:, :, 0] == 1.0)
+    assert set(res["parents"]) == {0, 1, 2, 3}
+
+
+def test_rpcmci_by_regime_separates_structures():
+    rng = np.random.default_rng(6)
+
+    def system(driver):
+        X = np.zeros((300, 3))
+        for t in range(1, 300):
+            for c in range(3):
+                X[t, c] = 0.4 * X[t - 1, c] + rng.normal(scale=0.3)
+            X[t, (driver + 1) % 3] += 0.7 * X[t - 1, driver]
+        return X
+
+    recs = [system(0), system(0), system(1), system(1)]
+    out = rpcmci_by_regime(recs, [0, 0, 1, 1], num_regimes=2, tau_max=1,
+                           alpha_level=0.01)
+    g0 = pcmci_val_graph(out[0], alpha_level=0.01)
+    g1 = pcmci_val_graph(out[1], alpha_level=0.01)
+    assert g0[0, 1] > 0.3 and g0[1, 2] < 0.2
+    assert g1[1, 2] > 0.3 and g1[0, 1] < 0.2
+
+
+# ------------------------------------------------- TS transformer
+
+def test_ts_transformer_encoder_shapes():
+    cfg = TSTransformerConfig(feat_dim=5, max_len=12, d_model=16, n_heads=4,
+                              num_layers=2, dim_feedforward=32)
+    model = TSTransformerEncoder(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jax.random.normal(jax.random.PRNGKey(1), (3, 12, 5))
+    out = model.forward(params, X)
+    assert out.shape == (3, 12, 5)
+    loss, aux = model.loss(params, X)
+    assert np.isfinite(float(loss))
+
+
+def test_ts_transformer_padding_mask():
+    cfg = TSTransformerConfig(feat_dim=4, max_len=10, d_model=8, n_heads=2,
+                              num_layers=1, dim_feedforward=16,
+                              norm="LayerNorm")
+    model = TSTransformerEncoder(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jax.random.normal(jax.random.PRNGKey(1), (2, 10, 4))
+    mask = np.ones((2, 10), dtype=bool)
+    mask[1, 6:] = False
+    out = model.forward(params, X, jax.numpy.asarray(mask))
+    assert np.isfinite(np.asarray(out)).all()
+    # padded-position content must not affect kept positions of that sample
+    X2 = np.asarray(X).copy()
+    X2[1, 6:] = 99.0
+    out2 = model.forward(params, jax.numpy.asarray(X2),
+                         jax.numpy.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out[1, :6]),
+                               np.asarray(out2[1, :6]), atol=2e-4)
+
+
+def test_ts_transformer_classifier_learns():
+    cfg = TSTransformerConfig(feat_dim=3, max_len=8, d_model=16, n_heads=4,
+                              num_layers=1, dim_feedforward=32,
+                              num_classes=2, norm="LayerNorm")
+    model = TSTransformerEncoderClassiregressor(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    # class 0: rising ramp; class 1: falling ramp
+    n = 64
+    y = rng.integers(0, 2, size=n)
+    ramp = np.linspace(-1, 1, 8)
+    X = np.stack([np.stack([(1 - 2 * yi) * ramp] * 3, axis=1)
+                  for yi in y]) + 0.1 * rng.normal(size=(n, 8, 3))
+    X = jax.numpy.asarray(X.astype(np.float32))
+    Y = jax.numpy.asarray(y)
+
+    import optax
+
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, X, Y):
+        (loss, _), grads = jax.value_and_grad(model.loss, has_aux=True)(
+            params, X, Y)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state, X, Y)
+    preds = np.argmax(np.asarray(model.forward(params, X)), axis=1)
+    assert (preds == y).mean() > 0.9
