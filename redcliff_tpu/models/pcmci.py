@@ -118,11 +118,16 @@ def pcmci(data, tau_max=1, pc_alpha=0.2, alpha_level=0.05,
     # ---- phase 1: PC1 condition selection per target -----------------------
     parents = {}
     for j in range(N):
-        remaining = list(candidates)
-        strength = {c: abs(parcorr_test(present[:, j],
-                                        _cand_series(lagged, *c))[0])
-                    for c in remaining}
-        p_dim = 0
+        remaining = []
+        strength = {}
+        # the initialization pass doubles as the p_dim=0 (unconditional)
+        # removal round
+        for c in candidates:
+            r, p = parcorr_test(present[:, j], _cand_series(lagged, *c))
+            if p <= pc_alpha:
+                remaining.append(c)
+                strength[c] = abs(r)
+        p_dim = 1
         while p_dim <= max_conds_dim and p_dim < len(remaining):
             removed_any = False
             # strongest-first ordering stabilizes the selection; one sort

@@ -25,6 +25,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from redcliff_tpu.models import clstm as clstm_mod
 from redcliff_tpu.models import cmlp as cmlp_mod
 from redcliff_tpu.models.embedders import build_embedder, CEmbedder, DGCNNEmbedder
 from redcliff_tpu.ops import losses as L
@@ -123,6 +124,12 @@ class RedcliffSCMLPConfig:
     forward_pass_mode: str = "apply_factor_weights_at_each_sim_step"
     num_sims: int = 1
     wavelet_level: int | None = None
+    # factor forecaster family: "cMLP" (the paper's model) or "cLSTM" (the
+    # REDCLIFF_S_CLSTM variant the reference factory declares but never
+    # shipped — model_utils.py:341 imports a missing file; implemented here).
+    # cLSTM factors use gen_hidden[0] as the per-series LSTM width and read
+    # GC from the input-weight column norms (no lag axis).
+    factor_network_type: str = "cMLP"
     training_mode: str = "pretrain_embedder_and_pretrain_factor_then_combined"
     num_pretrain_epochs: int = 0
     num_acclimation_epochs: int = 0
@@ -132,6 +139,8 @@ class RedcliffSCMLPConfig:
     state_score_smoothing_epsilon: float = 0.01
 
     def __post_init__(self):
+        assert self.factor_network_type in ("cMLP", "cLSTM"), \
+            self.factor_network_type
         assert self.training_mode in TRAINING_MODES, self.training_mode
         assert self.primary_gc_est_mode in GC_EST_MODES, self.primary_gc_est_mode
         assert self.forward_pass_mode in FORWARD_PASS_MODES, self.forward_pass_mode
@@ -198,11 +207,26 @@ class RedcliffSCMLP:
         cfg = self.config
         ke, kf = jax.random.split(key)
         factor_keys = jax.random.split(kf, cfg.num_factors)
-        factors = jax.vmap(
-            lambda k: cmlp_mod.init_cmlp_params(k, cfg.num_series, cfg.gen_lag,
-                                                list(cfg.gen_hidden))
-        )(factor_keys)
+        if cfg.factor_network_type == "cLSTM":
+            factors = jax.vmap(
+                lambda k: clstm_mod.init_clstm_params(
+                    k, cfg.num_series, cfg.gen_hidden[0])
+            )(factor_keys)
+        else:
+            factors = jax.vmap(
+                lambda k: cmlp_mod.init_cmlp_params(
+                    k, cfg.num_series, cfg.gen_lag, list(cfg.gen_hidden))
+            )(factor_keys)
         return {"embedder": self.embedder.init(ke), "factors": factors}
+
+    def _factor_apply(self, factor_params, window):
+        """One factor network's one-step prediction on a (B, lag, C) window
+        -> (B, 1, C). cLSTM factors consume the window sequentially and emit
+        the final step's forecast."""
+        if self.config.factor_network_type == "cLSTM":
+            preds, _ = clstm_mod.clstm_forward(factor_params, window)
+            return preds[:, -1:, :]
+        return cmlp_mod.cmlp_forward(factor_params, window)
 
     # ----------------------------------------------------------------- forward
     def _embed(self, params, window):
@@ -219,7 +243,7 @@ class RedcliffSCMLP:
         (K, B, 1, C)."""
         cfg = self.config
         w = window[:, -cfg.gen_lag :, :]
-        return jax.vmap(lambda p: cmlp_mod.cmlp_forward(p, w))(params["factors"])
+        return jax.vmap(lambda p: self._factor_apply(p, w))(params["factors"])
 
     def forward(self, params, X, factor_weightings=None):
         """Returns (x_sims (B, num_sims, C), factor_preds (num_sims, K, B, 1, C),
@@ -264,7 +288,7 @@ class RedcliffSCMLP:
                                (K,) + X[:, -cfg.gen_lag :, :].shape)
         per_factor_sims = []
         for s in range(cfg.num_sims):
-            preds = jax.vmap(cmlp_mod.cmlp_forward)(params["factors"], win)  # (K, B, 1, C)
+            preds = jax.vmap(self._factor_apply)(params["factors"], win)  # (K, B, 1, C)
             per_factor_sims.append(preds)
             win = jnp.concatenate([win[:, :, preds.shape[2] :, :], preds], axis=2)
         factor_sims = jnp.concatenate(per_factor_sims, axis=2)  # (K, B, S, C)
@@ -274,8 +298,23 @@ class RedcliffSCMLP:
     # ---------------------------------------------------------------------- GC
     def factor_gc(self, params, threshold=False, ignore_lag=True,
                   combine_wavelet_representations=False, rank_wavelets=False):
-        """(K, C, C[, L]) per-factor readouts (ref :440-451 via cmlp.GC)."""
+        """(K, C, C[, L]) per-factor readouts (ref :440-451 via cmlp.GC; cLSTM
+        factors read the input-weight column norms, ref clstm.py:126-156)."""
         cfg = self.config
+        if cfg.factor_network_type == "cLSTM":
+            mask = None
+            if rank_wavelets and cfg.wavelet_level is not None:
+                mask = cmlp_mod.build_wavelet_ranking_mask(
+                    cfg.num_series,
+                    wavelets_per_chan=cfg.num_series // cfg.num_chans)
+            G = jax.vmap(
+                lambda p: clstm_mod.clstm_gc(
+                    p, threshold=threshold, wavelet_mask=mask,
+                    rank_wavelets=rank_wavelets, num_chans=cfg.num_chans,
+                    combine_wavelet_representations=
+                    combine_wavelet_representations)
+            )(params["factors"])
+            return G if ignore_lag else G[..., None]
         mask = None
         if rank_wavelets and cfg.wavelet_level is not None:
             mask = cmlp_mod.build_wavelet_ranking_mask(

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -66,9 +67,6 @@ def _dense_init(key, d_in, d_out):
     }
 
 
-from functools import lru_cache
-
-
 @lru_cache(maxsize=32)
 def _fixed_pos_encoding_np(max_len, d_model):
     """Sinusoidal table (ref FixedPositionalEncoding :37-60), memoized as
@@ -91,6 +89,11 @@ def init_ts_transformer_params(key, cfg: TSTransformerConfig):
     if cfg.pos_encoding == "learnable":
         params["pos"] = 0.02 * jax.random.normal(
             keys[1], (cfg.max_len, cfg.d_model))
+    def _weight_init(k, d_in, d_out):
+        bound = 1.0 / math.sqrt(d_in)
+        return jax.random.uniform(k, (d_in, d_out), minval=-bound,
+                                  maxval=bound)
+
     layers = []
     k_idx = 2
     for _ in range(cfg.num_layers):
@@ -98,10 +101,10 @@ def init_ts_transformer_params(key, cfg: TSTransformerConfig):
             # attention projections carry no bias (the reference disables
             # bias in its BatchNorm layer "to mitigate numerical
             # instabilities", ts_transformer.py:102)
-            "wq": _dense_init(keys[k_idx], cfg.d_model, cfg.d_model)["w"],
-            "wk": _dense_init(keys[k_idx + 1], cfg.d_model, cfg.d_model)["w"],
-            "wv": _dense_init(keys[k_idx + 2], cfg.d_model, cfg.d_model)["w"],
-            "wo": _dense_init(keys[k_idx + 3], cfg.d_model, cfg.d_model)["w"],
+            "wq": _weight_init(keys[k_idx], cfg.d_model, cfg.d_model),
+            "wk": _weight_init(keys[k_idx + 1], cfg.d_model, cfg.d_model),
+            "wv": _weight_init(keys[k_idx + 2], cfg.d_model, cfg.d_model),
+            "wo": _weight_init(keys[k_idx + 3], cfg.d_model, cfg.d_model),
             "ff1": _dense_init(keys[k_idx + 4], cfg.d_model,
                                cfg.dim_feedforward),
             "ff2": _dense_init(keys[k_idx + 5], cfg.dim_feedforward,

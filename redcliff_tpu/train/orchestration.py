@@ -27,18 +27,83 @@ def _coeff(args_dict, key, default=0.0):
     return float(args_dict.get("coeff_dict", {}).get(key, default))
 
 
+def _build_redcliff(args_dict, employ_version_with_smoothing_loss,
+                    factor_network_type, gen_lag, gen_hidden, embed_lag,
+                    **coeff_overrides):
+    """Shared REDCLIFF config builder for the cMLP/cLSTM factor variants
+    (they differ only in lag/hidden sourcing and factor_network_type)."""
+    from ..models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+
+    emb_args = dict(args_dict.get("factor_score_embedder_args", []))
+    smoothing_coeff = _coeff(args_dict,
+                             "FACTOR_WEIGHT_SMOOTHING_PENALTY_COEFF") \
+        if employ_version_with_smoothing_loss else 0.0
+    cfg = RedcliffSCMLPConfig(
+        num_chans=args_dict["num_channels"],
+        gen_lag=gen_lag,
+        gen_hidden=gen_hidden,
+        embed_lag=embed_lag,
+        embed_hidden_sizes=tuple(args_dict["embed_hidden_sizes"]),
+        num_factors=args_dict["num_factors"],
+        num_supervised_factors=args_dict["num_supervised_factors"],
+        factor_network_type=factor_network_type,
+        forecast_coeff=_coeff(args_dict, "FORECAST_COEFF", 1.0),
+        factor_score_coeff=_coeff(args_dict, "FACTOR_SCORE_COEFF"),
+        factor_cos_sim_coeff=_coeff(args_dict, "FACTOR_COS_SIM_COEFF"),
+        factor_weight_l1_coeff=_coeff(args_dict, "FACTOR_WEIGHT_L1_COEFF"),
+        adj_l1_reg_coeff=_coeff(args_dict, "ADJ_L1_REG_COEFF"),
+        dagness_reg_coeff=_coeff(args_dict, "DAGNESS_REG_COEFF"),
+        use_sigmoid_restriction=args_dict["use_sigmoid_restriction"],
+        sigmoid_eccentricity_coeff=emb_args.get(
+            "sigmoid_eccentricity_coeff", 10.0),
+        factor_score_embedder_type=args_dict["factor_score_embedder_type"],
+        dgcnn_num_graph_conv_layers=emb_args.get("num_graph_conv_layers", 2),
+        dgcnn_num_hidden_nodes=emb_args.get("num_hidden_nodes", 32),
+        primary_gc_est_mode=args_dict["primary_gc_est_mode"],
+        forward_pass_mode=args_dict["forward_pass_mode"],
+        num_sims=args_dict["num_sims"],
+        wavelet_level=args_dict.get("wavelet_level"),
+        training_mode=args_dict["training_mode"],
+        num_pretrain_epochs=args_dict["num_pretrain_epochs"],
+        num_acclimation_epochs=args_dict.get("num_acclimation_epochs", 0),
+        factor_weight_smoothing_penalty_coeff=smoothing_coeff,
+        **coeff_overrides,
+    )
+    return RedcliffSCMLP(cfg)
+
+
 def create_model_instance(args_dict, employ_version_with_smoothing_loss=False):
     """Build the model object described by a parsed args dict
     (ref model_utils.py:338-639).  Returns the model instance; functional
     models are initialized via model.init(key) by the fit dispatch."""
     model_type = args_dict["model_type"]
 
-    if "REDCLIFF" in model_type and ("CLSTM" in model_type
-                                     or "DGCNN" in model_type):
+    if "REDCLIFF" in model_type and "DGCNN" in model_type:
         raise NotImplementedError(
             f"{model_type} is declared by the reference factory "
-            "(model_utils.py:341,344) but its model file was never "
+            "(model_utils.py:344) but its model file was never "
             "published; see SURVEY.md §2.2")
+
+    if "REDCLIFF" in model_type and "CLSTM" in model_type:
+        # declared-but-absent in the reference (model_utils.py:341 imports a
+        # missing file); implemented here as cLSTM factor networks inside the
+        # shared REDCLIFF core.  The cLSTM-family schema carries context /
+        # num_in_timesteps instead of gen_lag / embed_lag, and an int
+        # gen_hidden (the per-series LSTM width).
+        if "_S_" not in model_type:
+            raise NotImplementedError(
+                "only the supervised REDCLIFF_S_CLSTM variant is defined")
+        gen_hidden = args_dict["gen_hidden"]
+        if isinstance(gen_hidden, int):
+            gen_hidden = (gen_hidden,)
+        return _build_redcliff(
+            args_dict, employ_version_with_smoothing_loss,
+            factor_network_type="cLSTM",
+            gen_lag=args_dict["context"],
+            gen_hidden=tuple(gen_hidden),
+            embed_lag=args_dict.get("num_in_timesteps",
+                                    args_dict.get("embed_lag",
+                                                  args_dict["context"])))
 
     if "REDCLIFF" in model_type and "CMLP" in model_type:
         if "_S_" not in model_type:
@@ -47,46 +112,14 @@ def create_model_instance(args_dict, employ_version_with_smoothing_loss=False):
                 "only the supervised REDCLIFF_S_CMLP variant exists; the "
                 "unsupervised REDCLIFF_CMLP is unimplemented in the "
                 "reference as well")
-        from ..models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
-
-        emb_args = dict(args_dict.get("factor_score_embedder_args", []))
-        smoothing_coeff = _coeff(args_dict,
-                                 "FACTOR_WEIGHT_SMOOTHING_PENALTY_COEFF") \
-            if employ_version_with_smoothing_loss else 0.0
-        cfg = RedcliffSCMLPConfig(
-            num_chans=args_dict["num_channels"],
+        return _build_redcliff(
+            args_dict, employ_version_with_smoothing_loss,
+            factor_network_type="cMLP",
             gen_lag=args_dict["gen_lag"],
             gen_hidden=tuple(args_dict["gen_hidden"]),
             embed_lag=args_dict["embed_lag"],
-            embed_hidden_sizes=tuple(args_dict["embed_hidden_sizes"]),
-            num_factors=args_dict["num_factors"],
-            num_supervised_factors=args_dict["num_supervised_factors"],
-            forecast_coeff=_coeff(args_dict, "FORECAST_COEFF", 1.0),
-            factor_score_coeff=_coeff(args_dict, "FACTOR_SCORE_COEFF"),
-            factor_cos_sim_coeff=_coeff(args_dict, "FACTOR_COS_SIM_COEFF"),
-            factor_weight_l1_coeff=_coeff(args_dict,
-                                          "FACTOR_WEIGHT_L1_COEFF"),
-            adj_l1_reg_coeff=_coeff(args_dict, "ADJ_L1_REG_COEFF"),
-            dagness_reg_coeff=_coeff(args_dict, "DAGNESS_REG_COEFF"),
             dagness_lag_coeff=_coeff(args_dict, "DAGNESS_LAG_COEFF"),
-            dagness_node_coeff=_coeff(args_dict, "DAGNESS_NODE_COEFF"),
-            use_sigmoid_restriction=args_dict["use_sigmoid_restriction"],
-            sigmoid_eccentricity_coeff=emb_args.get(
-                "sigmoid_eccentricity_coeff", 10.0),
-            factor_score_embedder_type=args_dict["factor_score_embedder_type"],
-            dgcnn_num_graph_conv_layers=emb_args.get(
-                "num_graph_conv_layers", 2),
-            dgcnn_num_hidden_nodes=emb_args.get("num_hidden_nodes", 32),
-            primary_gc_est_mode=args_dict["primary_gc_est_mode"],
-            forward_pass_mode=args_dict["forward_pass_mode"],
-            num_sims=args_dict["num_sims"],
-            wavelet_level=args_dict.get("wavelet_level"),
-            training_mode=args_dict["training_mode"],
-            num_pretrain_epochs=args_dict["num_pretrain_epochs"],
-            num_acclimation_epochs=args_dict.get("num_acclimation_epochs", 0),
-            factor_weight_smoothing_penalty_coeff=smoothing_coeff,
-        )
-        return RedcliffSCMLP(cfg)
+            dagness_node_coeff=_coeff(args_dict, "DAGNESS_NODE_COEFF"))
 
     if "cMLP" in model_type or "CMLP" in model_type:
         from ..models.cmlp_fm import CMLPFM, CMLPFMConfig

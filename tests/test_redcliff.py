@@ -264,3 +264,77 @@ def test_redcliff_end_to_end_training(two_state_data, tmp_path):
     # confusion-matrix histories populated in combined epochs
     assert len(res.histories["factor_score_val_acc_history"]) > 0
     assert (tmp_path / "redcliff_run" / "final_best_model.bin").exists()
+
+
+def test_redcliff_clstm_factor_variant():
+    """REDCLIFF_S_CLSTM: cLSTM factor networks inside the shared core (the
+    variant the reference declares but never shipped)."""
+    import numpy as np
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+
+    cfg = RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=3, gen_hidden=(8,), embed_lag=5,
+        embed_hidden_sizes=(6,), num_factors=2, num_supervised_factors=2,
+        factor_network_type="cLSTM",
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive",
+        training_mode="combined", num_pretrain_epochs=0, num_sims=2)
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jax.random.normal(jax.random.PRNGKey(1), (3, 10, 4))
+    x_sims, factor_preds, fw_preds, label_preds = model.forward(params, X)
+    assert x_sims.shape == (3, 2, 4)
+    # GC: per-factor (C, C) from LSTM input weights, no lag axis
+    G = model.factor_gc(params)
+    assert G.shape == (2, 4, 4)
+    assert np.isfinite(np.asarray(G)).all()
+    G_lag = model.factor_gc(params, ignore_lag=False)
+    assert G_lag.shape == (2, 4, 4, 1)
+    # loss computes through both phases
+    loss, terms = model.loss_for_phase(params, X,
+                                       jnp.ones((3, 2, 10)), "combined")
+    assert np.isfinite(float(loss))
+
+
+def test_redcliff_clstm_post_weighted_mode():
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+
+    cfg = RedcliffSCMLPConfig(
+        num_chans=3, gen_lag=4, gen_hidden=(6,), embed_lag=4,
+        embed_hidden_sizes=(6,), num_factors=2, num_supervised_factors=2,
+        factor_network_type="cLSTM",
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive",
+        forward_pass_mode="apply_factor_weights_after_sim_completion",
+        training_mode="combined", num_pretrain_epochs=0, num_sims=3)
+    model = RedcliffSCMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 3))
+    x_sims, _, _, _ = model.forward(params, X)
+    assert x_sims.shape == (2, 3, 3)
+
+
+def test_redcliff_clstm_factory_dispatch():
+    from redcliff_tpu.train.orchestration import create_model_instance
+
+    args = {
+        "model_type": "REDCLIFF_S_CLSTM", "num_channels": 4,
+        "context": 3, "gen_hidden": 8, "num_in_timesteps": 5,
+        "embed_hidden_sizes": [6], "num_factors": 2,
+        "num_supervised_factors": 2,
+        "coeff_dict": {"FORECAST_COEFF": 1.0, "FACTOR_SCORE_COEFF": 1.0,
+                       "FACTOR_COS_SIM_COEFF": 0.0,
+                       "FACTOR_WEIGHT_L1_COEFF": 0.0,
+                       "ADJ_L1_REG_COEFF": 0.0},
+        "use_sigmoid_restriction": True,
+        "factor_score_embedder_type": "Vanilla_Embedder",
+        "factor_score_embedder_args": [],
+        "primary_gc_est_mode": "fixed_factor_exclusive",
+        "forward_pass_mode": "apply_factor_weights_at_each_sim_step",
+        "num_sims": 1, "wavelet_level": None, "training_mode": "combined",
+        "num_pretrain_epochs": 0,
+    }
+    model = create_model_instance(args)
+    assert model.config.factor_network_type == "cLSTM"
+    assert model.config.gen_lag == 3
+    assert model.config.gen_hidden == (8,)
