@@ -16,7 +16,18 @@ from .grid_selection import (
     rank_runs,
     select_best_models,
 )
+from .factor_scoring import (
+    average_factor_scoring_by_state,
+    evaluate_avg_factor_scoring_across_recordings,
+    factor_score_sweep,
+)
 from .model_io import load_artifact, load_model_for_eval
+from .summaries import (
+    extract_metric_table,
+    load_full_comparison_summary,
+    summarize_off_diag_f1,
+    write_cross_experiment_report,
+)
 from .supervised_discovery import (
     prepare_data_for_modeling,
     run_discovery_algorithm,
@@ -40,6 +51,10 @@ __all__ = [
     "average_factor_histories", "filter_incomplete_runs",
     "load_grid_summaries", "rank_runs", "select_best_models",
     "load_artifact", "load_model_for_eval",
+    "average_factor_scoring_by_state",
+    "evaluate_avg_factor_scoring_across_recordings", "factor_score_sweep",
+    "extract_metric_table", "load_full_comparison_summary",
+    "summarize_off_diag_f1", "write_cross_experiment_report",
     "prepare_data_for_modeling", "run_discovery_algorithm",
     "run_supervised_discovery_evaluation", "score_discovery_predictions",
     "compute_fixed_f1_stats", "compute_graph_comparison_stats",

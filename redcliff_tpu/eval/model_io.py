@@ -53,6 +53,23 @@ def load_artifact(path):
         return pickle.load(f)
 
 
+def _migrate_config(config):
+    """Fill config fields added after an artifact was pickled: unpickling
+    restores __dict__ directly, bypassing dataclass defaults, so a config
+    saved before a field existed lacks the attribute entirely."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(config):
+        for f in dataclasses.fields(config):
+            if not hasattr(config, f.name):
+                default = f.default if f.default is not dataclasses.MISSING \
+                    else (f.default_factory()
+                          if f.default_factory is not dataclasses.MISSING
+                          else None)
+                object.__setattr__(config, f.name, default)
+    return config
+
+
 def load_model_for_eval(path, model_class=None):
     """Reconstruct (model, params[, state]) from a saved artifact.
 
@@ -69,7 +86,7 @@ def load_model_for_eval(path, model_class=None):
     if cls_name not in registry:
         raise ValueError(f"unknown model class in artifact: {cls_name!r}")
     cls = registry[cls_name]
-    config = payload["config"]
+    config = _migrate_config(payload["config"])
     if cls_name in ("DynotearsModel", "DynotearsVanillaModel"):
         # solver-state artifacts: gc() reads instance state, no params pytree
         model = cls(config)
