@@ -1,11 +1,12 @@
 """Benchmark: REDCLIFF-S grid-training throughput on one chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 value        — training-window throughput (windows/sec/chip) of the vmapped
-               hyperparameter-grid REDCLIFF-S train step (G grid points trained
-               simultaneously — this framework's execution model).
+               hyperparameter-grid REDCLIFF-S train step at the headline grid
+               size (G grid points trained simultaneously — this framework's
+               execution model).
 vs_baseline  — speedup over the reference's execution pattern on the SAME chip:
                one jit'd train step per grid point, stepped sequentially
                (the SLURM-array one-process-per-point pattern of
@@ -13,24 +14,83 @@ vs_baseline  — speedup over the reference's execution pattern on the SAME chip
                point's compute already tensorized — i.e. this understates the
                true advantage over the reference's per-factor Python loops).
 
+Extra context fields (so "fast" is judgeable against hardware capability):
+  flops_per_step — XLA cost-analysis FLOPs of one compiled grid step
+  mfu_pct        — implied chip utilization vs the device's dense peak
+  g_scaling      — {G: windows/s} curve over grid sizes
+  device / error — backend actually used; error is non-null if the TPU was
+                   unavailable and the bench fell back to CPU
+
 The reference repository publishes no benchmark numbers (BASELINE.md), so the
 sequential-vs-grid ratio on identical hardware is the honest comparable.
+
+Hardened: backend init failure is caught and retried; the JSON line is ALWAYS
+emitted (with an "error" field when measurement was impossible).
 """
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
+# dense peak FLOPs/s per chip, bf16/fp-dense (public TPU specs); fp32 runs at
+# a lower peak on MXU — mfu_pct is therefore a conservative lower bound
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
-def main():
+
+def _emit(payload):
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _init_backend():
+    """Initialize a jax backend; retry once, then fall back to CPU.
+
+    Returns (jax, devices, error_or_None)."""
     import jax
 
-    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
-    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
-    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    last_err = None
+    for _ in range(2):
+        try:
+            return jax, jax.devices(), None
+        except RuntimeError as e:  # e.g. "Unable to initialize backend 'axon'"
+            last_err = e
+            time.sleep(5.0)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return jax, jax.devices(), f"accelerator backend unavailable ({last_err}); ran on cpu"
+    except Exception as e:  # pragma: no cover - no backend at all
+        return None, None, f"no jax backend available: {last_err!r} / {e!r}"
+
+
+def _flops_of(jax, compiled):
+    """XLA cost-analysis FLOPs of a compiled computation (None if unavailable)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = ca.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception:
+        return None
+
+
+def _model_config():
+    from redcliff_tpu.models.redcliff import RedcliffSCMLPConfig
 
     # D4IC-like shapes: 10 channels, gen_lag 4, embed_lag 16 (ref cached args)
-    cfg = RedcliffSCMLPConfig(
+    return RedcliffSCMLPConfig(
         num_chans=10, gen_lag=4, gen_hidden=(32,), embed_lag=16,
         embed_hidden_sizes=(0,), num_factors=5, num_supervised_factors=5,
         factor_score_coeff=2.0, factor_cos_sim_coeff=0.05,
@@ -40,45 +100,58 @@ def main():
         primary_gc_est_mode="conditional_factor_fixed_embedder",
         num_sims=2, training_mode="combined",
     )
-    model = RedcliffSCMLP(cfg)
-    G = 16
-    B = 64
-    steps = 30
+
+
+def _bench_grid(jax, model, G, B, steps):
+    """Throughput (windows/s) + FLOPs/step of the G-point vmapped grid step."""
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    cfg = model.config
     spec = GridSpec(points=[
         {"gen_lr": 1e-3 * (1 + (i % 4)), "adj_l1_reg_coeff": 1e-3 * (i % 2),
          "factor_cos_sim_coeff": 0.05 * (i % 3)}
         for i in range(G)
     ])
-    tc = RedcliffTrainConfig(batch_size=B)
-    runner = RedcliffGridRunner(model, tc, spec, mesh=None)
-
+    runner = RedcliffGridRunner(model, RedcliffTrainConfig(batch_size=B), spec,
+                                mesh=None)
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
-    X = rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32)
-    Y = rng.uniform(size=(B, cfg.num_supervised_factors, 1)).astype(np.float32)
-    Xd, Yd = jax.device_put(X), jax.device_put(Y)
+    X = jax.device_put(rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32))
+    Y = jax.device_put(
+        rng.uniform(size=(B, cfg.num_supervised_factors, 1)).astype(np.float32))
 
     params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
     coeffs = runner.coeffs
     step = runner._steps["combined"]
 
-    # --- grid-vmapped path ------------------------------------------------
-    p, a, b, _ = step(params, optA, optB, coeffs, Xd, Yd)  # compile
+    # AOT-compile ONCE and time through the compiled object (calling the jit
+    # wrapper after .lower().compile() would compile a second time — the jit
+    # executable cache is not populated by AOT compilation)
+    compiled = step.lower(params, optA, optB, coeffs, X, Y).compile()
+    flops = _flops_of(jax, compiled)
+
+    p, a, b, _ = compiled(params, optA, optB, coeffs, X, Y)  # warm dispatch
     jax.block_until_ready(p)
     t0 = time.perf_counter()
     for _ in range(steps):
-        p, a, b, _ = step(p, a, b, coeffs, Xd, Yd)
+        p, a, b, _ = compiled(p, a, b, coeffs, X, Y)
     jax.block_until_ready(p)
-    grid_time = time.perf_counter() - t0
-    grid_wps = G * B * steps / grid_time
+    dt = time.perf_counter() - t0
+    return G * B * steps / dt, flops, dt / steps, runner, (p, a, b, coeffs, X, Y)
 
-    # --- sequential per-point path (reference execution pattern) ----------
-    point_params = jax.tree.map(lambda x: x[0], params)
-    point_optA = jax.tree.map(lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optA)
-    point_optB = jax.tree.map(lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optB)
-    point_coeffs = {k: v[0] for k, v in coeffs.items()}
 
+def _bench_sequential(jax, model, runner, grid_state, G, B, steps):
+    """Reference execution pattern: one jit'd step per point, run sequentially."""
     import optax
+
+    params, optA, optB, coeffs, X, Y = grid_state
+    point_params = jax.tree.map(lambda x: x[0], params)
+    point_optA = jax.tree.map(
+        lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optA)
+    point_optB = jax.tree.map(
+        lambda x: x[0] if hasattr(x, "ndim") and x.ndim > 0 else x, optB)
+    point_coeffs = {k: v[0] for k, v in coeffs.items()}
 
     def single_step(params, a_state, b_state, coeffs, X, Y):
         def loss_fn(pp):
@@ -98,25 +171,83 @@ def main():
         )
         return params, a_state, b_state
 
-    sstep = jax.jit(single_step)
-    pp, aa, bb = sstep(point_params, point_optA, point_optB, point_coeffs, Xd, Yd)
+    sstep = jax.jit(single_step, donate_argnums=(0, 1, 2))
+    pp, aa, bb = sstep(point_params, point_optA, point_optB, point_coeffs, X, Y)
     jax.block_until_ready(pp)
-    seq_steps = max(steps // 3, 5)
     t0 = time.perf_counter()
-    for _ in range(seq_steps):
+    for _ in range(steps):
         for _ in range(G):  # one sequential step per grid point, like a job array
-            pp, aa, bb = sstep(pp, aa, bb, point_coeffs, Xd, Yd)
+            pp, aa, bb = sstep(pp, aa, bb, point_coeffs, X, Y)
     jax.block_until_ready(pp)
-    seq_time = time.perf_counter() - t0
-    seq_wps = G * B * seq_steps / seq_time
+    dt = time.perf_counter() - t0
+    return G * B * steps / dt
 
-    print(json.dumps({
+
+def main():
+    jax, devices, err = _init_backend()
+    if jax is None:
+        _emit({"metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
+               "value": None, "unit": "windows/s/chip", "vs_baseline": None,
+               "error": err})
+        return
+
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP
+
+    dev_kind = devices[0].device_kind
+    platform = devices[0].platform
+    on_cpu = platform == "cpu"
+    model = RedcliffSCMLP(_model_config())
+    B = 64
+    G_HEAD = 16
+    steps = 8 if on_cpu else 30
+
+    # --- G-scaling curve + headline measurement ---------------------------
+    # headline first so a wall-clock-budget bailout still yields the number
+    t_start = time.perf_counter()
+    budget_s = 420.0
+    g_scaling = {}
+    headline = None
+    extra_g = (1, 4) if on_cpu else (1, 4, 64)
+    for G in (G_HEAD,) + extra_g:
+        if G != G_HEAD and time.perf_counter() - t_start > budget_s:
+            print(f"bench: skipping G={G} (wall-clock budget)", file=sys.stderr)
+            continue
+        print(f"bench: measuring G={G}", file=sys.stderr, flush=True)
+        wps, flops, step_s, runner, state = _bench_grid(jax, model, G, B, steps)
+        g_scaling[str(G)] = round(wps, 1)
+        if G == G_HEAD:
+            headline = (wps, flops, step_s, runner, state)
+
+    grid_wps, flops_per_step, step_seconds, runner, grid_state = headline
+    seq_steps = max(steps // 3, 3)
+    seq_wps = _bench_sequential(jax, model, runner, grid_state, G_HEAD, B, seq_steps)
+
+    peak = PEAK_FLOPS.get(dev_kind)
+    mfu = (100.0 * flops_per_step / step_seconds / peak
+           if (flops_per_step and peak and not on_cpu) else None)
+
+    _emit({
         "metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
         "value": round(grid_wps, 1),
         "unit": "windows/s/chip",
         "vs_baseline": round(grid_wps / seq_wps, 2),
-    }))
+        "device": dev_kind,
+        "platform": platform,
+        "grid_points": G_HEAD,
+        "batch_size": B,
+        "flops_per_step": flops_per_step,
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        "g_scaling": g_scaling,
+        "error": err,
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        traceback.print_exc(file=sys.stderr)
+        _emit({"metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
+               "value": None, "unit": "windows/s/chip", "vs_baseline": None,
+               "error": f"{type(e).__name__}: {e}"})
+        sys.exit(0)

@@ -8,6 +8,17 @@ from .cross_alg import (
     find_run_directory,
     run_cross_algorithm_comparison,
 )
+from .edge_dynamics import (
+    compute_edge_lock_performance_v3_stats,
+    compute_edge_lock_performance_v4_stats,
+    compute_edge_rank_performance_v1_stats,
+    compute_edge_rank_performance_v2_stats,
+    compute_key_edge_correlation_stats,
+    compute_key_edge_covariance_stats,
+    compute_smoothed_edge_cross_edge_rank_covariance_stats,
+    compute_smoothed_edge_rank_covariance_stats,
+    evaluate_dynamic_graph_estimates,
+)
 from .gc_estimates import get_model_gc_estimates, get_model_gc_score_estimates
 from .grid_selection import (
     average_factor_histories,
@@ -45,6 +56,15 @@ from .stats import (
 
 __all__ = [
     "ancestor_aid", "oset_aid", "parent_aid", "shd",
+    "compute_edge_lock_performance_v3_stats",
+    "compute_edge_lock_performance_v4_stats",
+    "compute_edge_rank_performance_v1_stats",
+    "compute_edge_rank_performance_v2_stats",
+    "compute_key_edge_correlation_stats",
+    "compute_key_edge_covariance_stats",
+    "compute_smoothed_edge_cross_edge_rank_covariance_stats",
+    "compute_smoothed_edge_rank_covariance_stats",
+    "evaluate_dynamic_graph_estimates",
     "ALL_POSSIBLE_ALGORITHMS", "evaluate_algorithm_on_fold",
     "find_run_directory", "run_cross_algorithm_comparison",
     "get_model_gc_estimates", "get_model_gc_score_estimates",

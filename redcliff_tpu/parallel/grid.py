@@ -178,7 +178,10 @@ class RedcliffGridRunner:
             vstep = jax.vmap(
                 lambda p, a, b, c, X, Y, ph=phase: point_step(p, a, b, c, X, Y, ph),
                 in_axes=(0, 0, 0, 0, None, None))
-            self._steps[phase] = jax.jit(vstep)
+            # donate params + opt states: they are consumed and rebound every
+            # step, so XLA can update them in place instead of round-tripping
+            # a second copy of the whole grid state through HBM
+            self._steps[phase] = jax.jit(vstep, donate_argnums=(0, 1, 2))
         self._val = jax.jit(jax.vmap(point_val, in_axes=(0, 0, None, None)))
 
         def select_best(best_params, best_crit, best_epoch, params, crit, epoch):
@@ -255,7 +258,9 @@ class RedcliffGridRunner:
         G = len(self.spec.points)
         best_crit = jnp.full((G,), jnp.inf)
         best_epoch = jnp.zeros((G,), dtype=jnp.int32)
-        best_params = params
+        # materialize a copy: the train steps donate (consume) the live params
+        # buffers, so best_params must never alias them
+        best_params = jax.tree.map(jnp.copy, params)
         val_history = []
         aligned = False
         for it in range(max_iter):
@@ -283,14 +288,16 @@ class RedcliffGridRunner:
                 raise ValueError(
                     "validation dataset yielded no batches — increase "
                     "val_fraction or dataset size")
-            val_history.append(np.asarray(combo_sum) / n)
+            # keep per-epoch losses device-resident; one host transfer at the end
+            val_history.append(combo_sum / n)
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
                 best_params, best_crit, best_epoch = self._select_best(
                     best_params, best_crit, best_epoch, params, crit_sum / n,
                     jnp.int32(it))
             else:
-                best_params, best_epoch = params, jnp.full((G,), it, jnp.int32)
+                best_params = jax.tree.map(jnp.copy, params)
+                best_epoch = jnp.full((G,), it, jnp.int32)
 
         return GridResult(
             best_params=best_params,

@@ -1,0 +1,263 @@
+"""Edge-dynamics statistics: golden-value tests against hand-built reference
+semantics (scipy per-edge loops mirroring /root/reference/evaluate/eval_utils.py
+:43-654) on small random histories."""
+import numpy as np
+import pytest
+from scipy.stats import linregress, rankdata, spearmanr
+
+from redcliff_tpu.eval.edge_dynamics import (
+    compute_edge_lock_performance_v3_stats,
+    compute_edge_lock_performance_v4_stats,
+    compute_edge_rank_performance_v1_stats,
+    compute_edge_rank_performance_v2_stats,
+    compute_key_correlation_stats_betw_two_score_histories,
+    compute_key_covariance_stats_betw_two_score_histories,
+    compute_key_edge_correlation_stats,
+    compute_key_edge_covariance_stats,
+    compute_key_spearman_correlation_stats_betw_two_score_histories,
+    compute_key_stats_betw_two_gc_score_vecs,
+    compute_smoothed_edge_cross_edge_rank_covariance_stats,
+    compute_smoothed_edge_rank_covariance_stats,
+    dense_rank_per_window,
+    smooth_history,
+    spearman_numerator_cov,
+    vector_pearson,
+    vector_spearman,
+)
+
+
+def _histories(T=12, C=4, seed=0):
+    rng = np.random.default_rng(seed)
+    true = rng.uniform(size=(T, C, C))
+    true[:, C - 2, C - 1] = 0.0  # an edge with no true activation
+    est = 0.6 * true + 0.4 * rng.uniform(size=(T, C, C))
+    return est, true
+
+
+def _ref_smooth(hist, w):
+    # the reference's exact loop (eval_utils.py:68-78)
+    T = len(hist)
+    out = [np.zeros_like(hist[0]) for _ in range(T - w)]
+    C = hist[0].shape[0]
+    for i in range(C):
+        for j in range(C):
+            edge = [hist[t][i, j] for t in range(T)]
+            sm = [np.mean(edge[t:t + w]) for t in range(T - w)]
+            for t, v in enumerate(sm):
+                out[t][i, j] = v
+    return out
+
+
+def test_smooth_history_matches_reference_convention():
+    est, _ = _histories()
+    for w in (1, 3):
+        got = smooth_history(est, w)
+        want = np.stack(_ref_smooth(list(est), w))
+        assert got.shape[0] == est.shape[0] - w
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_dense_rank_per_window_matches_rankdata():
+    est, _ = _histories(T=5)
+    got = dense_rank_per_window(est)
+    for t in range(5):
+        want = rankdata(est[t], method="dense").reshape(est[t].shape)
+        np.testing.assert_array_equal(got[t], want)
+
+
+def test_vector_pearson_matches_linregress():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(20, 7))
+    y = rng.normal(size=(20, 7))
+    r, p = vector_pearson(x, y)
+    for e in range(7):
+        lr = linregress(x[:, e], y[:, e])
+        assert r[e] == pytest.approx(lr.rvalue, abs=1e-10)
+        assert p[e] == pytest.approx(lr.pvalue, abs=1e-10)
+
+
+def test_vector_spearman_matches_scipy():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(15, 5))
+    y = 0.5 * x + rng.normal(size=(15, 5))
+    r, p = vector_spearman(x, y)
+    for e in range(5):
+        sr, sp = spearmanr(x[:, e], y[:, e])
+        assert r[e] == pytest.approx(sr, abs=1e-10)
+        assert p[e] == pytest.approx(sp, abs=1e-10)
+
+
+def test_edge_lock_v4_covers_all_edges_with_pearson():
+    est, true = _histories()
+    C = est.shape[1]
+    stats = compute_edge_lock_performance_v4_stats(
+        "PearsonCorrelation", est, true, smoothing_window_size=2)
+    assert len(stats) == C * C
+    s_est, s_true = _ref_smooth(list(est), 2), _ref_smooth(list(true), 2)
+    i, j = 1, 2
+    lr = linregress([A[i, j] for A in s_est], [A[i, j] for A in s_true])
+    got = stats[f"{i}<-{j}"][
+        "PearsonCorrelation_curr_paradigm_smooth_activ_hist_stat"]
+    assert got["pearson_r"] == pytest.approx(lr.rvalue, abs=1e-10)
+    assert got["pearson_p"] == pytest.approx(lr.pvalue, abs=1e-10)
+
+
+def test_edge_lock_v3_filters_diagonal_and_inactive():
+    est, true = _histories()
+    C = est.shape[1]
+    stats = compute_edge_lock_performance_v3_stats(
+        "PearsonCorrelation", est, true, smoothing_window_size=1)
+    # no self-edges
+    assert all(k.split("<-")[0] != k.split("<-")[1] for k in stats)
+    assert len(stats) <= C * C - C
+
+
+def test_edge_lock_rejects_unknown_paradigm():
+    est, true = _histories()
+    with pytest.raises(NotImplementedError):
+        compute_edge_lock_performance_v4_stats("Wavelet", est, true)
+
+
+def test_edge_rank_v2_golden_values():
+    est, true = _histories(T=10, C=3, seed=3)
+    w = 2
+    stats = compute_edge_rank_performance_v2_stats(
+        "PearsonCorrelation", est, true, smoothing_window_size=w)
+    s_est, s_true = _ref_smooth(list(est), w), _ref_smooth(list(true), w)
+    r_est = [rankdata(A, method="dense").reshape(A.shape) for A in s_est]
+    r_true = [rankdata(A, method="dense").reshape(A.shape) for A in s_true]
+    for key, entry in stats.items():
+        if not isinstance(key, str):
+            continue
+        i, j = (int(v) for v in key.split("<-"))
+        er = np.array([A[i, j] for A in r_est])
+        tr = np.array([A[i, j] for A in r_true])
+        ea = np.array([A[i, j] for A in s_est])
+        ta = np.array([A[i, j] for A in s_true])
+        assert tr.mean() > 1.0 and i != j  # the reference's filter
+        assert entry["smooth_rank_MSE_across_windows"] == pytest.approx(
+            np.mean((er - tr) ** 2))
+        assert entry["smooth_activ_MSE_across_windows"] == pytest.approx(
+            np.mean((ea - ta) ** 2))
+        lr = linregress(er, tr)
+        got = entry["PearsonCorrelation_curr_paradigm_ranked_smooth_hist_stat"]
+        assert got["pearson_r"] == pytest.approx(lr.rvalue, abs=1e-10)
+
+
+def test_edge_rank_v2_aggregates_by_true_rank_key():
+    est, true = _histories(T=10, C=3, seed=4)
+    stats = compute_edge_rank_performance_v2_stats(
+        "PearsonCorrelation", est, true)
+    float_keys = [k for k in stats if not isinstance(k, str)]
+    assert float_keys, "expected per-true-rank aggregation keys"
+    total = sum(len(stats[k]["smooth_rank_MSE_across_windows"])
+                for k in float_keys)
+    n_edges = len([k for k in stats if isinstance(k, str)])
+    assert total == n_edges
+
+
+def test_edge_rank_v1_stats_and_paradigms():
+    est, true = _histories(T=10, C=3, seed=5)
+    for paradigm in ("PearsonCorrelation", "SpearmanCorrelation", "ROC_AUC"):
+        stats = compute_edge_rank_performance_v1_stats(paradigm, est, true)
+        str_keys = [k for k in stats if isinstance(k, str)]
+        assert str_keys
+        entry = stats[str_keys[0]]
+        assert "avg_smooth_rank_diff" in entry
+        assert "avg_of_smooth_activ_diffs_across_windows" in entry
+        if paradigm == "ROC_AUC":
+            # activation stat is always None under ROC_AUC (ref :377)
+            assert entry[
+                "ROC_AUC_curr_paradigm_smooth_activ_hist_stat"] is None
+
+
+def test_edge_rank_v1_diff_golden():
+    est, true = _histories(T=8, C=3, seed=6)
+    stats = compute_edge_rank_performance_v1_stats(
+        "PearsonCorrelation", est, true, smoothing_window_size=1)
+    s_est, s_true = _ref_smooth(list(est), 1), _ref_smooth(list(true), 1)
+    key = next(k for k in stats if isinstance(k, str))
+    i, j = (int(v) for v in key.split("<-"))
+    ea = np.array([A[i, j] for A in s_est])
+    ta = np.array([A[i, j] for A in s_true])
+    assert stats[key]["avg_smooth_activ_diff"] == pytest.approx(
+        ea.mean() - ta.mean())
+    assert stats[key]["avg_of_smooth_activ_diffs_across_windows"] == \
+        pytest.approx((ea - ta).mean())
+
+
+def test_spearman_numerator_cov_fixes_reference_bug():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=20)
+    y = np.exp(x) + rng.normal(size=20) * 0.01  # monotone -> rank cov != cov
+    fixed = spearman_numerator_cov(x, y)
+    buggy = spearman_numerator_cov(x, y, match_reference_bug=True)
+    assert buggy == pytest.approx(np.cov(x, y)[0, 1])
+    want = np.cov(rankdata(x), rankdata(y))[0, 1]
+    assert fixed == pytest.approx(want)
+    assert fixed != pytest.approx(buggy)
+
+
+def test_covariance_summaries():
+    est, true = _histories(T=9, C=3, seed=8)
+    out = compute_key_edge_covariance_stats(est, true)
+    covs, rcovs = [], []
+    for i in range(3):
+        for j in range(3):
+            covs.append(np.cov(est[:, i, j], true[:, i, j])[0, 1])
+            rcovs.append(np.cov(rankdata(est[:, i, j]),
+                                rankdata(true[:, i, j]))[0, 1])
+    assert out["avg_edge_cov"] == pytest.approx(np.mean(covs))
+    assert out["avg_edge_rank_cov"] == pytest.approx(np.mean(rcovs))
+
+
+def test_smoothed_rank_covariance_windows():
+    est, true = _histories(T=12, C=3, seed=9)
+    out = compute_smoothed_edge_rank_covariance_stats(
+        est, true, smoothing_window_sizes=(1, 3))
+    assert set(out) == {"smoothWindow1_avg_edge_rank_cov",
+                        "smoothWindow3_avg_edge_rank_cov"}
+    out2 = compute_smoothed_edge_cross_edge_rank_covariance_stats(
+        est, true, smoothing_window_sizes=(2,))
+    assert set(out2) == {"smoothWindow2_avg_edge_rank_cov"}
+    assert np.isfinite(out2["smoothWindow2_avg_edge_rank_cov"])
+
+
+def test_score_history_stats():
+    rng = np.random.default_rng(10)
+    est_h = rng.normal(size=25)
+    true_h = 0.7 * est_h + rng.normal(size=25) * 0.5
+    cov_stats = compute_key_covariance_stats_betw_two_score_histories(
+        est_h, true_h)
+    assert cov_stats["cov"] == pytest.approx(np.cov(est_h, true_h)[0, 1])
+    corr = compute_key_correlation_stats_betw_two_score_histories(est_h, true_h)
+    lr = linregress(est_h, true_h)
+    assert corr["r"] == pytest.approx(lr.rvalue, abs=1e-10)
+    assert corr["p"] == pytest.approx(lr.pvalue, abs=1e-10)
+    sp_stats = compute_key_spearman_correlation_stats_betw_two_score_histories(
+        est_h, true_h)
+    sr, sp = spearmanr(est_h, true_h)
+    assert sp_stats["sr"] == pytest.approx(sr, abs=1e-10)
+    assert sp_stats["sp"] == pytest.approx(sp, abs=1e-10)
+
+
+def test_score_vec_stats():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([1.0, 2.0, 4.0])
+    out = compute_key_stats_betw_two_gc_score_vecs(a, b)
+    assert out["mse"] == pytest.approx(np.mean((a - b) ** 2))
+    assert 0.9 < out["cosine_similarity"] <= 1.0
+
+
+def test_edge_correlation_summary():
+    est, true = _histories(T=10, C=3, seed=11)
+    out = compute_key_edge_correlation_stats(est, true)
+    rs = [linregress(est[:, i, j], true[:, i, j]).rvalue
+          for i in range(3) for j in range(3)]
+    # one constant true edge -> nan on both sides, like scipy
+    assert out["avg_edge_pearson_r"] == pytest.approx(
+        np.mean(rs), abs=1e-10, nan_ok=True)
+    finite = [r for r in rs if np.isfinite(r)]
+    pr, _ = vector_pearson(est.reshape(10, -1), true.reshape(10, -1))
+    np.testing.assert_allclose(
+        np.sort(pr[np.isfinite(pr)]), np.sort(finite), atol=1e-10)
