@@ -54,24 +54,54 @@ def _emit(payload):
     sys.stdout.flush()
 
 
-def _init_backend():
-    """Initialize a jax backend; retry once, then fall back to CPU.
+def _probe_accelerator(timeout_s=240.0):
+    """Check in a KILLABLE subprocess whether the accelerator backend can
+    initialize: a hung tunnel (observed with the axon TPU backend) would
+    otherwise block this process in a C call forever."""
+    import subprocess
 
-    Returns (jax, devices, error_or_None)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if r.returncode == 0:
+            return True, r.stdout.strip()
+        return False, f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        return False, f"accelerator backend init hung > {timeout_s:.0f}s"
+    except Exception as e:
+        return False, f"probe failed: {e!r}"
+
+
+def _init_backend():
+    """Initialize a jax backend; probe the accelerator in a subprocess first
+    (retry once), then fall back to CPU. Returns (jax, devices, error_or_None)."""
+    ok, info = _probe_accelerator()
+    if not ok:
+        print(f"bench: accelerator probe failed ({info}); retrying",
+              file=sys.stderr, flush=True)
+        time.sleep(5.0)
+        ok, info = _probe_accelerator()
+
     import jax
 
-    last_err = None
-    for _ in range(2):
+    if not ok:
+        err = f"accelerator backend unavailable ({info}); ran on cpu"
         try:
-            return jax, jax.devices(), None
-        except RuntimeError as e:  # e.g. "Unable to initialize backend 'axon'"
-            last_err = e
-            time.sleep(5.0)
+            jax.config.update("jax_platforms", "cpu")
+            return jax, jax.devices(), err
+        except Exception as e:  # pragma: no cover - no backend at all
+            return None, None, f"no jax backend available: {info!r} / {e!r}"
     try:
-        jax.config.update("jax_platforms", "cpu")
-        return jax, jax.devices(), f"accelerator backend unavailable ({last_err}); ran on cpu"
-    except Exception as e:  # pragma: no cover - no backend at all
-        return None, None, f"no jax backend available: {last_err!r} / {e!r}"
+        return jax, jax.devices(), None
+    except RuntimeError as e:
+        # probe succeeded but in-process init failed; last resort: cpu
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            return jax, jax.devices(), f"backend init failed ({e}); ran on cpu"
+        except Exception as e2:
+            return None, None, f"no jax backend available: {e!r} / {e2!r}"
 
 
 def _flops_of(jax, compiled):
@@ -123,19 +153,20 @@ def _bench_grid(jax, model, G, B, steps):
 
     params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
     coeffs = runner.coeffs
+    active = jax.numpy.ones((G,), dtype=bool)
     step = runner._steps["combined"]
 
     # AOT-compile ONCE and time through the compiled object (calling the jit
     # wrapper after .lower().compile() would compile a second time — the jit
     # executable cache is not populated by AOT compilation)
-    compiled = step.lower(params, optA, optB, coeffs, X, Y).compile()
+    compiled = step.lower(params, optA, optB, coeffs, active, X, Y).compile()
     flops = _flops_of(jax, compiled)
 
-    p, a, b, _ = compiled(params, optA, optB, coeffs, X, Y)  # warm dispatch
+    p, a, b, _ = compiled(params, optA, optB, coeffs, active, X, Y)  # warm dispatch
     jax.block_until_ready(p)
     t0 = time.perf_counter()
     for _ in range(steps):
-        p, a, b, _ = compiled(p, a, b, coeffs, X, Y)
+        p, a, b, _ = compiled(p, a, b, coeffs, active, X, Y)
     jax.block_until_ready(p)
     dt = time.perf_counter() - t0
     return G * B * steps / dt, flops, dt / steps, runner, (p, a, b, coeffs, X, Y)

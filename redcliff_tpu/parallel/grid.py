@@ -23,6 +23,7 @@ import optax
 
 from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
+from redcliff_tpu.train.freeze import apply_freeze
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
 
@@ -80,6 +81,7 @@ class GridResult:
     best_epoch: np.ndarray     # (G,)
     val_history: np.ndarray    # (epochs, G) validation combo loss
     coeffs: dict
+    active: np.ndarray = None  # (G,) bool; False = point early-stopped
 
 
 def group_configs_by_shape(config_dicts, shape_keys):
@@ -138,7 +140,7 @@ class RedcliffGridRunner:
         model = self.model
         need_gc, need_gc_lagged = self._need_gc, self._need_gc_lagged
 
-        def point_step(params, optA_state, optB_state, coeffs, X, Y, phase):
+        def point_step(params, optA_state, optB_state, coeffs, active, X, Y, phase):
             def loss_fn(p):
                 return model.loss_for_phase(
                     p, X, Y, phase, coeffs=coeffs,
@@ -148,9 +150,15 @@ class RedcliffGridRunner:
 
             def apply_group(group, grads_g, opt, opt_state, lr, wd):
                 g = jax.tree.map(lambda gr, pa: gr + wd * pa, grads_g, params[group])
-                upd, opt_state = opt.update(g, opt_state)
+                upd, new_state = opt.update(g, opt_state)
                 upd = jax.tree.map(lambda u: -lr * u, upd)
-                return optax.apply_updates(params[group], upd), opt_state
+                new_p = optax.apply_updates(params[group], upd)
+                # per-point early-stop lane mask: a converged point keeps its
+                # params/opt state unchanged (compute still runs — SPMD lanes
+                # stay uniform — but the update is discarded)
+                keep = lambda n, o: jax.tree.map(
+                    lambda a, b: jnp.where(active, a, b), n, o)
+                return keep(new_p, params[group]), keep(new_state, opt_state)
 
             new = dict(params)
             if phase in ("embedder_pretrain", "combined"):
@@ -176,12 +184,24 @@ class RedcliffGridRunner:
         self._steps = {}
         for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
             vstep = jax.vmap(
-                lambda p, a, b, c, X, Y, ph=phase: point_step(p, a, b, c, X, Y, ph),
-                in_axes=(0, 0, 0, 0, None, None))
+                lambda p, a, b, c, act, X, Y, ph=phase: point_step(
+                    p, a, b, c, act, X, Y, ph),
+                in_axes=(0, 0, 0, 0, 0, None, None))
             # donate params + opt states: they are consumed and rebound every
             # step, so XLA can update them in place instead of round-tripping
             # a second copy of the whole grid state through HBM
             self._steps[phase] = jax.jit(vstep, donate_argnums=(0, 1, 2))
+
+        # Freeze-mode accept/revert choreography: the shared trainer logic
+        # (train/freeze.py), vmapped over the grid axis
+        mode = model.config.training_mode
+        self._freeze_by_batch = "FreezeByBatch" in mode
+        self._freeze = "Freeze" in mode
+        if self._freeze:
+            self._freeze_step = jax.jit(
+                jax.vmap(lambda c, a: apply_freeze(model, mode, c, a),
+                         in_axes=(0, 0)),
+                donate_argnums=(0, 1))
         self._val = jax.jit(jax.vmap(point_val, in_axes=(0, 0, None, None)))
 
         def select_best(best_params, best_crit, best_epoch, params, crit, epoch):
@@ -261,6 +281,11 @@ class RedcliffGridRunner:
         # materialize a copy: the train steps donate (consume) the live params
         # buffers, so best_params must never alias them
         best_params = jax.tree.map(jnp.copy, params)
+        # Freeze-mode accepted tree (the per-point trainer's "accepted")
+        accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
+        # per-point early-stop lane mask: converged points stop updating
+        active = self._shard(jnp.ones((G,), dtype=bool))
+        stop_after = tc.lookback * tc.check_every
         val_history = []
         aligned = False
         for it in range(max_iter):
@@ -275,7 +300,9 @@ class RedcliffGridRunner:
             for X, Y in train_ds.batches(tc.batch_size, rng=rng):
                 for phase in phases:
                     params, optA_state, optB_state, _ = self._steps[phase](
-                        params, optA_state, optB_state, coeffs, X, Y)
+                        params, optA_state, optB_state, coeffs, active, X, Y)
+                if self._freeze_by_batch:
+                    params, accepted = self._freeze_step(params, accepted)
             combo_sum = 0.0
             crit_sum = 0.0
             n = 0
@@ -292,9 +319,24 @@ class RedcliffGridRunner:
             val_history.append(combo_sum / n)
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
-                best_params, best_crit, best_epoch = self._select_best(
-                    best_params, best_crit, best_epoch, params, crit_sum / n,
-                    jnp.int32(it))
+                if self._freeze:
+                    # end-of-epoch accept/revert; the accepted tree IS the
+                    # best-params analog (trainer fit loop, freeze branch)
+                    if not self._freeze_by_batch:
+                        params, accepted = self._freeze_step(params, accepted)
+                    _, best_crit, best_epoch = self._select_best(
+                        best_params, best_crit, best_epoch, params,
+                        crit_sum / n, jnp.int32(it))
+                    best_params = jax.tree.map(jnp.copy, accepted)
+                else:
+                    best_params, best_crit, best_epoch = self._select_best(
+                        best_params, best_crit, best_epoch, params, crit_sum / n,
+                        jnp.int32(it))
+                    # per-point early stop: a point whose criteria has not
+                    # improved for lookback*check_every epochs goes inactive
+                    # (the per-point trainer's break, ref :1522-1538)
+                    active = jnp.logical_and(
+                        active, (jnp.int32(it) - best_epoch) < stop_after)
             else:
                 best_params = jax.tree.map(jnp.copy, params)
                 best_epoch = jnp.full((G,), it, jnp.int32)
@@ -305,4 +347,5 @@ class RedcliffGridRunner:
             best_epoch=np.asarray(best_epoch),
             val_history=np.stack(val_history),
             coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
+            active=np.asarray(active),
         )

@@ -1,0 +1,73 @@
+"""Freeze-mode accept/revert choreography, shared by the per-point trainer and
+the grid engine.
+
+The reference's Freeze training modes keep a candidate and an accepted copy of
+every factor network; after each batch (FreezeByBatch) or epoch (FreezeByEpoch)
+a per-factor decision statistic chooses, factor by factor, whether the
+candidate update is kept or reverted (ref models/redcliff_s_cmlp.py:866-885,
+1116-1156, 1469-1515 — there via model deepcopies and per-factor Python loops;
+here as two pytrees merged with per-factor jnp.where masks, vmappable over a
+grid axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["factor_decision_stats", "freeze_accept_vector", "swap_factors",
+           "apply_freeze"]
+
+
+def factor_decision_stats(model, params):
+    """Per-factor (normalized L1, mean pairwise cosine) of the unlagged factor
+    GC estimates (ref determine_which_factors_need_updates :1116-1156)."""
+    G = model.factor_gc(params, ignore_lag=True)  # (K, C, C)
+    G = G / jnp.maximum(jnp.max(jnp.abs(G), axis=(1, 2), keepdims=True), 1e-12)
+    l1 = jnp.sum(jnp.abs(G), axis=(1, 2))  # (K,)
+    flat = G.reshape(G.shape[0], -1)
+    norms = jnp.maximum(jnp.linalg.norm(flat, axis=1), 1e-8)
+    cos = (flat @ flat.T) / (norms[:, None] * norms[None, :])
+    K = G.shape[0]
+    off = 1.0 - jnp.eye(K)
+    avg_cos = jnp.sum(cos * off, axis=1) / jnp.maximum(K - 1.0, 1.0)
+    return l1, avg_cos
+
+
+def freeze_accept_vector(mode, new_stats, old_stats):
+    """(K,) bool accept mask from the training mode's decision rule
+    (ref :866-885): 'withComboCosSimL1' accepts when cos*l1 shrinks,
+    'withL1' when l1 shrinks."""
+    l1_new, cos_new = new_stats
+    l1_old, cos_old = old_stats
+    if "withComboCosSimL1" in mode:
+        return (cos_new * l1_new) < (cos_old * l1_old)
+    if "withL1" in mode:
+        return l1_new < l1_old
+    raise NotImplementedError(f"no freeze decision rule in mode {mode!r}")
+
+
+def swap_factors(candidate, accepted, accept_vec):
+    """accept_vec: (K,) bool — True takes the candidate factor into the
+    accepted tree AND keeps it in the candidate; False reverts the candidate
+    factor to the accepted one. The embedder always follows the candidate."""
+
+    def pick(c_leaf, a_leaf):
+        m = accept_vec.reshape((-1,) + (1,) * (c_leaf.ndim - 1))
+        return jnp.where(m, c_leaf, a_leaf)
+
+    merged = jax.tree.map(pick, candidate["factors"], accepted["factors"])
+    new_candidate = dict(candidate, factors=merged)
+    new_accepted = dict(accepted, factors=merged,
+                        embedder=candidate["embedder"])
+    return new_candidate, new_accepted
+
+
+def apply_freeze(model, mode, candidate, accepted):
+    """One accept/revert round for a single (candidate, accepted) pair.
+    Traceable: vmap over a leading grid axis for the grid engine, jit for the
+    per-point trainer."""
+    accept = freeze_accept_vector(
+        mode,
+        factor_decision_stats(model, candidate),
+        factor_decision_stats(model, accepted))
+    return swap_factors(candidate, accepted, accept)

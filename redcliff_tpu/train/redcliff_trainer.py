@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
+from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
 
@@ -140,41 +141,10 @@ class RedcliffTrainer:
 
         self._label_preds = jax.jit(label_preds_fn)
 
-        def factor_decision_stats(params):
-            """Per-factor (normalized L1, pairwise-cosine-mean) of the unlagged
-            factor GC estimates (ref determine_which_factors_need_updates
-            :1116-1156)."""
-            G = model.factor_gc(params, ignore_lag=True)  # (K, C, C)
-            G = G / jnp.maximum(jnp.max(jnp.abs(G), axis=(1, 2), keepdims=True), 1e-12)
-            l1 = jnp.sum(jnp.abs(G), axis=(1, 2))  # (K,)
-            flat = G.reshape(G.shape[0], -1)
-            norms = jnp.maximum(jnp.linalg.norm(flat, axis=1), 1e-8)
-            cos = (flat @ flat.T) / (norms[:, None] * norms[None, :])
-            K = G.shape[0]
-            mask = 1.0 - jnp.eye(K)
-            avg_cos = jnp.sum(cos * mask, axis=1) / jnp.maximum(K - 1.0, 1.0)
-            return l1, avg_cos
-
-        self._factor_decision_stats = jax.jit(factor_decision_stats)
-
-        def swap_factors(candidate, accepted, accept_vec):
-            """accept_vec: (K,) bool — True takes the candidate factor into the
-            accepted tree AND keeps it in the candidate; False reverts the
-            candidate factor to the accepted one."""
-
-            def pick(c_leaf, a_leaf):
-                shape = (-1,) + (1,) * (c_leaf.ndim - 1)
-                m = accept_vec.reshape(shape)
-                merged = jnp.where(m, c_leaf, a_leaf)
-                return merged
-
-            merged_factors = jax.tree.map(pick, candidate["factors"], accepted["factors"])
-            new_candidate = dict(candidate, factors=merged_factors)
-            new_accepted = dict(accepted, factors=merged_factors,
-                                embedder=candidate["embedder"])
-            return new_candidate, new_accepted
-
-        self._swap_factors = jax.jit(swap_factors)
+        # freeze choreography shared with the grid engine (train/freeze.py)
+        self._freeze_step = jax.jit(
+            lambda c, a: apply_freeze(model, model.config.training_mode, c, a)
+        ) if "Freeze" in model.config.training_mode else None
 
     # --------------------------------------------------------------- alignment
     def align_factors_with_labels(self, params, train_ds):
@@ -348,16 +318,7 @@ class RedcliffTrainer:
     # ----------------------------------------------------------------- helpers
     def _apply_freeze(self, candidate, accepted):
         """Accept/revert per-factor updates (ref :866-885, 1469-1515)."""
-        mode = self.model.config.training_mode
-        l1_new, cos_new = self._factor_decision_stats(candidate)
-        l1_old, cos_old = self._factor_decision_stats(accepted)
-        if "withComboCosSimL1" in mode:
-            accept = (cos_new * l1_new) < (cos_old * l1_old)
-        elif "withL1" in mode:
-            accept = l1_new < l1_old
-        else:
-            raise NotImplementedError(mode)
-        return self._swap_factors(candidate, accepted, accept)
+        return self._freeze_step(candidate, accepted)
 
     def _confusion(self, params, X, Y):
         cfg = self.model.config

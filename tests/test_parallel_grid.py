@@ -133,6 +133,90 @@ def test_grid_alignment_with_pretrain_mode():
     assert np.all(np.isfinite(res.val_history))
 
 
+def _freeze_model(mode, **over):
+    kw = dict(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01, factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode=mode, num_pretrain_epochs=1)
+    kw.update(over)
+    return RedcliffSCMLP(RedcliffSCMLPConfig(**kw))
+
+
+@pytest.mark.parametrize("mode", [
+    "pretrain_embedder_then_post_train_factor_withL1FreezeByBatch",
+    "pretrain_embedder_then_post_train_factor_withComboCosSimL1FreezeByEpoch",
+])
+def test_grid_freeze_matches_independent_trainers(mode):
+    """A G-point Freeze-mode grid run reproduces G independent RedcliffTrainer
+    runs (the accept/revert choreography of ref redcliff_s_cmlp.py:866-885,
+    1469-1515 under the grid engine)."""
+    import dataclasses
+
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainer
+
+    model = _freeze_model(mode)
+    points = [{"gen_lr": 1e-3}, {"gen_lr": 5e-3}]
+    spec = GridSpec(points=points)
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=32, seed=7)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    key = jax.random.PRNGKey(11)
+    res = runner.fit(key, ds, ds)
+
+    init_params, _, _ = runner.init_grid(key)  # same key -> same init as fit
+    for g, point in enumerate(points):
+        tc_g = dataclasses.replace(tc, **{k: v for k, v in point.items()
+                                          if k in ("gen_lr", "embed_lr")})
+        trainer = RedcliffTrainer(model, tc_g)
+        params_g = jax.tree.map(lambda x: x[g], init_params)
+        out = trainer.fit(params_g, ds, ds)
+        for got, want in zip(jax.tree.leaves(res.best_params),
+                             jax.tree.leaves(out.params)):
+            np.testing.assert_allclose(np.asarray(got)[g], np.asarray(want),
+                                       rtol=2e-3, atol=2e-5)
+
+
+def test_grid_early_stop_lane_masking():
+    """A point whose criteria stops improving goes inactive and its parameters
+    freeze (per-point analog of RedcliffTrainer's early-stop break)."""
+    model = _model()
+    # point 1 has zero learning rates -> its criteria never improves -> it
+    # early-stops after stop_after=lookback*check_every=1 non-improving epoch
+    spec = GridSpec(points=[{"gen_lr": 1e-3},
+                            {"gen_lr": 0.0, "embed_lr": 0.0}])
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=32, lookback=1, check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(5), ds, ds)
+    assert res.active[0]
+    assert not res.active[1]
+    # the inactive lane's validation loss is frozen after it stopped
+    assert np.allclose(res.val_history[1:, 1], res.val_history[1, 1])
+
+
+def test_grid_step_lane_mask_freezes_point():
+    """Direct check: active=False lanes keep params and opt state bit-identical."""
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 1e-3}])
+    tc = RedcliffTrainConfig(batch_size=8)
+    runner = RedcliffGridRunner(model, tc, spec)
+    params, optA, optB = runner.init_grid(jax.random.PRNGKey(6))
+    before = jax.tree.map(jnp.copy, params)
+    cfg = model.config
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(8, cfg.max_lag + cfg.num_sims, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(8, 3, 1)).astype(np.float32)
+    active = jnp.asarray([True, False])
+    new, _, _, _ = runner._steps["combined"](
+        params, optA, optB, runner.coeffs, active, X, Y)
+    for b, n in zip(jax.tree.leaves(before), jax.tree.leaves(new)):
+        np.testing.assert_array_equal(np.asarray(b)[1], np.asarray(n)[1])
+        assert not np.allclose(np.asarray(b)[0], np.asarray(n)[0])
+
+
 def test_grid_mesh_divisibility_validated():
     model = _model()
     spec = GridSpec(points=[{} for _ in range(3)])
