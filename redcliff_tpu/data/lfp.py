@@ -150,8 +150,8 @@ def preprocess_tst_raw_lfps_for_windowed_training(
 
     state_names = ("homeCage", "openField", "tailSuspension")
     for mouse in mice:
-        m_lfp = [x for x in lfp_files if mouse in x]
-        m_time = [x for x in time_files if mouse in x]
+        m_lfp = [x for x in lfp_files if x.split("_")[0] == mouse]
+        m_time = [x for x in time_files if x.split("_")[0] == mouse]
         if len(m_lfp) != len(m_time):
             print(f"preprocess_tst: skipping mouse {mouse}: "
                   f"{len(m_lfp)} LFP vs {len(m_time)} TIME files", flush=True)
@@ -216,7 +216,7 @@ def preprocess_socpref_raw_lfps_for_windowed_training(
     n_chans = len(keys)
 
     for mouse in mice:
-        m_lfp = [x for x in lfp_files if mouse in x]
+        m_lfp = [x for x in lfp_files if x.split("_")[0] == mouse]
         m_cls = [x for x in label_files
                  if any(x[:23] == lf[:23] for lf in m_lfp)]
         if len(m_lfp) != len(m_cls):
@@ -225,7 +225,8 @@ def preprocess_socpref_raw_lfps_for_windowed_training(
         for lfp_name, cls_name in zip(m_lfp, m_cls):
             assert lfp_name[:23] == cls_name[:23]
             mat = scio.loadmat(os.path.join(label_data_path, cls_name))
-            start_step = sample_freq * int(mat["StartTime"])
+            start_step = sample_freq * int(
+                np.asarray(mat["StartTime"]).reshape(-1)[0])
             raw = load_lfp_data_matrix(
                 lfp_data_path, lfp_name, keys, n_chans,
                 sample_freq=sample_freq, cutoff=cutoff, lowcut=lowcut,

@@ -1,6 +1,19 @@
 """Evaluation layer: graph statistics, causal distances, GC-estimate
 dispatch, cross-algorithm comparison, and grid-search selection
 (rebuilds /root/reference/evaluate/, SURVEY.md §2.7)."""
+from .analysis import (
+    collect_summary_figures,
+    complexity_category,
+    condense_cross_experiment,
+    factor_selection_table,
+    generate_analysis_report,
+    network_complexity,
+    parse_system_name,
+    run_cross_experiment_analysis,
+    summarize_ablations,
+    visualize_factors_across_folds,
+    visualize_trained_model_factors,
+)
 from .causal_distances import ancestor_aid, oset_aid, parent_aid, shd
 from .cross_alg import (
     ALL_POSSIBLE_ALGORITHMS,
@@ -55,6 +68,11 @@ from .stats import (
 )
 
 __all__ = [
+    "collect_summary_figures", "complexity_category",
+    "condense_cross_experiment", "factor_selection_table",
+    "generate_analysis_report", "network_complexity", "parse_system_name",
+    "run_cross_experiment_analysis", "summarize_ablations",
+    "visualize_factors_across_folds", "visualize_trained_model_factors",
     "ancestor_aid", "oset_aid", "parent_aid", "shd",
     "compute_edge_lock_performance_v3_stats",
     "compute_edge_lock_performance_v4_stats",

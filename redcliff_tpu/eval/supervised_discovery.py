@@ -135,9 +135,12 @@ def run_discovery_algorithm(samples, alg_name, maxlags=1, pcmci_kwargs=None,
         elif alg_name == "selvar":
             raw = selvar(data * masks[r], maxlags=maxlags)
         elif alg_name == "PCMCI":
-            kw = dict(tau_max=maxlags)
+            # reference Table-2 setup: tau_max=2, pc_alpha=0.2,
+            # alpha_level=0.01 (ref eval_algsT_...py:120)
+            kw = dict(tau_max=max(maxlags, 2), pc_alpha=0.2,
+                      alpha_level=0.01)
             kw.update(pcmci_kwargs or {})
-            graph_alpha = kw.get("alpha_level", 0.05)
+            graph_alpha = kw.get("alpha_level", 0.01)
             segs = _regime_segments(samples, r, min_len=kw["tau_max"])
             if not segs:
                 preds.append(np.zeros((N, N)))
@@ -183,6 +186,12 @@ def score_discovery_predictions(preds_by_regime, true_graphs,
         pred = np.asarray(preds_by_regime[rf], dtype=np.float64)
         if transpose_predictions:
             pred = pred.T
+        # normalize by the max entry before scoring (ref :304 via
+        # normalize_numpy_array) so optF1_thresh values are on the
+        # reference's [0, 1] scale
+        peak = np.max(pred)
+        if peak > 0:
+            pred = pred / peak
         entry = {}
         thresh, f1 = compute_optimal_f1(labels, pred.ravel())
         entry["optF1_thresh"] = thresh

@@ -142,6 +142,10 @@ def mann_whitney_auc(pos, neg):
     scipy.stats.mannwhitneyu's U as used at ref :229-231."""
     pos = np.asarray(pos, dtype=np.float64).ravel()
     neg = np.asarray(neg, dtype=np.float64).ravel()
+    if len(pos) == 0 or len(neg) == 0:
+        # degenerate single-class task (e.g. under the 0.6 pretrain split):
+        # no ordering information, fall back to chance
+        return 0.5
     combined = np.concatenate([pos, neg])
     order = np.argsort(combined, kind="mergesort")
     ranks = np.empty(len(combined))

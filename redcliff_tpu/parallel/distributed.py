@@ -1,0 +1,126 @@
+"""Multi-host (DCN) bootstrap and host-spanning array utilities.
+
+The reference scales past one machine only through SLURM job arrays — fully
+independent processes, filesystem as the communication medium
+(ref train/REDCLIFF_S_CMLP_d4IC_BSCgs1.py:77, SURVEY §2.8). The TPU-native
+equivalent is jax's multi-controller runtime: every host runs the same
+program, ``jax.distributed.initialize`` connects them through a coordinator,
+and the device mesh simply spans all hosts — grid points ride ICI within a
+slice and DCN across slices, with XLA inserting the collectives.
+
+Recipe (documented + tested; see tests/test_multihost.py):
+
+1. every host calls :func:`initialize_distributed` first — before any other
+   jax API. Coordinator/process info comes from explicit arguments or from
+   the environment (``REDCLIFF_COORDINATOR``/``REDCLIFF_NUM_PROCESSES``/
+   ``REDCLIFF_PROCESS_ID``, or SLURM's variables on a cluster);
+2. build the mesh over the *global* device list (``grid_mesh()`` already uses
+   ``jax.devices()``, which is global after initialization);
+3. materialize grid-axis arrays with :func:`put_along_mesh` — each process
+   only allocates the shards it addresses;
+4. run the same jit'd grid program everywhere; replicated inputs (batches)
+   pass as plain numpy, identical on every host;
+5. read results back with :func:`gather_to_host`, which allgathers shards
+   over DCN so every host sees the full grid.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+__all__ = [
+    "initialize_distributed",
+    "is_distributed",
+    "put_along_mesh",
+    "gather_to_host",
+    "process_local_slice",
+]
+
+_initialized = False
+
+
+def _from_env(explicit, *names, cast=str):
+    if explicit is not None:
+        return explicit
+    for name in names:
+        val = os.environ.get(name)
+        if val is not None:
+            return cast(val)
+    return None
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, local_device_ids=None):
+    """Connect this process to the multi-host runtime (idempotent).
+
+    Arguments fall back to ``REDCLIFF_*`` env vars, then SLURM's
+    (``SLURM_NTASKS``/``SLURM_PROCID``), mirroring how the reference's
+    drivers read ``SLURM_ARRAY_TASK_ID`` — except the processes cooperate in
+    one program instead of running disjoint jobs. With no configuration at
+    all this is a no-op and the program stays single-process.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    # NB: no jax.process_count() probe here — any backend-touching call would
+    # initialize XLA and make jax.distributed.initialize() illegal
+    coordinator_address = _from_env(coordinator_address, "REDCLIFF_COORDINATOR")
+    if coordinator_address is None:
+        return False  # single-process run
+    num_processes = _from_env(num_processes, "REDCLIFF_NUM_PROCESSES",
+                              "SLURM_NTASKS", cast=int)
+    process_id = _from_env(process_id, "REDCLIFF_PROCESS_ID", "SLURM_PROCID",
+                           cast=int)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return True
+
+
+def is_distributed():
+    return jax.process_count() > 1
+
+
+def put_along_mesh(x, mesh, axis_name="grid"):
+    """Shard ``x`` (host-replicated numpy, leading axis = grid) over the mesh.
+
+    Single-process: a plain sharded device_put. Multi-host: each process
+    materializes only its addressable shards via make_array_from_callback —
+    the host-partitioned grid, every host holding 1/num_processes of the
+    points in device memory.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec(axis_name))
+    if jax.process_count() == 1:
+        return jax.device_put(x, sh)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+
+def gather_to_host(tree):
+    """Full numpy values on every host. Multi-host arrays allgather their
+    shards over DCN; single-process arrays just transfer."""
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+    from jax.experimental import multihost_utils
+
+    return jax.tree.map(np.asarray,
+                        multihost_utils.process_allgather(tree, tiled=True))
+
+
+def process_local_slice(total, process_id=None, num_processes=None):
+    """The contiguous [start, stop) range of grid points this host feeds when
+    staging host-partitioned inputs (e.g. streaming per-point datasets)."""
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if num_processes is None else num_processes
+    if total % n != 0:
+        raise ValueError(f"grid size {total} not divisible by {n} processes")
+    per = total // n
+    return pid * per, (pid + 1) * per

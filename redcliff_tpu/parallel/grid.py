@@ -22,8 +22,10 @@ import numpy as np
 import optax
 
 from redcliff_tpu.models.redcliff import phase_schedule
+from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
 from redcliff_tpu.train.freeze import apply_freeze
+from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
 
@@ -219,8 +221,10 @@ class RedcliffGridRunner:
     def _shard(self, tree):
         if self.mesh is None:
             return tree
-        sh = shard_leading_axis(self.mesh)
-        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+        # put_along_mesh handles both single-process (plain sharded
+        # device_put) and multi-host (each process materializes only its
+        # addressable shards) meshes
+        return jax.tree.map(lambda x: put_along_mesh(x, self.mesh), tree)
 
     def phase_for_epoch(self, epoch):
         return phase_schedule(self.model.config, epoch)
@@ -240,7 +244,8 @@ class RedcliffGridRunner:
         for b, (X, Y) in enumerate(train_ds.batches(tc.batch_size)):
             if b >= tc.max_factor_prior_batches:
                 break
-            preds.append(np.asarray(fw_fn(params, jnp.asarray(X[:, : cfg.max_lag, :]))))
+            preds.append(gather_to_host(
+                fw_fn(params, jnp.asarray(X[:, : cfg.max_lag, :]))))
             if Y.ndim == 3:
                 col = cfg.max_lag if Y.shape[2] > cfg.max_lag else 0
                 labels.append(np.asarray(Y[:, :, col]))
@@ -265,7 +270,14 @@ class RedcliffGridRunner:
             params["factors"])
         return dict(params, factors=factors)
 
-    def fit(self, key, train_ds, val_ds, max_iter=None) -> GridResult:
+    def fit(self, key, train_ds, val_ds, max_iter=None,
+            log_dir=None) -> GridResult:
+        with profiler_trace(self.tc.profile_dir):
+            return self._fit(key, train_ds, val_ds, max_iter=max_iter,
+                             log_dir=log_dir)
+
+    def _fit(self, key, train_ds, val_ds, max_iter=None,
+             log_dir=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
@@ -288,6 +300,10 @@ class RedcliffGridRunner:
         stop_after = tc.lookback * tc.check_every
         val_history = []
         aligned = False
+        logger = MetricLogger(log_dir)
+        logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
+                   training_mode=self.model.config.training_mode,
+                   points=list(self.spec.points))
         for it in range(max_iter):
             cfg0 = self.model.config
             if (not aligned and "pretrain_factor" in cfg0.training_mode
@@ -341,11 +357,32 @@ class RedcliffGridRunner:
                 best_params = jax.tree.map(jnp.copy, params)
                 best_epoch = jnp.full((G,), it, jnp.int32)
 
+            # structured per-epoch record; syncing the grid losses to host
+            # costs one transfer, so only do it on the check_every cadence.
+            # gather_to_host is a collective on multi-host meshes, so the
+            # guard must be uniform across processes (logger.active is not:
+            # typically only process 0 writes) — gather everywhere, write
+            # wherever a logger is attached
+            if it % tc.check_every == 0 and (
+                    logger.active or jax.process_count() > 1):
+                logger.log("epoch", epoch=it, phases=list(phases),
+                           val_combo_loss=gather_to_host(val_history[-1]),
+                           best_criteria=gather_to_host(best_crit),
+                           num_active=int(gather_to_host(active).sum()))
+
+        # one gather each; shared by the fit_end record and the result
+        final_crit = gather_to_host(best_crit)
+        final_epoch = gather_to_host(best_epoch)
+        final_active = gather_to_host(active)
+        logger.log("fit_end", best_epoch=final_epoch,
+                   best_criteria=final_crit,
+                   num_active=int(final_active.sum()))
+        logger.close()
         return GridResult(
-            best_params=best_params,
-            best_criteria=np.asarray(best_crit),
-            best_epoch=np.asarray(best_epoch),
-            val_history=np.stack(val_history),
+            best_params=gather_to_host(best_params),
+            best_criteria=final_crit,
+            best_epoch=final_epoch,
+            val_history=np.stack([gather_to_host(v) for v in val_history]),
             coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
-            active=np.asarray(active),
+            active=final_active,
         )

@@ -32,6 +32,7 @@ from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
+from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 
 __all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
 
@@ -56,6 +57,7 @@ class RedcliffTrainConfig:
     max_factor_prior_batches: int = 10
     unsupervised_start_index: int = 0
     max_samples_for_gc_tracking: int = 40  # ref MAX_NUM_SAMPS_FOR_GC_PROGRESS_TRACKING
+    profile_dir: str | None = None  # opt-in jax.profiler trace output dir
 
 
 @dataclass
@@ -175,6 +177,12 @@ class RedcliffTrainer:
     # --------------------------------------------------------------------- fit
     def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
             resume=True) -> RedcliffFitResult:
+        with profiler_trace(self.config.profile_dir):
+            return self._fit(params, train_ds, val_ds, true_GC=true_GC,
+                             save_dir=save_dir, resume=resume)
+
+    def _fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
+             resume=True) -> RedcliffFitResult:
         model, cfg = self.model, self.model.config
         tc = self.config
         self._true_GC = true_GC
@@ -228,6 +236,9 @@ class RedcliffTrainer:
                 tracker.__dict__.update(ck["tracker_state"])
 
         last_it = iter_start - 1
+        logger = MetricLogger(save_dir)
+        logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
+                   train_config=tc, resume_epoch=iter_start)
         for it in range(iter_start, tc.max_iter):
             last_it = it
             # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
@@ -266,6 +277,8 @@ class RedcliffTrainer:
             histories["avg_combo_loss"].append(val["combo_loss"])
 
             # early stopping (ref :1466-1538)
+            criteria = None
+            stop_early = False
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
                 cos_mean = tracker.latest_mean_supervised_cosine() if tracker else 0.0
                 if cfg.num_supervised_factors > 1:
@@ -292,10 +305,17 @@ class RedcliffTrainer:
                     elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
                         if tc.verbose:
                             print("Stopping early")
-                        break
+                        stop_early = True
             else:
                 best_it = it
                 best_params = params
+
+            # log before honoring the early stop so the stopping epoch's
+            # record (criteria included) lands in metrics.jsonl
+            logger.log("epoch", epoch=it, phases=list(phases), criteria=criteria,
+                       **val, **(tracker.latest_as_dict() if tracker else {}))
+            if stop_early:
+                break
 
             if it % tc.check_every == 0 and save_dir:
                 self._save_checkpoint(save_dir, it, best_params, accepted, params,
@@ -305,6 +325,10 @@ class RedcliffTrainer:
                 print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
 
         final_val = self.validate(best_params, val_ds, None)
+        logger.log("fit_end", best_it=best_it if best_it is not None else 0,
+                   best_loss=float(best_loss),
+                   final_val_loss=final_val["combo_loss"])
+        logger.close()
         if save_dir:
             self._save_checkpoint(save_dir, last_it, best_params, accepted, params,
                                   optA_state, optB_state, histories, best_it,

@@ -181,6 +181,27 @@ class GCProgressTracker:
             if key in histories:
                 histories[key].append(total / n_s)
 
+    def latest_as_dict(self):
+        """Most recent epoch's metrics, flattened for structured logging
+        (one key per factor/threshold/pair)."""
+        out = {}
+        for t in self.f1_thresholds:
+            for i in range(self.S):
+                if self.f1score_histories[t][i]:
+                    out[f"f1_t{t}_factor{i}"] = self.f1score_histories[t][i][-1]
+                    out[f"roc_auc_t{t}_factor{i}"] = self.roc_auc_histories[t][i][-1]
+                    out[f"f1_offdiag_t{t}_factor{i}"] = self.f1score_OffDiag_histories[t][i][-1]
+                    out[f"roc_auc_offdiag_t{t}_factor{i}"] = self.roc_auc_OffDiag_histories[t][i][-1]
+        for i in range(self.S):
+            if self.deltacon0_histories[i]:
+                out[f"deltacon0_factor{i}"] = self.deltacon0_histories[i][-1]
+                out[f"deltaffinity_factor{i}"] = self.deltaffinity_histories[i][-1]
+                out[f"gc_l1_factor{i}"] = self.gc_factor_l1_loss_histories[i][-1]
+        for key, h in self.gc_factor_cosine_sim_histories.items():
+            if h:
+                out[f"cosine_sim_{key}"] = h[-1]
+        return out
+
     def latest_mean_supervised_cosine(self):
         """Mean of the most recent supervised pairwise cosines — the stopping
         criterion component (ref redcliff_s_cmlp.py:1467)."""

@@ -27,6 +27,7 @@ import numpy as np
 import optax
 
 from redcliff_tpu.train.tracking import GCProgressTracker
+from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 
 __all__ = ["TrainConfig", "Trainer", "FitResult", "save_model", "load_model"]
 
@@ -42,6 +43,7 @@ class TrainConfig:
     prox_penalty: str | None = None  # "GL" | "GSGL" | "H"
     prox_lam: float = 0.0
     verbose: int = 0
+    profile_dir: str | None = None  # opt-in jax.profiler trace output dir
 
 
 @dataclass
@@ -196,44 +198,55 @@ class Trainer:
         step_key = jax.random.PRNGKey(cfg.seed) if self._wants_rng else None
         step_counter = 0
         last_it = iter_start - 1
-        for it in range(iter_start, cfg.max_iter):
-            last_it = it
-            for X, Y in train_ds.batches(cfg.batch_size, rng=rng):
-                step_rng = (jax.random.fold_in(step_key, step_counter)
-                            if self._wants_rng else None)
-                step_counter += 1
-                params, opt_state, _, _ = self._train_step(params, opt_state, X, Y,
-                                                           step_rng)
+        logger = MetricLogger(save_dir)
+        logger.log("fit_start", model=type(self.model).__name__,
+                   train_config=cfg, resume_epoch=iter_start)
+        with profiler_trace(cfg.profile_dir):
+            for it in range(iter_start, cfg.max_iter):
+                last_it = it
+                for X, Y in train_ds.batches(cfg.batch_size, rng=rng):
+                    step_rng = (jax.random.fold_in(step_key, step_counter)
+                                if self._wants_rng else None)
+                    step_counter += 1
+                    params, opt_state, _, _ = self._train_step(params, opt_state,
+                                                               X, Y, step_rng)
 
-            if tracker is not None:
-                self._epoch_gc_tracking(params, tracker, true_GC, track_X)
+                if tracker is not None:
+                    self._epoch_gc_tracking(params, tracker, true_GC, track_X)
 
-            val = self.validate(params, val_ds)
-            histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))
-            histories["avg_adj_penalty"].append(val.get("adj_l1_penalty", 0.0))
-            histories["avg_combo_loss"].append(val["combo_loss"])
+                val = self.validate(params, val_ds)
+                histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))
+                histories["avg_adj_penalty"].append(val.get("adj_l1_penalty", 0.0))
+                histories["avg_combo_loss"].append(val["combo_loss"])
 
-            if hasattr(self.model, "validation_criteria"):
-                criteria = float(self.model.validation_criteria(params, val))
-            else:
-                criteria = val["combo_loss"]
+                if hasattr(self.model, "validation_criteria"):
+                    criteria = float(self.model.validation_criteria(params, val))
+                else:
+                    criteria = val["combo_loss"]
 
-            if criteria < best_loss:
-                best_loss = criteria
-                best_it = it
-                best_params = params
-            elif best_it is not None and (it - best_it) == cfg.lookback * cfg.check_every:
-                if cfg.verbose:
-                    print("Stopping early")
-                break
+                logger.log("epoch", epoch=it, criteria=criteria, **val,
+                           **(tracker.latest_as_dict() if tracker else {}))
 
-            if it % cfg.check_every == 0 and save_dir:
-                self._save_checkpoint(save_dir, it, best_params, opt_state, params,
-                                      histories, best_it, best_loss, tracker)
-            if cfg.verbose and it % max(1, cfg.check_every) == 0:
-                print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
+                if criteria < best_loss:
+                    best_loss = criteria
+                    best_it = it
+                    best_params = params
+                elif best_it is not None and (it - best_it) == cfg.lookback * cfg.check_every:
+                    if cfg.verbose:
+                        print("Stopping early")
+                    break
+
+                if it % cfg.check_every == 0 and save_dir:
+                    self._save_checkpoint(save_dir, it, best_params, opt_state, params,
+                                          histories, best_it, best_loss, tracker)
+                if cfg.verbose and it % max(1, cfg.check_every) == 0:
+                    print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
 
         final_val = self.validate(best_params, val_ds)
+        logger.log("fit_end", best_it=best_it if best_it is not None else 0,
+                   best_loss=float(best_loss),
+                   final_val_loss=final_val["combo_loss"])
+        logger.close()
         if save_dir:
             # stamp the actual last trained epoch so a later resume with a larger
             # max_iter continues from where training really stopped; the resumable

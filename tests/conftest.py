@@ -17,3 +17,9 @@ import jax  # noqa: E402
 # virtual CPU mesh for determinism and f32 matmul exactness
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test (full pipelines, "
+        "multi-process runs)")

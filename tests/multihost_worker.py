@@ -1,0 +1,99 @@
+"""Worker process for the 2-process multi-host (DCN) grid test.
+
+Each worker owns 2 virtual CPU devices; jax.distributed connects the workers
+through the loopback coordinator, giving a 4-device global mesh spanning both
+processes — the same topology as two TPU slices over DCN, scaled down. Run by
+tests/test_multihost.py as:
+
+    python tests/multihost_worker.py <port> <process_id> <num_processes> <outdir>
+"""
+import os
+import pickle
+import sys
+
+PORT, PID, NPROC, OUTDIR = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                            sys.argv[4])
+LOCAL_DEVICES = 2
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the session sitecustomize can register an experimental TPU backend that wins
+# over JAX_PLATFORMS; hard-override exactly like tests/conftest.py
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+
+from redcliff_tpu.data.datasets import ArrayDataset  # noqa: E402
+from redcliff_tpu.models.redcliff import (  # noqa: E402
+    RedcliffSCMLP, RedcliffSCMLPConfig)
+from redcliff_tpu.parallel.distributed import (  # noqa: E402
+    gather_to_host, initialize_distributed, is_distributed, process_local_slice,
+    put_along_mesh)
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner  # noqa: E402
+from redcliff_tpu.parallel.mesh import grid_mesh  # noqa: E402
+from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig  # noqa: E402
+
+
+def main():
+    assert initialize_distributed(f"127.0.0.1:{PORT}", NPROC, PID)
+    assert jax.process_count() == NPROC, jax.process_count()
+    assert jax.process_index() == PID
+    assert len(jax.devices()) == NPROC * LOCAL_DEVICES  # global device list
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+    assert is_distributed()
+
+    # host-partitioned staging: this process feeds its contiguous block
+    G = 4
+    lo, hi = process_local_slice(G)
+    assert hi - lo == G // NPROC
+
+    mesh = grid_mesh()  # spans both processes
+    assert mesh.devices.size == NPROC * LOCAL_DEVICES
+
+    # sharded put: only the addressable shards materialize on this host
+    probe = put_along_mesh(np.arange(G, dtype=np.float32), mesh)
+    assert len(probe.addressable_shards) == LOCAL_DEVICES
+    np.testing.assert_array_equal(gather_to_host(probe),
+                                  np.arange(G, dtype=np.float32))
+
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01, factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    cfg = model.config
+    rng = np.random.default_rng(0)  # same data on every host (replicated input)
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(64, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(64, 3, 1)).astype(np.float32)
+    ds = ArrayDataset(X, Y)
+
+    spec = GridSpec(points=[{"gen_lr": 1e-3 * (i + 1)} for i in range(G)])
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=32)
+    runner = RedcliffGridRunner(model, tc, spec, mesh=mesh)
+    res = runner.fit(jax.random.PRNGKey(0), ds, ds)
+
+    assert res.val_history.shape == (2, G)
+    assert np.all(np.isfinite(res.val_history))
+
+    with open(os.path.join(OUTDIR, f"result_{PID}.pkl"), "wb") as f:
+        pickle.dump({
+            "val_history": res.val_history,
+            "best_criteria": res.best_criteria,
+            "best_leaf": np.asarray(jax.tree.leaves(res.best_params)[0]),
+        }, f)
+    print(f"worker {PID}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,219 @@
+"""L6 analysis/reporting layer tests: complexity scoring, cross-experiment
+condensation, ablation summaries, model visualization, and the one-command
+report (notebook + summ_/plotCrossExpSummaries capability)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.eval.analysis import (
+    ALG_ALIASES,
+    collect_summary_figures,
+    complexity_category,
+    condense_cross_experiment,
+    factor_selection_table,
+    generate_analysis_report,
+    network_complexity,
+    parse_system_name,
+    run_cross_experiment_analysis,
+    short_system_name,
+    summarize_ablations,
+    visualize_factors_across_folds,
+    visualize_trained_model_factors,
+)
+from redcliff_tpu.eval.summaries import OFFDIAG_PARADIGM
+
+
+def test_network_complexity_and_banding():
+    # (ne / (nc^2 - nc))^-1: the paper's inverse-sparsity score
+    assert network_complexity(12, 11) == pytest.approx(132 / 11)  # 12.0
+    assert network_complexity(3, 1) == pytest.approx(6.0)
+    assert network_complexity(6, 2) == pytest.approx(15.0)
+    assert complexity_category(6.0) == "Low"
+    assert complexity_category(12.0) == "Moderate"
+    assert complexity_category(15.0) == "High"
+    # bounds are (lower, upper]: <=7 Low, >13 High (ref plotCross...py:144-149)
+    assert complexity_category(7.0) == "Low"
+    assert complexity_category(13.0) == "Moderate"
+
+
+def test_parse_system_name_both_forms():
+    long = ("numF2_numSF2_numN12_numE11_edgesNonlinear_labelsOneHot_"
+            "noiT-gaussian_noiL-1-0_oFscF_data")
+    d = parse_system_name(long)
+    assert d["num_factors"] == 2
+    assert d["num_supervised_factors"] == 2
+    assert d["num_nodes"] == 12
+    assert d["num_edges"] == 11
+    assert short_system_name(long) == "nN12_nE11_nF2"
+    d2 = parse_system_name("nN6_nE4_nF3")
+    assert (d2["num_nodes"], d2["num_edges"], d2["num_factors"]) == (6, 4, 3)
+
+
+def _fake_summary(alg_vals):
+    """A full_comparrisson_summary dict in the cross_alg driver's layout."""
+    by_alg = {}
+    for alg, vals in alg_vals.items():
+        vals = np.asarray(vals, dtype=np.float64)
+        by_alg[alg] = {
+            "f1_vals_across_factors": vals.tolist(),
+            "f1_mean_across_factors": float(vals.mean()),
+            "f1_median_across_factors": float(np.median(vals)),
+            "f1_std_dev_across_factors": float(vals.std()),
+            "f1_mean_std_err_across_factors": float(
+                vals.std() / np.sqrt(len(vals))),
+        }
+    return {"cv_main": {OFFDIAG_PARADIGM: by_alg}}
+
+
+def _write_eval_tree(root):
+    systems = {
+        # complexity (12^2-12)/11 = 12.0 -> Moderate
+        "numF2_numSF2_numN12_numE11_data": {
+            "REDCLIFF_S_CMLP_WithSmoothing": [0.9, 0.8],
+            "CMLP": [0.6, 0.5],
+        },
+        # complexity 6 -> Low
+        "numF2_numSF2_numN3_numE1_data": {
+            "REDCLIFF_S_CMLP_WithSmoothing": [0.7, 0.75],
+            "CMLP": [0.72, 0.6],
+        },
+    }
+    for sys_key, alg_vals in systems.items():
+        d = os.path.join(root, sys_key)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "full_comparrisson_summary.pkl"),
+                  "wb") as f:
+            pickle.dump(_fake_summary(alg_vals), f)
+    return systems
+
+
+def test_condense_cross_experiment_with_improvements(tmp_path):
+    _write_eval_tree(str(tmp_path))
+    out = condense_cross_experiment(
+        str(tmp_path), baseline_alg="REDCLIFF_S_CMLP_WithSmoothing")
+    assert len(out) == 2
+    entry = out["numF2_numSF2_numN12_numE11_data"]
+    assert entry["complexity"] == pytest.approx(12.0)
+    assert entry["alg_stats"]["CMLP"]["mean"] == pytest.approx(0.55)
+    # improvement vs baseline: per-factor diffs [0.3, 0.3] -> mean 0.3, sem 0
+    imp = entry["improvements"]["CMLP"]
+    assert imp["mean"] == pytest.approx(0.3)
+    assert imp["sem"] == pytest.approx(0.0)
+    # the baseline's improvement over itself is zero
+    assert entry["improvements"]["REDCLIFF_S_CMLP_WithSmoothing"][
+        "mean"] == pytest.approx(0.0)
+
+
+def test_run_cross_experiment_analysis_writes_figures(tmp_path):
+    eval_root = tmp_path / "evals"
+    save_root = tmp_path / "report"
+    _write_eval_tree(str(eval_root))
+    out = run_cross_experiment_analysis(str(eval_root), str(save_root))
+    assert out["by_category"]["Moderate"] == [
+        "numF2_numSF2_numN12_numE11_data"]
+    assert out["by_category"]["Low"] == ["numF2_numSF2_numN3_numE1_data"]
+    names = os.listdir(save_root)
+    assert "system_details.pkl" in names
+    assert any(n.startswith("Moderate_complexity_cross_synth") for n in names)
+    assert any("REDCImprovement" in n for n in names)
+    with open(save_root / "system_details.pkl", "rb") as f:
+        details = pickle.load(f)
+    assert details["numF2_numSF2_numN12_numE11_data"][
+        "dataset_name"] == "nN12_nE11_nF2"
+
+
+def test_summarize_ablations_golden():
+    summaries = {
+        "full": _fake_summary({"REDCLIFF_S_CMLP": [0.9, 0.8]}),
+        "no_cos_sim": _fake_summary({"REDCLIFF_S_CMLP": [0.7, 0.6]}),
+    }
+    table = summarize_ablations(summaries, "full")
+    assert table["full"]["mean"] == pytest.approx(0.85)
+    assert table["full"]["full_minus_variant_mean"] == pytest.approx(0.0)
+    assert table["no_cos_sim"]["mean"] == pytest.approx(0.65)
+    assert table["no_cos_sim"]["full_minus_variant_mean"] == pytest.approx(0.2)
+    assert table["no_cos_sim"]["full_minus_variant_sem"] == pytest.approx(0.0)
+
+
+def test_factor_selection_table(tmp_path):
+    runs = {}
+    for nf, losses in ((2, [3.0, 2.0, 1.5]), (3, [3.0, 1.0, 1.2])):
+        fold_dirs = []
+        for fold in range(2):
+            d = tmp_path / f"nf{nf}_fold{fold}"
+            os.makedirs(d)
+            meta = {"avg_forecasting_loss": [x + 0.1 * fold for x in losses],
+                    "avg_factor_loss": [x * 0.5 for x in losses]}
+            with open(d / "training_meta_data_and_hyper_parameters.pkl",
+                      "wb") as f:
+                pickle.dump(meta, f)
+            fold_dirs.append(str(d))
+        runs[nf] = fold_dirs
+    table = factor_selection_table(runs)
+    # best (min) forecasting loss per fold: nf=2 -> [1.5, 1.6], nf=3 -> [1.0, 1.1]
+    assert table[2]["avg_forecasting_loss_mean"] == pytest.approx(1.55)
+    assert table[3]["avg_forecasting_loss_mean"] == pytest.approx(1.05)
+    assert table[3]["avg_factor_loss_mean"] == pytest.approx(0.5)
+
+
+def test_collect_summary_figures(tmp_path):
+    eval_root = tmp_path / "evals"
+    sub = eval_root / "sysA" / "cv_main"
+    os.makedirs(sub)
+    fig = sub / f"factor_level_{OFFDIAG_PARADIGM}_f1_vals_by_algorithm.png"
+    fig.write_bytes(b"png")
+    out = collect_summary_figures(str(eval_root), str(tmp_path / "report"))
+    assert len(out) == 1
+    assert os.path.basename(out[0]).startswith("sysA_factor_level_")
+
+
+def test_generate_analysis_report_end_to_end(tmp_path):
+    eval_root = tmp_path / "evals"
+    save_root = tmp_path / "report"
+    _write_eval_tree(str(eval_root))
+    report = generate_analysis_report(str(eval_root), str(save_root))
+    assert "off_diag_f1" in report["tables"]
+    mean_table = report["tables"]["off_diag_f1"]["mean"]
+    assert mean_table["numF2_numSF2_numN12_numE11_data"][
+        "CMLP"] == pytest.approx(0.55)
+    assert (save_root / "analysis_report.pkl").exists()
+    assert (save_root / "system_details.pkl").exists()
+    # headline CSV written by the summaries condenser
+    csvs = [n for n in os.listdir(save_root) if n.endswith(".csv")]
+    assert csvs
+
+
+def test_visualize_trained_model_factors(tmp_path):
+    """Model visualization path on a loadable artifact (the notebook's
+    per-fold GC visualization cells)."""
+    from redcliff_tpu.models.dynotears import DynotearsConfig
+
+    rng = np.random.default_rng(0)
+    true_g = (rng.uniform(size=(4, 4, 1)) > 0.5).astype(float)
+    runs = []
+    for fold in range(2):
+        run = tmp_path / f"dset_fold{fold}_run"
+        os.makedirs(run)
+        with open(run / "final_best_model.bin", "wb") as f:
+            pickle.dump({"model_class": "DynotearsVanillaModel",
+                         "config": DynotearsConfig(lag_size=1),
+                         "a_est": true_g[:, :, 0] + 0.01 * fold}, f)
+        runs.append(str(run))
+
+    save = tmp_path / "vis"
+    ests = visualize_trained_model_factors(
+        runs[0], "DYNOTEARS_Vanilla", 1, str(save), true_gcs=[true_g])
+    assert len(ests) == 1
+    assert (save / "factor_0_gc_est.png").exists()
+    assert (save / "all_factors_gc_est.png").exists()
+
+    avg = visualize_factors_across_folds(
+        runs, "DYNOTEARS_Vanilla", 1, str(tmp_path / "vis_folds"),
+        true_gcs=[true_g])
+    assert len(avg) == 1
+    assert (tmp_path / "vis_folds" / "avg_across_folds_gc_est.png").exists()
+    assert (tmp_path / "vis_folds" / "fold_0" / "factor_0_gc_est.png").exists()
+    # normalized averaging keeps estimates on [0, 1]
+    assert np.max(avg[0]) <= 1.0 + 1e-9

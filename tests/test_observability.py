@@ -1,0 +1,125 @@
+"""Structured observability (SURVEY §5): jsonl metric logging schema and the
+opt-in profiler hook, replacing the reference's stdout-scrape observability
+(ref README.md:96, redcliff_s_cmlp.py:1549-1569)."""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu.data import synthetic as S
+from redcliff_tpu.data.datasets import train_val_split
+from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+from redcliff_tpu.train.trainer import TrainConfig, Trainer
+from redcliff_tpu.utils.observability import (
+    MetricLogger, jsonable, profiler_trace, read_jsonl)
+
+
+def test_jsonable_coerces_numpy_and_dataclasses():
+    cfg = TrainConfig(learning_rate=0.5)
+    out = jsonable({
+        "int": np.int64(3),
+        "float": np.float32(1.5),
+        "arr": np.arange(4).reshape(2, 2),
+        "jax": jax.numpy.ones((2,)),
+        "cfg": cfg,
+        "nested": [np.float64(2.0), ("a", np.int32(1))],
+    })
+    assert out["int"] == 3 and isinstance(out["int"], int)
+    assert out["float"] == 1.5 and isinstance(out["float"], float)
+    assert out["arr"] == [[0, 1], [2, 3]]
+    assert out["jax"] == [1.0, 1.0]
+    assert out["cfg"]["learning_rate"] == 0.5
+    assert out["nested"] == [2.0, ["a", 1]]
+    json.dumps(out)  # round-trips through the encoder
+
+
+def test_metric_logger_writes_and_reads(tmp_path):
+    with MetricLogger(str(tmp_path)) as log:
+        assert log.active
+        log.log("epoch", epoch=0, loss=np.float32(1.25))
+        log.log("epoch", epoch=1, loss=0.5)
+        log.log("fit_end", best_it=1)
+    recs = read_jsonl(str(tmp_path))
+    assert [r["event"] for r in recs] == ["epoch", "epoch", "fit_end"]
+    assert all("wall_time" in r for r in recs)
+    assert recs[0]["loss"] == 1.25
+    epochs = read_jsonl(str(tmp_path), event="epoch")
+    assert len(epochs) == 2
+
+    # resume appends rather than truncating
+    with MetricLogger(str(tmp_path)) as log:
+        log.log("fit_start", resume_epoch=2)
+    assert len(read_jsonl(str(tmp_path))) == 4
+
+
+def test_metric_logger_none_is_noop():
+    log = MetricLogger(None)
+    assert not log.active
+    log.log("epoch", epoch=0)  # must not raise
+    log.close()
+
+
+def test_trainer_emits_epoch_schema(tmp_path):
+    D = 4
+    p = S.reference_curation_params(D)
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=D, num_lags=2, num_factors=1, make_factors_orthogonal=False,
+        make_factors_singular_components=False, rand_seed=3,
+        off_diag_edge_strengths=p["off_diag_edge_strengths"],
+        diag_receiving_node_forgetting_coeffs=p["diag_receiving_node_forgetting_coeffs"],
+        diag_sending_node_forgetting_coeffs=p["diag_sending_node_forgetting_coeffs"],
+        num_edges_per_graph=4,
+    )
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(0), graphs, acts, p["base_freqs"], p["noise_mu"],
+        p["noise_var"], p["innovation_amp"], num_samples=64,
+        recording_length=24, burnin_period=5, num_labeled_sys_states=1)
+    train_ds, val_ds = train_val_split(np.asarray(X), np.asarray(Y),
+                                       val_fraction=0.25,
+                                       rng=np.random.default_rng(0))
+    model = CMLPFM(CMLPFMConfig(num_chans=D, gen_lag=2, gen_hidden=(8,),
+                                input_length=8))
+    params = model.init(jax.random.PRNGKey(1))
+    run = str(tmp_path / "run")
+    trainer = Trainer(model, TrainConfig(learning_rate=1e-3, max_iter=3,
+                                         batch_size=32, check_every=1))
+    trainer.fit(params, train_ds, val_ds, true_GC=[graphs[0]], save_dir=run)
+
+    recs = read_jsonl(run)
+    events = [r["event"] for r in recs]
+    assert events[0] == "fit_start"
+    assert events[-1] == "fit_end"
+    epochs = [r for r in recs if r["event"] == "epoch"]
+    assert len(epochs) == 3
+    for i, r in enumerate(epochs):
+        assert r["epoch"] == i
+        assert isinstance(r["combo_loss"], float)
+        assert isinstance(r["criteria"], float)
+        # GC-vs-oracle metrics flattened in when a tracker is active
+        assert "f1_t0.0_factor0" in r
+        assert "roc_auc_t0.0_factor0" in r
+        assert "deltacon0_factor0" in r
+    start = recs[0]
+    assert start["model"] == "CMLPFM"
+    assert start["train_config"]["max_iter"] == 3
+    end = recs[-1]
+    assert set(end) >= {"best_it", "best_loss", "final_val_loss"}
+
+    # the file is line-delimited JSON (the structured-logging contract)
+    with open(os.path.join(run, "metrics.jsonl")) as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_profiler_trace_noop_and_real(tmp_path):
+    # disabled: no-op
+    with profiler_trace(None):
+        pass
+    # enabled: produces a trace artifact tree
+    out = tmp_path / "profile"
+    with profiler_trace(str(out)):
+        jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
+    produced = [os.path.join(dp, f) for dp, _, fs in os.walk(out) for f in fs]
+    assert produced, "profiler trace produced no files"

@@ -1,0 +1,146 @@
+"""End-to-end pipeline integration: curate -> array-task train (2 algorithms)
+-> cross-algorithm eval -> grid selection -> analysis report, composing the
+layers exclusively through the filesystem contract (run-folder names,
+final_best_model.bin, summary pickles) the way the reference's SLURM flow does
+(SURVEY §3.1/§3.4 call stacks)."""
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.data.curation import curate_synthetic_fold
+from redcliff_tpu.eval.analysis import generate_analysis_report
+from redcliff_tpu.eval.cross_alg import run_cross_algorithm_comparison
+from redcliff_tpu.eval.grid_selection import (load_grid_summaries,
+                                              select_best_models)
+from redcliff_tpu.train.driver import set_up_and_run_experiments
+from redcliff_tpu.utils.config import read_in_data_args
+
+
+def _write_cmlp_args(path):
+    with open(path, "w") as f:
+        json.dump({
+            "num_sims": "1", "embed_hidden_sizes": "[8]", "batch_size": "8",
+            "gen_eps": "0.0001", "gen_weight_decay": "0.0", "max_iter": "3",
+            "lookback": "2", "check_every": "1", "verbose": "0",
+            "output_length": "1", "wavelet_level": "None",
+            "gen_hidden": "[8]", "gen_lr": "0.005",
+            "gen_lag_and_input_len": "3", "FORECAST_COEFF": "1.0",
+            "ADJ_L1_REG_COEFF": "0.001", "DAGNESS_REG_COEFF": "0.0",
+            "DAGNESS_LAG_COEFF": "0.0", "DAGNESS_NODE_COEFF": "0.0",
+        }, f)
+
+
+def _write_redcliff_args(path):
+    with open(path, "w") as f:
+        json.dump({
+            "num_sims": "1", "embed_hidden_sizes": "[8]", "batch_size": "8",
+            "gen_eps": "0.0001", "gen_weight_decay": "0.0", "max_iter": "3",
+            "lookback": "2", "check_every": "1", "verbose": "0",
+            "output_length": "1", "wavelet_level": "None",
+            "gen_hidden": "[8]", "gen_lr": "0.005",
+            "gen_lag_and_input_len": "3", "embed_lag": "4",
+            "FORECAST_COEFF": "1.0", "ADJ_L1_REG_COEFF": "0.001",
+            "num_factors": "2", "num_supervised_factors": "2",
+            "use_sigmoid_restriction": "1",
+            "factor_score_embedder_type": "Vanilla_Embedder",
+            "primary_gc_est_mode": "fixed_factor_exclusive",
+            "forward_pass_mode": "apply_factor_weights_at_each_sim_step",
+            "FACTOR_SCORE_COEFF": "10.0", "DAGNESS_REG_COEFF": "0.0",
+            "DAGNESS_LAG_COEFF": "0.0", "DAGNESS_NODE_COEFF": "0.0",
+            "FACTOR_WEIGHT_L1_COEFF": "0.01", "FACTOR_COS_SIM_COEFF": "0.01",
+            "training_mode": "combined", "embed_lr": "0.005",
+            "embed_eps": "0.0001", "embed_weight_decay": "0.0",
+            "num_pretrain_epochs": "0", "num_acclimation_epochs": "0",
+            "prior_factors_path": "None", "cost_criteria": "combo",
+            "unsupervised_start_index": "0",
+            "max_factor_prior_batches": "5",
+            "stopping_criteria_forecast_coeff": "1.0",
+            "stopping_criteria_factor_coeff": "1.0",
+            "stopping_criteria_cosSim_coeff": "1.0",
+            "deltaConEps": "0.1", "in_degree_coeff": "1.0",
+            "out_degree_coeff": "1.0",
+        }, f)
+
+
+@pytest.mark.slow
+def test_full_pipeline_curate_train_eval_select_report(tmp_path):
+    # --- 1. curation: shards + cached-args with stringified true graphs ---
+    fold_dir, graphs = curate_synthetic_fold(
+        str(tmp_path / "data"), fold_id=0, num_nodes=5, num_factors=2,
+        num_supervised_factors=2, num_samples_in_train_set=16,
+        num_samples_in_val_set=8, sample_recording_len=30,
+        folder_name="toySys")
+    data_args_file = os.path.join(fold_dir, "data_fold0_cached_args.txt")
+    assert os.path.isfile(data_args_file)
+
+    # the true graphs round-trip through the cached-args text contract
+    gc_args = read_in_data_args(
+        {"model_type": "REDCLIFF_S_CMLP",
+         "data_cached_args_file": data_args_file},
+        read_in_gc_factors_for_eval=True)
+    true_gcs = gc_args["true_GC_factors"]
+    assert len(true_gcs) == 2
+
+    # --- 2. array-task training of two algorithm families, one root each ---
+    roots = {}
+    for model_type, writer, args_name in (
+            ("REDCLIFF_S_CMLP", _write_redcliff_args,
+             "REDCLIFF_S_CMLP_toy_cached_args.txt"),
+            ("cMLP", _write_cmlp_args, "cMLP_toy_cached_args.txt")):
+        margs = tmp_path / args_name
+        writer(str(margs))
+        # root folder names carry the algorithm name: the eval layer resolves
+        # them by substring (cross_alg.select_algorithm_root)
+        alias = "CMLP" if model_type == "cMLP" else model_type
+        save_root = tmp_path / "runs" / f"{alias}_models"
+        os.makedirs(save_root)
+        task_id = set_up_and_run_experiments(
+            {"save_root_path": str(save_root)}, [str(margs)],
+            [data_args_file], possible_model_types=[model_type],
+            possible_data_sets=["data_fold0"], task_id=1)
+        assert task_id == 1
+        runs = os.listdir(save_root)
+        assert len(runs) == 1
+        run_dir = save_root / runs[0]
+        assert (run_dir / "final_best_model.bin").exists()
+        assert (run_dir / "training_meta_data_and_hyper_parameters.pkl"
+                ).exists()
+        assert (run_dir / "metrics.jsonl").exists()  # observability contract
+        roots[alias] = str(save_root)
+
+    # --- 3. cross-algorithm evaluation through the filesystem contract ---
+    sys_key = "numF2_numSF2_numN5_numE6_toy_data"
+    eval_root = tmp_path / "evals"
+    out_dir = eval_root / sys_key
+    full = run_cross_algorithm_comparison(
+        list(roots.values()), {"data_fold0": {0: true_gcs}}, str(out_dir),
+        num_folds=1, plot=True)
+    assert set(full["data_fold0"]["fold_0_details"]) == {
+        "REDCLIFF_S_CMLP", "CMLP"}
+    assert (out_dir / "full_comparrisson_summary.pkl").exists()
+    paradigm = "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag"
+    by_alg = full["data_fold0"][paradigm]
+    for alg in ("REDCLIFF_S_CMLP", "CMLP"):
+        f1s = by_alg[alg]["f1_vals_across_factors"]
+        assert len(f1s) == 2 and all(np.isfinite(f1s))
+
+    # --- 4. grid-search selection over the trained run metadata ---
+    summaries = load_grid_summaries(roots["REDCLIFF_S_CMLP"])
+    best = select_best_models(
+        roots["REDCLIFF_S_CMLP"],
+        selection_criteria=("forecasting_loss", "factor_loss"))
+    assert best["forecasting_loss"]["best_run"] in summaries
+
+    # --- 5. one-command analysis report over the eval tree ---
+    report = generate_analysis_report(str(eval_root), str(tmp_path / "report"))
+    assert sys_key in report["tables"]["off_diag_f1"]["mean"]
+    assert report["system_details"][sys_key]["dataset_complexity"] == \
+        pytest.approx((5 * 5 - 5) / 6)
+    report_files = os.listdir(tmp_path / "report")
+    assert "analysis_report.pkl" in report_files
+    assert any(f.endswith(".csv") for f in report_files)
+    # collected per-system figures from the cross-alg run landed in the report
+    assert report["figures"]

@@ -82,6 +82,85 @@ def test_signal_wavelet_state_recon(tmp_path):
     assert _written(p4)
 
 
+def test_extended_helper_family(tmp_path):
+    """The long-tail helpers (ref plotting.py:14-256, 458-646) each write a
+    nonempty figure."""
+    from redcliff_tpu.utils import plotting as P
+
+    rng = np.random.default_rng(3)
+    p = lambda name: str(tmp_path / name)
+
+    P.plot_cross_experiment_summary(
+        p("xexp.png"), means=rng.uniform(size=6), sems=rng.uniform(size=6) * .1,
+        alg_names=["A", "B", "C"], dataset_names=["numN10_numE20", "numN5_numE9"],
+        title="t", xlabel="F1", x_domain_lim=(0, 1))
+    assert _written(p("xexp.png"))
+
+    P.plot_confidence_interval_summary(
+        p("ci.png"), [1, 2, 3], [0.5, 1.5, 2.5], [1.5, 2.5, 3.5],
+        center_label="median", title="t", criteria_name="loss",
+        domain_name="epoch")
+    assert _written(p("ci.png"))
+
+    P.make_bar_and_whisker_plot_overlay(
+        {"a": [1.0, 2.0, 3.0], "b": [2.0, 2.5]}, p("bw.png"), title="t")
+    assert _written(p("bw.png"))
+
+    P.plot_scattered_results([1, 2, 3], [4, 5, 6], p("sc.png"), x_eps=0.1,
+                             y_eps=0.1)
+    assert _written(p("sc.png"))
+
+    P.plot_training_loss([3.0, 2.0, 1.0], p("tl.png"))
+    assert _written(p("tl.png"))
+
+    P.plot_x_simulation_comparison(rng.normal(size=(2, 30, 3)),
+                                   rng.normal(size=(2, 30, 3)), p("sim.png"))
+    assert _written(p("sim.png"))
+
+    P.plot_scatter([1, 2], [3, 4], "t", "x", "y", p("s2.png"))
+    assert _written(p("s2.png"))
+
+    P.plot_curve([1, 2, 3], "t", "x", "y", p("c.png"), domain_start=5)
+    assert _written(p("c.png"))
+
+    P.plot_curve_comparison([[1, 2, 3], [2, 3, 4]], "t", "x", "y", p("cc.png"))
+    assert _written(p("cc.png"))
+
+    P.plot_curve_comparison_from_dict({"a": [1, 2], "b": [2, 3]}, "t", "x",
+                                      "y", p("ccd.png"))
+    assert _written(p("ccd.png"))
+
+    P.plot_system_state_score_comparison(p("ssc.png"),
+                                         rng.uniform(size=(3, 30)))
+    assert _written(p("ssc.png"))
+
+    P.plot_avg_system_state_score_comparison(
+        p("avg.png"), [rng.uniform(size=(2, 20)) for _ in range(3)],
+        [rng.uniform(size=(2, 20)) for _ in range(3)])
+    assert _written(p("avg.png"))
+
+    P.plot_estimated_vs_true_curve(p("evt.png"), [1, 2, 3], [1.1, 2.1, 2.9])
+    assert _written(p("evt.png"))
+
+    # zoom companions
+    P.plot_all_signal_channels(rng.normal(size=(60, 2)), p("z.png"), zoom=10)
+    assert _written(p("z.png"))
+    assert _written(p("z_ZOOMED.png"))
+    assert _written(p("z_partiallyZOOMED.png"))
+
+
+def test_scatter_sem_diff_plots(tmp_path):
+    """make_diff_plots writes per-group IMPROVEMENTS subfolders with pairwise
+    difference figures (ref plotting.py:177-198)."""
+    results = {"algA": [0.8, 0.9], "algB": [0.6, 0.7]}
+    p = str(tmp_path / "main.png")
+    make_scatter_and_std_err_of_mean_plot_overlay(
+        results, p, "t", "alg", "f1", make_diff_plots=True)
+    assert _written(p)
+    assert _written(str(tmp_path / "algA_IMPROVEMENTS" / "main.png"))
+    assert _written(str(tmp_path / "algB_IMPROVEMENTS" / "main.png"))
+
+
 def test_cross_experiment_grid_and_aliases(tmp_path):
     summary = {"dsetA": {"algA": 0.9, "algB": 0.7},
                "dsetB": {"algA": 0.85}}
