@@ -22,6 +22,9 @@ class ArrayDataset:
     (jax transfers once per batch — or pre-shard via parallel.grid for multi-chip).
     """
 
+    _dev = None  # lazily-populated device-resident (X, Y) cache
+    supports_device_batches = True  # trainers probe this before device=True
+
     def __init__(self, X, Y=None, normalize=True, stats=None, grid_search=False):
         X = np.asarray(X, dtype=np.float32)
         Y = None if Y is None else np.asarray(Y, dtype=np.float32)
@@ -57,18 +60,51 @@ class ArrayDataset:
     def num_timesteps(self):
         return self.X.shape[1]
 
-    def batches(self, batch_size, rng=None, drop_remainder=False):
-        """Yield (X, Y) minibatches; shuffled when an np.random.Generator is given."""
+    def _device_arrays(self, sharding=None):
+        if self._dev is None:
+            import jax
+
+            put = ((lambda a: jax.device_put(a, sharding))
+                   if sharding is not None else jax.numpy.asarray)
+            self._dev = (put(self.X),
+                         None if self.Y is None else put(self.Y))
+        return self._dev
+
+    def batches(self, batch_size, rng=None, drop_remainder=False,
+                device=False, sharding=None):
+        """Yield (X, Y) minibatches; shuffled when an np.random.Generator is
+        given.
+
+        ``device=True`` caches the whole dataset in device memory once and
+        slices batches with a device-side gather, so epochs re-ship only the
+        (tiny) index array instead of the batch data host->device every step
+        — the datasets here are orders of magnitude smaller than HBM. Keep
+        the default (host numpy) in multi-process runs, where inputs must
+        stay uncommitted to replicate across hosts.
+
+        ``sharding`` (used with ``device=True``) places the cached copy with
+        that sharding — pass a replicated mesh sharding so batch gathers for
+        mesh-sharded programs stay on-device with no per-step resharding.
+        The cache is built once: the first caller's sharding wins.
+        """
         n = len(self.X)
         idx = np.arange(n)
         if rng is not None:
             rng.shuffle(idx)
+        if device:
+            import jax
+
+            # multi-process guard lives here, not at call sites: committed
+            # per-host arrays cannot replicate across hosts
+            device = jax.process_count() == 1
+        Xs, Ys = (self._device_arrays(sharding) if device
+                  else (self.X, self.Y))
         stop = (n // batch_size) * batch_size if drop_remainder else n
         for start in range(0, stop, batch_size):
             sel = idx[start : start + batch_size]
             if len(sel) == 0:
                 break
-            yield self.X[sel], (None if self.Y is None else self.Y[sel])
+            yield Xs[sel], (None if Ys is None else Ys[sel])
 
     def num_batches(self, batch_size, drop_remainder=False):
         n = len(self.X)

@@ -136,14 +136,22 @@ def _norm(x, scale, shift, kind, eps=1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * scale + shift
 
 
-def _attention(layer, x, pad_mask, n_heads):
+def _attention(layer, x, pad_mask, n_heads, seq_mesh=None, seq_axis="seq"):
     """Batched multi-head self-attention. x: (B, T, D); pad_mask: (B, T)
-    True = keep."""
+    True = keep.  With ``seq_mesh``, attention runs as ring attention with
+    the time axis sharded over the mesh (parallel.sequence) — exact, but
+    per-device memory is O(T / n_devices)."""
     B, T, D = x.shape
     H, hd = n_heads, D // n_heads
     q = (x @ layer["wq"]).reshape(B, T, H, hd)
     k = (x @ layer["wk"]).reshape(B, T, H, hd)
     v = (x @ layer["wv"]).reshape(B, T, H, hd)
+    if seq_mesh is not None:
+        from redcliff_tpu.parallel.sequence import ring_attention
+
+        out = ring_attention(q, k, v, seq_mesh,
+                             axis_name=seq_axis).reshape(B, T, D)
+        return out @ layer["wo"]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
     if pad_mask is not None:
         neg = jnp.finfo(x.dtype).min
@@ -154,10 +162,23 @@ def _attention(layer, x, pad_mask, n_heads):
 
 
 def ts_transformer_encode(params, cfg: TSTransformerConfig, X,
-                          padding_masks=None):
+                          padding_masks=None, seq_mesh=None, seq_axis="seq"):
     """(B, T, feat_dim) -> (B, T, d_model) encoder embeddings
-    (ref TSTransformerEncoder.forward :169-190 up to the output head)."""
+    (ref TSTransformerEncoder.forward :169-190 up to the output head).
+
+    ``seq_mesh`` turns on sequence parallelism for long recordings: the time
+    axis shards across the mesh, attention runs as ring attention, and the
+    remaining (time-local) projections/FFN/norms are auto-partitioned by XLA
+    along the same axis — the mvts BatchNorm's batch×time statistics become
+    mesh psums, so results match the dense path exactly.  Padding masks are
+    not supported in this mode (long-recording encoding doesn't pad)."""
     B, T, _ = X.shape
+    if seq_mesh is not None:
+        assert padding_masks is None, \
+            "padding_masks unsupported under sequence parallelism"
+        from redcliff_tpu.parallel.sequence import sequence_sharded
+
+        X = sequence_sharded(X, seq_mesh, seq_axis)
     x = (X @ params["project_inp"]["w"] + params["project_inp"]["b"]) \
         * math.sqrt(cfg.d_model)
     if cfg.pos_encoding == "learnable":
@@ -165,7 +186,8 @@ def ts_transformer_encode(params, cfg: TSTransformerConfig, X,
     else:
         x = x + _fixed_pos_encoding(cfg.max_len, cfg.d_model)[None, :T]
     for layer in params["layers"]:
-        a = _attention(layer, x, padding_masks, cfg.n_heads)
+        a = _attention(layer, x, padding_masks, cfg.n_heads,
+                       seq_mesh=seq_mesh, seq_axis=seq_axis)
         x = _norm(x + a, layer["norm1_scale"], layer["norm1_shift"], cfg.norm)
         h = _act(cfg)(x @ layer["ff1"]["w"] + layer["ff1"]["b"])
         h = h @ layer["ff2"]["w"] + layer["ff2"]["b"]
@@ -185,8 +207,9 @@ class TSTransformerEncoder:
     def init(self, key):
         return init_ts_transformer_params(key, self.config)
 
-    def forward(self, params, X, padding_masks=None):
-        z = ts_transformer_encode(params, self.config, X, padding_masks)
+    def forward(self, params, X, padding_masks=None, seq_mesh=None):
+        z = ts_transformer_encode(params, self.config, X, padding_masks,
+                                  seq_mesh=seq_mesh)
         return z @ params["output"]["w"] + params["output"]["b"]
 
     def loss(self, params, X, Y=None, padding_masks=None):

@@ -313,7 +313,16 @@ class RedcliffGridRunner:
                 params = self._shard(params)
                 aligned = True
             phases = self.phase_for_epoch(it)
-            for X, Y in train_ds.batches(tc.batch_size, rng=rng):
+            # device-resident batches (HBM copy + per-batch device gather),
+            # replicated over the mesh; ArrayDataset itself falls back to
+            # host numpy in multi-process runs
+            if getattr(train_ds, "supports_device_batches", False):
+                dev_kw = {"device": True,
+                          "sharding": (replicated(self.mesh)
+                                       if self.mesh is not None else None)}
+            else:
+                dev_kw = {}
+            for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
                 for phase in phases:
                     params, optA_state, optB_state, _ = self._steps[phase](
                         params, optA_state, optB_state, coeffs, active, X, Y)

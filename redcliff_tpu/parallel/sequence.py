@@ -1,0 +1,130 @@
+"""Sequence/context parallelism: ring attention over a device-mesh axis.
+
+The reference consumes only short windows (T <= ~1000 and models read <= 16
+steps of context per prediction — SURVEY §5 "long-context"), so it never
+needed sequence parallelism. This framework treats long context as
+first-class: full-rate LFP recordings (minutes at 1 kHz) can be encoded by
+the TS transformer without windowing by sharding the TIME axis across the
+mesh and running **ring attention** — the blockwise-softmax algorithm of
+Liu et al. (Ring Attention with Blockwise Transformers, arXiv:2310.01889):
+
+* every device holds one contiguous block of Q/K/V along time;
+* K/V blocks rotate around the ring via ``jax.lax.ppermute`` (ICI
+  neighbor exchange — no all-gather, so per-device memory stays
+  O(T/n_devices) instead of O(T));
+* each device folds every visiting K/V block into a numerically-stable
+  online softmax (running max / normalizer, the flash-attention recurrence),
+  overlapping compute with the next block's transfer.
+
+``ring_attention`` is the kernel; ``sequence_sharded`` is the convenience
+sharding constraint used to keep the rest of an encoder (projections, FFN,
+norms) auto-partitioned by XLA along the same axis, with GSPMD inserting the
+(cheap, exact) psums for batch-statistic norms.
+"""
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "seq_mesh", "sequence_sharded"]
+
+_NEG = -1e30  # softmax mask value; avoids -inf NaNs for fully-masked rows
+
+
+def seq_mesh(n_devices=None, axis_name="seq", devices=None):
+    """1-D mesh over the sequence axis (grid_mesh with a "seq" axis)."""
+    from redcliff_tpu.parallel.mesh import grid_mesh
+
+    return grid_mesh(n_devices, axis_name=axis_name, devices=devices)
+
+
+def sequence_sharded(x, mesh, axis_name="seq", time_axis=1):
+    """Constrain ``x`` to be sharded along its time axis over the mesh."""
+    spec = [None] * x.ndim
+    spec[time_axis] = axis_name
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec)))
+
+
+@lru_cache(maxsize=64)
+def _ring_program(mesh, axis_name, causal, scale, n_dev):
+    """Compiled ring-attention program, cached per (mesh, axis, causal,
+    scale) so eager call sites (one per encoder layer per forward) reuse one
+    jit entry instead of recompiling."""
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def local(q_blk, k_blk, v_blk):
+        B, T_loc, H, D = q_blk.shape
+        my_idx = jax.lax.axis_index(axis_name)
+        q_pos = my_idx * T_loc + jnp.arange(T_loc)
+        # accumulators marked device-varying so the fori_loop carry type is
+        # stable under shard_map's varying-manual-axes tracking
+        varying = lambda a: jax.lax.pcast(a, (axis_name,), to="varying")
+        m0 = varying(jnp.full((B, H, T_loc), _NEG, q_blk.dtype))
+        l0 = varying(jnp.zeros((B, H, T_loc), q_blk.dtype))
+        o0 = varying(jnp.zeros((B, H, T_loc, D), q_blk.dtype))
+
+        def fold(step, k_cur, v_cur, m, l, o):
+            # after `step` forward rotations, this device holds the block
+            # that originated on device (my_idx - step) mod n
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q_blk, k_cur) * scale
+            if causal:
+                src = jax.lax.rem(my_idx - step + n_dev, n_dev)
+                k_pos = src * T_loc + jnp.arange(T_loc)
+                keep = (k_pos[None, None, None, :]
+                        <= q_pos[None, None, :, None])
+                logits = jnp.where(keep, logits, _NEG)
+            m_cur = logits.max(axis=-1)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(logits - m_new[..., None])
+            l_new = l * alpha + p.sum(axis=-1)
+            o_new = (o * alpha[..., None]
+                     + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur))
+            return m_new, l_new, o_new
+
+        def body(step, carry):
+            k_cur, v_cur, m, l, o = carry
+            m, l, o = fold(step, k_cur, v_cur, m, l, o)
+            k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+            return k_next, v_next, m, l, o
+
+        # the last visiting block is folded outside the loop so its (unused)
+        # rotation is never issued — one fewer K/V exchange per call
+        k_last, v_last, m, l, o = jax.lax.fori_loop(
+            0, n_dev - 1, body, (k_blk, v_blk, m0, l0, o0))
+        m, l, o = fold(n_dev - 1, k_last, v_last, m, l, o)
+        out = o / jnp.maximum(l, 1e-30)[..., None]  # (B, H, T_loc, D)
+        return out.transpose(0, 2, 1, 3)
+
+    spec = P(None, axis_name, None, None)
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec))
+
+
+def ring_attention(q, k, v, mesh, axis_name="seq", causal=False, scale=None):
+    """Exact multi-head attention with the sequence axis sharded over
+    ``mesh``'s ``axis_name``.
+
+    Args:
+      q, k, v: (B, T, H, D) arrays, T divisible by the mesh size. They may be
+        unsharded (this call shards them) or already sharded along T.
+      causal: mask future keys using GLOBAL positions (block offsets are
+        tracked through the rotation).
+      scale: logit scale; default 1/sqrt(D).
+
+    Returns (B, T, H, D), sharded along T like the inputs.
+    """
+    n_dev = mesh.devices.size
+    T, D = q.shape[1], q.shape[3]
+    assert T % n_dev == 0, (
+        f"sequence length {T} not divisible by mesh size {n_dev}")
+    scale = 1.0 / math.sqrt(D) if scale is None else scale
+    return _ring_program(mesh, axis_name, bool(causal), float(scale),
+                         n_dev)(q, k, v)

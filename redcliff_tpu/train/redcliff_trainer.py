@@ -251,7 +251,12 @@ class RedcliffTrainer:
             conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
                         if cfg.num_supervised_factors > 0 else None)
 
-            for X, Y in train_ds.batches(tc.batch_size, rng=rng):
+            # device-resident batches when the dataset supports them; plain
+            # call otherwise so duck-typed batches() implementations work
+            dev_kw = ({"device": True}
+                      if getattr(train_ds, "supports_device_batches", False)
+                      else {})
+            for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
                 for phase in phases:
                     params, optA_state, optB_state, _, _ = self._steps[phase](
                         params, optA_state, optB_state, X, Y)

@@ -198,13 +198,21 @@ class Trainer:
         step_key = jax.random.PRNGKey(cfg.seed) if self._wants_rng else None
         step_counter = 0
         last_it = iter_start - 1
+        # batches as device-side gathers from an HBM-resident copy: epochs
+        # re-ship only index arrays, not batch data. Datasets without the
+        # capability keep the plain call (no kwarg), so duck-typed batches()
+        # implementations still work
+        dev_kw = ({"device": True}
+                  if getattr(train_ds, "supports_device_batches", False)
+                  else {})
         logger = MetricLogger(save_dir)
         logger.log("fit_start", model=type(self.model).__name__,
                    train_config=cfg, resume_epoch=iter_start)
         with profiler_trace(cfg.profile_dir):
             for it in range(iter_start, cfg.max_iter):
                 last_it = it
-                for X, Y in train_ds.batches(cfg.batch_size, rng=rng):
+                for X, Y in train_ds.batches(cfg.batch_size, rng=rng,
+                                             **dev_kw):
                     step_rng = (jax.random.fold_in(step_key, step_counter)
                                 if self._wants_rng else None)
                     step_counter += 1

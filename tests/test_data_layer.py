@@ -332,3 +332,28 @@ def test_socpref_windows_aligned_with_start_time(tmp_path):
         # the labeled interval [6000, 9000) (filter edge effects aside)
         mean_abs_step = float(win[:, 0].mean())
         assert 5800 < mean_abs_step < 9200, mean_abs_step
+
+
+def test_array_dataset_device_batches_match_host():
+    """device=True yields the same batch contents as host numpy batches (same
+    shuffle), but as device-resident jax arrays gathered from one HBM copy."""
+    import jax
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(13, 6, 3)).astype(np.float32)
+    Y = rng.uniform(size=(13, 2)).astype(np.float32)
+    ds = ArrayDataset(X, Y)
+    host = list(ds.batches(4, rng=np.random.default_rng(7)))
+    dev = list(ds.batches(4, rng=np.random.default_rng(7), device=True))
+    assert len(host) == len(dev)
+    for (hx, hy), (dx, dy) in zip(host, dev):
+        assert isinstance(dx, jax.Array)
+        np.testing.assert_array_equal(hx, np.asarray(dx))
+        np.testing.assert_array_equal(hy, np.asarray(dy))
+    # the device cache is built once and reused across epochs
+    assert ds._dev is not None
+    first = ds._dev
+    list(ds.batches(4, device=True))
+    assert ds._dev is first

@@ -1,0 +1,104 @@
+"""Ring-attention sequence parallelism on the virtual 8-device mesh: exact
+agreement with dense attention (the sharded path must be a pure execution
+strategy, not an approximation)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from redcliff_tpu.models.ts_transformer import (
+    TSTransformerConfig, TSTransformerEncoder, ts_transformer_encode)
+from redcliff_tpu.parallel.sequence import (ring_attention, seq_mesh,
+                                            sequence_sharded)
+
+
+def _dense_attention(q, k, v, causal=False):
+    B, T, H, D = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+    if causal:
+        keep = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(keep[None, None], logits, -1e30)
+    attn = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 64, 4, 8
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(qkv, causal):
+    q, k, v = qkv
+    mesh = seq_mesh(8)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    want = _dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+    # output is genuinely sharded along time over all 8 devices
+    assert len(got.sharding.device_set) == 8
+
+
+def test_ring_attention_mesh_subset(qkv):
+    """Works on a mesh smaller than all devices (T divisible by mesh size)."""
+    q, k, v = qkv
+    mesh = seq_mesh(4)
+    got = ring_attention(q, k, v, mesh)
+    want = _dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_attention_rejects_indivisible_T(qkv):
+    q, k, v = qkv
+    with pytest.raises(AssertionError, match="not divisible"):
+        ring_attention(q[:, :60], k[:, :60], v[:, :60], seq_mesh(8))
+
+
+@pytest.mark.parametrize("norm", ["LayerNorm", "BatchNorm"])
+def test_sequence_parallel_encoder_matches_dense(norm):
+    """The full TS-transformer encoder under sequence parallelism (ring
+    attention + XLA-partitioned FFN/norms) reproduces the dense encoder,
+    including the mvts BatchNorm whose batch-time statistics psum over the
+    mesh."""
+    cfg = TSTransformerConfig(feat_dim=3, max_len=64, d_model=16, n_heads=4,
+                              num_layers=2, dim_feedforward=32, norm=norm)
+    model = TSTransformerEncoder(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    X = jnp.asarray(np.random.default_rng(1).normal(
+        size=(2, 64, 3)).astype(np.float32))
+
+    dense = model.forward(params, X)
+    sp = model.forward(params, X, seq_mesh=seq_mesh(8))
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(dense),
+                               rtol=5e-5, atol=5e-6)
+
+
+def test_sequence_sharded_constraint():
+    mesh = seq_mesh(8)
+    x = jnp.ones((2, 32, 5))
+    y = jax.jit(lambda a: sequence_sharded(a, mesh) * 2)(x)
+    np.testing.assert_array_equal(np.asarray(y), 2 * np.ones((2, 32, 5)))
+
+
+def test_long_sequence_memory_scaling():
+    """The point of ring attention: a sequence long enough that dense
+    attention logits would be T^2-sized still encodes with per-device blocks
+    of T/8 — exercised by running a length-1024 input through the sharded
+    path and spot-checking against dense on a slice-invariant statistic."""
+    cfg = TSTransformerConfig(feat_dim=2, max_len=1024, d_model=8, n_heads=2,
+                              num_layers=1, dim_feedforward=16,
+                              norm="LayerNorm")
+    model = TSTransformerEncoder(cfg)
+    params = model.init(jax.random.PRNGKey(2))
+    X = jnp.asarray(np.random.default_rng(3).normal(
+        size=(1, 1024, 2)).astype(np.float32))
+    sp = model.forward(params, X, seq_mesh=seq_mesh(8))
+    dense = model.forward(params, X)
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(dense),
+                               rtol=5e-5, atol=5e-6)
