@@ -23,7 +23,8 @@ from __future__ import annotations
 import numpy as np
 from scipy import stats
 
-__all__ = ["parcorr_test", "pcmci", "pcmci_val_graph", "rpcmci_by_regime"]
+__all__ = ["parcorr_test", "pcmci", "pcmci_val_graph", "rpcmci_by_regime",
+           "rpcmci"]
 
 
 def parcorr_test(x, y, Z=None):
@@ -198,6 +199,186 @@ def pcmci_val_graph(result, alpha_level=0.05, ignore_lag=True):
     if ignore_lag:
         return val[:, :, 1:].max(axis=2)
     return val[:, :, 1:]
+
+
+def _ridge_var_fit(feats, targs, lam):
+    """Ridge-regularized linear VAR solve: feats (M, F), targs (M, N)."""
+    F = feats.shape[1]
+    A = feats.T @ feats + lam * np.eye(F)
+    return np.linalg.solve(A, feats.T @ targs)  # (F, N)
+
+
+def _var_design(rec, tau_max):
+    """Per-recording lagged design matrix (T-tau, N*tau + 1) with intercept
+    and its targets (T-tau, N)."""
+    rec = np.asarray(rec, dtype=np.float64)
+    T, N = rec.shape
+    if T <= tau_max:
+        return None, None
+    cols = [rec[tau_max - tau : T - tau] for tau in range(1, tau_max + 1)]
+    feats = np.concatenate(cols + [np.ones((T - tau_max, 1))], axis=1)
+    return feats, rec[tau_max:]
+
+
+def _viterbi_assign(errors, switching_penalty):
+    """Min-cost per-step regime path: errors (T, K); transition cost
+    ``switching_penalty`` per regime change."""
+    T, K = errors.shape
+    cost = errors[0].copy()
+    back = np.zeros((T, K), dtype=int)
+    for t in range(1, T):
+        stay = cost
+        best_prev = stay.min()
+        trans = np.minimum(stay, best_prev + switching_penalty)
+        back[t] = np.where(stay <= best_prev + switching_penalty,
+                           np.arange(K), stay.argmin())
+        cost = trans + errors[t]
+    path = np.zeros(T, dtype=int)
+    path[-1] = int(cost.argmin())
+    for t in range(T - 1, 0, -1):
+        path[t - 1] = back[t, path[t]]
+    return path
+
+
+def rpcmci(recordings, num_regimes, tau_max=1, assign_per="recording",
+           n_iter=20, n_inits=3, switching_penalty=0.0, ridge_lam=1e-2,
+           seed=0, pc_alpha=0.2, alpha_level=0.05):
+    """Unsupervised regime-PCMCI: jointly learn a regime assignment and
+    per-regime causal graphs from unlabeled recordings — the capability of
+    tigramite's RPCMCI (Saggioro et al. 2020, "Reconstructing regime-dependent
+    causal relationships from observational time series"; external Table-2
+    dep in SURVEY §2.5 / ref evaluate notebook cell 71), implemented natively.
+
+    Annealed alternating optimization: (a) fit one ridge-VAR error model per
+    regime on its assigned samples; (b) reassign each unit to the regime
+    whose model predicts it best — a whole recording when
+    ``assign_per="recording"`` (the D4IC structure: one dominant network per
+    window), or per time step via a min-cost path with ``switching_penalty``
+    per regime change when ``assign_per="timestep"``. The best of ``n_inits``
+    random initializations (lowest total prediction error) wins, then PCMCI
+    runs per learned regime.
+
+    Returns {"assignment", "results": {regime: pcmci result | None},
+    "error": float}. ``assignment`` is (num_recordings,) int for recording
+    mode (-1 marks recordings shorter than tau_max, which are excluded), or
+    a list of per-recording (T - tau_max,) int paths for timestep mode (None
+    for excluded recordings). Learned regime indices are arbitrary — align
+    to ground truth with utils.metrics Hungarian matching before scoring.
+    """
+    recordings = [np.asarray(r, dtype=np.float64) for r in recordings]
+    all_designs = [_var_design(rec, tau_max) for rec in recordings]
+    # recordings too short for the lag structure are excluded; `keep` maps
+    # filtered-design positions back to recording indices
+    keep = [i for i, (f, _) in enumerate(all_designs) if f is not None]
+    designs = [all_designs[i] for i in keep]
+    if not designs:
+        raise ValueError("no recording longer than tau_max")
+    rng = np.random.default_rng(seed)
+    R = len(designs)
+    K = num_regimes
+
+    def errors_for(W, feats, targs):
+        resid = feats @ W - targs
+        return (resid ** 2).sum(axis=1)  # per-step error
+
+    best = None
+    for _ in range(max(n_inits, 1)):
+        if assign_per == "recording":
+            assign = rng.integers(0, K, size=R)
+        else:
+            # contiguous-chunk random init: per-timestep random labels make
+            # every regime fit the same average model (no identifiability);
+            # chunks give the initial fits distinct temporal support
+            assign = []
+            for _, targs in designs:
+                T_r = len(targs)
+                chunk = max(T_r // (4 * K), tau_max + 1)
+                labels = np.repeat(rng.integers(0, K, size=T_r // chunk + 1),
+                                   chunk)[:T_r]
+                assign.append(labels)
+        for _ in range(n_iter):
+            # (a) per-regime ridge-VAR fit over assigned rows
+            Ws = []
+            for k in range(K):
+                rows_f, rows_t = [], []
+                for r, (feats, targs) in enumerate(designs):
+                    sel = (np.full(len(targs), assign[r] == k)
+                           if assign_per == "recording" else assign[r] == k)
+                    if np.any(sel):
+                        rows_f.append(feats[sel])
+                        rows_t.append(targs[sel])
+                if rows_f:
+                    Ws.append(_ridge_var_fit(np.concatenate(rows_f),
+                                             np.concatenate(rows_t),
+                                             ridge_lam))
+                else:
+                    Ws.append(None)  # empty regime: keep it empty
+            # (b) reassignment
+            new_assign = [] if assign_per == "timestep" else np.zeros(R, int)
+            total = 0.0
+            for r, (feats, targs) in enumerate(designs):
+                errs = np.stack(
+                    [errors_for(W, feats, targs) if W is not None
+                     else np.full(len(targs), np.inf) for W in Ws], axis=1)
+                if assign_per == "recording":
+                    rec_err = errs.sum(axis=0)
+                    new_assign[r] = int(rec_err.argmin())
+                    total += rec_err[new_assign[r]]
+                else:
+                    # scale-free switching cost: `switching_penalty` is
+                    # measured in average per-step errors, so the same value
+                    # works across signal scales/noise levels
+                    finite = errs.min(axis=1)
+                    pen = switching_penalty * float(
+                        finite[np.isfinite(finite)].mean())
+                    path = _viterbi_assign(errs, pen)
+                    new_assign.append(path)
+                    total += errs[np.arange(len(path)), path].sum()
+            if assign_per == "recording":
+                converged = np.array_equal(new_assign, assign)
+            else:
+                converged = all(np.array_equal(a, b)
+                                for a, b in zip(new_assign, assign))
+            assign = new_assign
+            if converged:
+                break
+        if best is None or total < best[0]:
+            best = (total, assign)
+
+    total, assign = best
+    # final per-regime discovery on the learned segmentation
+    if assign_per == "recording":
+        results = rpcmci_by_regime([recordings[i] for i in keep], assign, K,
+                                   tau_max=tau_max, pc_alpha=pc_alpha,
+                                   alpha_level=alpha_level)
+        full_assign = np.full(len(recordings), -1, dtype=int)
+        full_assign[keep] = assign
+        return {"assignment": full_assign, "results": results,
+                "error": float(total)}
+
+    results = {}
+    for k in range(K):
+        regs = []
+        for d, i in enumerate(keep):
+            rec = recordings[i]
+            path = assign[d]
+            start = None
+            for t in range(len(path) + 1):
+                active = t < len(path) and path[t] == k
+                if active and start is None:
+                    start = t
+                elif not active and start is not None:
+                    if t - start > tau_max:
+                        # include the lag context before the segment
+                        regs.append(rec[start : t + tau_max])
+                    start = None
+        results[k] = (pcmci(regs, tau_max=tau_max, pc_alpha=pc_alpha,
+                            alpha_level=alpha_level) if regs else None)
+    full_paths = [None] * len(recordings)
+    for d, i in enumerate(keep):
+        full_paths[i] = assign[d]
+    return {"assignment": full_paths, "results": results,
+            "error": float(total)}
 
 
 def rpcmci_by_regime(recordings, regime_labels, num_regimes, tau_max=1,

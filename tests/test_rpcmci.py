@@ -1,0 +1,135 @@
+"""Unsupervised regime-PCMCI (native tigramite-RPCMCI capability) and the
+notebook's D4IC regime experiment driver."""
+import numpy as np
+import pytest
+
+from redcliff_tpu.eval.supervised_discovery import (
+    run_d4ic_regime_pcmci_experiment)
+from redcliff_tpu.models.pcmci import pcmci_val_graph, rpcmci
+
+
+def _var_recording(A, T, rng, noise=0.1):
+    N = A.shape[0]
+    x = np.zeros((T, N))
+    x[0] = rng.normal(size=N)
+    for t in range(1, T):
+        x[t] = A @ x[t - 1] + noise * rng.normal(size=N)
+    return x
+
+
+@pytest.fixture(scope="module")
+def two_regime_data():
+    # regime 0: x0 drives x1; regime 1: x1 drives x0 (+ weak self-decay)
+    A0 = np.array([[0.5, 0.0, 0.0], [0.8, 0.3, 0.0], [0.0, 0.0, 0.4]])
+    A1 = np.array([[0.3, 0.8, 0.0], [0.0, 0.5, 0.0], [0.0, 0.0, 0.4]])
+    rng = np.random.default_rng(0)
+    recs, labels = [], []
+    for i in range(16):
+        k = i % 2
+        recs.append(_var_recording(A0 if k == 0 else A1, 80, rng))
+        labels.append(k)
+    return recs, np.asarray(labels)
+
+
+def test_rpcmci_recovers_recording_regimes(two_regime_data):
+    recs, labels = two_regime_data
+    out = rpcmci(recs, num_regimes=2, tau_max=1, seed=0)
+    assign = np.asarray(out["assignment"])
+    # perfect clustering up to label permutation
+    agree = max((assign == labels).mean(), (assign != labels).mean())
+    assert agree == 1.0, (assign, labels)
+    # per-regime graphs recover the planted directed edge as the strongest
+    # off-diagonal link (val graph entry (i, j) = X_i -> X_j)
+    tops = set()
+    for k in (0, 1):
+        val = pcmci_val_graph(out["results"][k], alpha_level=0.05)
+        off = val * (1 - np.eye(3))
+        tops.add(divmod(int(off.argmax()), 3))
+    assert tops == {(0, 1), (1, 0)}
+    assert np.isfinite(out["error"])
+
+
+def test_rpcmci_skips_short_recordings_without_misalignment(two_regime_data):
+    """Recordings shorter than tau_max are excluded (-1 in the assignment)
+    and must not shift other recordings' labels (index-alignment
+    regression)."""
+    recs, labels = two_regime_data
+    rng = np.random.default_rng(9)
+    mixed = [rng.normal(size=(1, 3))] + recs[:8] + [rng.normal(size=(1, 3))]
+    out = rpcmci(mixed, num_regimes=2, tau_max=1, seed=0)
+    assign = np.asarray(out["assignment"])
+    assert len(assign) == len(mixed)
+    assert assign[0] == -1 and assign[-1] == -1
+    kept = assign[1:-1]
+    agree = max((kept == labels[:8]).mean(), (kept != labels[:8]).mean())
+    assert agree == 1.0
+
+    # timestep mode: excluded recordings appear as None paths
+    out_t = rpcmci(mixed, num_regimes=2, tau_max=1, assign_per="timestep",
+                   switching_penalty=10.0, seed=0)
+    paths = out_t["assignment"]
+    assert paths[0] is None and paths[-1] is None
+    assert all(p is not None and len(p) == 79 for p in paths[1:-1])
+
+
+def test_rpcmci_timestep_mode_finds_switch():
+    A0 = np.array([[0.5, 0.0], [0.9, 0.3]])
+    A1 = np.array([[0.3, 0.9], [0.0, 0.5]])
+    rng = np.random.default_rng(1)
+    first = _var_recording(A0, 150, rng)
+    second = _var_recording(A1, 150, rng)
+    series = np.concatenate([first, second])
+    out = rpcmci([series], num_regimes=2, tau_max=1, assign_per="timestep",
+                 switching_penalty=5.0, seed=0)
+    path = out["assignment"][0]
+    assert len(path) == 299  # T - tau_max
+    # each half dominated by one regime, different between halves
+    first_mode = np.bincount(path[:120]).argmax()
+    second_mode = np.bincount(path[-120:]).argmax()
+    assert first_mode != second_mode
+    assert (path[:120] == first_mode).mean() > 0.8
+    assert (path[-120:] == second_mode).mean() > 0.8
+    # the switching penalty keeps the path piecewise-constant
+    assert (np.diff(path) != 0).sum() <= 10
+
+
+@pytest.fixture(scope="module")
+def d4ic_like_samples():
+    A0 = np.array([[0.5, 0.0, 0.0], [0.8, 0.3, 0.0], [0.0, 0.2, 0.4]])
+    A1 = np.array([[0.3, 0.8, 0.0], [0.0, 0.5, 0.0], [0.6, 0.0, 0.4]])
+    rng = np.random.default_rng(2)
+    samples = []
+    for i in range(16):
+        k = i % 2
+        x = _var_recording(A0 if k == 0 else A1, 60, rng)
+        y = np.zeros((2, 60))
+        y[k] = 1.0  # dominant-network coefficient trace
+        samples.append((x.astype(np.float32), y.astype(np.float32)))
+    # VAR transition A[i, j] = x_j drives x_i, which IS the
+    # columns-drive-rows convention the transposed predictions use
+    truths = [(np.abs(A) * (1 - np.eye(3)) > 0.1).astype(float)
+              for A in (A0, A1)]
+    return samples, truths
+
+
+@pytest.mark.parametrize("pred_source", ["graph", "val_matrix"])
+def test_d4ic_experiment_oracle_regimes(d4ic_like_samples, pred_source):
+    samples, truths = d4ic_like_samples
+    out = run_d4ic_regime_pcmci_experiment(
+        samples, truths, regime_source="oracle", pred_source=pred_source,
+        transpose=True, tau_max=2)
+    assert set(out["optF1Scores_by_regime"]) == {0, 1}
+    assert 0.0 <= out["cross_regime_mean"] <= 1.0
+    # planted 2-edge graphs on clean VAR data: discovery should do well
+    assert out["cross_regime_mean"] > 0.6, out["optF1Scores_by_regime"]
+    assert np.isfinite(out["cross_regime_sem"])
+
+
+def test_d4ic_experiment_learned_regimes(d4ic_like_samples):
+    samples, truths = d4ic_like_samples
+    out = run_d4ic_regime_pcmci_experiment(
+        samples, truths, regime_source="learned", pred_source="graph",
+        transpose=True, tau_max=2)
+    # unsupervised regimes + Hungarian alignment should still beat chance
+    assert out["cross_regime_mean"] > 0.6, out["optF1Scores_by_regime"]
+    assert set(out["preds_by_regime"]) == {0, 1}
