@@ -343,6 +343,9 @@ def run_d4ic_regime_pcmci_experiment(samples, true_graphs,
     return {
         "optF1Scores_by_regime": scores,
         "cross_regime_mean": float(np.mean(vals)),
+        # population-std SEM (ddof=0): the reference's convention everywhere
+        # (notebook cell 73, eval stats summarize_values) — kept for output
+        # parity even though sample-std SEM would be the textbook estimator
         "cross_regime_sem": float(np.std(vals) / np.sqrt(len(vals))),
         "preds_by_regime": preds_by_regime,
     }
