@@ -54,9 +54,16 @@ from .summaries import (
 )
 from .supervised_discovery import (
     prepare_data_for_modeling,
+    run_d4ic_regime_pcmci_experiment,
     run_discovery_algorithm,
     run_supervised_discovery_evaluation,
     score_discovery_predictions,
+)
+from .system_level import (
+    evaluate_fold_system_level,
+    evaluate_system_level_cv,
+    evaluate_system_level_gs,
+    key_similarity_stats,
 )
 from .stats import (
     compute_fixed_f1_stats,
@@ -93,8 +100,11 @@ __all__ = [
     "evaluate_avg_factor_scoring_across_recordings", "factor_score_sweep",
     "extract_metric_table", "load_full_comparison_summary",
     "summarize_off_diag_f1", "write_cross_experiment_report",
-    "prepare_data_for_modeling", "run_discovery_algorithm",
+    "prepare_data_for_modeling", "run_d4ic_regime_pcmci_experiment",
+    "run_discovery_algorithm",
     "run_supervised_discovery_evaluation", "score_discovery_predictions",
+    "evaluate_fold_system_level", "evaluate_system_level_cv",
+    "evaluate_system_level_gs", "key_similarity_stats",
     "compute_fixed_f1_stats", "compute_graph_comparison_stats",
     "compute_key_stats", "compute_optimal_f1_stats", "summarize_values",
     "three_view_optimal_f1_stats",

@@ -21,7 +21,33 @@ __all__ = [
     "get_data_for_model_training",
     "call_model_fit_method",
     "call_model_eval_method",
+    "generate_signal_from_sequential_factor_model",
 ]
+
+
+def generate_signal_from_sequential_factor_model(model, params, x0,
+                                                 sim_steps):
+    """Autoregressive signal generation from a trained factor model
+    (ref general_utils/model_utils.py:316-336): starting from the context
+    window ``x0`` (B, context, C), predict one step, slide the window, and
+    repeat for ``sim_steps`` — as one ``lax.scan`` instead of the
+    reference's Python loop over device tensors. Works with any model whose
+    ``forward(params, window)`` returns the simulated steps first (REDCLIFF
+    variants, cMLP_FM/cLSTM_FM). Returns (B, sim_steps, C)."""
+    import jax.numpy as jnp
+
+    x0 = jnp.asarray(x0)
+
+    def step(window, _):
+        out = model.forward(params, window)
+        sims = out[0] if isinstance(out, tuple) else out
+        pred = sims[:, 0, :]
+        window = jnp.concatenate([window[:, 1:, :], pred[:, None, :]],
+                                 axis=1)
+        return window, pred
+
+    _, preds = jax.lax.scan(step, x0, None, length=sim_steps)
+    return jnp.transpose(preds, (1, 0, 2))
 
 
 def _coeff(args_dict, key, default=0.0):
