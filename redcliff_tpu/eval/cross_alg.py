@@ -26,7 +26,36 @@ __all__ = [
     "find_run_directory",
     "evaluate_algorithm_on_fold",
     "run_cross_algorithm_comparison",
+    "read_in_true_causal_graphs_for_all_datasets",
 ]
+
+
+def read_in_true_causal_graphs_for_all_datasets(dataset_names,
+                                                files_of_cached_data_args,
+                                                data_vis_root_save_path=None):
+    """Load every dataset's true per-factor GC tensors from its cached-args
+    file, optionally writing ground-truth visualization folders
+    (ref eval_utils.py:25-42). Returns [per-dataset [factor tensors]] in
+    dataset order."""
+    from ..utils.config import load_true_gc_factors
+
+    true_causal_graphs = []
+    for dset_name, dset_args in zip(dataset_names,
+                                    files_of_cached_data_args):
+        factors = load_true_gc_factors(dset_args)
+        if data_vis_root_save_path is not None:
+            vis_dir = os.path.join(data_vis_root_save_path, dset_name)
+            os.makedirs(vis_dir, exist_ok=True)
+            try:
+                from ..utils.plotting import \
+                    plot_gc_est_comparisons_by_factor
+                plot_gc_est_comparisons_by_factor(
+                    factors, None,
+                    os.path.join(vis_dir, "true_gc_factors.png"))
+            except ImportError:
+                pass
+        true_causal_graphs.append(factors)
+    return true_causal_graphs
 
 # ref eval_sysOptF1...py:75-87
 ALL_POSSIBLE_ALGORITHMS = [

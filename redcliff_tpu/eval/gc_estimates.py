@@ -9,7 +9,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["get_model_gc_estimates", "get_model_gc_score_estimates"]
+__all__ = ["get_model_gc_estimates", "get_model_gc_score_estimates",
+           "get_combined_gc_representations_across_factors"]
 
 
 def _np_list(graphs):
@@ -97,3 +98,14 @@ def get_model_gc_score_estimates(model, params, model_type,
                                  "DYNOTEARS", "NAVAR")):
         return np.ones(num_ests_required)
     raise NotImplementedError(f"unrecognized model_type: {model_type!r}")
+
+
+def get_combined_gc_representations_across_factors(estimated_gcs, true_gcs):
+    """Element-wise sums of the per-factor estimates and truths — the
+    "system graph" view used by combined-representation analyses
+    (ref eval_utils.py:884-891). Returns (combo_est, combo_true)."""
+    combo_true = np.sum([np.asarray(t, dtype=np.float64) for t in true_gcs],
+                        axis=0)
+    combo_est = np.sum([np.asarray(e, dtype=np.float64)
+                        for e in estimated_gcs], axis=0)
+    return combo_est, combo_true

@@ -26,7 +26,7 @@ import re
 
 import numpy as np
 
-from ..utils.config import read_in_data_args
+from ..utils.config import load_true_gc_factors
 from ..utils.metrics import (
     compute_cosine_similarity,
     compute_mse,
@@ -115,7 +115,9 @@ def evaluate_fold_system_level(est_gcs, true_gcs, eps=0.1,
             unsupervised_start_index=unsupervised_start_index,
             return_sorting_inds=True)
         u = unsupervised_start_index
-        tail = [None] * (len(ests) - u)
+        # slots sized by TRUTH count (as in misc.sort_unsupervised_estimates:
+        # a truth index from the assignment can exceed the estimate count)
+        tail = [None] * (len(trues) - u)
         for est_ind, gt_ind in zip(matched_est, matched_true):
             tail[gt_ind] = ests[u + est_ind]
         leftover = [ests[u + i] for i in range(len(ests) - u)
@@ -185,10 +187,7 @@ def _aggregate_folds(fold_stats):
 
 
 def _true_graphs_from_args(data_args_file, model_type):
-    args = read_in_data_args({"model_type": model_type,
-                              "data_cached_args_file": data_args_file},
-                             read_in_gc_factors_for_eval=True)
-    return args["true_GC_factors"]
+    return load_true_gc_factors(data_args_file, model_type=model_type)
 
 
 def evaluate_system_level_cv(model_type, trained_models_root_path,
@@ -236,8 +235,14 @@ def evaluate_system_level_cv(model_type, trained_models_root_path,
             est_gcs = get_model_gc_estimates(model, params, model_type,
                                              len(true_gcs), X=X)
             # token-less run dirs get a position-derived string key so they
-            # can never collide with a real fold's integer key
+            # can never collide with a real fold's integer key; duplicate
+            # fold tokens (e.g. a rerun directory) keep both results under
+            # disambiguated keys instead of silently overwriting
             key = fold if fold is not None else f"pos_{pos}"
+            if key in fold_stats:
+                print(f"evaluate_system_level_cv: duplicate run for fold "
+                      f"{key!r} ({run_dir}); keeping both", flush=True)
+                key = f"{key}_pos{pos}"
             fold_stats[key] = evaluate_fold_system_level(est_gcs, true_gcs,
                                                          **options)
         agg = _aggregate_folds(fold_stats)

@@ -12,7 +12,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["grid_mesh", "shard_leading_axis", "replicated", "P", "Mesh"]
+__all__ = ["grid_mesh", "shard_leading_axis", "replicated",
+           "shard_factor_axis", "P", "Mesh"]
 
 
 def grid_mesh(n_devices=None, axis_name="grid", devices=None):
@@ -30,3 +31,24 @@ def shard_leading_axis(mesh, axis_name="grid"):
 
 def replicated(mesh):
     return NamedSharding(mesh, P())
+
+
+def shard_factor_axis(params, mesh, axis_name=None):
+    """Expert-style factor parallelism (SURVEY §2.8): the K factor networks
+    are structurally a dense MoE, so their stacked parameters (leading K
+    axis on every ``params["factors"]`` leaf) shard across the mesh like
+    experts, while the embedder replicates.  XLA then partitions the
+    vmapped per-factor einsums and inserts the psum at the mixture sum.
+
+    K must be divisible by the mesh size.  ``axis_name`` defaults to the
+    mesh's (single) axis, so any 1-D mesh works regardless of its name."""
+    axis_name = mesh.axis_names[0] if axis_name is None else axis_name
+    fac_sh = NamedSharding(mesh, P(axis_name))
+    rep = NamedSharding(mesh, P())
+    out = dict(params)
+    out["factors"] = jax.tree.map(
+        lambda x: jax.device_put(x, fac_sh), params["factors"])
+    for key, sub in params.items():
+        if key != "factors":
+            out[key] = jax.tree.map(lambda x: jax.device_put(x, rep), sub)
+    return out

@@ -176,17 +176,31 @@ class RedcliffTrainer:
 
     # --------------------------------------------------------------------- fit
     def fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
-            resume=True) -> RedcliffFitResult:
+            resume=True, factor_mesh=None) -> RedcliffFitResult:
+        """``factor_mesh`` shards the K factor networks across the mesh like
+        experts (parallel.mesh.shard_factor_axis) — XLA partitions the
+        per-factor compute and inserts the psum at the mixture sum. K must
+        divide by the mesh size."""
         with profiler_trace(self.config.profile_dir):
             return self._fit(params, train_ds, val_ds, true_GC=true_GC,
-                             save_dir=save_dir, resume=resume)
+                             save_dir=save_dir, resume=resume,
+                             factor_mesh=factor_mesh)
 
     def _fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
-             resume=True) -> RedcliffFitResult:
+             resume=True, factor_mesh=None) -> RedcliffFitResult:
         model, cfg = self.model, self.model.config
         tc = self.config
         self._true_GC = true_GC
         rng = np.random.default_rng(tc.seed)
+        if factor_mesh is not None:
+            from redcliff_tpu.parallel.mesh import shard_factor_axis
+
+            assert cfg.num_factors % factor_mesh.devices.size == 0, (
+                f"num_factors {cfg.num_factors} must divide by the factor "
+                f"mesh size {factor_mesh.devices.size}")
+            params = shard_factor_axis(params, factor_mesh)
+        # optax init zeros_like the (possibly sharded) params, so optimizer
+        # state inherits the factor sharding automatically
         optA_state = self.optA.init(params["embedder"])
         optB_state = self.optB.init(params["factors"])
         mode = cfg.training_mode
@@ -234,6 +248,25 @@ class RedcliffTrainer:
             aligned = ck.get("aligned", False)
             if tracker is not None and ck.get("tracker_state") is not None:
                 tracker.__dict__.update(ck["tracker_state"])
+            if factor_mesh is not None:
+                # checkpoints hold plain numpy: re-apply the factor sharding
+                # to every resumed tree or the run would silently continue
+                # unsharded (and per-device memory sized for 1/N factors
+                # would overflow on real chips)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                params = shard_factor_axis(params, factor_mesh)
+                best_params = shard_factor_axis(best_params, factor_mesh)
+                accepted = shard_factor_axis(accepted, factor_mesh)
+                fac_sh = NamedSharding(factor_mesh,
+                                       PartitionSpec(
+                                           factor_mesh.axis_names[0]))
+                rep = NamedSharding(factor_mesh, PartitionSpec())
+                put = lambda sh: (lambda x: jax.device_put(x, sh)
+                                  if hasattr(x, "ndim") and x.ndim > 0
+                                  else x)
+                optB_state = jax.tree.map(put(fac_sh), optB_state)
+                optA_state = jax.tree.map(put(rep), optA_state)
 
         last_it = iter_start - 1
         logger = MetricLogger(save_dir)

@@ -23,7 +23,20 @@ __all__ = [
     "read_in_data_adjacency_matrices",
     "read_in_model_args",
     "read_in_data_args",
+    "load_true_gc_factors",
 ]
+
+
+def load_true_gc_factors(data_cached_args_file,
+                         model_type="REDCLIFF_S_CMLP"):
+    """The per-dataset true factor graphs from a cached-args file — the one
+    place the eval layer goes through the cached-args truth contract
+    (``model_type`` only selects the parsing schema; the default reads the
+    most generic format, ref eval_utils.py:33)."""
+    args = read_in_data_args({"model_type": model_type,
+                              "data_cached_args_file": data_cached_args_file},
+                             read_in_gc_factors_for_eval=True)
+    return args["true_GC_factors"]
 
 
 def parse_input_list_of_ints(list_string):

@@ -123,3 +123,63 @@ def test_evaluate_system_level_cv_and_gs(tmp_path):
     # the fold-0 run was built from fold 0's truth: it must outscore fold 1's
     assert (gs["dyno_data_fold0_run"]["normal"]["cos_sim"][0]
             >= gs["dyno_data_fold1_run"]["normal"]["cos_sim"][0])
+
+
+def test_combined_gc_and_true_graph_loader(tmp_path):
+    """Small eval helpers: combined system-graph view (ref :884-891) and the
+    all-datasets truth loader (ref :25-42)."""
+    from redcliff_tpu.eval.cross_alg import (
+        read_in_true_causal_graphs_for_all_datasets)
+    from redcliff_tpu.eval.gc_estimates import (
+        get_combined_gc_representations_across_factors)
+
+    ests = [np.ones((3, 3)), 2 * np.ones((3, 3))]
+    trues = [np.eye(3), np.eye(3)]
+    ce, ct = get_combined_gc_representations_across_factors(ests, trues)
+    np.testing.assert_array_equal(ce, 3 * np.ones((3, 3)))
+    np.testing.assert_array_equal(ct, 2 * np.eye(3))
+
+    fold_dir, graphs = curate_synthetic_fold(
+        str(tmp_path / "data"), fold_id=0, num_nodes=4, num_factors=2,
+        num_samples_in_train_set=2, num_samples_in_val_set=2,
+        sample_recording_len=15, folder_name="toySys")
+    args_file = os.path.join(fold_dir, "data_fold0_cached_args.txt")
+    loaded = read_in_true_causal_graphs_for_all_datasets(
+        ["data_fold0"], [args_file], str(tmp_path / "vis"))
+    assert len(loaded) == 1 and len(loaded[0]) == 2
+    np.testing.assert_allclose(np.asarray(loaded[0][0]).sum(),
+                               np.asarray(graphs[0]).sum(), rtol=1e-6)
+    assert (tmp_path / "vis" / "data_fold0" / "true_gc_factors.png").exists()
+
+
+def test_sort_with_more_truths_than_estimates():
+    """Slot list sizes by the truth count (regression: IndexError when a
+    truth index from the Hungarian assignment exceeds the estimate count)."""
+    rng = np.random.default_rng(3)
+    trues = [(rng.uniform(size=(4, 4, 2)) > 0.6).astype(float)
+             for _ in range(3)]
+    ests = [t.sum(axis=2) for t in trues[:2]]
+    out = evaluate_fold_system_level(ests, trues,
+                                     sort_unsupervised_ests=True)
+    # zip truncates to the estimate count; all values finite
+    assert len(out["normal"]["cos_sim"]) == 2
+    assert np.all(np.isfinite(out["normal"]["cos_sim"]))
+
+
+def test_cv_duplicate_fold_runs_kept(tmp_path):
+    """Two run dirs with the same fold token both survive aggregation under
+    disambiguated keys (regression: silent overwrite)."""
+    fold_dir, graphs = curate_synthetic_fold(
+        str(tmp_path / "data"), fold_id=0, num_nodes=4, num_factors=2,
+        num_samples_in_train_set=2, num_samples_in_val_set=2,
+        sample_recording_len=15, folder_name="toySys")
+    args_file = os.path.join(fold_dir, "data_fold0_cached_args.txt")
+    root = tmp_path / "DYNOTEARS_Vanilla_models"
+    truth0 = np.asarray(graphs[0]).sum(axis=2)
+    _write_dyno_run(str(root / "dyno_data_fold0_run"), truth0 + 0.01)
+    _write_dyno_run(str(root / "dyno_data_fold0_retry"), truth0 + 0.02)
+    out = evaluate_system_level_cv(
+        "DYNOTEARS_Vanilla", str(root), ["data"], [args_file],
+        str(tmp_path / "eval"))
+    by_fold = out["data"]["normal"]["cos_sim"]["by_fold"]
+    assert len(by_fold) == 2  # both runs kept
