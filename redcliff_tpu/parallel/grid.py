@@ -26,6 +26,7 @@ from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
+from redcliff_tpu.utils.precision import matmul_precision_ctx
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
 
@@ -142,13 +143,17 @@ class RedcliffGridRunner:
         model = self.model
         need_gc, need_gc_lagged = self._need_gc, self._need_gc_lagged
 
+        precision = self.tc.matmul_precision
+
         def point_step(params, optA_state, optB_state, coeffs, active, X, Y, phase):
             def loss_fn(p):
                 return model.loss_for_phase(
                     p, X, Y, phase, coeffs=coeffs,
                     need_gc=need_gc, need_gc_lagged=need_gc_lagged)
 
-            (combo, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            with matmul_precision_ctx(precision):
+                (combo, _), grads = jax.value_and_grad(loss_fn,
+                                                       has_aux=True)(params)
 
             def apply_group(group, grads_g, opt, opt_state, lr, wd):
                 g = jax.tree.map(lambda gr, pa: gr + wd * pa, grads_g, params[group])
@@ -174,9 +179,10 @@ class RedcliffGridRunner:
             return new, optA_state, optB_state, combo
 
         def point_val(params, coeffs, X, Y):
-            combo, parts = model.loss_for_phase(
-                params, X, Y, "combined", coeffs=coeffs,
-                need_gc=need_gc, need_gc_lagged=need_gc_lagged)
+            with matmul_precision_ctx(precision):
+                combo, parts = model.loss_for_phase(
+                    params, X, Y, "combined", coeffs=coeffs,
+                    need_gc=need_gc, need_gc_lagged=need_gc_lagged)
             # stopping criteria: factor + forecast terms with coefficients divided
             # out (ref :1683-1703, :1466-1538)
             f = parts["forecasting_loss"] / jnp.maximum(coeffs["forecast_coeff"], 1e-12)
@@ -200,9 +206,12 @@ class RedcliffGridRunner:
         self._freeze_by_batch = "FreezeByBatch" in mode
         self._freeze = "Freeze" in mode
         if self._freeze:
+            def freeze_point(c, a):
+                with matmul_precision_ctx(precision):
+                    return apply_freeze(model, mode, c, a)
+
             self._freeze_step = jax.jit(
-                jax.vmap(lambda c, a: apply_freeze(model, mode, c, a),
-                         in_axes=(0, 0)),
+                jax.vmap(freeze_point, in_axes=(0, 0)),
                 donate_argnums=(0, 1))
         self._val = jax.jit(jax.vmap(point_val, in_axes=(0, 0, None, None)))
 

@@ -33,6 +33,7 @@ from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
+from redcliff_tpu.utils.precision import matmul_precision_ctx
 
 __all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
 
@@ -58,6 +59,11 @@ class RedcliffTrainConfig:
     unsupervised_start_index: int = 0
     max_samples_for_gc_tracking: int = 40  # ref MAX_NUM_SAMPS_FOR_GC_PROGRESS_TRACKING
     profile_dir: str | None = None  # opt-in jax.profiler trace output dir
+    # matmul precision for every jit'd step (train/eval/label-pred/freeze,
+    # forward + backward): None = backend default; "bfloat16" runs MXU
+    # passes in bf16 (params stay f32) — the standard TPU speed/accuracy
+    # trade for models whose loss tolerates it
+    matmul_precision: str | None = None
 
 
 @dataclass
@@ -99,11 +105,15 @@ class RedcliffTrainer:
     def _build_steps(self):
         model = self.model
 
+        precision = self.config.matmul_precision
+
         def make_step(phase):
             def step(params, optA_state, optB_state, X, Y):
-                (combo, parts), grads = jax.value_and_grad(
-                    lambda p: model.loss_for_phase(p, X, Y, phase), has_aux=True
-                )(params)
+                with matmul_precision_ctx(precision):
+                    (combo, parts), grads = jax.value_and_grad(
+                        lambda p: model.loss_for_phase(p, X, Y, phase),
+                        has_aux=True,
+                    )(params)
                 if phase == "embedder_pretrain":
                     upd, optA_state = self.optA.update(
                         grads["embedder"], optA_state, params["embedder"])
@@ -132,21 +142,27 @@ class RedcliffTrainer:
             self._steps[phase] = make_step(phase)
 
         def eval_loss(params, X, Y):
-            return model.loss_for_phase(params, X, Y, "combined")
+            with matmul_precision_ctx(precision):
+                return model.loss_for_phase(params, X, Y, "combined")
 
         self._eval_loss = jax.jit(eval_loss)
 
         def label_preds_fn(params, X):
             W = model.config.max_lag
-            _, _, _, label_preds = model.forward(params, X[:, :W, :])
+            with matmul_precision_ctx(precision):
+                _, _, _, label_preds = model.forward(params, X[:, :W, :])
             return label_preds[0]
 
         self._label_preds = jax.jit(label_preds_fn)
 
         # freeze choreography shared with the grid engine (train/freeze.py)
-        self._freeze_step = jax.jit(
-            lambda c, a: apply_freeze(model, model.config.training_mode, c, a)
-        ) if "Freeze" in model.config.training_mode else None
+        def freeze_fn(c, a):
+            with matmul_precision_ctx(precision):
+                return apply_freeze(model, model.config.training_mode, c, a)
+
+        self._freeze_step = (jax.jit(freeze_fn)
+                             if "Freeze" in model.config.training_mode
+                             else None)
 
     # --------------------------------------------------------------- alignment
     def align_factors_with_labels(self, params, train_ds):

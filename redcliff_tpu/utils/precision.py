@@ -1,0 +1,20 @@
+"""Matmul precision control for jit'd steps.
+
+TPU MXUs run matmuls fastest in bfloat16; parameters stay f32 and only the
+contraction precision drops — the standard speed/accuracy trade. The context
+applies at trace time, so wrapping a step body inside its jit covers the
+forward and (because grad is traced inside it) the backward pass.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["matmul_precision_ctx"]
+
+
+def matmul_precision_ctx(precision):
+    """``jax.default_matmul_precision`` context; ``None`` is a no-op."""
+    return (jax.default_matmul_precision(precision) if precision
+            else contextlib.nullcontext())
