@@ -61,14 +61,19 @@ class ArrayDataset:
         return self.X.shape[1]
 
     def _device_arrays(self, sharding=None):
+        # keyed by sharding so a dataset shared between a single-device
+        # trainer and a mesh grid runner keeps one correctly-placed copy per
+        # placement instead of silently reusing the first caller's
         if self._dev is None:
+            self._dev = {}
+        if sharding not in self._dev:
             import jax
 
             put = ((lambda a: jax.device_put(a, sharding))
                    if sharding is not None else jax.numpy.asarray)
-            self._dev = (put(self.X),
-                         None if self.Y is None else put(self.Y))
-        return self._dev
+            self._dev[sharding] = (put(self.X),
+                                   None if self.Y is None else put(self.Y))
+        return self._dev[sharding]
 
     def batches(self, batch_size, rng=None, drop_remainder=False,
                 device=False, sharding=None):
@@ -85,7 +90,9 @@ class ArrayDataset:
         ``sharding`` (used with ``device=True``) places the cached copy with
         that sharding — pass a replicated mesh sharding so batch gathers for
         mesh-sharded programs stay on-device with no per-step resharding.
-        The cache is built once: the first caller's sharding wins.
+        One cached copy is kept per distinct sharding (None included), so
+        mixed single-device and mesh callers each get a correctly-placed
+        copy.
         """
         n = len(self.X)
         idx = np.arange(n)

@@ -114,32 +114,38 @@ def _regime_segments(samples, regime, min_len):
     return segments
 
 
-def run_discovery_algorithm(samples, alg_name, maxlags=1, pcmci_kwargs=None,
-                            prepared=None):
+def run_discovery_algorithm(samples, alg_name, maxlags=None,
+                            pcmci_kwargs=None, prepared=None):
     """Per-regime GC score matrices from one discovery algorithm
     (ref run_tidybench_experiment :197-214).  Returns [pred (N, N)] indexed
     by regime.  ``prepared`` accepts a prepare_data_for_modeling result so
-    multi-algorithm sweeps concatenate the windows once."""
+    multi-algorithm sweeps concatenate the windows once.
+
+    ``maxlags`` defaults per algorithm to the reference's Table-2 settings:
+    1 for the tidybench family and tau_max=2 for PCMCI (ref
+    eval_algsT_...py:120).  An explicitly passed value is honored for every
+    algorithm, PCMCI included."""
     if prepared is None:
         prepared = prepare_data_for_modeling(samples)
     data, _, masks, _, _, N, num_regimes = prepared
+    lags = 1 if maxlags is None else maxlags
     preds = []
     for r in range(num_regimes):
         if alg_name == "slarac":
-            raw = slarac(data * masks[r], maxlags=maxlags,
+            raw = slarac(data * masks[r], maxlags=lags,
                          post_standardise=True)
         elif alg_name == "qrbs":
-            raw = qrbs(data * masks[r], lags=maxlags, post_standardise=True)
+            raw = qrbs(data * masks[r], lags=lags, post_standardise=True)
         elif alg_name == "lasar":
-            raw = lasar(data * masks[r], maxlags=maxlags,
+            raw = lasar(data * masks[r], maxlags=lags,
                         post_standardise=True)
         elif alg_name == "selvar":
-            raw = selvar(data * masks[r], maxlags=maxlags)
+            raw = selvar(data * masks[r], maxlags=lags)
         elif alg_name == "PCMCI":
             # reference Table-2 setup: tau_max=2, pc_alpha=0.2,
             # alpha_level=0.01 (ref eval_algsT_...py:120)
-            kw = dict(tau_max=max(maxlags, 2), pc_alpha=0.2,
-                      alpha_level=0.01)
+            kw = dict(tau_max=2 if maxlags is None else maxlags,
+                      pc_alpha=0.2, alpha_level=0.01)
             kw.update(pcmci_kwargs or {})
             graph_alpha = kw.get("alpha_level", 0.01)
             segs = _regime_segments(samples, r, min_len=kw["tau_max"])
@@ -218,12 +224,14 @@ def run_supervised_discovery_evaluation(samples, true_gc_factors,
                                         algorithms=("slarac", "qrbs",
                                                     "lasar", "selvar",
                                                     "PCMCI"),
-                                        maxlags=1, save_path=None,
+                                        maxlags=None, save_path=None,
                                         transpose_predictions=True,
                                         pcmci_kwargs=None):
     """End-to-end Table-2 evaluation: binarize/diag-mask the true factor
     graphs (ref :250-258), run every algorithm per regime, score.  Returns
-    {alg: {"preds": [...], "stats": {...}}} and optionally pickles it."""
+    {alg: {"preds": [...], "stats": {...}}} and optionally pickles it.
+    ``maxlags=None`` keeps each algorithm's reference default (tidybench 1,
+    PCMCI tau_max=2)."""
     true_graphs = []
     for g in true_gc_factors:
         g = np.asarray(g, dtype=np.float64)

@@ -122,28 +122,36 @@ def evaluate_fold_system_level(est_gcs, true_gcs, eps=0.1,
             tail[gt_ind] = ests[u + est_ind]
         leftover = [ests[u + i] for i in range(len(ests) - u)
                     if i not in matched_est]
-        ests = ests[:u] + [t for t in tail if t is not None] + leftover
+        # keep None placeholders for unmatched truths so positional pairing
+        # with `trues` stays aligned; the scoring loop skips them
+        ests = ests[:u] + tail + leftover
     if evaluate_identity_baseline:
         # overwrite with identity, keeping each estimate's rank (ref :1251)
-        ests = [np.eye(e.shape[0])[:, :, None] if e.ndim == 3
+        ests = [None if e is None
+                else np.eye(e.shape[0])[:, :, None] if e.ndim == 3
                 else np.eye(e.shape[0]) for e in ests]
     if exclude_self_connections:
         # estimates only — the reference never masks the truth (ref :1255)
-        ests = [e * (1.0 - (np.eye(e.shape[0])[:, :, None] if e.ndim == 3
-                            else np.eye(e.shape[0]))) for e in ests]
+        ests = [None if e is None
+                else e * (1.0 - (np.eye(e.shape[0])[:, :, None] if e.ndim == 3
+                                 else np.eye(e.shape[0]))) for e in ests]
     if not evaluate_identity_baseline:
         # full-tensor max BEFORE lag-summing (ref :1260); zero-max guarded
         # (the reference would emit NaNs there)
-        ests = [e / np.max(e) if np.max(e) > 0 else e for e in ests]
-    if average_estimated_graphs_together and len(ests) > len(trues):
+        ests = [None if e is None
+                else e / np.max(e) if np.max(e) > 0 else e for e in ests]
+    live_ests = [e for e in ests if e is not None]
+    if average_estimated_graphs_together and len(live_ests) > len(trues):
         assert len(trues) == 1, (
             "averaging estimates together requires exactly one true graph "
             "(ref :1265)")
-        ests = [np.mean(ests, axis=0)]
+        ests = [np.mean(live_ests, axis=0)]
 
     out = {"normal": {k: [] for k in METRIC_KEYS},
            "transposed": {k: [] for k in METRIC_KEYS}}
     for true_gc, gc_est in zip(trues, ests):
+        if gc_est is None:  # truth left unmatched by the Hungarian sort
+            continue
         # lag-summed comparison only, for fairness between lagged and
         # non-lagged estimators (ref :1277-1280)
         if true_gc.ndim == 3:

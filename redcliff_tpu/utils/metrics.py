@@ -173,7 +173,15 @@ def compute_negative_likelihood_ratio(labels, preds, pred_cutoff=None):
 # ---------------------------------------------------------------------------
 
 def matsusita_distance(S1, S2):
-    """sqrt(sum((sqrt(S1)-sqrt(S2))^2)) — eq. 7.3 (ref metrics.py:130-134)."""
+    """sqrt(sum((sqrt(S1)-sqrt(S2))^2)) — eq. 7.3 (ref metrics.py:130-134).
+
+    Deliberate deviation from the reference: affinity matrices from signed
+    (negative-valued) graph estimates produce negative entries, where the
+    reference silently emits NaN; we clamp entries at zero before the sqrt
+    so the distance stays finite (negative affinity ~ zero similarity mass).
+    """
+    S1 = np.maximum(np.asarray(S1, dtype=np.float64), 0.0)
+    S2 = np.maximum(np.asarray(S2, dtype=np.float64), 0.0)
     return float(np.sqrt(np.sum((np.sqrt(S1) - np.sqrt(S2)) ** 2.0)))
 
 

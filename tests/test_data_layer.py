@@ -352,8 +352,18 @@ def test_array_dataset_device_batches_match_host():
         assert isinstance(dx, jax.Array)
         np.testing.assert_array_equal(hx, np.asarray(dx))
         np.testing.assert_array_equal(hy, np.asarray(dy))
-    # the device cache is built once and reused across epochs
+    # the device cache is built once per sharding and reused across epochs
     assert ds._dev is not None
-    first = ds._dev
+    first = ds._dev[None]
     list(ds.batches(4, device=True))
-    assert ds._dev is first
+    assert ds._dev[None] is first
+    # a mesh-sharded caller gets its own correctly-placed copy instead of
+    # silently reusing the unsharded cache (regression)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("grid",))
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    shard_batches = list(ds.batches(4, rng=np.random.default_rng(7),
+                                    device=True, sharding=repl))
+    assert ds._dev[repl][0].sharding.is_equivalent_to(repl, X.ndim)
+    assert ds._dev[None] is first  # unsharded cache untouched
+    for (hx, hy), (sx, sy) in zip(host, shard_batches):
+        np.testing.assert_array_equal(hx, np.asarray(sx))

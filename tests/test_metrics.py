@@ -83,6 +83,21 @@ def test_deltacon0_decreases_with_perturbation():
     assert 0 < s_large < s_small < 1
 
 
+def test_deltacon0_finite_on_signed_graphs():
+    """Signed (negative-valued) estimates yield negative affinity entries;
+    the reference NaNs there — we clamp at zero so the whole DeltaCon0
+    family stays finite (documented deviation in matsusita_distance)."""
+    rng = np.random.default_rng(5)
+    A = rng.normal(size=(6, 6))  # signed entries
+    B = (rng.uniform(size=(6, 6)) > 0.5).astype(float)
+    with np.errstate(invalid="raise"):
+        d = M.matsusita_distance(A - 0.5, B - 0.5)
+        s = M.deltacon0(A, B, eps=0.1)
+        sdd = M.deltacon0_with_directed_degrees(A, B, eps=0.1)
+        daf = M.deltaffinity(A, B, eps=0.1)
+    assert np.isfinite([d, s, sdd, daf]).all()
+
+
 def test_deltacon0_hand_computed_two_node():
     # two nodes, single directed edge vs empty graph, eps=0.5
     A = np.array([[0.0, 1.0], [0.0, 0.0]])

@@ -70,7 +70,9 @@ def test_standardized_off_diagonal_predictions():
 def test_run_discovery_algorithm_shapes(alg):
     rng = np.random.default_rng(1)
     samples = _two_regime_samples(rng, num_windows=4, T=60)
-    preds = run_discovery_algorithm(samples, alg, maxlags=1)
+    # maxlags=None keeps each algorithm's reference default
+    # (tidybench 1, PCMCI tau_max=2)
+    preds = run_discovery_algorithm(samples, alg)
     assert len(preds) == 2
     for p in preds:
         assert p.shape == (3, 3)
@@ -104,7 +106,7 @@ def test_end_to_end_discovery_recovers_regimes():
     rng = np.random.default_rng(3)
     samples = _two_regime_samples(rng, num_windows=10, T=150)
     results = run_supervised_discovery_evaluation(
-        samples, _true_graphs(), algorithms=("slarac", "PCMCI"), maxlags=1)
+        samples, _true_graphs(), algorithms=("slarac", "PCMCI"))
     for alg in ("slarac", "PCMCI"):
         s = results[alg]["stats"]
         # each regime's driving edge should be recovered well above chance

@@ -161,9 +161,34 @@ def test_sort_with_more_truths_than_estimates():
     ests = [t.sum(axis=2) for t in trues[:2]]
     out = evaluate_fold_system_level(ests, trues,
                                      sort_unsupervised_ests=True)
-    # zip truncates to the estimate count; all values finite
+    # unmatched truths are skipped, matched pairs are scored
     assert len(out["normal"]["cos_sim"]) == 2
     assert np.all(np.isfinite(out["normal"]["cos_sim"]))
+
+
+def test_sort_pairs_estimates_with_matched_truths():
+    """When the Hungarian assignment matches estimates to truths {0, 2},
+    the estimate matched to truth 2 must be scored against truth 2, not
+    compacted onto unmatched truth 1 (regression: silent mispairing when
+    fewer estimates than truths).
+
+    The matcher replicates the reference's scipy-minimize-over-cosine
+    behavior, so the chosen pairs are the LOWEST-similarity ones: with
+    e0 = t0+t1 and e1 = t1+t2 the optimal assignment is e0->t2, e1->t0
+    (both cost 0), leaving t1 unmatched."""
+    base = np.zeros((4, 4))
+    t0 = base.copy(); t0[0, 1] = 1.0
+    t1 = base.copy(); t1[1, 2] = 1.0
+    t2 = base.copy(); t2[2, 3] = 1.0
+    trues = [t0, t1, t2]
+    ests = [t0 + t1, t1 + t2]
+    out = evaluate_fold_system_level(ests, trues,
+                                     sort_unsupervised_ests=True)
+    assert len(out["normal"]["cos_sim"]) == 2
+    # correct pairing scores (t0 vs e1) and (t2 vs e0): cosine 0, MSE 3/16.
+    # the old compacting behavior scored (t1 vs e0): cosine ~0.707, MSE 1/16
+    np.testing.assert_allclose(out["normal"]["cos_sim"], 0.0, atol=1e-12)
+    np.testing.assert_allclose(out["normal"]["mse"], 3.0 / 16.0, atol=1e-12)
 
 
 def test_cv_duplicate_fold_runs_kept(tmp_path):
