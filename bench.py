@@ -5,8 +5,9 @@ Prints ONE JSON line:
 
 value        — training-window throughput (windows/sec/chip) of the vmapped
                hyperparameter-grid REDCLIFF-S train step at the headline grid
-               size (G grid points trained simultaneously — this framework's
-               execution model).
+               size, driven through the lax.scan k-batch dispatch (one host
+               dispatch per k batches — the framework's production execution
+               mode; parallel/grid.py scan_batches).
 vs_baseline  — speedup over the reference's execution pattern on the SAME chip:
                one jit'd train step per grid point, stepped sequentially
                (the SLURM-array one-process-per-point pattern of
@@ -15,19 +16,24 @@ vs_baseline  — speedup over the reference's execution pattern on the SAME chip
                true advantage over the reference's per-factor Python loops).
 
 Extra context fields (so "fast" is judgeable against hardware capability):
-  flops_per_step — XLA cost-analysis FLOPs of one compiled grid step
-  mfu_pct        — implied chip utilization vs the device's dense peak
-  g_scaling      — {G: windows/s} curve over grid sizes
-  device / error — backend actually used; error is non-null if the TPU was
-                   unavailable and the bench fell back to CPU
+  flops_per_step  — XLA cost-analysis FLOPs of one compiled per-batch grid step
+  mfu_pct         — chip utilization vs dense peak, from the SCANNED dispatch
+                    (dispatch overhead amortized over k batches — honest MFU)
+  g_scaling       — {G: {wps, wps_scan, mfu_pct}} over grid sizes
+  probe_log       — every accelerator probe attempt (the axon TPU tunnel hangs
+                    intermittently for minutes; attempts spread with backoff)
+  device / error  — backend actually used; error non-null if the TPU was
+                    unavailable and the bench fell back to CPU
 
-The reference repository publishes no benchmark numbers (BASELINE.md), so the
+Architecture: the parent process NEVER initializes a jax backend. It probes the
+accelerator in killable subprocesses on a backoff schedule and runs the actual
+measurement in a child process (`bench.py --measure tpu|cpu`), so a tunnel that
+hangs mid-run is killed and retried instead of wedging the bench. The reference
+repository publishes no benchmark numbers (BASELINE.md), so the
 sequential-vs-grid ratio on identical hardware is the honest comparable.
-
-Hardened: backend init failure is caught and retried; the JSON line is ALWAYS
-emitted (with an "error" field when measurement was impossible).
 """
 import json
+import subprocess
 import sys
 import time
 import traceback
@@ -48,25 +54,36 @@ PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+METRIC = "redcliff_s_grid_train_windows_per_sec_per_chip"
+
+# probe schedule: wait this long before each successive attempt (seconds);
+# spread so a minutes-long tunnel outage is sampled at distinct times
+PROBE_WAITS = (0.0, 15.0, 45.0, 105.0, 225.0)
+PROBE_TIMEOUT_S = 75.0
+MEASURE_TIMEOUT_S = 1500.0
+
 
 def _emit(payload):
     print(json.dumps(payload))
     sys.stdout.flush()
 
 
-def _probe_accelerator(timeout_s=240.0):
+# ---------------------------------------------------------------------------
+# parent: probe + orchestrate
+# ---------------------------------------------------------------------------
+def _probe_accelerator(timeout_s=PROBE_TIMEOUT_S):
     """Check in a KILLABLE subprocess whether the accelerator backend can
     initialize: a hung tunnel (observed with the axon TPU backend) would
     otherwise block this process in a C call forever."""
-    import subprocess
-
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; d = jax.devices(); print(d[0].platform)"],
             capture_output=True, text=True, timeout=timeout_s)
-        if r.returncode == 0:
+        if r.returncode == 0 and r.stdout.strip() not in ("", "cpu"):
             return True, r.stdout.strip()
+        if r.returncode == 0:
+            return False, f"no accelerator: backend is {r.stdout.strip()!r}"
         return False, f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
     except subprocess.TimeoutExpired:
         return False, f"accelerator backend init hung > {timeout_s:.0f}s"
@@ -74,37 +91,67 @@ def _probe_accelerator(timeout_s=240.0):
         return False, f"probe failed: {e!r}"
 
 
-def _init_backend():
-    """Initialize a jax backend; probe the accelerator in a subprocess first
-    (retry once), then fall back to CPU. Returns (jax, devices, error_or_None)."""
-    ok, info = _probe_accelerator()
-    if not ok:
-        print(f"bench: accelerator probe failed ({info}); retrying",
-              file=sys.stderr, flush=True)
-        time.sleep(5.0)
-        ok, info = _probe_accelerator()
-
-    import jax
-
-    if not ok:
-        err = f"accelerator backend unavailable ({info}); ran on cpu"
-        try:
-            jax.config.update("jax_platforms", "cpu")
-            return jax, jax.devices(), err
-        except Exception as e:  # pragma: no cover - no backend at all
-            return None, None, f"no jax backend available: {info!r} / {e!r}"
+def _run_measure_child(platform, timeout_s=MEASURE_TIMEOUT_S):
+    """Run the measurement in a child process; return (payload | None, info)."""
     try:
-        return jax, jax.devices(), None
-    except RuntimeError as e:
-        # probe succeeded but in-process init failed; last resort: cpu
+        r = subprocess.run(
+            [sys.executable, __file__, "--measure", platform],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"measurement on {platform} hung > {timeout_s:.0f}s"
+    sys.stderr.write(r.stderr[-4000:])
+    for line in reversed(r.stdout.strip().splitlines()):
         try:
-            jax.config.update("jax_platforms", "cpu")
-            return jax, jax.devices(), f"backend init failed ({e}); ran on cpu"
-        except Exception as e2:
-            return None, None, f"no jax backend available: {e!r} / {e2!r}"
+            payload = json.loads(line)
+            if isinstance(payload, dict) and payload.get("metric") == METRIC:
+                return payload, "ok"
+        except json.JSONDecodeError:
+            continue
+    return None, (f"measurement child on {platform} rc={r.returncode} "
+                  f"emitted no result JSON: {r.stderr.strip()[-300:]}")
 
 
-def _flops_of(jax, compiled):
+def _orchestrate():
+    t0 = time.monotonic()
+    probe_log = []
+    for i, wait in enumerate(PROBE_WAITS):
+        if wait:
+            time.sleep(wait)
+        ok, info = _probe_accelerator()
+        probe_log.append({"attempt": i, "t_offset_s": round(time.monotonic() - t0, 1),
+                          "ok": ok, "info": info})
+        print(f"bench: probe {i} at +{probe_log[-1]['t_offset_s']}s -> {info}",
+              file=sys.stderr, flush=True)
+        if not ok:
+            continue
+        payload, minfo = _run_measure_child("tpu")
+        if payload is not None and payload.get("value"):
+            payload["probe_log"] = probe_log
+            _emit(payload)
+            return
+        # tunnel dropped mid-measurement: log and keep probing
+        probe_log.append({"attempt": i, "t_offset_s": round(time.monotonic() - t0, 1),
+                          "ok": False, "info": f"measure: {minfo}"})
+        print(f"bench: TPU measurement failed ({minfo}); continuing probes",
+              file=sys.stderr, flush=True)
+
+    err = (f"accelerator unavailable across {len(PROBE_WAITS)} spread probe "
+           f"attempts over {round(time.monotonic() - t0)}s; ran on cpu")
+    payload, minfo = _run_measure_child("cpu", timeout_s=900.0)
+    if payload is None:
+        _emit({"metric": METRIC, "value": None, "unit": "windows/s/chip",
+               "vs_baseline": None, "error": f"{err}; then {minfo}",
+               "probe_log": probe_log})
+        return
+    payload["error"] = err
+    payload["probe_log"] = probe_log
+    _emit(payload)
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement
+# ---------------------------------------------------------------------------
+def _flops_of(compiled):
     """XLA cost-analysis FLOPs of a compiled computation (None if unavailable)."""
     try:
         ca = compiled.cost_analysis()
@@ -132,19 +179,23 @@ def _model_config():
     )
 
 
-def _bench_grid(jax, model, G, B, steps):
-    """Throughput (windows/s) + FLOPs/step of the G-point vmapped grid step."""
+def _make_runner(jax, model, G, B):
     from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
 
-    cfg = model.config
     spec = GridSpec(points=[
         {"gen_lr": 1e-3 * (1 + (i % 4)), "adj_l1_reg_coeff": 1e-3 * (i % 2),
          "factor_cos_sim_coeff": 0.05 * (i % 3)}
         for i in range(G)
     ])
-    runner = RedcliffGridRunner(model, RedcliffTrainConfig(batch_size=B), spec,
-                                mesh=None)
+    return RedcliffGridRunner(model, RedcliffTrainConfig(batch_size=B), spec,
+                              mesh=None)
+
+
+def _bench_grid(jax, model, G, B, steps, scan_k):
+    """Per-batch and scanned throughput (+FLOPs) of the G-point grid step."""
+    cfg = model.config
+    runner = _make_runner(jax, model, G, B)
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
     X = jax.device_put(rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32))
@@ -160,8 +211,7 @@ def _bench_grid(jax, model, G, B, steps):
     # wrapper after .lower().compile() would compile a second time — the jit
     # executable cache is not populated by AOT compilation)
     compiled = step.lower(params, optA, optB, coeffs, active, X, Y).compile()
-    flops = _flops_of(jax, compiled)
-
+    flops = _flops_of(compiled)
     p, a, b, _ = compiled(params, optA, optB, coeffs, active, X, Y)  # warm dispatch
     jax.block_until_ready(p)
     t0 = time.perf_counter()
@@ -169,7 +219,32 @@ def _bench_grid(jax, model, G, B, steps):
         p, a, b, _ = compiled(p, a, b, coeffs, active, X, Y)
     jax.block_until_ready(p)
     dt = time.perf_counter() - t0
-    return G * B * steps / dt, flops, dt / steps, runner, (p, a, b, coeffs, X, Y)
+    wps = G * B * steps / dt
+
+    # scanned k-batch dispatch: same update semantics (grid scan test pins
+    # bit-parity), one host dispatch per k batches
+    Xs = jax.numpy.stack([X] * scan_k)
+    Ys = jax.numpy.stack([Y] * scan_k)
+    sstep = runner._scan_steps["combined"]
+    scompiled = sstep.lower(p, a, b, coeffs, active, Xs, Ys).compile()
+    sflops = _flops_of(scompiled)
+    p, a, b, _ = scompiled(p, a, b, coeffs, active, Xs, Ys)  # warm dispatch
+    jax.block_until_ready(p)
+    sdispatches = max(2, steps // scan_k)
+    t0 = time.perf_counter()
+    for _ in range(sdispatches):
+        p, a, b, _ = scompiled(p, a, b, coeffs, active, Xs, Ys)
+    jax.block_until_ready(p)
+    sdt = time.perf_counter() - t0
+    scan_wps = G * B * scan_k * sdispatches / sdt
+    scan_dispatch_s = sdt / sdispatches
+
+    return {
+        "wps": wps, "flops": flops, "step_s": dt / steps,
+        "scan_wps": scan_wps, "scan_flops": sflops,
+        "scan_dispatch_s": scan_dispatch_s,
+        "runner": runner, "state": (p, a, b, coeffs, X, Y),
+    }
 
 
 def _bench_sequential(jax, model, runner, grid_state, G, B, steps):
@@ -214,19 +289,24 @@ def _bench_sequential(jax, model, runner, grid_state, G, B, steps):
     return G * B * steps / dt
 
 
-def main():
-    jax, devices, err = _init_backend()
-    if jax is None:
-        _emit({"metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
-               "value": None, "unit": "windows/s/chip", "vs_baseline": None,
-               "error": err})
-        return
+def _measure(platform):
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if platform == "tpu" and devices[0].platform == "cpu":
+        # the tunnel dropped between the parent's probe and this child's
+        # init and jax fell back to CPU — exit non-zero so the parent keeps
+        # probing instead of publishing a CPU number as the TPU result
+        print("measure child: requested accelerator but backend is cpu",
+              file=sys.stderr, flush=True)
+        raise SystemExit(3)
 
     from redcliff_tpu.models.redcliff import RedcliffSCMLP
 
     dev_kind = devices[0].device_kind
-    platform = devices[0].platform
-    on_cpu = platform == "cpu"
+    on_cpu = devices[0].platform == "cpu"
     model = RedcliffSCMLP(_model_config())
     B = 64
     # headline = the largest grid the bench sweeps: the framework's execution
@@ -234,48 +314,62 @@ def main():
     # model in a fraction of HBM (G-scaling below shows near-linear gains)
     G_HEAD = 16 if on_cpu else 64
     steps = 8 if on_cpu else 30
+    scan_k = 4 if on_cpu else 8
+    peak = PEAK_FLOPS.get(dev_kind)
 
-    # --- G-scaling curve + headline measurement ---------------------------
-    # headline first so a wall-clock-budget bailout still yields the number
     t_start = time.perf_counter()
-    budget_s = 300.0
+    budget_s = 180.0 if on_cpu else 420.0
     g_scaling = {}
     headline = None
-    # each extra G costs one compile (~40s on TPU); keep the sweep small
-    # enough that the whole bench stays well under the driver's time budget
-    extra_g = (1, 4) if on_cpu else (1, 4, 256)
+    # each extra G costs two compiles (~40s each on TPU); keep the sweep small
+    # enough that the whole bench stays under the measurement timeout
+    extra_g = (1, 4) if on_cpu else (1, 4, 128, 256)
     for G in (G_HEAD,) + extra_g:
         if G != G_HEAD and time.perf_counter() - t_start > budget_s:
             print(f"bench: skipping G={G} (wall-clock budget)", file=sys.stderr)
             continue
         print(f"bench: measuring G={G}", file=sys.stderr, flush=True)
-        wps, flops, step_s, runner, state = _bench_grid(jax, model, G, B, steps)
-        g_scaling[str(G)] = round(wps, 1)
+        r = _bench_grid(jax, model, G, B, steps, scan_k)
+        mfu = (100.0 * r["scan_flops"] / r["scan_dispatch_s"] / peak
+               if (r["scan_flops"] and peak and not on_cpu) else None)
+        g_scaling[str(G)] = {
+            "wps": round(r["wps"], 1),
+            "wps_scan": round(r["scan_wps"], 1),
+            "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        }
         if G == G_HEAD:
-            headline = (wps, flops, step_s, runner, state)
+            headline = r
 
-    grid_wps, flops_per_step, step_seconds, runner, grid_state = headline
     seq_steps = max(steps // 3, 3)
-    seq_wps = _bench_sequential(jax, model, runner, grid_state, G_HEAD, B, seq_steps)
+    seq_wps = _bench_sequential(jax, model, headline["runner"],
+                                headline["state"], G_HEAD, B, seq_steps)
 
-    peak = PEAK_FLOPS.get(dev_kind)
-    mfu = (100.0 * flops_per_step / step_seconds / peak
-           if (flops_per_step and peak and not on_cpu) else None)
-
+    mfu_head = (100.0 * headline["scan_flops"] / headline["scan_dispatch_s"]
+                / peak
+                if (headline["scan_flops"] and peak and not on_cpu) else None)
     _emit({
-        "metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
-        "value": round(grid_wps, 1),
+        "metric": METRIC,
+        "value": round(headline["scan_wps"], 1),
         "unit": "windows/s/chip",
-        "vs_baseline": round(grid_wps / seq_wps, 2),
+        "vs_baseline": round(headline["scan_wps"] / seq_wps, 2),
         "device": dev_kind,
-        "platform": platform,
+        "platform": devices[0].platform,
         "grid_points": G_HEAD,
         "batch_size": B,
-        "flops_per_step": flops_per_step,
-        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        "scan_batches": scan_k,
+        "per_step_wps": round(headline["wps"], 1),
+        "flops_per_step": headline["flops"],
+        "mfu_pct": round(mfu_head, 2) if mfu_head is not None else None,
         "g_scaling": g_scaling,
-        "error": err,
+        "error": None,
     })
+
+
+def main():
+    if "--measure" in sys.argv:
+        _measure(sys.argv[sys.argv.index("--measure") + 1])
+        return
+    _orchestrate()
 
 
 if __name__ == "__main__":
@@ -283,7 +377,6 @@ if __name__ == "__main__":
         main()
     except Exception as e:
         traceback.print_exc(file=sys.stderr)
-        _emit({"metric": "redcliff_s_grid_train_windows_per_sec_per_chip",
-               "value": None, "unit": "windows/s/chip", "vs_baseline": None,
-               "error": f"{type(e).__name__}: {e}"})
+        _emit({"metric": METRIC, "value": None, "unit": "windows/s/chip",
+               "vs_baseline": None, "error": f"{type(e).__name__}: {e}"})
         sys.exit(0)

@@ -72,13 +72,19 @@ def _gate(est_A, true_A, caller):
 
 
 def compute_optimal_f1_stats(est_A, true_A):
-    """{"f1", "decision_threshold"} via a best-F1 threshold scan, or {} when
-    the inputs are degenerate (ref :656-679)."""
+    """{"f1", "decision_threshold", "roc_auc"} via a best-F1 threshold scan,
+    or {} when the inputs are degenerate (ref :656-679).
+
+    "roc_auc" is an addition beyond the reference's stats dict (whose
+    function name promises it but only emits f1 — ref :656): the flattened
+    estimate scored against the binarized truth, same convention as the
+    in-training tracking (ref model_utils.py:54-67)."""
     labels = _gate(est_A, true_A, "compute_optimal_f1_stats")
     if labels is None:
         return {}
     thresh, f1 = compute_optimal_f1(labels, np.asarray(est_A).ravel())
-    return {"f1": f1, "decision_threshold": thresh}
+    return {"f1": f1, "decision_threshold": thresh,
+            "roc_auc": roc_auc(labels, np.asarray(est_A).ravel())}
 
 
 def compute_fixed_f1_stats(est_A, true_A, pred_cutoffs=DEFAULT_PRED_CUTOFFS):

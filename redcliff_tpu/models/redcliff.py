@@ -136,7 +136,8 @@ class RedcliffSCMLPConfig:
     # state-smoothing variant (ref redcliff_s_cmlp_withStateSmoothing.py:30,50):
     # coefficient 0 disables the penalty, recovering the base model exactly
     factor_weight_smoothing_penalty_coeff: float = 0.0
-    state_score_smoothing_epsilon: float = 0.01
+    # the reference's ctor default (:23) is never overridden by any driver
+    state_score_smoothing_epsilon: float = 0.0001
 
     def __post_init__(self):
         assert self.factor_network_type in ("cMLP", "cLSTM"), \

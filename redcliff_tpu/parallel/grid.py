@@ -36,18 +36,25 @@ COEFF_AXES = (
     "factor_weight_smoothing_penalty_coeff",
 )
 OPT_AXES = ("embed_lr", "gen_lr", "embed_weight_decay", "gen_weight_decay")
+# per-point stopping-criteria coefficients (the reference mirrors loss coeffs
+# into these in the drivers, ref train/...BSCgs1.py:102-105); fall back to the
+# train config scalars
+STOP_AXES = ("stopping_criteria_forecast_coeff",
+             "stopping_criteria_factor_coeff",
+             "stopping_criteria_cosSim_coeff")
 
 
 @dataclass
 class GridSpec:
     """G hyperparameter points sharing one model shape. Each entry of ``points``
-    maps coefficient/optimizer axis names (COEFF_AXES + OPT_AXES) to floats;
-    unspecified axes fall back to the base config / train config values."""
+    maps coefficient/optimizer/stopping axis names (COEFF_AXES + OPT_AXES +
+    STOP_AXES) to floats; unspecified axes fall back to the base config /
+    train config values."""
 
     points: Sequence[dict]
 
     def __post_init__(self):
-        valid = set(COEFF_AXES) | set(OPT_AXES)
+        valid = set(COEFF_AXES) | set(OPT_AXES) | set(STOP_AXES)
         for i, p in enumerate(self.points):
             unknown = set(p) - valid
             if unknown:
@@ -62,7 +69,7 @@ class GridSpec:
             out[name] = jnp.asarray(
                 [p.get(name, getattr(base_cfg, name)) for p in self.points],
                 dtype=jnp.float32)
-        for name in OPT_AXES:
+        for name in OPT_AXES + STOP_AXES:
             out[name] = jnp.asarray(
                 [p.get(name, getattr(train_cfg, name)) for p in self.points],
                 dtype=jnp.float32)
@@ -183,13 +190,50 @@ class RedcliffGridRunner:
                 combo, parts = model.loss_for_phase(
                     params, X, Y, "combined", coeffs=coeffs,
                     need_gc=need_gc, need_gc_lagged=need_gc_lagged)
-            # stopping criteria: factor + forecast terms with coefficients divided
-            # out (ref :1683-1703, :1466-1538)
-            f = parts["forecasting_loss"] / jnp.maximum(coeffs["forecast_coeff"], 1e-12)
-            fa = parts["factor_loss"] / jnp.maximum(coeffs["factor_score_coeff"], 1e-12)
-            return combo, f + fa
+            # coefficient-normalized stopping-criteria terms (the reference
+            # divides each val part by its loss coefficient "for comparisson
+            # in grid-searches", ref validate_training :1684-1699, mirrored
+            # by RedcliffTrainer.validate); the per-point criteria
+            # combination (stopping coeffs x these means, ref :1466-1538)
+            # happens in _fit so the means aggregate over ALL val batches
+            fo = parts["forecasting_loss"] / jnp.where(
+                coeffs["forecast_coeff"] > 0, coeffs["forecast_coeff"], 1.0)
+            fa = parts["factor_loss"] / jnp.where(
+                coeffs["factor_score_coeff"] > 0,
+                coeffs["factor_score_coeff"], 1.0)
+            return combo, fo, fa
+
+        # supervised pairwise-cosine stopping term (ref :1467): mean cosine
+        # between max-normalized lag-summed supervised GC estimates on the
+        # first val batch, mirroring RedcliffTrainer._epoch_gc_tracking +
+        # GCTracker._track_cosines
+        S = model.config.num_supervised_factors
+        cfg_gc = model.config
+
+        def point_cos(params, X):
+            est = model.gc(params, cfg_gc.primary_gc_est_mode, X=X,
+                           threshold=False, ignore_lag=True)[..., 0]
+            S_eff = min(S, est.shape[1])
+            if S_eff < 2:
+                return jnp.zeros(())
+            sup = est[:, :S_eff]
+            m = jnp.max(sup, axis=(-2, -1), keepdims=True)
+            # positive-max guard in f32 (the trainer's host-side 1e-300 floor
+            # underflows to 0 here); zero/negative-max estimates pass through
+            # unscaled and the norm floor below keeps the cosine finite
+            sup = sup / jnp.where(m > 0, m, 1.0)
+            flat = sup.reshape(sup.shape[0], S_eff, -1)
+            norms = jnp.maximum(jnp.linalg.norm(flat, axis=-1), 1e-8)
+            sims = (jnp.einsum("nik,njk->nij", flat, flat)
+                    / (norms[:, :, None] * norms[:, None, :]))
+            iu = jnp.triu_indices(S_eff, k=1)
+            return jnp.mean(sims[:, iu[0], iu[1]])
+
+        self._cos = (jax.jit(jax.vmap(point_cos, in_axes=(0, None)))
+                     if S > 1 else None)
 
         self._steps = {}
+        self._scan_steps = {}
         for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
             vstep = jax.vmap(
                 lambda p, a, b, c, act, X, Y, ph=phase: point_step(
@@ -199,6 +243,24 @@ class RedcliffGridRunner:
             # step, so XLA can update them in place instead of round-tripping
             # a second copy of the whole grid state through HBM
             self._steps[phase] = jax.jit(vstep, donate_argnums=(0, 1, 2))
+
+            # k-batch scanned variant: one dispatch drives lax.scan over k
+            # pre-staged device-resident batches (Xs (k, B, T, C), Ys
+            # (k, ...)), amortizing the per-step dispatch overhead that
+            # dominates wall-clock at large G (BASELINE.md: ~0.24 ms/step
+            # floor past G~64)
+            def scan_step(params, optA_state, optB_state, coeffs, active,
+                          Xs, Ys, _vstep=vstep):
+                def body(carry, xy):
+                    p, a, b = carry
+                    p, a, b, combo = _vstep(p, a, b, coeffs, active, *xy)
+                    return (p, a, b), combo
+
+                (p, a, b), combos = jax.lax.scan(
+                    body, (params, optA_state, optB_state), (Xs, Ys))
+                return p, a, b, combos
+
+            self._scan_steps[phase] = jax.jit(scan_step, donate_argnums=(0, 1, 2))
 
         # Freeze-mode accept/revert choreography: the shared trainer logic
         # (train/freeze.py), vmapped over the grid axis
@@ -331,19 +393,63 @@ class RedcliffGridRunner:
                                        if self.mesh is not None else None)}
             else:
                 dev_kw = {}
-            for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
-                for phase in phases:
-                    params, optA_state, optB_state, _ = self._steps[phase](
-                        params, optA_state, optB_state, coeffs, active, X, Y)
-                if self._freeze_by_batch:
-                    params, accepted = self._freeze_step(params, accepted)
+            # scanning batches k-at-a-time preserves update order only when
+            # the epoch runs a single phase (multi-phase epochs interleave
+            # phases within each batch) and no per-batch freeze runs between
+            k = (tc.scan_batches
+                 if not self._freeze_by_batch and len(phases) == 1 else 0)
+            if k > 1:
+                # group FULL-SIZE labeled batches and drive each group with
+                # one scanned dispatch; short batches (the epoch remainder,
+                # which would break jnp.stack's uniform shapes) and
+                # label-less batches take the per-batch step in order
+                phase = phases[0]
+                state = (params, optA_state, optB_state)
+                group = []
+
+                def run_group(state, group):
+                    if len(group) > 1:
+                        Xs = jnp.stack([jnp.asarray(x) for x, _ in group])
+                        Ys = jnp.stack([jnp.asarray(y) for _, y in group])
+                        return self._scan_steps[phase](*state, coeffs, active,
+                                                       Xs, Ys)[:3]
+                    for X, Y in group:
+                        state = self._steps[phase](*state, coeffs, active,
+                                                   X, Y)[:3]
+                    return state
+
+                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                    if Y is None or X.shape[0] != tc.batch_size:
+                        state = run_group(state, group)
+                        group = []
+                        state = self._steps[phase](*state, coeffs, active,
+                                                   X, Y)[:3]
+                        continue
+                    group.append((X, Y))
+                    if len(group) == k:
+                        state = run_group(state, group)
+                        group = []
+                state = run_group(state, group)
+                params, optA_state, optB_state = state
+            else:
+                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                    for phase in phases:
+                        params, optA_state, optB_state, _ = self._steps[phase](
+                            params, optA_state, optB_state, coeffs, active, X, Y)
+                    if self._freeze_by_batch:
+                        params, accepted = self._freeze_step(params, accepted)
             combo_sum = 0.0
-            crit_sum = 0.0
+            forecast_sum = 0.0
+            factor_sum = 0.0
             n = 0
+            first_val_X = None
             for X, Y in val_ds.batches(tc.batch_size):
-                combo, crit = self._val(params, coeffs, X, Y)
+                if first_val_X is None:
+                    first_val_X = X
+                combo, fo, fa = self._val(params, coeffs, X, Y)
                 combo_sum = combo_sum + combo
-                crit_sum = crit_sum + crit
+                forecast_sum = forecast_sum + fo
+                factor_sum = factor_sum + fa
                 n += 1
             if n == 0:
                 raise ValueError(
@@ -353,6 +459,26 @@ class RedcliffGridRunner:
             val_history.append(combo_sum / n)
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
+                # per-point stopping criteria, the trainer's branches
+                # (redcliff_trainer.py:336-346, ref :1466-1538): stopping
+                # coefficients x coefficient-normalized val means, plus the
+                # supervised pairwise-cosine term when
+                # num_supervised_factors > 1.  NB the grid always includes
+                # the cosine term (like the reference, whose fit always
+                # tracks GC); the trainer zeroes it when fit() is called
+                # without true_GC (no tracker) — parity holds on the
+                # reference-shaped path, which passes ground truth
+                crit = (coeffs["stopping_criteria_forecast_coeff"]
+                        * (forecast_sum / n))
+                if cfg.num_supervised_factors >= 1:
+                    crit = crit + (coeffs["stopping_criteria_factor_coeff"]
+                                   * (factor_sum / n))
+                if self._cos is not None:
+                    Xw = jnp.asarray(np.asarray(
+                        first_val_X)[: tc.max_samples_for_gc_tracking,
+                                     : cfg.max_lag, :])
+                    crit = crit + (coeffs["stopping_criteria_cosSim_coeff"]
+                                   * self._cos(params, Xw))
                 if self._freeze:
                     # end-of-epoch accept/revert; the accepted tree IS the
                     # best-params analog (trainer fit loop, freeze branch)
@@ -360,17 +486,18 @@ class RedcliffGridRunner:
                         params, accepted = self._freeze_step(params, accepted)
                     _, best_crit, best_epoch = self._select_best(
                         best_params, best_crit, best_epoch, params,
-                        crit_sum / n, jnp.int32(it))
+                        crit, jnp.int32(it))
                     best_params = jax.tree.map(jnp.copy, accepted)
                 else:
                     best_params, best_crit, best_epoch = self._select_best(
-                        best_params, best_crit, best_epoch, params, crit_sum / n,
+                        best_params, best_crit, best_epoch, params, crit,
                         jnp.int32(it))
-                    # per-point early stop: a point whose criteria has not
-                    # improved for lookback*check_every epochs goes inactive
-                    # (the per-point trainer's break, ref :1522-1538)
-                    active = jnp.logical_and(
-                        active, (jnp.int32(it) - best_epoch) < stop_after)
+                # per-point early stop: a point whose criteria has not
+                # improved for lookback*check_every epochs goes inactive
+                # (the per-point trainer's break, ref :1522-1538) — applied
+                # in Freeze modes too, matching the trainer's all-modes rule
+                active = jnp.logical_and(
+                    active, (jnp.int32(it) - best_epoch) < stop_after)
             else:
                 best_params = jax.tree.map(jnp.copy, params)
                 best_epoch = jnp.full((G,), it, jnp.int32)

@@ -64,6 +64,11 @@ class RedcliffTrainConfig:
     # passes in bf16 (params stay f32) — the standard TPU speed/accuracy
     # trade for models whose loss tolerates it
     matmul_precision: str | None = None
+    # grid engine only: drive lax.scan over groups of this many pre-staged
+    # device-resident batches per dispatch (amortizes per-step dispatch
+    # overhead at large G); <= 1 keeps the one-dispatch-per-batch path.
+    # Ignored in FreezeByBatch modes (accept/revert runs between batches)
+    scan_batches: int = 0
 
 
 @dataclass
@@ -350,6 +355,16 @@ class RedcliffTrainer:
                     if criteria < best_loss:
                         best_loss = criteria
                         best_it = it
+                    elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
+                        # deliberate deviation: the reference's Freeze-mode
+                        # stop rule (ref :1510-1515) is inert because the
+                        # factor-status update above it is debug-disabled
+                        # (ref :1490 "FOR DEBUGGING"), so Freeze runs always
+                        # hit max_iter; we apply the standard lookback rule
+                        # in all modes so Freeze runs terminate too
+                        if tc.verbose:
+                            print("Stopping early")
+                        stop_early = True
                     best_params = accepted
                 else:
                     if criteria < best_loss:

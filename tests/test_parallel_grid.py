@@ -179,6 +179,90 @@ def test_grid_freeze_matches_independent_trainers(mode):
                                        rtol=2e-3, atol=2e-5)
 
 
+def test_grid_selection_criteria_matches_trainer():
+    """Grid best_epoch/best_criteria equal the per-point trainer's
+    best_it/best_loss on the same data — per-point stopping coefficients
+    applied to coefficient-normalized val means plus the supervised
+    pairwise-cosine term (num_supervised_factors=2), exactly as
+    redcliff_trainer.py:336-346 / ref :1466-1538."""
+    import dataclasses
+
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainer
+
+    model = _model()  # S=2 -> cosSim term participates in the criteria
+    points = [
+        {"gen_lr": 1e-3, "stopping_criteria_cosSim_coeff": 0.5},
+        {"gen_lr": 5e-3, "stopping_criteria_forecast_coeff": 2.0,
+         "stopping_criteria_factor_coeff": 0.5},
+    ]
+    spec = GridSpec(points=points)
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=32, seed=7)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    key = jax.random.PRNGKey(21)
+    res = runner.fit(key, ds, ds)
+
+    cfg = model.config
+    # any truth works: the cosine stopping term compares estimates to each
+    # other, the tracker just has to exist (trainer gates the term on it)
+    true_GC = [np.eye(cfg.num_chans) for _ in range(cfg.num_supervised_factors)]
+    init_params, _, _ = runner.init_grid(key)  # same key -> same init as fit
+    stop_keys = ("gen_lr", "embed_lr", "stopping_criteria_forecast_coeff",
+                 "stopping_criteria_factor_coeff",
+                 "stopping_criteria_cosSim_coeff")
+    for g, point in enumerate(points):
+        tc_g = dataclasses.replace(tc, **{k: v for k, v in point.items()
+                                          if k in stop_keys})
+        trainer = RedcliffTrainer(model, tc_g)
+        params_g = jax.tree.map(lambda x: x[g], init_params)
+        out = trainer.fit(params_g, ds, ds, true_GC=true_GC)
+        assert int(res.best_epoch[g]) == out.best_it, (g, point)
+        np.testing.assert_allclose(res.best_criteria[g], out.best_loss,
+                                   rtol=2e-3)
+
+
+def test_grid_scan_batches_matches_per_batch():
+    """The lax.scan k-batch step reproduces the one-dispatch-per-batch path
+    bit-for-bit on the same data/seed (dispatch amortization must not change
+    training semantics), including a non-divisible epoch remainder."""
+    import dataclasses
+
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3}])
+    key = jax.random.PRNGKey(9)
+    # n=80: 5 full batches -> one scan group of 4 + per-batch remainder of 1;
+    # n=56: 3 full + 1 SHORT batch (8 rows) that must flush the group to the
+    # per-batch step instead of breaking jnp.stack (regression)
+    for n in (80, 56):
+        ds = _data(model, n=n)
+        tc = RedcliffTrainConfig(max_iter=2, batch_size=16, seed=5)
+        res_plain = RedcliffGridRunner(model, tc, spec).fit(key, ds, ds)
+        tc_scan = dataclasses.replace(tc, scan_batches=4)
+        res_scan = RedcliffGridRunner(model, tc_scan, spec).fit(key, ds, ds)
+        np.testing.assert_allclose(res_scan.val_history, res_plain.val_history,
+                                   rtol=1e-6, atol=1e-7)
+        for a, b in zip(jax.tree.leaves(res_scan.best_params),
+                        jax.tree.leaves(res_plain.best_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_grid_freeze_points_early_stop():
+    """Freeze-mode grid points early-stop too: a zero-lr point's criteria
+    never improves, so its lane goes inactive after lookback*check_every
+    epochs (regression: the freeze branch never updated the active mask)."""
+    model = _freeze_model(
+        "pretrain_embedder_then_post_train_factor_withL1FreezeByEpoch")
+    spec = GridSpec(points=[{"gen_lr": 1e-3},
+                            {"gen_lr": 0.0, "embed_lr": 0.0}])
+    tc = RedcliffTrainConfig(max_iter=5, batch_size=32, lookback=1,
+                             check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(13), ds, ds)
+    assert not res.active[1]
+
+
 def test_grid_early_stop_lane_masking():
     """A point whose criteria stops improving goes inactive and its parameters
     freeze (per-point analog of RedcliffTrainer's early-stop break)."""

@@ -1,0 +1,410 @@
+"""Direct numerical A/B against the actual torch reference implementation.
+
+Loads /root/reference/models/redcliff_s_cmlp.py (and the withStateSmoothing
+variant), copies ONE set of torch weights into the JAX pytree, and asserts on
+identical inputs:
+
+* forward outputs (x_sims, per-factor preds, factor weightings, state labels)
+  under BOTH forward_pass modes (ref :249-319, :322-381),
+* every loss term (forecasting, factor, cosine, fw-L1, adj-L1 — ref :620-686 —
+  plus the Smooth variant's fw_smoothing term, ref Smooth :667-692) under all
+  three phase gatings and all three label-shape conventions,
+* all 9 GC readout modes, lagged and unlagged (ref :411-617),
+
+to float32 tolerance. Covered embedders: Vanilla (MLPClassifierForMultiple/
+SingleObjectives) and cEmbedder — both pure torch in the reference. The DGCNN
+embedder depends on the external torcheeg package, which is not installed, so
+the reference's own DGCNN path cannot execute here (stubbing it with our
+reimplementation would make the A/B circular); it is exercised by the native
+tests in test_dgcnn.py instead.
+
+The reference is imported from its own directory with stub torcheeg/pywt
+modules (import-time dependencies only; no stubbed code runs in these tests).
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REF_ROOT = "/root/reference"
+
+
+# --------------------------------------------------------------------------
+# reference import scaffolding
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ref():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    for name, attrs in [
+        ("torcheeg", {}),
+        ("torcheeg.models", {"DGCNN": type("DGCNN", (), {})}),
+        ("pywt", {"swt": None, "iswt": None, "Wavelet": None}),
+    ]:
+        if name not in sys.modules:
+            m = types.ModuleType(name)
+            for a, v in attrs.items():
+                setattr(m, a, v)
+            sys.modules[name] = m
+    sys.modules["torcheeg"].models = sys.modules["torcheeg.models"]
+    if REF_ROOT not in sys.path:
+        sys.path.append(REF_ROOT)
+    from models.redcliff_s_cmlp import REDCLIFF_S_CMLP
+    from models.redcliff_s_cmlp_withStateSmoothing import (
+        REDCLIFF_S_CMLP_withStateSmoothing,
+    )
+
+    ns = types.SimpleNamespace(
+        REDCLIFF_S_CMLP=REDCLIFF_S_CMLP,
+        Smooth=REDCLIFF_S_CMLP_withStateSmoothing,
+    )
+    return ns
+
+
+# shared shape/coefficient configuration (multi-layer factors, K > S so both
+# supervised and unsupervised factors exist, num_sims > 2 so the 3-point
+# smoothing branch runs)
+C, GEN_LAG, EMBED_LAG = 5, 3, 6
+GEN_HIDDEN = [8, 6]
+EMBED_HIDDEN = [12]
+K, S, NUM_SIMS = 4, 2, 3
+ECC = 10.0
+COEFFS = dict(FORECAST_COEFF=1.0, FACTOR_SCORE_COEFF=2.0,
+              FACTOR_COS_SIM_COEFF=0.3, FACTOR_WEIGHT_L1_COEFF=0.05,
+              ADJ_L1_REG_COEFF=0.01, DAGNESS_REG_COEFF=0.0,
+              DAGNESS_LAG_COEFF=0.0, DAGNESS_NODE_COEFF=0.0)
+MAX_LAG = max(GEN_LAG, EMBED_LAG)
+
+
+def _build_ref_model(ref, embedder_type, forward_mode, gc_mode,
+                     smooth=False, num_sims=NUM_SIMS):
+    coeffs = dict(COEFFS)
+    if smooth:
+        coeffs["FACTOR_WEIGHT_SMOOTHING_PENALTY_COEFF"] = 0.7
+    embedder_args = []
+    if embedder_type == "cEmbedder":
+        # ctor appends these positionally after (num_chans, S, K, sigmoid):
+        # sigmoid_eccentricity_coeff, embed_lag, hidden (ref :109-116)
+        embedder_args = [("sigmoid_eccentricity_coeff", ECC),
+                         ("embed_lag", EMBED_LAG),
+                         ("hidden", list(EMBED_HIDDEN))]
+    cls = ref.Smooth if smooth else ref.REDCLIFF_S_CMLP
+    torch.manual_seed(0)
+    return cls(
+        num_chans=C, gen_lag=GEN_LAG, gen_hidden=list(GEN_HIDDEN),
+        embed_lag=EMBED_LAG, embed_hidden_sizes=list(EMBED_HIDDEN),
+        num_in_timesteps=MAX_LAG, num_out_timesteps=1, num_factors=K,
+        num_supervised_factors=S, coeff_dict=coeffs,
+        use_sigmoid_restriction=True, factor_score_embedder_type=embedder_type,
+        factor_score_embedder_args=embedder_args,
+        primary_gc_est_mode=gc_mode, forward_pass_mode=forward_mode,
+        num_sims=num_sims, training_mode="combined",
+    )
+
+
+def _build_jax_model(embedder_type, forward_mode, gc_mode, smooth=False,
+                     num_sims=NUM_SIMS):
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+
+    return RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=C, gen_lag=GEN_LAG, gen_hidden=tuple(GEN_HIDDEN),
+        embed_lag=EMBED_LAG, embed_hidden_sizes=tuple(EMBED_HIDDEN),
+        num_factors=K, num_supervised_factors=S,
+        forecast_coeff=COEFFS["FORECAST_COEFF"],
+        factor_score_coeff=COEFFS["FACTOR_SCORE_COEFF"],
+        factor_cos_sim_coeff=COEFFS["FACTOR_COS_SIM_COEFF"],
+        factor_weight_l1_coeff=COEFFS["FACTOR_WEIGHT_L1_COEFF"],
+        adj_l1_reg_coeff=COEFFS["ADJ_L1_REG_COEFF"],
+        factor_weight_smoothing_penalty_coeff=0.7 if smooth else 0.0,
+        use_sigmoid_restriction=True, sigmoid_eccentricity_coeff=ECC,
+        factor_score_embedder_type=("Vanilla_Embedder"
+                                    if embedder_type == "Vanilla_Embedder"
+                                    else embedder_type),
+        primary_gc_est_mode=gc_mode, forward_pass_mode=forward_mode,
+        num_sims=num_sims, training_mode="combined",
+    ))
+
+
+# --------------------------------------------------------------------------
+# torch -> JAX weight copying
+# --------------------------------------------------------------------------
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+def _copy_factors(ref_model):
+    """cMLP factor stack: ref factors[k].networks[c].layers[li] Conv1d weights
+    -> our layer list of {w (K, C, h, C, L) | (K, C, d_out, d_in), b}."""
+    n_layers = len(ref_model.factors[0].networks[0].layers)
+    layers = []
+    for li in range(n_layers):
+        w_k, b_k = [], []
+        for factor in ref_model.factors:
+            w_c = np.stack([_np(net.layers[li].weight) for net in factor.networks])
+            b_c = np.stack([_np(net.layers[li].bias) for net in factor.networks])
+            if li > 0:  # 1x1 conv: (d_out, d_in, 1) -> (d_out, d_in)
+                w_c = w_c[..., 0]
+            w_k.append(w_c)
+            b_k.append(b_c)
+        layers.append({"w": np.stack(w_k), "b": np.stack(b_k)})
+    return layers
+
+
+def _copy_vanilla_multi_embedder(ref_model):
+    e = ref_model.factor_score_embedder
+    p = {"trunk": {
+        "conv1": _np(e.series_embedding_layers[0].weight)[:, 0],
+        "conv2": _np(e.series_embedding_layers[2].weight)[:, :, 0],
+    }}
+    if e.unsup_factor_weighting_layer is not None:
+        p["unsup_head"] = _np(e.unsup_factor_weighting_layer.weight).T
+    return p
+
+
+def _copy_cembedder(ref_model):
+    e = ref_model.factor_score_embedder
+    n_layers = len(e.networks[0].layers)
+    nets = []
+    for li in range(n_layers):
+        w = np.stack([_np(net.layers[li].weight) for net in e.networks])
+        b = np.stack([_np(net.layers[li].bias) for net in e.networks])
+        if li > 0:
+            w = w[..., 0]
+        nets.append({"w": w, "b": b})
+    return {"nets": nets}
+
+
+def _copy_params(ref_model, embedder_type):
+    import jax.numpy as jnp
+
+    if embedder_type == "Vanilla_Embedder":
+        emb = _copy_vanilla_multi_embedder(ref_model)
+    elif embedder_type == "cEmbedder":
+        emb = _copy_cembedder(ref_model)
+    else:
+        raise NotImplementedError(embedder_type)
+    params = {"embedder": emb, "factors": _copy_factors(ref_model)}
+    import jax
+
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _data(rng, batch=7, label_shape="trace"):
+    T = MAX_LAG + NUM_SIMS + 2
+    X = rng.normal(size=(batch, T, C)).astype(np.float32)
+    if label_shape == "trace":
+        Y = rng.uniform(size=(batch, S + 1, T)).astype(np.float32)
+    elif label_shape == "static3":
+        Y = rng.uniform(size=(batch, S + 1, 1)).astype(np.float32)
+    else:  # 2-D (orig DREAM4)
+        Y = rng.uniform(size=(batch, S + 1)).astype(np.float32)
+    return X, Y
+
+
+# --------------------------------------------------------------------------
+# forward parity
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("embedder_type", ["Vanilla_Embedder", "cEmbedder"])
+@pytest.mark.parametrize("forward_mode", [
+    "apply_factor_weights_at_each_sim_step",
+    "apply_factor_weights_after_sim_completion",
+])
+def test_forward_parity(ref, embedder_type, forward_mode):
+    gc_mode = "fixed_factor_exclusive"
+    ref_model = _build_ref_model(ref, embedder_type, forward_mode, gc_mode)
+    jax_model = _build_jax_model(embedder_type, forward_mode, gc_mode)
+    params = _copy_params(ref_model, embedder_type)
+    X, _ = _data(np.random.default_rng(0))
+    Xw = X[:, :MAX_LAG, :]
+
+    with torch.no_grad():
+        r_sims, r_fp, r_fw, r_lab = ref_model.forward(torch.from_numpy(Xw))
+    j_sims, j_fp, j_fw, j_lab = jax_model.forward(params, Xw)
+
+    np.testing.assert_allclose(np.asarray(j_sims), _np(r_sims),
+                               rtol=1e-5, atol=1e-5)
+    assert len(j_fw) == len(r_fw)
+    for jw, rw in zip(j_fw, r_fw):
+        np.testing.assert_allclose(np.asarray(jw), _np(rw), rtol=1e-5, atol=1e-6)
+    assert len(j_lab) == len(r_lab)
+    for jl, rl in zip(j_lab, r_lab):
+        np.testing.assert_allclose(np.asarray(jl), _np(rl), rtol=1e-5, atol=1e-6)
+
+    if forward_mode == "apply_factor_weights_at_each_sim_step":
+        # ref: list (sims) of list (K) of (B, 1, C); ours: list (sims) of (K, B, 1, C)
+        for jp, rp in zip(j_fp, r_fp):
+            np.testing.assert_allclose(np.asarray(jp),
+                                       np.stack([_np(t) for t in rp]),
+                                       rtol=1e-5, atol=1e-5)
+    else:
+        # ref: list (K) of (B, S, C); ours: list (sims) of (K, B, 1, C)
+        ours = np.concatenate([np.asarray(p) for p in j_fp], axis=2)  # (K, B, S, C)
+        theirs = np.stack([_np(t) for t in r_fp])  # (K, B, S, C)
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# loss-term parity
+# --------------------------------------------------------------------------
+@pytest.mark.parametrize("label_shape", ["trace", "static3", "static2"])
+@pytest.mark.parametrize("phase,flags", [
+    ("combined", dict(embedder_pretrain_loss=False, factor_pretrain_loss=False)),
+    ("embedder_pretrain", dict(embedder_pretrain_loss=True, factor_pretrain_loss=False)),
+    ("factor_pretrain", dict(embedder_pretrain_loss=False, factor_pretrain_loss=True)),
+])
+def test_loss_term_parity(ref, label_shape, phase, flags):
+    embedder_type = "Vanilla_Embedder"
+    forward_mode = "apply_factor_weights_at_each_sim_step"
+    gc_mode = "conditional_factor_fixed_embedder"
+    # embedder GC modes need a causal embedder
+    gc_mode_for = "fixed_factor_exclusive"
+    ref_model = _build_ref_model(ref, embedder_type, forward_mode, gc_mode_for)
+    jax_model = _build_jax_model(embedder_type, forward_mode, gc_mode_for)
+    params = _copy_params(ref_model, embedder_type)
+    X, Y = _data(np.random.default_rng(1), label_shape=label_shape)
+    Xw = X[:, :MAX_LAG, :]
+    targets = X[:, MAX_LAG : MAX_LAG + NUM_SIMS, :]
+
+    with torch.no_grad():
+        r_sims, _, _, r_lab = ref_model.forward(torch.from_numpy(Xw))
+        r_combo, r_terms = ref_model.compute_loss(
+            torch.from_numpy(X[:, :EMBED_LAG, :]), r_sims,
+            torch.from_numpy(targets), r_lab, torch.from_numpy(Y),
+            gc_mode_for, **flags)
+    r_forecast, r_factor, r_cos, r_l1, r_adj, _ = r_terms
+
+    j_combo, j_parts = jax_model.loss_for_phase(params, X, Y, phase)
+    np.testing.assert_allclose(float(j_parts["forecasting_loss"]),
+                               float(r_forecast), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_parts["factor_loss"]),
+                               float(r_factor), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_parts["fw_l1_penalty"]),
+                               float(r_l1), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_parts["adj_l1_penalty"]),
+                               float(r_adj), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_parts["factor_cos_sim_penalty"]),
+                               float(r_cos), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_combo), float(r_combo),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_loss_parity_with_conditional_gc_mode_in_loss(ref):
+    """The canonical experiment configuration scores GC in-loss with the
+    conditional_factor_fixed_embedder mode, which requires a causal embedder
+    (cEmbedder here; D4IC uses DGCNN)."""
+    embedder_type = "cEmbedder"
+    forward_mode = "apply_factor_weights_at_each_sim_step"
+    gc_mode = "conditional_factor_fixed_embedder"
+    ref_model = _build_ref_model(ref, embedder_type, forward_mode, gc_mode)
+    jax_model = _build_jax_model(embedder_type, forward_mode, gc_mode)
+    params = _copy_params(ref_model, embedder_type)
+    X, Y = _data(np.random.default_rng(2), label_shape="trace")
+    Xw = X[:, :MAX_LAG, :]
+    targets = X[:, MAX_LAG : MAX_LAG + NUM_SIMS, :]
+
+    with torch.no_grad():
+        r_sims, _, _, r_lab = ref_model.forward(torch.from_numpy(Xw))
+        r_combo, r_terms = ref_model.compute_loss(
+            torch.from_numpy(X[:, :EMBED_LAG, :]), r_sims,
+            torch.from_numpy(targets), r_lab, torch.from_numpy(Y), gc_mode)
+    j_combo, j_parts = jax_model.loss_for_phase(params, X, Y, "combined")
+    np.testing.assert_allclose(float(j_parts["factor_cos_sim_penalty"]),
+                               float(r_terms[2]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(j_parts["adj_l1_penalty"]),
+                               float(r_terms[4]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_combo), float(r_combo),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("num_sims", [2, 3])
+def test_smoothing_term_parity(ref, num_sims):
+    """Smooth variant: the epsilon-masked (num_sims == 2) and 3-point
+    monotonicity (num_sims > 2) smoothing penalties (ref Smooth :667-692)."""
+    embedder_type = "Vanilla_Embedder"
+    forward_mode = "apply_factor_weights_at_each_sim_step"
+    gc_mode = "fixed_factor_exclusive"
+    ref_model = _build_ref_model(ref, embedder_type, forward_mode, gc_mode,
+                                 smooth=True, num_sims=num_sims)
+    jax_model = _build_jax_model(embedder_type, forward_mode, gc_mode,
+                                 smooth=True, num_sims=num_sims)
+    assert float(ref_model.STATE_SCORE_SMOOTHING_EPSILON) == pytest.approx(
+        jax_model.config.state_score_smoothing_epsilon)
+    params = _copy_params(ref_model, embedder_type)
+    X, Y = _data(np.random.default_rng(3), label_shape="trace")
+    Xw = X[:, :MAX_LAG, :]
+    targets = X[:, MAX_LAG : MAX_LAG + num_sims, :]
+
+    with torch.no_grad():
+        r_sims, _, _, r_lab = ref_model.forward(torch.from_numpy(Xw))
+        r_combo, r_terms = ref_model.compute_loss(
+            torch.from_numpy(X[:, :EMBED_LAG, :]), r_sims,
+            torch.from_numpy(targets), r_lab, torch.from_numpy(Y), gc_mode)
+    # Smooth variant term order: [forecast, factor, cos, fw_l1, SMOOTH, adj, dag]
+    r_smooth = r_terms[4]
+    j_combo, j_parts = jax_model.loss_for_phase(params, X, Y, "combined")
+    np.testing.assert_allclose(float(j_parts["fw_smoothing_penalty"]),
+                               float(r_smooth), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(j_combo), float(r_combo),
+                               rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# GC readout parity — all 9 modes
+# --------------------------------------------------------------------------
+FACTOR_ONLY_MODES = ["fixed_factor_exclusive", "conditional_factor_exclusive"]
+ALL_MODES = [
+    "fixed_factor_exclusive", "raw_embedder", "conditional_factor_exclusive",
+    "fixed_embedder_exclusive", "conditional_embedder_exclusive",
+    "fixed_factor_fixed_embedder", "conditional_factor_fixed_embedder",
+    "fixed_factor_conditional_embedder",
+    "conditional_factor_conditional_embedder",
+]
+
+
+def _assert_gc_match(jax_model, params, ref_model, mode, X, ignore_lag):
+    with torch.no_grad():
+        r = ref_model.GC(mode, X=None if "conditional" not in mode
+                         else torch.from_numpy(X),
+                         threshold=False, ignore_lag=ignore_lag)
+    j = jax_model.gc_as_lists(params, mode,
+                              X=None if "conditional" not in mode else X,
+                              threshold=False, ignore_lag=ignore_lag)
+    assert len(j) == len(r), (mode, len(j), len(r))
+    for s, (js, rs) in enumerate(zip(j, r)):
+        assert len(js) == len(rs), (mode, s, len(js), len(rs))
+        for jf, rf in zip(js, rs):
+            rf = _np(rf)
+            if rf.ndim == 2:
+                rf = rf[:, :, None]
+            np.testing.assert_allclose(np.asarray(jf), rf, rtol=1e-5,
+                                       atol=1e-6, err_msg=f"{mode} il={ignore_lag}")
+
+
+@pytest.mark.parametrize("ignore_lag", [True, False])
+@pytest.mark.parametrize("mode", ALL_MODES)
+def test_gc_readout_parity_cembedder(ref, mode, ignore_lag):
+    embedder_type = "cEmbedder"
+    ref_model = _build_ref_model(
+        ref, embedder_type, "apply_factor_weights_at_each_sim_step", mode)
+    jax_model = _build_jax_model(
+        embedder_type, "apply_factor_weights_at_each_sim_step", mode)
+    params = _copy_params(ref_model, embedder_type)
+    X = np.random.default_rng(4).normal(size=(6, MAX_LAG, C)).astype(np.float32)
+    _assert_gc_match(jax_model, params, ref_model, mode, X, ignore_lag)
+
+
+@pytest.mark.parametrize("ignore_lag", [True, False])
+@pytest.mark.parametrize("mode", FACTOR_ONLY_MODES)
+def test_gc_readout_parity_vanilla(ref, mode, ignore_lag):
+    embedder_type = "Vanilla_Embedder"
+    ref_model = _build_ref_model(
+        ref, embedder_type, "apply_factor_weights_at_each_sim_step", mode)
+    jax_model = _build_jax_model(
+        embedder_type, "apply_factor_weights_at_each_sim_step", mode)
+    params = _copy_params(ref_model, embedder_type)
+    X = np.random.default_rng(5).normal(size=(6, MAX_LAG, C)).astype(np.float32)
+    _assert_gc_match(jax_model, params, ref_model, mode, X, ignore_lag)
