@@ -111,9 +111,13 @@ def _run_measure_child(platform, timeout_s=MEASURE_TIMEOUT_S):
                   f"emitted no result JSON: {r.stderr.strip()[-300:]}")
 
 
+MAX_MEASURE_ATTEMPTS = 2
+
+
 def _orchestrate():
     t0 = time.monotonic()
     probe_log = []
+    measure_attempts = 0
     for i, wait in enumerate(PROBE_WAITS):
         if wait:
             time.sleep(wait)
@@ -124,6 +128,13 @@ def _orchestrate():
               file=sys.stderr, flush=True)
         if not ok:
             continue
+        if measure_attempts >= MAX_MEASURE_ATTEMPTS:
+            # a tunnel that probes OK but hangs mid-measure must not keep
+            # burning 25-minute measurement timeouts; bound the total
+            probe_log.append({"attempt": i, "ok": False,
+                              "info": "measurement attempt budget exhausted"})
+            break
+        measure_attempts += 1
         payload, minfo = _run_measure_child("tpu")
         if payload is not None and payload.get("value"):
             payload["probe_log"] = probe_log
@@ -143,7 +154,10 @@ def _orchestrate():
                "vs_baseline": None, "error": f"{err}; then {minfo}",
                "probe_log": probe_log})
         return
-    payload["error"] = err
+    # append (never replace) any error the CPU child itself reported, so a
+    # fallback-path crash stays diagnosable from the published JSON
+    child_err = payload.get("error")
+    payload["error"] = f"{err}; child: {child_err}" if child_err else err
     payload["probe_log"] = probe_log
     _emit(payload)
 

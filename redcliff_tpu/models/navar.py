@@ -121,10 +121,11 @@ class NAVAR:
 
     def causal_matrix(self, params, X):
         """std of each contribution stream over all training windows
-        (ref navar.py:119-122). Returns (N_src, N_tgt)."""
+        (ref navar.py:119-122; torch.std is the UNBIASED estimator, hence
+        ddof=1). Returns (N_src, N_tgt)."""
         Xw, _ = self._windows(X)
         _, contributions = self.forward(params, Xw)
-        return jnp.std(contributions, axis=0)
+        return jnp.std(contributions, axis=0, ddof=1)
 
     # ---- trainer protocol ------------------------------------------------
     gc_requires_data = True
@@ -239,10 +240,10 @@ class NAVARLSTM:
 
     def causal_matrix(self, params, X):
         """std over (batch x time) of the (N, N) contribution streams from the
-        full sequences (ref navar.py:240-243)."""
+        full sequences (ref navar.py:240-243; torch.std => ddof=1)."""
         _, contributions = self.forward(params, X[:, :-1, :])
         N = self.config.num_nodes
-        return jnp.std(contributions.reshape(-1, N, N), axis=0)
+        return jnp.std(contributions.reshape(-1, N, N), axis=0, ddof=1)
 
     # ---- trainer protocol ------------------------------------------------
     gc_requires_data = True

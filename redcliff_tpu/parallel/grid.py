@@ -408,7 +408,10 @@ class RedcliffGridRunner:
                 group = []
 
                 def run_group(state, group):
-                    if len(group) > 1:
+                    # only full k-groups take the scanned dispatch: a
+                    # remainder group of 2..k-1 would jit-specialize (and
+                    # fully compile) a second scanned step per distinct size
+                    if len(group) == k:
                         Xs = jnp.stack([jnp.asarray(x) for x, _ in group])
                         Ys = jnp.stack([jnp.asarray(y) for _, y in group])
                         return self._scan_steps[phase](*state, coeffs, active,

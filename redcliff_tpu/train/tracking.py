@@ -173,8 +173,14 @@ class GCProgressTracker:
             n_s += 1
             for i in range(len(sample)):
                 for j in range(i + 1, len(sample)):
-                    a = sample[i] / max(np.max(sample[i]), 1e-300)
-                    b = sample[j] / max(np.max(sample[j]), 1e-300)
+                    # float64 before the max-normalization: the reference's
+                    # 1e-300 floor (ref model_utils.py:191-209) underflows to
+                    # zero in the float32 arrays jax hands us, turning an
+                    # all-zero estimate into a divide-by-zero
+                    a = np.asarray(sample[i], dtype=np.float64)
+                    b = np.asarray(sample[j], dtype=np.float64)
+                    a = a / max(np.max(a), 1e-300)
+                    b = b / max(np.max(b), 1e-300)
                     key = f"{i + label_offset}and{j + label_offset}"
                     sums[key] = sums.get(key, 0.0) + compute_cosine_similarity(a, b)
         for key, total in sums.items():

@@ -338,3 +338,23 @@ def test_redcliff_clstm_factory_dispatch():
     assert model.config.factor_network_type == "cLSTM"
     assert model.config.gen_lag == 3
     assert model.config.gen_hidden == (8,)
+
+
+def test_gc_tracker_zero_estimate_cosine_warning_free():
+    """An all-zero float32 GC estimate must not trip a divide-by-zero in the
+    cosine tracking (regression: the reference's 1e-300 max floor underflows
+    to zero in float32, ref model_utils.py:191-209)."""
+    import warnings
+
+    from redcliff_tpu.train.tracking import GCProgressTracker
+
+    t = GCProgressTracker(2, 4, num_factors=2)
+    rng = np.random.default_rng(0)
+    truth = (rng.uniform(size=(4, 4)) > 0.5).astype(np.float64)
+    zero = np.zeros((4, 4), dtype=np.float32)
+    est = rng.uniform(size=(4, 4)).astype(np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t.update(true_GC=[truth, truth], est_by_sample=[[zero, est]],
+                 est_by_sample_lagsummed=[[zero, est]])
+    assert t.gc_factor_cosine_sim_histories["0and1"] == [0.0]

@@ -20,6 +20,11 @@ tests in test_dgcnn.py instead.
 
 The reference is imported from its own directory with stub torcheeg/pywt
 modules (import-time dependencies only; no stubbed code runs in these tests).
+
+Also A/B'd against the actual reference code here: the DYNOTEARS
+augmented-Lagrangian solver (scipy vs scipy, incl. the warm-started refit
+chain) and NAVAR (forward, contributions, and the std-over-windows causal
+matrix).
 """
 import sys
 import types
@@ -408,3 +413,195 @@ def test_gc_readout_parity_vanilla(ref, mode, ignore_lag):
     params = _copy_params(ref_model, embedder_type)
     X = np.random.default_rng(5).normal(size=(6, MAX_LAG, C)).astype(np.float32)
     _assert_gc_match(jax_model, params, ref_model, mode, X, ignore_lag)
+
+
+# --------------------------------------------------------------------------
+# DYNOTEARS solver parity (no torch involved: scipy vs scipy)
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ref_dynotears():
+    """Import the reference's vendored causalnex solver with the external
+    causalnex package stubbed (only its StructureModel wrapper is imported;
+    the core _learn_dynamic_structure never touches it)."""
+    for name, attrs in [
+        ("causalnex", {}),
+        ("causalnex.structure", {"StructureModel": type("SM", (), {})}),
+        ("causalnex.structure.transformers",
+         {"DynamicDataTransformer": type("DDT", (), {})}),
+    ]:
+        if name not in sys.modules:
+            m = types.ModuleType(name)
+            for a, v in attrs.items():
+                setattr(m, a, v)
+            sys.modules[name] = m
+    sys.modules["causalnex"].structure = sys.modules["causalnex.structure"]
+    sys.modules["causalnex.structure"].transformers = sys.modules[
+        "causalnex.structure.transformers"]
+    if REF_ROOT not in sys.path:
+        sys.path.append(REF_ROOT)
+    from models import causalnex_dynotears
+
+    return causalnex_dynotears
+
+
+def _var_data(rng, d=4, p=2, n=80):
+    series = np.zeros((n + p, d))
+    A1 = 0.4 * (rng.uniform(size=(d, d)) > 0.7)
+    for t in range(p, n + p):
+        series[t] = series[t - 1] @ A1 + rng.normal(scale=0.5, size=d)
+    X = series[p:]
+    Xlags = np.concatenate(
+        [series[p - k : n + p - k] for k in range(1, p + 1)], axis=1)
+    return X, Xlags
+
+
+def _ref_bounds(d, p):
+    bnds_w = 2 * [(0, 0) if i == j else (0, None)
+                  for i in range(d) for j in range(d)]
+    bnds_a = []
+    for _ in range(1, p + 1):
+        bnds_a.extend(2 * [(0, None) for _ in range(d * d)])
+    return bnds_w + bnds_a
+
+
+def test_dynotears_solver_parity(ref_dynotears):
+    """Our augmented-Lagrangian DYNOTEARS solve reproduces the reference's
+    _learn_dynamic_structure (ref causalnex_dynotears.py:333-510) W and A
+    matrices on identical data."""
+    from redcliff_tpu.models.dynotears import dynotears_solve
+
+    rng = np.random.default_rng(11)
+    d, p = 4, 2
+    X, Xlags = _var_data(rng, d=d, p=p)
+    w_ref, a_ref = ref_dynotears._learn_dynamic_structure(
+        X, Xlags, _ref_bounds(d, p), 0.1, 0.1, 100, 1e-8)[:2]
+    res = dynotears_solve(X, Xlags, lambda_w=0.1, lambda_a=0.1,
+                          max_iter=100, h_tol=1e-8)
+    np.testing.assert_allclose(res.w_mat, w_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(res.a_mat, a_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dynotears_warm_start_parity(ref_dynotears):
+    """The stochastic variant's warm-started refit chain: threading
+    (wa, rho, alpha, h) through a second call matches the reference's
+    keyword-threaded state handling (ref :162-173,478-509)."""
+    from redcliff_tpu.models.dynotears import DynotearsState, dynotears_solve
+
+    rng = np.random.default_rng(13)
+    d, p = 3, 1
+    X1, Xl1 = _var_data(rng, d=d, p=p, n=50)
+    X2, Xl2 = _var_data(rng, d=d, p=p, n=50)
+    bnds = _ref_bounds(d, p)
+
+    r1 = ref_dynotears._learn_dynamic_structure(
+        X1, Xl1, bnds, 0.1, 0.1, 50, 1e-8)
+    _, _, wa_ref, rho_ref, alpha_ref, h_ref, h_new_ref, wa_new_ref = r1[:8]
+    r2 = ref_dynotears._learn_dynamic_structure(
+        X2, Xl2, bnds, 0.1, 0.1, 50, 1e-8, wa_est=wa_ref.copy(),
+        rho=rho_ref, alpha=alpha_ref, h_value=h_ref, h_new=h_new_ref,
+        wa_new=wa_new_ref.copy())
+
+    o1 = dynotears_solve(X1, Xl1, lambda_w=0.1, lambda_a=0.1, max_iter=50,
+                         h_tol=1e-8)
+    o2 = dynotears_solve(X2, Xl2, lambda_w=0.1, lambda_a=0.1, max_iter=50,
+                         h_tol=1e-8, state=o1.state)
+    np.testing.assert_allclose(o1.w_mat, r1[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(o2.w_mat, r2[0], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(o2.a_mat, r2[1], rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# NAVAR parity (vendored torch module, ref models/navar.py:9-127)
+# --------------------------------------------------------------------------
+def test_navar_forward_and_causal_matrix_parity(ref):
+    """Copy the reference NAVAR's grouped-conv weights into our per-node
+    einsum pytree and assert predictions, contributions, and the
+    std-over-windows causal matrix match (ref navar.py:41-51,119-122)."""
+    from models.navar import NAVAR as RefNAVAR
+
+    from redcliff_tpu.models.navar import NAVAR, NAVARConfig
+
+    N, H, L, HL = 5, 8, 4, 2
+    torch.manual_seed(1)
+    ref_model = RefNAVAR(num_nodes=N, num_hidden=H, maxlags=L,
+                         hidden_layers=HL, dropout=0)
+    ours = NAVAR(NAVARConfig(num_nodes=N, num_hidden=H, maxlags=L,
+                             hidden_layers=HL, dropout=0.0))
+
+    params = {
+        "w1": _np(ref_model.first_hidden_layer.weight).reshape(N, H, L),
+        "b1": _np(ref_model.first_hidden_layer.bias).reshape(N, H),
+        "hidden": [
+            {"w": _np(layer.weight).reshape(N, H, H),
+             "b": _np(layer.bias).reshape(N, H)}
+            for layer in ref_model.hidden_layer_list
+        ],
+        "wc": _np(ref_model.contributions.weight).reshape(N, N, H),
+        "bc": _np(ref_model.contributions.bias).reshape(N, N),
+        "bias": _np(ref_model.biases)[0],
+    }
+
+    rng = np.random.default_rng(2)
+    B = 6
+    Xw = rng.normal(size=(B, L, N)).astype(np.float32)
+    with torch.no_grad():
+        # torch input layout: (batch, nodes, time)
+        r_pred, r_contrib = ref_model(
+            torch.from_numpy(np.swapaxes(Xw, 1, 2)))
+    j_pred, j_contrib = ours.forward(params, Xw)
+    np.testing.assert_allclose(np.asarray(j_pred), _np(r_pred),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(j_contrib).reshape(B, N * N),
+        _np(r_contrib)[:, :, 0], rtol=1e-5, atol=1e-6)
+
+    # causal matrix: std of each contribution stream over windows
+    # (ref fit loop :119-122 computes torch.std over the training epoch)
+    j_cm = np.asarray(j_contrib).reshape(B, N * N).std(axis=0, ddof=1)
+    r_cm = torch.std(r_contrib[:, :, 0], dim=0)
+    np.testing.assert_allclose(j_cm, _np(r_cm), rtol=1e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# cLSTM parity (vendored torch module, ref models/clstm.py:12-160)
+# --------------------------------------------------------------------------
+def test_clstm_forward_and_gc_parity(ref):
+    """Copy the reference cLSTM's per-series nn.LSTM + Conv1d-head weights
+    into our scanned stacked block and assert per-step predictions and the
+    input-weight-norm GC readout match (ref clstm.py:100-112,126-156)."""
+    from models.clstm import cLSTM as RefCLSTM
+
+    from redcliff_tpu.models.clstm import clstm_forward, clstm_gc
+
+    C, H, T, B = 4, 6, 12, 5
+    torch.manual_seed(3)
+    ref_model = RefCLSTM(num_chans=C, hidden=H)
+
+    params = {
+        "w_ih": np.stack([_np(n.lstm.weight_ih_l0)
+                          for n in ref_model.networks]),
+        "w_hh": np.stack([_np(n.lstm.weight_hh_l0)
+                          for n in ref_model.networks]),
+        "b": np.stack([_np(n.lstm.bias_ih_l0) + _np(n.lstm.bias_hh_l0)
+                       for n in ref_model.networks]),
+        "head": {
+            "w": np.stack([_np(n.linear.weight)[0, :, 0]
+                           for n in ref_model.networks]),
+            "b": np.stack([_np(n.linear.bias)[0]
+                           for n in ref_model.networks]),
+        },
+    }
+
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(B, T, C)).astype(np.float32)
+    with torch.no_grad():
+        r_pred, _ = ref_model(torch.from_numpy(X))
+    j_pred, _ = clstm_forward(params, X)
+    np.testing.assert_allclose(np.asarray(j_pred), _np(r_pred),
+                               rtol=1e-5, atol=1e-5)
+
+    with torch.no_grad():
+        r_gc = ref_model.GC(threshold=False)
+    j_gc = clstm_gc(params, threshold=False)
+    np.testing.assert_allclose(np.asarray(j_gc), _np(r_gc),
+                               rtol=1e-5, atol=1e-6)
