@@ -131,7 +131,9 @@ def _orchestrate():
         if measure_attempts >= MAX_MEASURE_ATTEMPTS:
             # a tunnel that probes OK but hangs mid-measure must not keep
             # burning 25-minute measurement timeouts; bound the total
-            probe_log.append({"attempt": i, "ok": False,
+            probe_log.append({"attempt": i,
+                              "t_offset_s": round(time.monotonic() - t0, 1),
+                              "ok": False,
                               "info": "measurement attempt budget exhausted"})
             break
         measure_attempts += 1
@@ -146,8 +148,13 @@ def _orchestrate():
         print(f"bench: TPU measurement failed ({minfo}); continuing probes",
               file=sys.stderr, flush=True)
 
-    err = (f"accelerator unavailable across {len(PROBE_WAITS)} spread probe "
-           f"attempts over {round(time.monotonic() - t0)}s; ran on cpu")
+    if measure_attempts >= MAX_MEASURE_ATTEMPTS:
+        err = (f"accelerator probed OK but {measure_attempts} measurement "
+               f"attempts failed/hung (see probe_log); ran on cpu")
+    else:
+        err = (f"accelerator unavailable across {len(PROBE_WAITS)} spread "
+               f"probe attempts over {round(time.monotonic() - t0)}s; "
+               f"ran on cpu")
     payload, minfo = _run_measure_child("cpu", timeout_s=900.0)
     if payload is None:
         _emit({"metric": METRIC, "value": None, "unit": "windows/s/chip",

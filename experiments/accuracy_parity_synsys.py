@@ -96,10 +96,52 @@ DYNOTEARS_ARGS = {
     "tabu_parent_nodes": "None", "tabu_child_nodes": "None",
     "lag_size": "1", "signal_format": "original",
 }
+# ref train/cLSTM_synSysInnovGauss1030_BLgs2_mi300_cached_args.txt
+CLSTM_ARGS = {
+    "output_length": "1", "num_sims": "1", "embed_hidden_sizes": "[10]",
+    "batch_size": "128", "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "max_iter": "300", "lookback": "3", "check_every": "5", "verbose": "0",
+    "num_factors": "1", "num_supervised_factors": "0",
+    "wavelet_level": "None", "gen_hidden": "25", "gen_lr": "0.0001",
+    "context": "2", "max_input_length": "4", "FORECAST_COEFF": "1.0",
+    "FACTOR_SCORE_COEFF": "0.0", "ADJ_L1_REG_COEFF": "1.0",
+    "DAGNESS_REG_COEFF": "0.0", "DAGNESS_LAG_COEFF": "0.0",
+    "DAGNESS_NODE_COEFF": "0.0",
+}
+# ref train/DGCNN_synSysInnovGauss1030_BLgs2_mi300_cached_args.txt
+# (num_channels/num_classes follow the 6-node 2-factor dataset, as the
+# reference's per-dataset overwrite would set them)
+DGCNN_ARGS = {
+    "batch_size": "128", "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "max_iter": "300", "lookback": "1", "check_every": "10", "verbose": "0",
+    "num_channels": "6", "wavelet_level": "None",
+    "num_wavelets_per_chan": "1", "num_features_per_node": "2",
+    "num_graph_conv_layers": "3", "num_hidden_nodes": "250",
+    "num_classes": "2", "signal_format": "original flattened",
+    "gen_lr": "0.0001",
+}
+# ref train/DCSFANMF_synSysInnovGauss1030_BOBPgs2Parsim_cached_args.txt
+# (n_components/n_sup_networks follow the 2-factor dataset)
+DCSFA_ARGS = {
+    "batch_size": "128", "num_high_level_node_features": "13",
+    "best_model_name": "dCSFA-NMF-best-model.pt", "num_node_features": "50",
+    "n_components": "2", "n_sup_networks": "2",
+    "signal_format": "original flattened directed_spectrum vanilla",
+    "h": "256", "momentum": "0.9", "lr": "0.0005", "recon_weight": "2.0",
+    "sup_weight": "1.0", "sup_recon_weight": "1.0",
+    "sup_smoothness_weight": "1.0", "n_epochs": "250",
+    "n_pre_epochs": "50", "nmf_max_iter": "10",
+}
 
+# the reference's synSys experiment matrix is REDCLIFF-S vs
+# {cMLP, cLSTM, DGCNN, DCSFA-NMF} (train/*_synSysInnovGauss1030_*); NAVAR and
+# DYNOTEARS are its d4IC-only baselines, included here as extended baselines
 MODELS = (
     ("REDCLIFF_S_CMLP", REDCLIFF_ARGS, "REDCLIFF_S_CMLP"),
     ("cMLP", CMLP_ARGS, "CMLP"),
+    ("cLSTM", CLSTM_ARGS, "CLSTM"),
+    ("DGCNN", DGCNN_ARGS, "DGCNN"),
+    ("DCSFANMF", DCSFA_ARGS, "DCSFA"),
     ("NAVAR_CMLP", NAVAR_ARGS, "NAVAR_CMLP"),
     ("DYNOTEARS_Vanilla", DYNOTEARS_ARGS, "DYNOTEARS_Vanilla"),
 )
@@ -115,9 +157,21 @@ def main():
                          "fold parallelism), skip evaluation")
     ap.add_argument("--eval-only", action="store_true",
                     help="skip training (runs must exist) and just evaluate")
+    ap.add_argument("--system", default="6-2-2", choices=["6-2-2", "12-11-2"],
+                    help="synthetic system (nodes-edges-factors shorthand "
+                         "nN-nE-nF as in the paper)")
+    ap.add_argument("--algs", default="all", choices=["all", "ref"],
+                    help="'ref' = the reference's synSys baseline set only "
+                         "(REDCLIFF, cMLP, cLSTM, DGCNN, DCSFA)")
     args = ap.parse_args()
     base = args.workdir
     os.makedirs(base, exist_ok=True)
+    num_nodes, num_edges, _nf = (int(v) for v in args.system.split("-"))
+    sys_folder = f"synSys{num_nodes}{num_edges}2"
+    models = MODELS
+    if args.algs == "ref":
+        models = tuple(m for m in MODELS
+                       if m[0] not in ("NAVAR_CMLP", "DYNOTEARS_Vanilla"))
 
     # the reference curates 1040/240 recordings per class label (x(S+1)
     # labels = 3120/720); this environment has ONE cpu core, so we keep the
@@ -125,17 +179,28 @@ def main():
     # and coefficient rescaling stay exactly at reference scale
     n_train = 1040 if not args.smoke else 240
     n_val = 240 if not args.smoke else 96
-    model_args = {name: dict(a) for name, a, _ in MODELS}
+    model_args = {name: dict(a) for name, a, _ in models}
+    if num_nodes != 6:
+        for key in ("NAVAR_CMLP",):
+            if key in model_args:
+                model_args[key]["num_nodes"] = str(num_nodes)
+        if "DGCNN" in model_args:
+            model_args["DGCNN"]["num_channels"] = str(num_nodes)
     # deviation from the reference's d4IC NAVAR epochs=1000: the synSys
     # dataset is ~13x larger per fold and this study runs on CPU; NAVAR
     # plateaus well before 250 epochs here (loss history in the run dir)
-    model_args["NAVAR_CMLP"].update(epochs="250", check_every="50")
+    if "NAVAR_CMLP" in model_args:
+        model_args["NAVAR_CMLP"].update(epochs="250", check_every="50")
     if args.smoke:
         model_args["REDCLIFF_S_CMLP"].update(
             max_iter="12", num_pretrain_epochs="4",
             num_acclimation_epochs="4", check_every="2")
         model_args["cMLP"].update(max_iter="10", check_every="2")
-        model_args["NAVAR_CMLP"].update(epochs="40", check_every="20")
+        model_args["cLSTM"].update(max_iter="10", check_every="2")
+        model_args["DGCNN"].update(max_iter="10", check_every="2")
+        model_args["DCSFANMF"].update(n_epochs="10", n_pre_epochs="4")
+        if "NAVAR_CMLP" in model_args:
+            model_args["NAVAR_CMLP"].update(epochs="40", check_every="20")
 
     folds_to_run = (range(args.folds) if args.only_fold is None
                     else [args.only_fold])
@@ -144,13 +209,13 @@ def main():
     for fold in folds_to_run:
         t0 = time.time()
         fold_dir, _ = curate_synthetic_fold(
-            os.path.join(base, "data"), fold_id=fold, num_nodes=6,
+            os.path.join(base, "data"), fold_id=fold, num_nodes=num_nodes,
             num_lags=2, num_factors=2, num_supervised_factors=2,
-            num_edges_per_graph=2, num_samples_in_train_set=n_train,
+            num_edges_per_graph=num_edges, num_samples_in_train_set=n_train,
             num_samples_in_val_set=n_val, sample_recording_len=100,
             burnin_period=50, label_type_setting="OneHot",
             noise_type="gaussian", noise_level=1.0,
-            folder_name="synSys622")
+            folder_name=sys_folder)
         data_args_by_fold[fold] = os.path.join(
             fold_dir, f"data_fold{fold}_cached_args.txt")
         true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
@@ -158,7 +223,7 @@ def main():
               flush=True)
 
     roots = {}
-    for model_type, _, alias in MODELS:
+    for model_type, _, alias in models:
         margs_file = os.path.join(base, f"{model_type}_synSys_cached_args.txt")
         with open(margs_file, "w") as f:
             json.dump(model_args[model_type], f)
@@ -187,7 +252,7 @@ def main():
     from redcliff_tpu.data.shards import load_shard_samples
     for fold in range(args.folds):
         if fold not in data_args_by_fold:
-            fd = os.path.join(base, "data", "synSys622", f"fold_{fold}")
+            fd = os.path.join(base, "data", sys_folder, f"fold_{fold}")
             data_args_by_fold[fold] = os.path.join(
                 fd, f"data_fold{fold}_cached_args.txt")
             true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
@@ -199,14 +264,16 @@ def main():
 
     full = run_cross_algorithm_comparison(
         list(roots.values()), {"data": true_by_fold},
-        os.path.join(base, "evals", "numF2_numSF2_numN6_numE2_synSys622"),
+        os.path.join(base, "evals",
+                     f"numF2_numSF2_numN{num_nodes}_numE{num_edges}_"
+                     f"{sys_folder}"),
         num_folds=args.folds, plot=not args.smoke,
-        algorithms=[alias for _, _, alias in MODELS],
+        algorithms=[alias for _, _, alias in models],
         eval_inputs=eval_inputs)
 
     paradigm = "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag"
-    out = {"dataset": "synSys622 (numF2_numSF2_numN6_numE2, OneHot, "
-                      "gaussian innovations, reference sample counts)",
+    out = {"dataset": f"{sys_folder} (numF2_numSF2_numN{num_nodes}_"
+                      f"numE{num_edges}, OneHot, gaussian innovations)",
            "folds": args.folds, "smoke": bool(args.smoke),
            "train_samples_per_fold": n_train, "algorithms": {}}
     for alg, stats in full["data"][paradigm].items():
@@ -223,8 +290,9 @@ def main():
               f"ROC-AUC {out['algorithms'][alg]['offdiag_roc_auc_mean']}",
               flush=True)
 
+    tag = "" if args.system == "6-2-2" else "_" + args.system.replace("-", "_")
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "ACCURACY_SYNSYS.json" if not args.smoke
+                        f"ACCURACY_SYNSYS{tag}.json" if not args.smoke
                         else "ACCURACY_SYNSYS_smoke.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=2)

@@ -44,11 +44,24 @@ MODEL_REGISTRY = _registry
 def load_artifact(path):
     """Load a raw artifact payload from a run dir or file path."""
     if os.path.isdir(path):
-        for name in ("final_best_model.bin", "dCSFA-NMF-best-model.pkl"):
+        # cached-args may carry any best_model_name extension (the reference
+        # synSys DCSFA args use dCSFA-NMF-best-model.pt)
+        cands = [x for x in os.listdir(path)
+                 if x.startswith("dCSFA-NMF-best-model")]
+        # several best_model_name extensions may coexist (e.g. a stale .pkl
+        # next to the current .pt): take the most recently written
+        cands.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
+                   reverse=True)
+        names = ["final_best_model.bin"] + cands
+        for name in names:
             cand = os.path.join(path, name)
             if os.path.isfile(cand):
                 path = cand
                 break
+        else:
+            raise FileNotFoundError(
+                f"no model artifact (final_best_model.bin / "
+                f"dCSFA-NMF-best-model*) in {path!r}")
     with open(path, "rb") as f:
         return pickle.load(f)
 

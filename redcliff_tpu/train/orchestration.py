@@ -335,8 +335,19 @@ def call_model_fit_method(model, args_dict, train_ds, val_ds, save_dir=None,
             raise ValueError(
                 "DCSFA training requires feature-format datasets "
                 "(signal_format='directed_spectrum*'); got raw windows")
-        y_tr = np.asarray(train_ds.Y).reshape(len(train_ds), -1)
-        y_val = np.asarray(val_ds.Y).reshape(len(val_ds), -1)
+        def dcsfa_labels(ds):
+            """Label traces (N, R, T) average over time (the reference's
+            average_label_over_time_steps=True, ref synthetic_datasets.py:335)
+            and slice to the fit contract's n_sup_networks columns
+            (ref dcsfa_nmf.py fit docstring: y is [n_samples, n_sup_networks])."""
+            y = np.asarray(ds.Y)
+            if y.ndim == 3:
+                y = y.mean(axis=2)
+            y = y.reshape(len(ds), -1)
+            return y[:, : model.config.n_sup_networks]
+
+        y_tr = dcsfa_labels(train_ds)
+        y_val = dcsfa_labels(val_ds)
         params, state, hist = model.fit(
             key, X_tr, y_tr, X_val=X_val, y_val=y_val,
             n_epochs=args_dict.get("n_epochs", 100),
