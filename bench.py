@@ -148,9 +148,9 @@ def _orchestrate():
         print(f"bench: TPU measurement failed ({minfo}); continuing probes",
               file=sys.stderr, flush=True)
 
-    if measure_attempts >= MAX_MEASURE_ATTEMPTS:
+    if measure_attempts > 0:
         err = (f"accelerator probed OK but {measure_attempts} measurement "
-               f"attempts failed/hung (see probe_log); ran on cpu")
+               f"attempt(s) failed/hung (see probe_log); ran on cpu")
     else:
         err = (f"accelerator unavailable across {len(PROBE_WAITS)} spread "
                f"probe attempts over {round(time.monotonic() - t0)}s; "

@@ -181,11 +181,12 @@ def main():
     n_val = 240 if not args.smoke else 96
     model_args = {name: dict(a) for name, a, _ in models}
     if num_nodes != 6:
+        # NAVAR's num_nodes comes from its model cached-args; every other
+        # family's channel count is overwritten from the DATA cached-args by
+        # read_in_data_args
         for key in ("NAVAR_CMLP",):
             if key in model_args:
                 model_args[key]["num_nodes"] = str(num_nodes)
-        if "DGCNN" in model_args:
-            model_args["DGCNN"]["num_channels"] = str(num_nodes)
     # deviation from the reference's d4IC NAVAR epochs=1000: the synSys
     # dataset is ~13x larger per fold and this study runs on CPU; NAVAR
     # plateaus well before 250 epochs here (loss history in the run dir)

@@ -41,17 +41,31 @@ def _registry():
 MODEL_REGISTRY = _registry
 
 
-def load_artifact(path):
-    """Load a raw artifact payload from a run dir or file path."""
+def load_artifact(path, best_model_name=None):
+    """Load a raw artifact payload from a run dir or file path.
+
+    ``best_model_name`` names the artifact explicitly (DCSFA cached-args make
+    it configurable); otherwise the standard names are tried, newest first,
+    falling back to a lone pickle-like file in the directory."""
     if os.path.isdir(path):
+        cands = []
+        if best_model_name and os.path.isfile(os.path.join(path,
+                                                           best_model_name)):
+            cands.append(best_model_name)
         # cached-args may carry any best_model_name extension (the reference
-        # synSys DCSFA args use dCSFA-NMF-best-model.pt)
-        cands = [x for x in os.listdir(path)
-                 if x.startswith("dCSFA-NMF-best-model")]
-        # several best_model_name extensions may coexist (e.g. a stale .pkl
-        # next to the current .pt): take the most recently written
-        cands.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
-                   reverse=True)
+        # synSys DCSFA args use dCSFA-NMF-best-model.pt); several may coexist
+        # (e.g. a stale .pkl next to the current .pt): newest first
+        std = [x for x in os.listdir(path)
+               if x.startswith("dCSFA-NMF-best-model")]
+        std.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
+                 reverse=True)
+        cands += std
+        if not cands:
+            # non-standard best_model_name: accept a LONE pickle-like file
+            loose = [x for x in os.listdir(path)
+                     if x.endswith((".pt", ".pkl", ".bin"))]
+            if len(loose) == 1:
+                cands = loose
         names = ["final_best_model.bin"] + cands
         for name in names:
             cand = os.path.join(path, name)
@@ -83,7 +97,7 @@ def _migrate_config(config):
     return config
 
 
-def load_model_for_eval(path, model_class=None):
+def load_model_for_eval(path, model_class=None, best_model_name=None):
     """Reconstruct (model, params[, state]) from a saved artifact.
 
     Returns (model, params) for functional models, or (model, params, state)
@@ -91,7 +105,7 @@ def load_model_for_eval(path, model_class=None):
     overrides the class recorded in the payload (useful for alias loading,
     the reference's alg_name_alias concept).
     """
-    payload = load_artifact(path)
+    payload = load_artifact(path, best_model_name=best_model_name)
     registry = _registry()
     cls_name = model_class or payload.get("model_class")
     if cls_name is None and "config" in payload:

@@ -699,7 +699,11 @@ class FullDCSFAModel(DcsfaNmf):
             node_len = F * (2 * n - 1)
             assert factor.shape[1] == n * node_len
             node_subfactors = factor.reshape(n, node_len)
-            raw = unflatten_directed_spectrum_features(node_subfactors)
+            # accumulate_shared_entries matches the reference readout, whose
+            # unflatten doubles off-diagonal entries (ref dcsfa_nmf.py:1305
+            # via misc.py:178-195)
+            raw = unflatten_directed_spectrum_features(
+                node_subfactors, accumulate_shared_entries=True)
         else:
             raw = factor.reshape(n, n, F)
         GC = raw * raw

@@ -182,19 +182,34 @@ def flatten_directed_spectrum_features(x):
     return x_flat
 
 
-def unflatten_directed_spectrum_features(x_flat):
-    """Inverse of flatten_directed_spectrum_features (ref misc.py:178-195)."""
+def unflatten_directed_spectrum_features(x_flat, accumulate_shared_entries=False):
+    """Inverse of flatten_directed_spectrum_features (ref misc.py:178-195).
+
+    The reference's implementation ACCUMULATES the row and column writes
+    (``x[...] = x_flat[...] + x[...]``), so every off-diagonal entry — which
+    appears in two nodes' flattened rows — comes out doubled; it is not a
+    true inverse. ``accumulate_shared_entries=True`` reproduces that exactly
+    (the reference's only call site, the DCSFA GC readout
+    ref dcsfa_nmf.py:1305, inherits the doubling); the default keeps the
+    exact inverse for feature round-trips.
+    """
     x_flat = np.asarray(x_flat)
     assert x_flat.ndim == 2
     n = x_flat.shape[0]
     m = x_flat.shape[1] // (2 * n - 1)
-    x = np.zeros((n, n, m), dtype=x_flat.dtype)
+    # float64 output like the reference's np.zeros (also keeps the halving
+    # below exact for integer inputs)
+    x = np.zeros((n, n, m))
     for i in range(m):
         c0 = i * (2 * n - 1)
         for j in range(n):
-            x[j, :, i] = x_flat[j, c0 : c0 + n]
-            x[:j, j, i] = x_flat[j, c0 + n : c0 + n + j]
-            x[j + 1 :, j, i] = x_flat[j, c0 + n + j : c0 + (2 * n - 1)]
+            x[j, :, i] += x_flat[j, c0 : c0 + n]
+            x[:j, j, i] += x_flat[j, c0 + n : c0 + n + j]
+            x[j + 1 :, j, i] += x_flat[j, c0 + n + j : c0 + (2 * n - 1)]
+    if not accumulate_shared_entries:
+        # halve the doubled off-diagonal entries back to the true inverse
+        off = ~np.eye(n, dtype=bool)
+        x[off] *= 0.5
     return x
 
 

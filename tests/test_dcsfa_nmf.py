@@ -149,8 +149,11 @@ def test_full_dcsfa_gc_dirspec_layout():
 
 
 def test_full_dcsfa_gc_recovers_planted_tensor():
-    """A W_nmf row built by flattening a known dirspec tensor must unflatten
-    back to (elementwise square, summed over features) of that tensor."""
+    """A W_nmf row built by flattening a known dirspec tensor unflattens with
+    the REFERENCE's accumulate semantics: off-diagonal entries (present in
+    two nodes' flattened rows) come back doubled, so the squared-and-summed
+    GC carries a 4x off-diagonal factor (ref dcsfa_nmf.py:1305 via
+    misc.py:178-195)."""
     n_nodes, F = 3, 2
     rng = np.random.default_rng(7)
     planted = rng.uniform(0.1, 1.0, size=(n_nodes, n_nodes, F))
@@ -159,7 +162,9 @@ def test_full_dcsfa_gc_recovers_planted_tensor():
                            n_components=1, n_sup_networks=1, h=8)
     gc = model.get_factor_gc(flat.reshape(1, -1), threshold=False,
                              ignore_features=True)
-    np.testing.assert_allclose(gc, (planted**2).sum(axis=2), rtol=1e-6)
+    scale = 4.0 - 3.0 * np.eye(n_nodes)  # 1x diag, 4x off-diag
+    np.testing.assert_allclose(gc, scale * (planted**2).sum(axis=2),
+                               rtol=1e-6)
 
 
 def test_full_dcsfa_vanilla_layout():

@@ -605,3 +605,67 @@ def test_clstm_forward_and_gc_parity(ref):
     j_gc = clstm_gc(params, threshold=False)
     np.testing.assert_allclose(np.asarray(j_gc), _np(r_gc),
                                rtol=1e-5, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# DCSFA-NMF parity (vendored torch module, ref models/dcsfa_nmf.py)
+# --------------------------------------------------------------------------
+def test_dcsfa_transform_and_gc_parity(ref):
+    """Copy a reference FullDCSFAModel's encoder/NMF/logistic weights into
+    our param pytree and assert eval-mode transform outputs (recon, class
+    probabilities, scores) and the per-factor GC readout match
+    (ref dcsfa_nmf.py transform :796-860, get_factor_GC :1299-1315)."""
+    from models.dcsfa_nmf import FullDCSFAModel as RefFull
+
+    from redcliff_tpu.models.dcsfa_nmf import (DcsfaNmfConfig,
+                                               FullDCSFAModel)
+
+    N_NODES, HLF, NC, NS, H = 4, 3, 3, 2, 16
+    node_factor_len = HLF * (2 * N_NODES - 1)
+    dim_in = N_NODES * node_factor_len
+    torch.manual_seed(5)
+    ref_model = RefFull(num_nodes=N_NODES, num_high_level_node_features=HLF,
+                        n_components=NC, n_sup_networks=NS, h=H,
+                        device="cpu")
+    ref_model._initialize(dim_in)
+    ref_model.eval()
+
+    ours = FullDCSFAModel(
+        num_nodes=N_NODES, num_high_level_node_features=HLF,
+        gc_feature_layout="dirspec",
+        config=DcsfaNmfConfig(n_components=NC, n_sup_networks=NS, h=H))
+
+    enc = ref_model.encoder
+    params = {
+        "W_nmf": _np(ref_model.W_nmf),
+        "enc1": {"w": _np(enc[0].weight).T, "b": _np(enc[0].bias)},
+        "bn_scale": _np(enc[1].weight), "bn_shift": _np(enc[1].bias),
+        "enc2": {"w": _np(enc[3].weight).T, "b": _np(enc[3].bias)},
+        "phi": np.array([_np(p)[0] for p in ref_model.phi_list]),
+        "beta": np.stack([_np(b)[:, 0] for b in ref_model.beta_list]),
+    }
+    state = {"bn_mean": _np(enc[1].running_mean),
+             "bn_var": _np(enc[1].running_var)}
+
+    rng = np.random.default_rng(6)
+    X = np.abs(rng.normal(size=(9, dim_in))).astype(np.float32)
+    with torch.no_grad():
+        r_recon, r_pred, r_s = ref_model.transform(
+            torch.from_numpy(X), avg_intercept=True, return_npy=True)
+    j_s, _ = ours.encode(params, state, X, train=False)
+    j_s = np.asarray(j_s)
+    j_recon = j_s @ np.asarray(ours.get_w_nmf(params))
+    j_pred = np.asarray(ours.class_predictions(params, j_s,
+                                               avg_intercept=True))
+    np.testing.assert_allclose(j_s, r_s, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(j_recon, r_recon, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(j_pred, r_pred, rtol=1e-4, atol=1e-5)
+
+    # GC readout from the copied W_nmf (threshold=False path is pure numpy
+    # in the reference, so it runs without torch state)
+    r_gc = ref_model.GC(threshold=False, ignore_features=True)
+    j_gc = ours.gc(params, threshold=False)
+    assert len(j_gc) >= NS
+    for k in range(len(r_gc)):
+        np.testing.assert_allclose(np.asarray(j_gc[k]), np.asarray(r_gc[k]),
+                                   rtol=1e-4, atol=1e-6)
