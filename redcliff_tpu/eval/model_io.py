@@ -48,22 +48,31 @@ def load_artifact(path, best_model_name=None):
     it configurable); otherwise the standard names are tried, newest first,
     falling back to a lone pickle-like file in the directory."""
     if os.path.isdir(path):
-        cands = []
-        if best_model_name and os.path.isfile(os.path.join(path,
-                                                           best_model_name)):
-            cands.append(best_model_name)
+        if best_model_name:
+            # an explicit name wins outright and must exist
+            named = os.path.join(path, best_model_name)
+            if not os.path.isfile(named):
+                raise FileNotFoundError(
+                    f"best_model_name {best_model_name!r} not found in "
+                    f"{path!r}")
+            path = named
+            with open(path, "rb") as f:
+                return pickle.load(f)
         # cached-args may carry any best_model_name extension (the reference
         # synSys DCSFA args use dCSFA-NMF-best-model.pt); several may coexist
         # (e.g. a stale .pkl next to the current .pt): newest first
-        std = [x for x in os.listdir(path)
-               if x.startswith("dCSFA-NMF-best-model")]
-        std.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
-                 reverse=True)
-        cands += std
+        cands = [x for x in os.listdir(path)
+                 if x.startswith("dCSFA-NMF-best-model")]
+        cands.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
+                   reverse=True)
         if not cands:
             # non-standard best_model_name: accept a LONE pickle-like file
+            # that is not one of the known non-model artifacts
+            non_model = {"training_meta_data_and_hyper_parameters.pkl",
+                         "trainer_checkpoint.pkl"}
             loose = [x for x in os.listdir(path)
-                     if x.endswith((".pt", ".pkl", ".bin"))]
+                     if x.endswith((".pt", ".pkl", ".bin"))
+                     and x not in non_model]
             if len(loose) == 1:
                 cands = loose
         names = ["final_best_model.bin"] + cands

@@ -54,7 +54,11 @@ class TSTransformerConfig:
 
 
 def _act(cfg):
-    return jax.nn.gelu if cfg.activation == "gelu" else jax.nn.relu
+    if cfg.activation == "gelu":
+        # exact (erf) form: torch F.gelu's default, which the reference uses;
+        # jax.nn.gelu defaults to the tanh approximation
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    return jax.nn.relu
 
 
 def _dense_init(key, d_in, d_out):

@@ -669,3 +669,125 @@ def test_dcsfa_transform_and_gc_parity(ref):
     for k in range(len(r_gc)):
         np.testing.assert_allclose(np.asarray(j_gc[k]), np.asarray(r_gc[k]),
                                    rtol=1e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# TS transformer parity (vendored mvts module, ref models/ts_transformer.py)
+# --------------------------------------------------------------------------
+def _shim_encoder_layers(ref_model):
+    """torch>=2.2's nn.TransformerEncoder passes is_causal= to each layer;
+    the reference's custom TransformerBatchNormEncoderLayer predates that
+    kwarg.  Drop it — version compatibility only, no math changes."""
+    for layer in ref_model.transformer_encoder.layers:
+        orig = layer.forward
+
+        def fwd(src, *a, _o=orig, **kw):
+            kw.pop("is_causal", None)
+            return _o(src, *a, **kw)
+
+        layer.forward = fwd
+
+
+def _copy_ts_transformer(ref_model, num_layers, learnable_pos=False):
+    d = ref_model.d_model
+    params = {"project_inp": {"w": _np(ref_model.project_inp.weight).T,
+                              "b": _np(ref_model.project_inp.bias)}}
+    if learnable_pos:
+        params["pos"] = _np(ref_model.pos_enc.pe)[:, 0, :]
+    layers = []
+    for li in range(num_layers):
+        rl = ref_model.transformer_encoder.layers[li]
+        in_proj = _np(rl.self_attn.in_proj_weight)
+        layers.append({
+            "wq": in_proj[:d].T, "wk": in_proj[d:2 * d].T,
+            "wv": in_proj[2 * d:].T,
+            "wo": _np(rl.self_attn.out_proj.weight).T,
+            "ff1": {"w": _np(rl.linear1.weight).T, "b": _np(rl.linear1.bias)},
+            "ff2": {"w": _np(rl.linear2.weight).T, "b": _np(rl.linear2.bias)},
+            "norm1_scale": _np(rl.norm1.weight),
+            "norm1_shift": _np(rl.norm1.bias),
+            "norm2_scale": _np(rl.norm2.weight),
+            "norm2_shift": _np(rl.norm2.bias),
+        })
+    params["layers"] = layers
+    params["output"] = {"w": _np(ref_model.output_layer.weight).T,
+                        "b": _np(ref_model.output_layer.bias)}
+    return params
+
+
+@pytest.mark.parametrize("partial_mask", [False, True])
+def test_ts_transformer_encoder_parity(ref, partial_mask):
+    """Copy the reference TSTransformerEncoder's weights (BatchNorm variant,
+    the mvts default) and assert the denoising-head forward matches in
+    batch-statistics mode (ref :145-190).  dropout=0 so train() only
+    switches BatchNorm to the batch statistics our stateless norm uses."""
+    from models.ts_transformer import TSTransformerEncoder as RefTST
+
+    from redcliff_tpu.models.ts_transformer import (TSTransformerConfig,
+                                                    TSTransformerEncoder)
+
+    F_DIM, T, D, H, L, FF = 5, 12, 8, 2, 2, 16
+    torch.manual_seed(7)
+    ref_model = RefTST(feat_dim=F_DIM, max_len=T, d_model=D, n_heads=H,
+                       num_layers=L, dim_feedforward=FF, dropout=0.0,
+                       pos_encoding="fixed", activation="gelu",
+                       norm="BatchNorm")
+    ref_model.train()  # batch-statistics BatchNorm; dropout=0 stays inert
+    _shim_encoder_layers(ref_model)
+
+    cfg = TSTransformerConfig(feat_dim=F_DIM, max_len=T, d_model=D,
+                              n_heads=H, num_layers=L, dim_feedforward=FF,
+                              pos_encoding="fixed", activation="gelu",
+                              norm="BatchNorm")
+    ours = TSTransformerEncoder(cfg)
+    params = _copy_ts_transformer(ref_model, L)
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(6, T, F_DIM)).astype(np.float32)
+    mask = np.ones((6, T), dtype=bool)
+    if partial_mask:
+        mask[:, -3:] = False
+    with torch.no_grad():
+        r_out = ref_model(torch.from_numpy(X), torch.from_numpy(mask))
+    j_out = ours.forward(params, X, padding_masks=mask)
+    np.testing.assert_allclose(np.asarray(j_out), _np(r_out),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ts_transformer_classiregressor_parity(ref):
+    """The classification head: padded embeddings zeroed, flattened linear
+    (ref TSTransformerEncoderClassiregressor :192-250)."""
+    from models.ts_transformer import (
+        TSTransformerEncoderClassiregressor as RefClf,
+    )
+
+    from redcliff_tpu.models.ts_transformer import (
+        TSTransformerConfig,
+        TSTransformerEncoderClassiregressor,
+    )
+
+    F_DIM, T, D, H, L, FF, NCLS = 4, 10, 8, 2, 1, 12, 3
+    torch.manual_seed(9)
+    ref_model = RefClf(feat_dim=F_DIM, max_len=T, d_model=D, n_heads=H,
+                       num_layers=L, dim_feedforward=FF, num_classes=NCLS,
+                       dropout=0.0, pos_encoding="fixed", activation="gelu",
+                       norm="BatchNorm")
+    ref_model.train()
+    _shim_encoder_layers(ref_model)
+
+    cfg = TSTransformerConfig(feat_dim=F_DIM, max_len=T, d_model=D,
+                              n_heads=H, num_layers=L, dim_feedforward=FF,
+                              num_classes=NCLS, pos_encoding="fixed",
+                              activation="gelu", norm="BatchNorm")
+    ours = TSTransformerEncoderClassiregressor(cfg)
+    params = _copy_ts_transformer(ref_model, L)
+
+    rng = np.random.default_rng(10)
+    X = rng.normal(size=(5, T, F_DIM)).astype(np.float32)
+    mask = np.ones((5, T), dtype=bool)
+    mask[:, -2:] = False
+    with torch.no_grad():
+        r_out = ref_model(torch.from_numpy(X), torch.from_numpy(mask))
+    j_out = ours.forward(params, X, padding_masks=mask)
+    np.testing.assert_allclose(np.asarray(j_out), _np(r_out),
+                               rtol=1e-4, atol=1e-5)
