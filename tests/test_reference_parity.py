@@ -23,8 +23,11 @@ modules (import-time dependencies only; no stubbed code runs in these tests).
 
 Also A/B'd against the actual reference code here: the DYNOTEARS
 augmented-Lagrangian solver (scipy vs scipy, incl. the warm-started refit
-chain) and NAVAR (forward, contributions, and the std-over-windows causal
-matrix).
+chain), NAVAR (forward, contributions, std-over-windows causal matrix),
+cLSTM (stacked-LSTM forward + input-norm GC), DCSFA-NMF (eval-mode
+transform, class predictions, reconstruction, W_nmf GC readout incl. the
+reference's off-diagonal-doubling unflatten), and the mvts TS transformer
+(BatchNorm encoder + classiregressor head) — six model families total.
 """
 import sys
 import types
