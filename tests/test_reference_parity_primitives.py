@@ -1,0 +1,233 @@
+"""L1-primitive A/B against the actual reference implementations.
+
+Imports the reference's general_utils modules from /root/reference and
+compares our metric library, GC plumbing, directed-spectrum estimator
+(both directly and through the feature pipeline), and signal-processing
+helpers on identical random inputs.  (The model-level A/B lives in
+test_reference_parity.py, whose ref() fixture carries the same
+reference-import scaffolding plus the torcheeg stub that module needs.)
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REF_ROOT = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def refgu():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    if "pywt" not in sys.modules:
+        m = types.ModuleType("pywt")
+        m.swt = m.iswt = m.Wavelet = None
+        sys.modules["pywt"] = m
+    if REF_ROOT not in sys.path:
+        sys.path.append(REF_ROOT)
+    from general_utils import directed_spectrum as rds
+    from general_utils import metrics as rm
+    from general_utils import misc as rmisc
+    from general_utils import time_series as rts
+
+    return types.SimpleNamespace(metrics=rm, misc=rmisc, ts=rts, ds=rds)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+# --------------------------------------------------------------------------
+# metrics library
+# --------------------------------------------------------------------------
+def test_optimal_f1_and_fixed_f1_match_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    labels = (rng.uniform(size=60) > 0.6).astype(int)
+    scores = rng.uniform(size=60)
+    r_thresh, r_f1 = refgu.metrics.compute_optimal_f1(list(labels), scores)
+    j_thresh, j_f1 = M.compute_optimal_f1(labels, scores)
+    assert j_f1 == pytest.approx(r_f1)
+    assert j_thresh == pytest.approx(r_thresh)
+    for cutoff in (0.3, 0.5, 0.8):
+        assert M.compute_f1(labels, scores, cutoff) == pytest.approx(
+            refgu.metrics.compute_f1(list(labels), scores, cutoff))
+
+
+def test_confusion_rate_family_matches_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    labels = (rng.uniform(size=40) > 0.5).astype(int)
+    preds = rng.uniform(size=40)
+    cutoff = 0.45
+    r = refgu.metrics.compute_true_PosNeg_and_false_PosNeg_rates(
+        labels, preds, pred_cutoff=cutoff)
+    j = M.confusion_counts(labels, preds, cutoff)
+    np.testing.assert_array_equal(j, r)  # (tp, tn, fp, fn) counts
+    assert M.compute_sensitivity(labels, preds, cutoff) == pytest.approx(
+        refgu.metrics.compute_sensitivity(labels, preds, pred_cutoff=cutoff))
+    assert M.compute_specificity(labels, preds, cutoff) == pytest.approx(
+        refgu.metrics.compute_specificity(labels, preds, pred_cutoff=cutoff))
+
+
+def test_deltacon_family_matches_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    A = rng.uniform(size=(6, 6))
+    B = (rng.uniform(size=(6, 6)) > 0.5).astype(float)
+    eps = 0.1
+    assert M.deltacon0(A, B, eps) == pytest.approx(
+        float(refgu.metrics.deltacon0(A, B, eps)))
+    assert M.deltacon0(A, B, eps, make_graphs_undirected=True) == pytest.approx(
+        float(refgu.metrics.deltacon0(A, B, eps, make_graphs_undirected=True)))
+    assert M.deltacon0_with_directed_degrees(A, B, eps, 1.0, 2.0) == \
+        pytest.approx(float(refgu.metrics.deltacon0_with_directed_degrees(
+            A, B, eps, in_degree_coeff=1.0, out_degree_coeff=2.0)))
+    assert M.deltaffinity(A, B, eps) == pytest.approx(
+        float(refgu.metrics.deltaffinity(A, B, eps)))
+    assert M.deltaffinity(A, B, eps, max_path_length=3) == pytest.approx(
+        float(refgu.metrics.deltaffinity(A, B, eps, max_path_length=3)))
+    assert M.matsusita_distance(np.abs(A), np.abs(B)) == pytest.approx(
+        float(refgu.metrics.matsusita_distance(np.abs(A), np.abs(B))))
+
+
+def test_path_length_mse_matches_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    A = (rng.uniform(size=(5, 5)) > 0.6).astype(float)
+    B = (rng.uniform(size=(5, 5)) > 0.6).astype(float)
+    r_total, r_per_k = refgu.metrics.path_length_mse(A, B)
+    j_total, j_per_k = M.path_length_mse(A, B)
+    assert j_total == pytest.approx(float(r_total))
+    np.testing.assert_allclose(j_per_k, [float(x) for x in r_per_k],
+                               rtol=1e-10)
+
+
+def test_hungarian_and_cosine_match_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    ests = [rng.uniform(size=(4, 4)) for _ in range(3)]
+    trues = [rng.uniform(size=(4, 4)) for _ in range(3)]
+    r_rows, r_cols = refgu.metrics.solve_linear_sum_assignment_between_graph_options(
+        ests, trues)
+    j_rows, j_cols = M.solve_linear_sum_assignment_between_graph_options(
+        ests, trues)
+    np.testing.assert_array_equal(j_rows, r_rows)
+    np.testing.assert_array_equal(j_cols, r_cols)
+    assert M.compute_cosine_similarity(ests[0], trues[0]) == pytest.approx(
+        float(refgu.metrics.compute_cosine_similarity(ests[0], trues[0])))
+
+
+def test_dagness_and_components_match_reference(refgu, rng):
+    from redcliff_tpu.utils import metrics as M
+
+    A = rng.uniform(size=(5, 5))
+    ref_loss = refgu.metrics.DAGNessLoss()(torch.from_numpy(A))
+    assert float(M.dagness_penalty(A)) == pytest.approx(float(ref_loss),
+                                                        rel=1e-6)
+    B = (rng.uniform(size=(6, 6)) > 0.7).astype(float)
+    assert M.get_number_of_connected_components(B) == \
+        refgu.metrics.get_number_of_connected_components(B)
+
+
+def test_misc_plumbing_matches_reference(refgu, rng):
+    from redcliff_tpu.utils import misc as misc
+
+    A = rng.uniform(size=(4, 4))
+    np.testing.assert_allclose(misc.normalize_array(A),
+                               refgu.misc.normalize_numpy_array(A))
+    np.testing.assert_allclose(
+        misc.mask_diag_elements(A),
+        refgu.misc.mask_diag_elements_of_square_numpy_array(A))
+    vals = list(rng.uniform(size=7))
+    np.testing.assert_allclose(
+        misc.place_on_zero_to_one_scale(vals),
+        refgu.misc.place_list_elements_on_zero_to_one_scale(vals))
+    G = rng.uniform(size=(3, 4, 2))
+    np.testing.assert_allclose(
+        misc.flatten_gc_with_lags(G),
+        refgu.misc.flatten_GC_estimate_with_lags(G))
+    sqG = rng.uniform(size=(4, 4 * 2))
+    np.testing.assert_allclose(
+        misc.unflatten_gc_with_lags(sqG),
+        refgu.misc.unflatten_GC_estimate_with_lags(sqG))
+    sq = rng.uniform(size=(4, 4, 2))
+    np.testing.assert_allclose(
+        misc.flatten_directed_spectrum_features(sq),
+        refgu.misc.flatten_directed_spectrum_features(sq))
+    # the reference's unflatten doubles off-diagonal entries; our
+    # accumulate_shared_entries=True reproduces it exactly
+    flat = misc.flatten_directed_spectrum_features(sq)
+    np.testing.assert_allclose(
+        misc.unflatten_directed_spectrum_features(
+            flat, accumulate_shared_entries=True),
+        refgu.misc.unflatten_directed_spectrum_features(flat))
+
+
+# --------------------------------------------------------------------------
+# signal processing + directed spectrum
+# --------------------------------------------------------------------------
+def test_filters_and_outliers_match_reference(refgu, rng):
+    from redcliff_tpu.utils import time_series as TS
+
+    x = rng.normal(size=1000)
+    fs = 500
+    r = refgu.ts.filter_signal_via_lowpass(x, fs, cutoff=40.0)
+    j = TS.filter_signal_via_lowpass(x, fs, cutoff=40.0)
+    np.testing.assert_allclose(j, r, rtol=1e-8, atol=1e-10)
+    r = refgu.ts.filter_signal_via_bandpass(x, fs, lowcut=5.0, highcut=50.0)
+    j = TS.filter_signal_via_bandpass(x, fs, lowcut=5.0, highcut=50.0)
+    np.testing.assert_allclose(j, r, rtol=1e-8, atol=1e-10)
+    lfps = {"roi": x.copy()}
+    lfps["roi"][100] = 50.0
+    r_marked = refgu.ts.mark_outliers({k: v.copy() for k, v in lfps.items()},
+                                      fs)
+    j_marked = TS.mark_outliers({k: v.copy() for k, v in lfps.items()}, fs)
+    np.testing.assert_array_equal(np.isnan(j_marked["roi"]),
+                                  np.isnan(r_marked["roi"]))
+
+
+def test_high_level_signal_features_match_reference(refgu, rng):
+    """CSD power features + directed spectrum — the DCSFA input features
+    (ref time_series.py:121-238, directed_spectrum.py:48-145)."""
+    from redcliff_tpu.utils import time_series as TS
+
+    x = rng.normal(size=(64, 3)).astype(np.float64)
+    kwargs = dict(fs=1000, min_freq=0.0, max_freq=250.0,
+                  directed_spectrum=True,
+                  csd_params={"detrend": "constant", "window": "hann",
+                              "nperseg": 32, "noverlap": 16, "nfft": None})
+    r = refgu.ts.make_high_level_signal_features(x, **kwargs)
+    j = TS.make_high_level_signal_features(x, **kwargs)
+    assert set(j.keys()) >= {"power", "freq", "dir_spec"}
+    np.testing.assert_allclose(np.asarray(j["freq"]), np.asarray(r["freq"]),
+                               rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(j["power"]),
+                               np.asarray(r["power"]), rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(j["dir_spec"]),
+                               np.asarray(r["dir_spec"]),
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_directed_spectrum_matches_reference(refgu, rng):
+    """Direct A/B of the Wilson-factorization directed-spectrum estimator
+    (ref directed_spectrum.py:48-145), pairwise and joint."""
+    from redcliff_tpu.utils import directed_spectrum as DS
+
+    x = rng.normal(size=(2, 3, 128))  # [n_window, n_roi, time]
+    csd_params = {"detrend": "constant", "window": "hann", "nperseg": 64,
+                  "noverlap": 32, "nfft": None}
+    for pairwise in (True, False):
+        r_f, r_ds = refgu.ds.get_directed_spectrum(
+            x, 500, pairwise=pairwise, csd_params=csd_params)
+        j_f, j_ds = DS.get_directed_spectrum(
+            x, 500, pairwise=pairwise, csd_params=csd_params)
+        np.testing.assert_allclose(np.asarray(j_f), np.asarray(r_f),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(j_ds), np.asarray(r_ds),
+                                   rtol=1e-4, atol=1e-8)
