@@ -23,3 +23,29 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running integration test (full pipelines, "
         "multi-process runs)")
+
+
+def add_reference_to_path(extra_stubs=()):
+    """Make /root/reference importable for the A/B parity suites: headless
+    matplotlib, stub modules for import-time-only dependencies that are not
+    installed (pywt always; torcheeg for the model-level suite), and the
+    reference root on sys.path.  Returns the reference root."""
+    import sys
+    import types
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    stubs = {"pywt": {"swt": None, "iswt": None, "Wavelet": None}}
+    for name, attrs in extra_stubs:
+        stubs[name] = attrs
+    for name, attrs in stubs.items():
+        if name not in sys.modules:
+            m = types.ModuleType(name)
+            for a, v in attrs.items():
+                setattr(m, a, v)
+            sys.modules[name] = m
+    ref_root = "/root/reference"
+    if ref_root not in sys.path:
+        sys.path.append(ref_root)
+    return ref_root

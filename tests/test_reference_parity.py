@@ -45,22 +45,13 @@ REF_ROOT = "/root/reference"
 # --------------------------------------------------------------------------
 @pytest.fixture(scope="module")
 def ref():
-    import matplotlib
+    from conftest import add_reference_to_path
 
-    matplotlib.use("Agg")
-    for name, attrs in [
+    add_reference_to_path(extra_stubs=[
         ("torcheeg", {}),
         ("torcheeg.models", {"DGCNN": type("DGCNN", (), {})}),
-        ("pywt", {"swt": None, "iswt": None, "Wavelet": None}),
-    ]:
-        if name not in sys.modules:
-            m = types.ModuleType(name)
-            for a, v in attrs.items():
-                setattr(m, a, v)
-            sys.modules[name] = m
+    ])
     sys.modules["torcheeg"].models = sys.modules["torcheeg.models"]
-    if REF_ROOT not in sys.path:
-        sys.path.append(REF_ROOT)
     from models.redcliff_s_cmlp import REDCLIFF_S_CMLP
     from models.redcliff_s_cmlp_withStateSmoothing import (
         REDCLIFF_S_CMLP_withStateSmoothing,

@@ -20,15 +20,9 @@ REF_ROOT = "/root/reference"
 
 @pytest.fixture(scope="module")
 def refgu():
-    import matplotlib
+    from conftest import add_reference_to_path
 
-    matplotlib.use("Agg")
-    if "pywt" not in sys.modules:
-        m = types.ModuleType("pywt")
-        m.swt = m.iswt = m.Wavelet = None
-        sys.modules["pywt"] = m
-    if REF_ROOT not in sys.path:
-        sys.path.append(REF_ROOT)
+    add_reference_to_path()
     from general_utils import directed_spectrum as rds
     from general_utils import metrics as rm
     from general_utils import misc as rmisc
@@ -231,3 +225,98 @@ def test_directed_spectrum_matches_reference(refgu, rng):
                                    rtol=1e-10)
         np.testing.assert_allclose(np.asarray(j_ds), np.asarray(r_ds),
                                    rtol=1e-4, atol=1e-8)
+
+
+# --------------------------------------------------------------------------
+# tidybench (pure-numpy reference algorithms)
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def reftb(refgu):
+    from tidybench import lasar as rlasar
+    from tidybench import qrbs as rqrbs
+    from tidybench import slarac as rslarac
+
+    return types.SimpleNamespace(slarac=rslarac, qrbs=rqrbs, lasar=rlasar)
+
+
+def _var_series(rng, T=120, N=4):
+    x = np.zeros((T, N))
+    A = 0.4 * (rng.uniform(size=(N, N)) > 0.7)
+    for t in range(1, T):
+        x[t] = x[t - 1] @ A + rng.normal(scale=0.5, size=N)
+    return x
+
+
+def test_slarac_deterministic_core_matches_reference(reftb, rng, monkeypatch):
+    """n_subsamples=0 removes the random subsampling, leaving the full-data
+    VAR coefficient scores (ref slarac.py:56-57).  maxlags=1 is fully
+    deterministic; for maxlags=2 both sides' random effective-lag draw
+    (ref :88) is pinned to the maximum so the regression math can be A/B'd."""
+    from redcliff_tpu.tidybench.slarac import slarac
+
+    data = _var_series(rng)
+    r = reftb.slarac.slarac(data.copy(), maxlags=1, n_subsamples=0)
+    j = slarac(data.copy(), maxlags=1, n_subsamples=0)
+    np.testing.assert_allclose(np.asarray(j), np.asarray(r),
+                               rtol=1e-8, atol=1e-10)
+
+    def ref_choice(a, size=None):
+        if size is not None:  # the subsample-size draw (empty here)
+            return np.asarray(a)[:0]
+        return np.asarray(a)[-1]  # the effective-lag draw -> max lag
+
+    monkeypatch.setattr(reftb.slarac.np.random, "choice", ref_choice)
+
+    class _MaxLag:
+        def integers(self, low, high, size=None):
+            return high - 1
+
+        def choice(self, a, size=None):
+            return np.asarray(a)[:0]  # n_subsamples == 0
+
+    import importlib
+
+    jsm = importlib.import_module("redcliff_tpu.tidybench.slarac")
+    monkeypatch.setattr(jsm.np.random, "default_rng",
+                        lambda rng=None: _MaxLag())
+    r = reftb.slarac.slarac(data.copy(), maxlags=2, n_subsamples=0)
+    j = slarac(data.copy(), maxlags=2, n_subsamples=0)
+    np.testing.assert_allclose(np.asarray(j), np.asarray(r),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_lasar_deterministic_core_matches_reference(reftb, rng):
+    """n_subsamples=0: the full-data LassoCV estimate only
+    (ref lasar.py:58-60) — deterministic A/B."""
+    from redcliff_tpu.tidybench.lasar import lasar
+
+    data = _var_series(rng, T=150)
+    r = reftb.lasar.lasar(data.copy(), maxlags=2, n_subsamples=0)
+    j = lasar(data.copy(), maxlags=2, n_subsamples=0)
+    np.testing.assert_allclose(np.asarray(j), np.asarray(r),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_qrbs_ridge_core_matches_reference(reftb, rng, monkeypatch):
+    """The ridge + lag-aggregation + quantile math, with both sides'
+    bootstrap forced to the same deterministic first-k rows (the reference
+    resamples through sklearn's global RNG, ours through a Generator, so
+    exact A/B of the random draws is impossible by construction)."""
+    import importlib
+
+    jqm = importlib.import_module("redcliff_tpu.tidybench.qrbs")
+
+    data = _var_series(rng, T=140)
+    monkeypatch.setattr(reftb.qrbs, "resample",
+                        lambda X, y, n_samples: (X[:n_samples], y[:n_samples]))
+
+    class _FirstK:
+        def integers(self, low, high, size):
+            return np.arange(size)
+
+    monkeypatch.setattr(jqm.np.random, "default_rng",
+                        lambda rng=None: _FirstK())
+    r = reftb.qrbs.qrbs(data.copy(), lags=2, n_resamples=3)
+    j = jqm.qrbs(data.copy(), lags=2, n_resamples=3)
+    np.testing.assert_allclose(np.asarray(j), np.asarray(r),
+                               rtol=1e-6, atol=1e-9)
