@@ -417,22 +417,17 @@ def ref_dynotears():
     """Import the reference's vendored causalnex solver with the external
     causalnex package stubbed (only its StructureModel wrapper is imported;
     the core _learn_dynamic_structure never touches it)."""
-    for name, attrs in [
+    from conftest import add_reference_to_path
+
+    add_reference_to_path(extra_stubs=[
         ("causalnex", {}),
         ("causalnex.structure", {"StructureModel": type("SM", (), {})}),
         ("causalnex.structure.transformers",
          {"DynamicDataTransformer": type("DDT", (), {})}),
-    ]:
-        if name not in sys.modules:
-            m = types.ModuleType(name)
-            for a, v in attrs.items():
-                setattr(m, a, v)
-            sys.modules[name] = m
+    ])
     sys.modules["causalnex"].structure = sys.modules["causalnex.structure"]
     sys.modules["causalnex.structure"].transformers = sys.modules[
         "causalnex.structure.transformers"]
-    if REF_ROOT not in sys.path:
-        sys.path.append(REF_ROOT)
     from models import causalnex_dynotears
 
     return causalnex_dynotears

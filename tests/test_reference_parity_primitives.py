@@ -7,15 +7,12 @@ helpers on identical random inputs.  (The model-level A/B lives in
 test_reference_parity.py, whose ref() fixture carries the same
 reference-import scaffolding plus the torcheeg stub that module needs.)
 """
-import sys
 import types
 
 import numpy as np
 import pytest
 
 torch = pytest.importorskip("torch")
-
-REF_ROOT = "/root/reference"
 
 
 @pytest.fixture(scope="module")
@@ -320,3 +317,44 @@ def test_qrbs_ridge_core_matches_reference(reftb, rng, monkeypatch):
     j = jqm.qrbs(data.copy(), lags=2, n_resamples=3)
     np.testing.assert_allclose(np.asarray(j), np.asarray(r),
                                rtol=1e-6, atol=1e-9)
+
+
+# --------------------------------------------------------------------------
+# synthetic sVAR dynamics (the test oracle's generator, ref data/data_utils.py)
+# --------------------------------------------------------------------------
+def test_nvar_step_matches_reference(refgu, rng):
+    """One step of the 2-lag sinusoid-driven nonlinear VAR
+    (ref data_utils.py:47-86) with the noise variance zeroed so the
+    dynamics are deterministic: sinusoidal self-connections, per-edge
+    min0/max0 nonlinearities, identity edges."""
+    from data import data_utils as rdu
+
+    from redcliff_tpu.data.synthetic import (ACT_MAX0, ACT_MIN0,
+                                             _step_matrices, nvar_step_np)
+
+    D, L = 4, 2
+    A = rng.uniform(-0.6, 0.6, size=(D, D, L))
+    f = rng.uniform(0.05, 0.45, size=(D, 1))
+    hist = [rng.normal(size=(D, 1)) for _ in range(2)]  # [t-2, t-1]
+
+    # per-edge nonlinearity assignment: identity / min0 / max0, mirrored in
+    # both encodings (the reference takes callables, ours integer codes)
+    # the reference applies per-edge nonlinearities to self terms too
+    # (ref data_utils.py:71-78), so the diagonal participates as well
+    acts = rng.integers(0, 3, size=(D, D, L))
+    fn_map = {0: None,
+              1: lambda x: np.min((x, 0)),
+              2: lambda x: np.max((x, 0))}
+    nonlin = [[[fn_map[int(acts[i, j, l])] for l in range(L)]
+               for j in range(D)] for i in range(D)]
+    r = rdu.multivariate_relational_nvar_sinusoid_with_gaussian_innovations(
+        hist, A, f=f, mu=np.zeros((D, 1)), var=np.zeros((D, 1)),
+        innovation_amp=np.ones((D, 1)), d=D, NUM_LAGS=L,
+        nonlinear_functions_by_lagged_adjacency=nonlin)
+
+    code_map = {0: 0, 1: ACT_MIN0, 2: ACT_MAX0}
+    codes = np.vectorize(code_map.get)(acts)
+    M1, M2 = _step_matrices(A, f[:, 0])
+    j = nvar_step_np(hist[-1][:, 0], hist[-2][:, 0], M1, M2, codes,
+                     innovation=np.zeros(D))
+    np.testing.assert_allclose(j, r[:, 0], rtol=1e-10, atol=1e-12)
