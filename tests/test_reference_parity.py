@@ -37,8 +37,6 @@ import pytest
 
 torch = pytest.importorskip("torch")
 
-REF_ROOT = "/root/reference"
-
 
 # --------------------------------------------------------------------------
 # reference import scaffolding
@@ -780,3 +778,49 @@ def test_ts_transformer_classiregressor_parity(ref):
     j_out = ours.forward(params, X, padding_masks=mask)
     np.testing.assert_allclose(np.asarray(j_out), _np(r_out),
                                rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# cMLP_FM parity (vendored torch module, ref models/cmlp_fm.py:58-148)
+# --------------------------------------------------------------------------
+def test_cmlp_fm_multisim_forward_parity(ref):
+    """The single-factor baseline's autoregressive multi-sim forecast: each
+    sim emits T' = input_length - lag + 1 steps and the window slides by T'
+    (ref cmlp_fm.py:96-148) — covers the T' > 1 window slide that the
+    REDCLIFF forward A/B (T' == 1) does not."""
+    from models.cmlp_fm import cMLP_FM as RefFM
+
+    from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+
+    C, LAG, IN_LEN, SIMS = 4, 3, 8, 3
+    torch.manual_seed(11)
+    ref_model = RefFM(
+        num_chans=C, gen_lag=LAG, gen_hidden=[8, 6],
+        embed_hidden_sizes=[8], num_in_timesteps=IN_LEN,
+        num_out_timesteps=1, num_sims=SIMS,
+        coeff_dict={"FORECAST_COEFF": 1.0,
+                    "ADJ_L1_REG_COEFF": 0.0, "DAGNESS_REG_COEFF": 0.0,
+                    "DAGNESS_LAG_COEFF": 0.0, "DAGNESS_NODE_COEFF": 0.0},
+        wavelet_level=None, save_path=None)
+
+    ours = CMLPFM(CMLPFMConfig(num_chans=C, gen_lag=LAG, gen_hidden=(8, 6),
+                               num_sims=SIMS, input_length=IN_LEN))
+    # _copy_factors over the 1-factor ModuleList, K axis stripped
+    params = {"factor": [{k: v[0] for k, v in layer.items()}
+                         for layer in _copy_factors(ref_model)]}
+
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(5, IN_LEN, C)).astype(np.float32)
+    with torch.no_grad():
+        r_out = ref_model(torch.from_numpy(X))
+    if isinstance(r_out, tuple):
+        r_out = r_out[0]
+    j_out = ours.forward(params, X)
+    np.testing.assert_allclose(np.asarray(j_out), _np(r_out),
+                               rtol=1e-5, atol=1e-5)
+
+    r_gc = ref_model.factors[0].GC(threshold=False, ignore_lag=True)
+    j_gc = ours.gc(params, threshold=False, ignore_lag=True)[0]
+    np.testing.assert_allclose(np.asarray(j_gc), _np(r_gc),
+                               rtol=1e-5, atol=1e-6)
+
