@@ -1,0 +1,159 @@
+"""Opportunistic TPU bench watcher.
+
+The axon TPU tunnel in this environment is intermittently reachable (observed
+round 3: one ~30-minute live window in ~7 hours, every other probe hung).
+bench.py already probes with killable subprocesses on a spread schedule, but a
+single bench invocation can only sample a few minutes of tunnel availability —
+if the driver's end-of-round bench run misses the window, no TPU number lands
+in the round artifact even when the tunnel WAS alive earlier.
+
+This watcher closes that gap: it runs in the background for the whole round,
+probing the tunnel on a steady cadence, and the moment a probe succeeds it runs
+the FULL bench measurement (`bench.py --measure tpu` — scan-dispatch G-curve
+including G>=128 scanned MFU, vs_baseline sequential ratio) in a killable child
+and writes the result to `experiments/TPU_BENCH_CACHE.json` with a
+`measured_at` timestamp. `bench.py` then embeds the newest cached TPU
+measurement (marked `cached: true`, with provenance) whenever its own live
+probes fail, so the round's BENCH artifact carries real-TPU evidence from any
+live window during the round, not just the minutes the driver happened to run.
+
+Also validates the Pallas group-lasso prox kernel on the real chip during the
+same window (cheap; one extra child) and records the max abs error in the
+cache.
+
+Usage: python tpu_watch.py [--duration-s 39600] [--interval-s 420]
+Writes a human log to experiments/tpu_watch.log.
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+import bench  # reuse the killable probe/measure children + cache writer/lock
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE_PATH = bench.TPU_CACHE_PATH
+LOG_PATH = os.path.join(REPO, "experiments", "tpu_watch.log")
+
+# after a successful measurement, wait this long before re-measuring on a later
+# live window (a fresher timestamp is worth a re-run, but not back-to-back)
+REFRESH_MIN_S = 90 * 60.0
+
+PALLAS_CHECK_SRC = r"""
+import json
+import numpy as np
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != "cpu", "tunnel fell back to cpu"
+from redcliff_tpu.ops.pallas_prox import gl_prox_pallas
+from redcliff_tpu.ops.prox import prox_update
+rng = np.random.default_rng(0)
+W = jnp.asarray(rng.normal(size=(5, 12, 32, 12, 4)).astype(np.float32))
+lam, lr = 0.013, 0.002
+got = gl_prox_pallas(W, lam, lr, interpret=False)
+want = prox_update(W, lam, lr, "GL")
+err = float(jnp.max(jnp.abs(got - want)))
+print(json.dumps({"ok": err < 5e-6, "max_abs_err": err,
+                  "device": jax.devices()[0].device_kind}))
+"""
+
+
+def _utcnow():
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _log(msg):
+    line = f"[{_utcnow()}] {msg}"
+    print(line, flush=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+
+
+def _pallas_check(timeout_s=420.0):
+    try:
+        r = subprocess.run([sys.executable, "-c", PALLAS_CHECK_SRC],
+                           capture_output=True, text=True, timeout=timeout_s,
+                           cwd=REPO)
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {"ok": False, "error": f"rc={r.returncode}: {r.stderr[-300:]}"}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"pallas check hung > {timeout_s:.0f}s"}
+    except Exception as e:  # noqa: BLE001 - cache must record, not crash
+        return {"ok": False, "error": repr(e)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration-s", type=float, default=39600.0)
+    ap.add_argument("--interval-s", type=float, default=420.0)
+    args = ap.parse_args()
+
+    t0 = time.monotonic()
+    started_at = _utcnow()
+    attempts = 0
+    successes = 0
+    last_success_mono = None
+    _log(f"tpu_watch start: duration={args.duration_s:.0f}s "
+         f"interval={args.interval_s:.0f}s cache={CACHE_PATH}")
+
+    while time.monotonic() - t0 < args.duration_s:
+        attempts += 1
+        ok, info = bench._probe_accelerator()
+        _log(f"probe {attempts}: ok={ok} {info}")
+        if ok:
+            fresh_enough = (last_success_mono is not None and
+                            time.monotonic() - last_success_mono < REFRESH_MIN_S)
+            if not fresh_enough:
+                # survive watcher restarts: a cache written minutes ago by a
+                # previous watcher/bench process is just as fresh
+                cached = bench._load_tpu_cache()
+                if cached is not None:
+                    try:
+                        measured = datetime.datetime.strptime(
+                            cached["measured_at"], "%Y-%m-%dT%H:%M:%SZ"
+                        ).replace(tzinfo=datetime.timezone.utc)
+                        age = (datetime.datetime.now(datetime.timezone.utc)
+                               - measured).total_seconds()
+                        fresh_enough = age < REFRESH_MIN_S
+                    except (KeyError, ValueError):
+                        pass
+            if fresh_enough:
+                _log("live window but cache is fresh; skipping re-measure")
+            elif not bench._acquire_measure_lock(wait_s=0.0):
+                # a live bench.py run owns the chip; its result lands in the
+                # same cache, so this window is covered either way
+                _log("live window but another measurement holds the lock")
+            else:
+                try:
+                    _log("tunnel LIVE -> running full TPU bench measurement")
+                    payload, minfo = bench._run_measure_child("tpu")
+                    if payload is not None and payload.get("value"):
+                        pallas = _pallas_check()
+                        bench._write_tpu_cache(
+                            payload, source="tpu_watch.py opportunistic window",
+                            extras={"watch_started_at": started_at,
+                                    "probe_attempts_before_success": attempts,
+                                    "pallas_prox_check": pallas})
+                        successes += 1
+                        last_success_mono = time.monotonic()
+                        _log(f"MEASUREMENT CACHED: value={payload.get('value')} "
+                             f"vs_baseline={payload.get('vs_baseline')} "
+                             f"device={payload.get('device')} pallas={pallas}")
+                    else:
+                        _log(f"measurement failed mid-window: {minfo}")
+                finally:
+                    bench._release_measure_lock()
+        time.sleep(args.interval_s)
+
+    _log(f"tpu_watch done: {attempts} probes, {successes} cached measurements")
+
+
+if __name__ == "__main__":
+    main()
