@@ -13,9 +13,17 @@ reference driver does, ref train/...Parsim.py:98-105):
    100 acclimation) plus the cMLP, NAVAR-cMLP and DYNOTEARS baselines through
    the real array-task driver,
 3. score every run's GC estimates against the fold's true factor graphs with
-   the cross-algorithm optimal-F1 battery (eval/cross_alg.py), and
-4. write mean±SEM off-diag optimal-F1 / ROC-AUC per algorithm to
-   ACCURACY_SYNSYS.json for BASELINE.md's accuracy-parity row.
+   the cross-algorithm optimal-F1 battery (eval/cross_alg.py),
+4. optionally (--dynamic) score the dynamic readouts — embedder state-score
+   tracking and conditional-GC edge dynamics vs the oracle activations
+   (eval/dynamic_readout.py), and
+5. write mean±SEM off-diag optimal-F1 / ROC-AUC per algorithm to
+   ACCURACY_SYNSYS_<N>_<E>_<F>.json for BASELINE.md.
+
+The --system flag generalizes the study to any N-E-F (nodes-edges-factors)
+configuration of the reference's synSysIG1030 complexity sweep;
+experiments/run_banded_sweep.sh drives the multi-system banded study and
+experiments/banded_condense.py condenses it into BANDED_SYNSYS.json.
 
 Run:  python experiments/accuracy_parity_synsys.py <workdir> [--folds N]
       [--smoke]   (reduced samples/epochs for a plumbing check)
@@ -157,17 +165,24 @@ def main():
                          "fold parallelism), skip evaluation")
     ap.add_argument("--eval-only", action="store_true",
                     help="skip training (runs must exist) and just evaluate")
-    ap.add_argument("--system", default="6-2-2", choices=["6-2-2", "12-11-2"],
+    ap.add_argument("--system", default="6-2-2",
                     help="synthetic system (nodes-edges-factors shorthand "
-                         "nN-nE-nF as in the paper)")
+                         "N-E-F as in the paper, e.g. 6-2-2, 12-11-2, 3-1-2, "
+                         "6-4-2, 6-2-3 — any of the reference synSysIG1030 "
+                         "complexity-sweep configurations)")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="additionally score the DYNAMIC readouts (embedder "
+                         "state-score tracking + conditional-GC edge dynamics "
+                         "vs the oracle activations) for every algorithm")
     ap.add_argument("--algs", default="all", choices=["all", "ref"],
                     help="'ref' = the reference's synSys baseline set only "
                          "(REDCLIFF, cMLP, cLSTM, DGCNN, DCSFA)")
     args = ap.parse_args()
     base = args.workdir
     os.makedirs(base, exist_ok=True)
-    num_nodes, num_edges, _nf = (int(v) for v in args.system.split("-"))
-    sys_folder = f"synSys{num_nodes}{num_edges}2"
+    num_nodes, num_edges, num_factors = (int(v)
+                                         for v in args.system.split("-"))
+    sys_folder = f"synSys{num_nodes}_{num_edges}_{num_factors}"
     models = MODELS
     if args.algs == "ref":
         models = tuple(m for m in MODELS
@@ -187,6 +202,18 @@ def main():
         for key in ("NAVAR_CMLP",):
             if key in model_args:
                 model_args[key]["num_nodes"] = str(num_nodes)
+    if num_factors != 2:
+        # the reference's per-dataset factor-count overwrite (its drivers set
+        # num_factors from the data cached-args, ref train/...Parsim.py:96)
+        model_args["REDCLIFF_S_CMLP"].update(
+            num_factors=str(num_factors),
+            num_supervised_factors=str(num_factors))
+        if "DGCNN" in model_args:
+            model_args["DGCNN"]["num_classes"] = str(num_factors)
+        if "DCSFANMF" in model_args:
+            model_args["DCSFANMF"].update(
+                n_components=str(num_factors),
+                n_sup_networks=str(num_factors))
     # deviation from the reference's d4IC NAVAR epochs=1000: the synSys
     # dataset is ~13x larger per fold and this study runs on CPU; NAVAR
     # plateaus well before 250 epochs here (loss history in the run dir)
@@ -211,7 +238,8 @@ def main():
         t0 = time.time()
         fold_dir, _ = curate_synthetic_fold(
             os.path.join(base, "data"), fold_id=fold, num_nodes=num_nodes,
-            num_lags=2, num_factors=2, num_supervised_factors=2,
+            num_lags=2, num_factors=num_factors,
+            num_supervised_factors=num_factors,
             num_edges_per_graph=num_edges, num_samples_in_train_set=n_train,
             num_samples_in_val_set=n_val, sample_recording_len=100,
             burnin_period=50, label_type_setting="OneHot",
@@ -263,18 +291,18 @@ def main():
         eval_inputs["data"][fold] = np.stack(
             [np.asarray(x) for x, _ in samples[:128]])
 
+    system_key = (f"numF{num_factors}_numSF{num_factors}_"
+                  f"numN{num_nodes}_numE{num_edges}_{sys_folder}")
     full = run_cross_algorithm_comparison(
         list(roots.values()), {"data": true_by_fold},
-        os.path.join(base, "evals",
-                     f"numF2_numSF2_numN{num_nodes}_numE{num_edges}_"
-                     f"{sys_folder}"),
+        os.path.join(base, "evals", system_key),
         num_folds=args.folds, plot=not args.smoke,
         algorithms=[alias for _, _, alias in models],
         eval_inputs=eval_inputs)
 
     paradigm = "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag"
-    out = {"dataset": f"{sys_folder} (numF2_numSF2_numN{num_nodes}_"
-                      f"numE{num_edges}, OneHot, gaussian innovations)",
+    out = {"dataset": f"{system_key} (OneHot, gaussian innovations)",
+           "system": args.system,
            "folds": args.folds, "smoke": bool(args.smoke),
            "train_samples_per_fold": n_train, "algorithms": {}}
     for alg, stats in full["data"][paradigm].items():
@@ -291,7 +319,17 @@ def main():
               f"ROC-AUC {out['algorithms'][alg]['offdiag_roc_auc_mean']}",
               flush=True)
 
-    tag = "" if args.system == "6-2-2" else "_" + args.system.replace("-", "_")
+    if args.dynamic:
+        from redcliff_tpu.eval.dynamic_readout import (
+            run_dynamic_readout_evaluation)
+        dyn = run_dynamic_readout_evaluation(
+            roots=roots, data_args_by_fold=data_args_by_fold,
+            true_by_fold=true_by_fold, num_folds=args.folds,
+            num_supervised_factors=num_factors,
+            save_root=os.path.join(base, "evals", system_key, "dynamic"))
+        out["dynamic_readouts"] = dyn
+
+    tag = "_" + args.system.replace("-", "_")
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         f"ACCURACY_SYNSYS{tag}.json" if not args.smoke
                         else "ACCURACY_SYNSYS_smoke.json")

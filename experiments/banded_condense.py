@@ -1,0 +1,150 @@
+"""Condense the multi-system synSys study into the paper's separating result.
+
+Walks the shared eval root produced by repeated runs of
+``accuracy_parity_synsys.py --system N-E-F`` (one eval tree per system),
+then:
+
+1. runs the complexity-banded cross-experiment analysis
+   (eval/analysis.run_cross_experiment_analysis — the rebuild of the
+   reference's plotCrossExpSummaries_...synSysIG1030... driver): per-band
+   per-algorithm absolute optimal-F1 and the pairwise per-factor improvement
+   of REDCLIFF-S over every baseline;
+2. aggregates the per-system dynamic-readout summaries (state-score tracking
+   + conditional-GC dynamics, eval/dynamic_readout.py) into one table;
+3. writes experiments/BANDED_SYNSYS.json with the banded improvement table,
+   the dynamic-readout table, and per-system detail — the artifact behind
+   BASELINE.md's separating-result section.
+
+Run:  python experiments/banded_condense.py <workdir> [--out FILE]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from redcliff_tpu.eval.analysis import (  # noqa: E402
+    run_cross_experiment_analysis)
+
+BASELINE_ALG = "REDCLIFF_S_CMLP"
+
+
+def band_improvement_table(condensed, by_category):
+    """{band: {alg: {mean, sem, n_systems, per_system}}} — the mean across
+    systems in the band of the per-system mean per-factor improvement of
+    REDCLIFF-S over each algorithm (ref plotCross...py:160-262 semantics:
+    improvements are baseline_vals - alg_vals per factor)."""
+    out = {}
+    for band, sys_keys in by_category.items():
+        alg_accum = {}
+        for key in sys_keys:
+            imps = condensed[key]["improvements"] or {}
+            for alg, st in imps.items():
+                if alg == BASELINE_ALG:
+                    continue
+                alg_accum.setdefault(alg, {})[key] = st["mean"]
+        out[band] = {}
+        for alg, per_sys in alg_accum.items():
+            vals = [v for v in per_sys.values()
+                    if v is not None and np.isfinite(v)]
+            if not vals:
+                continue
+            out[band][alg] = {
+                "mean_improvement": float(np.mean(vals)),
+                "sem": float(np.std(vals) / np.sqrt(len(vals)))
+                if len(vals) > 1 else 0.0,
+                "n_systems": len(vals),
+                "per_system": {k: float(v) for k, v in per_sys.items()},
+            }
+    return out
+
+
+def collect_dynamic_summaries(eval_root):
+    """{system_key: {alg: {metric: {mean, sem, n}}}} from the per-system
+    dynamic_readout_summary.json files."""
+    out = {}
+    for sys_key in sorted(os.listdir(eval_root)):
+        p = os.path.join(eval_root, sys_key, "dynamic",
+                         "dynamic_readout_summary.json")
+        if os.path.isfile(p):
+            with open(p) as f:
+                out[sys_key] = json.load(f)
+    return out
+
+
+def aggregate_dynamic(dyn_by_system):
+    """{alg: {metric: {mean, sem, n_systems}}} across systems (mean of the
+    per-system means; SEM across systems)."""
+    accum = {}
+    for stats in dyn_by_system.values():
+        for alg, metrics in stats.items():
+            for metric, st in (metrics or {}).items():
+                if st is None or st.get("mean") is None:
+                    continue
+                accum.setdefault(alg, {}).setdefault(metric, []).append(
+                    st["mean"])
+    out = {}
+    for alg, metrics in accum.items():
+        out[alg] = {}
+        for metric, vals in metrics.items():
+            out[alg][metric] = {
+                "mean": float(np.mean(vals)),
+                "sem": float(np.std(vals) / np.sqrt(len(vals)))
+                if len(vals) > 1 else 0.0,
+                "n_systems": len(vals),
+            }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--plot", action="store_true")
+    args = ap.parse_args()
+    eval_root = os.path.join(args.workdir, "evals")
+    save_root = os.path.join(args.workdir, "banded_analysis")
+
+    res = run_cross_experiment_analysis(
+        eval_root, save_root, baseline_alg=BASELINE_ALG, plot=args.plot)
+    bands = band_improvement_table(res["condensed"], res["by_category"])
+    dyn_by_system = collect_dynamic_summaries(eval_root)
+
+    per_system = {}
+    for key, entry in res["condensed"].items():
+        per_system[key] = {
+            "complexity": entry["complexity"],
+            "band": res["system_details"][key]["complexity_category"],
+            "alg_optf1": {a: {"mean": st["mean"], "sem": st["sem"]}
+                          for a, st in entry["alg_stats"].items()},
+            "improvements_of_redcliff": {
+                a: st for a, st in (entry["improvements"] or {}).items()
+                if a != BASELINE_ALG},
+        }
+
+    out = {
+        "baseline_alg": BASELINE_ALG,
+        "paradigm": "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag / f1",
+        "banded_improvement": bands,
+        "dynamic_readouts_by_system": dyn_by_system,
+        "dynamic_readouts_aggregate": aggregate_dynamic(dyn_by_system),
+        "per_system": per_system,
+        "by_category": res["by_category"],
+    }
+    dest = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BANDED_SYNSYS.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[done] wrote {dest}", flush=True)
+    for band in ("High", "Moderate", "Low"):
+        for alg, st in bands.get(band, {}).items():
+            print(f"[band {band}] REDCLIFF-S vs {alg}: "
+                  f"{st['mean_improvement']:+.3f} ± {st['sem']:.3f} "
+                  f"({st['n_systems']} systems)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Multi-system complexity-banded synSys study (the paper's separating result).
+#
+# Runs the reference-scale accuracy study over systems spanning the paper's
+# complexity bands (complexity = (C^2-C)/E; Low <=7 < Moderate <=13 < High),
+# drawn from the reference's synSysIG1030 sweep matrix
+# (/root/reference/evaluate/plotCrossExpSummaries_...synSysIG1030...py:67-115):
+#   6-2-2   High     (15.0)
+#   12-11-2 Moderate (12.0)
+#   3-1-2   Low      (6.0)
+#   6-2-3   High     (15.0, 3 factors)
+#   6-4-2   Moderate (7.5)
+#   6-6-2   Low      (5.0)
+# ordered so every band is covered as early as possible. Each system gets its
+# own workdir (run-dir discovery is per-system); eval trees are assembled into
+# one root for the banded condenser as systems complete.
+#
+# Usage: experiments/run_banded_sweep.sh [BASE=/tmp/banded] [FOLDS=3]
+set -u
+BASE="${1:-/tmp/banded}"
+FOLDS="${2:-3}"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+mkdir -p "$BASE" "$BASE/all/evals"
+
+for sys in 6-2-2 12-11-2 3-1-2 6-2-3 6-4-2 6-6-2; do
+    echo "[sweep] $(date -u +%H:%M:%S) starting system $sys" | tee -a "$BASE/sweep.log"
+    python "$REPO/experiments/accuracy_parity_synsys.py" "$BASE/sys_$sys" \
+        --folds "$FOLDS" --algs ref --system "$sys" --dynamic \
+        > "$BASE/log_$sys.txt" 2>&1
+    rc=$?
+    echo "[sweep] $(date -u +%H:%M:%S) system $sys rc=$rc" | tee -a "$BASE/sweep.log"
+    # assemble what exists so far and re-condense (partial results stay usable)
+    cp -r "$BASE/sys_$sys/evals/." "$BASE/all/evals/" 2>/dev/null
+    python "$REPO/experiments/banded_condense.py" "$BASE/all" \
+        >> "$BASE/sweep.log" 2>&1
+done
+echo "[sweep] $(date -u +%H:%M:%S) done" | tee -a "$BASE/sweep.log"
