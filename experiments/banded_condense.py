@@ -28,8 +28,14 @@ import numpy as np  # noqa: E402
 
 from redcliff_tpu.eval.analysis import (  # noqa: E402
     run_cross_experiment_analysis)
+from redcliff_tpu.eval.stats import summarize_values  # noqa: E402
 
 BASELINE_ALG = "REDCLIFF_S_CMLP"
+
+
+def _mean_sem(vals):
+    s = summarize_values(vals)
+    return s["mean"], s["mean_std_err"]
 
 
 def band_improvement_table(condensed, by_category):
@@ -52,10 +58,10 @@ def band_improvement_table(condensed, by_category):
                     if v is not None and np.isfinite(v)]
             if not vals:
                 continue
+            mean, sem = _mean_sem(vals)
             out[band][alg] = {
-                "mean_improvement": float(np.mean(vals)),
-                "sem": float(np.std(vals) / np.sqrt(len(vals)))
-                if len(vals) > 1 else 0.0,
+                "mean_improvement": mean,
+                "sem": sem,
                 "n_systems": len(vals),
                 "per_system": {k: float(v) for k, v in per_sys.items()},
             }
@@ -90,12 +96,9 @@ def aggregate_dynamic(dyn_by_system):
     for alg, metrics in accum.items():
         out[alg] = {}
         for metric, vals in metrics.items():
-            out[alg][metric] = {
-                "mean": float(np.mean(vals)),
-                "sem": float(np.std(vals) / np.sqrt(len(vals)))
-                if len(vals) > 1 else 0.0,
-                "n_systems": len(vals),
-            }
+            mean, sem = _mean_sem(vals)
+            out[alg][metric] = {"mean": mean, "sem": sem,
+                                "n_systems": len(vals)}
     return out
 
 

@@ -72,13 +72,18 @@ def _score_steps(recording_len, history):
 
 def true_dynamic_graph_history(Y, true_graphs, history):
     """(T', C, C) truth: at each scoreable step, the dominant state's
-    normalized graph. Y is the oracle (S, T) activation trace."""
+    normalized graph. Y is the oracle (S, T) activation trace.
+
+    Returns (hist, dom, valid): windows whose dominant label row has no
+    corresponding truth graph (the pooled unsupervised-states row the curation
+    appends when num_supervised < num_factors) are marked invalid — their true
+    graph is a mixture of unidentified factors, so they cannot be scored."""
     Y = np.asarray(Y)
     num, off = _score_steps(Y.shape[1], history)
     normed = np.stack([lag_normed_graph(g) for g in true_graphs])
     dom = np.argmax(Y[:, off: off + num], axis=0)  # (T',)
-    dom = np.minimum(dom, len(true_graphs) - 1)
-    return normed[dom], dom
+    valid = dom < len(true_graphs)
+    return normed[np.minimum(dom, len(true_graphs) - 1)], dom, valid
 
 
 def _sliding_windows(recording, history):
@@ -102,15 +107,15 @@ def score_state_tracking(weight_trace, Y, history):
     rs = []
     for k in range(truth.shape[0]):
         a, b = w[k, :num], truth[k]
-        sa, sb = np.std(a), np.std(b)
-        if sa > 0 and sb > 0:
-            rs.append(float(np.corrcoef(a, b)[0, 1]))
-        else:
-            # a constant trace cannot track a varying target (and vice versa)
-            rs.append(0.0 if (sa > 0) != (sb > 0) else 1.0)
+        if np.std(b) <= 0:
+            # a constant oracle trace defines no tracking target on this
+            # recording — skip it (same convention as the degenerate-window
+            # handling on the graph side), rather than scoring it 0 or 1
+            continue
+        rs.append(float(np.corrcoef(a, b)[0, 1]) if np.std(a) > 0 else 0.0)
     acc = float(np.mean(np.argmax(w[:, :num], axis=0)
                         == np.argmax(truth, axis=0)))
-    return {"state_score_r": float(np.mean(rs)),
+    return {"state_score_r": float(np.mean(rs)) if rs else None,
             "dominant_state_acc": acc}
 
 
@@ -193,18 +198,24 @@ def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
     for x, y in samples[:max_recordings]:
         x = np.asarray(x)
         y = np.asarray(y)
-        true_hist, _ = true_dynamic_graph_history(y, true_graphs, history)
+        true_hist, _, valid = true_dynamic_graph_history(y, true_graphs,
+                                                         history)
         num_steps = true_hist.shape[0]
         if is_redcliff:
             windows = _sliding_windows(x, history)
             weightings, _ = model._embed(params, windows)
             w = np.asarray(weightings)[:, :num_supervised_factors].T
             st = score_state_tracking(w, y, history)
-            metrics["state_score_r"].append(st["state_score_r"])
+            if st["state_score_r"] is not None:
+                metrics["state_score_r"].append(st["state_score_r"])
             metrics["dominant_state_acc"].append(st["dominant_state_acc"])
             est_hist = _redcliff_conditional_history(model, params, windows)
         else:
             est_hist = static_graph_history(static_est, num_steps)
+        if not valid.all():
+            if not valid.any():
+                continue
+            est_hist, true_hist = est_hist[valid], true_hist[valid]
         gt = score_dynamic_graph_tracking(est_hist, true_hist)
         if gt["dynamic_optimal_f1"] is not None:
             metrics["dynamic_optimal_f1"].append(gt["dynamic_optimal_f1"])
@@ -229,13 +240,19 @@ def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
     from ..data.shards import load_shard_samples
 
     os.makedirs(save_root, exist_ok=True)
+    # one shard load per fold, shared by every algorithm (the validation split
+    # is hundreds of recordings; reloading it per (alg, fold) would dominate
+    # wall-clock on a single core)
+    samples_by_fold = {
+        fold: load_shard_samples(os.path.join(
+            os.path.dirname(data_args_by_fold[fold]), "validation"))
+        for fold in range(num_folds)
+    }
     out = {}
     for alg, alg_root in roots.items():
         per_alg = {}
         for fold in range(num_folds):
-            val_dir = os.path.join(
-                os.path.dirname(data_args_by_fold[fold]), "validation")
-            samples = load_shard_samples(val_dir)
+            samples = samples_by_fold[fold]
             run_dir = find_run_directory(alg_root, cv_dset_name, fold)
             m = evaluate_dynamic_readouts_on_fold(
                 run_dir, alg, true_by_fold[fold], samples,

@@ -41,13 +41,26 @@ def test_lag_normed_graph_reduces_and_scales():
 
 def test_true_dynamic_graph_history_follows_dominant_state():
     Y, graphs = _two_state_truth()
-    hist, dom = true_dynamic_graph_history(Y, graphs, history=10)
+    hist, dom, valid = true_dynamic_graph_history(Y, graphs, history=10)
     assert hist.shape == (50, 4, 4)
+    assert valid.all()
     # first window is scored at step 9 (state 0), last at step 58 (state 1)
     assert dom[0] == 0 and dom[-1] == 1
     assert hist[0][0, 1] == pytest.approx(1.0)
     assert hist[0][1, 0] == pytest.approx(0.0)
     assert hist[-1][1, 0] == pytest.approx(1.0)
+
+
+def test_pooled_unsupervised_label_row_marks_windows_invalid():
+    """A dominant label row with no truth graph (the pooled unsupervised row)
+    must invalidate the window, not silently score an arbitrary graph."""
+    Y, graphs = _two_state_truth()
+    Y = np.vstack([Y, np.zeros((1, Y.shape[1]))])
+    Y[2, 20:30] = 5.0  # pooled row dominates steps 20..29
+    _, dom, valid = true_dynamic_graph_history(Y, graphs, history=10)
+    assert (~valid).sum() == 10
+    assert (dom[~valid] == 2).all()
+    assert valid[:11].all() and valid[-10:].all()
 
 
 def test_score_state_tracking_perfect_and_constant():
@@ -62,11 +75,17 @@ def test_score_state_tracking_perfect_and_constant():
     # a constant readout cannot track a varying oracle
     st0 = score_state_tracking(np.full((2, num), 0.5), Y, history)
     assert st0["state_score_r"] == pytest.approx(0.0)
+    # a constant ORACLE defines no tracking target: skipped, not scored
+    Yc = np.zeros_like(Y)
+    Yc[0] = 1.0  # state 0 dominant for the whole recording
+    stc = score_state_tracking(w, Yc, history)
+    assert stc["state_score_r"] is None
+    assert 0.0 <= stc["dominant_state_acc"] <= 1.0
 
 
 def test_dynamic_graph_tracking_separates_conditional_from_static():
     Y, graphs = _two_state_truth()
-    true_hist, _ = true_dynamic_graph_history(Y, graphs, history=10)
+    true_hist, _, _ = true_dynamic_graph_history(Y, graphs, history=10)
     # a conditional estimator that switches with the truth
     cond = score_dynamic_graph_tracking(true_hist + 1e-3, true_hist)
     assert cond["dynamic_optimal_f1"] == pytest.approx(1.0)
