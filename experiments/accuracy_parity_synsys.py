@@ -276,9 +276,11 @@ def main():
               "after all folds finish", flush=True)
         return
 
-    # eval windows for data-dependent GC readouts (NAVAR contribution stats)
+    # eval windows for data-dependent GC readouts (NAVAR contribution stats),
+    # z-scored exactly as the training loaders normalized them — the models
+    # never saw raw-amplitude signals
     eval_inputs = {"data": {}}
-    from redcliff_tpu.data.shards import load_shard_samples
+    from redcliff_tpu.data.shards import load_normalized_samples
     for fold in range(args.folds):
         if fold not in data_args_by_fold:
             fd = os.path.join(base, "data", sys_folder, f"fold_{fold}")
@@ -287,9 +289,8 @@ def main():
             true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
         val_dir = os.path.join(os.path.dirname(data_args_by_fold[fold]),
                                "validation")
-        samples = load_shard_samples(val_dir)
-        eval_inputs["data"][fold] = np.stack(
-            [np.asarray(x) for x, _ in samples[:128]])
+        eval_inputs["data"][fold] = np.asarray(
+            load_normalized_samples(val_dir).X[:128])
 
     system_key = (f"numF{num_factors}_numSF{num_factors}_"
                   f"numN{num_nodes}_numE{num_edges}_{sys_folder}")

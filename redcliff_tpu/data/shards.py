@@ -67,6 +67,17 @@ def load_shard_samples(data_path, drop_nan=True):
     return samples
 
 
+def load_normalized_samples(split_dir):
+    """One split's recordings z-scored with the SAME per-split channel stats
+    the training loaders apply (load_normalized_split_datasets) — the one
+    shared recipe for eval paths that feed trained models raw recordings
+    (models never saw unnormalized amplitudes). Returns an ArrayDataset."""
+    from .datasets import ArrayDataset
+
+    X, Y = samples_to_arrays(load_shard_samples(split_dir))
+    return ArrayDataset(X, Y, normalize=True, grid_search=False)
+
+
 def samples_to_arrays(samples):
     """[[x, y], ...] -> (X (N, T, C), Y (N, ...)) dense arrays.
 

@@ -94,27 +94,35 @@ def _sliding_windows(recording, history):
     return np.transpose(view[:num], (0, 2, 1))
 
 
-def score_state_tracking(weight_trace, Y, history):
+def score_state_tracking(weight_trace, Y, history, valid=None):
     """Embedder state-score tracking vs the oracle trace.
 
     weight_trace: (K, T') factor weightings per scoreable step;
-    Y: (S, T) oracle activations. Returns {state_score_r, dominant_state_acc}.
+    Y: (S, T) oracle activations; valid: optional (T',) window mask (windows
+    dominated by the pooled unsupervised row have no supervised truth and are
+    excluded from BOTH metrics, same rule as the graph-tracking path).
+    Returns {state_score_r, dominant_state_acc} (None when unscoreable).
     """
     Y = np.asarray(Y, dtype=np.float64)
     w = np.asarray(weight_trace, dtype=np.float64)
     num, off = _score_steps(Y.shape[1], history)
     truth = Y[: w.shape[0], off: off + num]
+    w = w[:, :num]
+    if valid is not None:
+        truth = truth[:, valid[:num]]
+        w = w[:, valid[:num]]
+    if truth.shape[1] == 0:
+        return {"state_score_r": None, "dominant_state_acc": None}
     rs = []
     for k in range(truth.shape[0]):
-        a, b = w[k, :num], truth[k]
+        a, b = w[k], truth[k]
         if np.std(b) <= 0:
             # a constant oracle trace defines no tracking target on this
             # recording — skip it (same convention as the degenerate-window
             # handling on the graph side), rather than scoring it 0 or 1
             continue
         rs.append(float(np.corrcoef(a, b)[0, 1]) if np.std(a) > 0 else 0.0)
-    acc = float(np.mean(np.argmax(w[:, :num], axis=0)
-                        == np.argmax(truth, axis=0)))
+    acc = float(np.mean(np.argmax(w, axis=0) == np.argmax(truth, axis=0)))
     return {"state_score_r": float(np.mean(rs)) if rs else None,
             "dominant_state_acc": acc}
 
@@ -205,10 +213,11 @@ def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
             windows = _sliding_windows(x, history)
             weightings, _ = model._embed(params, windows)
             w = np.asarray(weightings)[:, :num_supervised_factors].T
-            st = score_state_tracking(w, y, history)
+            st = score_state_tracking(w, y, history, valid=valid)
             if st["state_score_r"] is not None:
                 metrics["state_score_r"].append(st["state_score_r"])
-            metrics["dominant_state_acc"].append(st["dominant_state_acc"])
+            if st["dominant_state_acc"] is not None:
+                metrics["dominant_state_acc"].append(st["dominant_state_acc"])
             est_hist = _redcliff_conditional_history(model, params, windows)
         else:
             est_hist = static_graph_history(static_est, num_steps)
@@ -237,17 +246,17 @@ def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
     """
     import json
 
-    from ..data.shards import load_shard_samples
+    from ..data.shards import load_normalized_samples
 
     os.makedirs(save_root, exist_ok=True)
     # one shard load per fold, shared by every algorithm (the validation split
     # is hundreds of recordings; reloading it per (alg, fold) would dominate
-    # wall-clock on a single core)
-    samples_by_fold = {
-        fold: load_shard_samples(os.path.join(
+    # wall-clock on a single core); recordings arrive z-scored like training
+    samples_by_fold = {}
+    for fold in range(num_folds):
+        ds = load_normalized_samples(os.path.join(
             os.path.dirname(data_args_by_fold[fold]), "validation"))
-        for fold in range(num_folds)
-    }
+        samples_by_fold[fold] = list(zip(ds.X, ds.Y))
     out = {}
     for alg, alg_root in roots.items():
         per_alg = {}

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import warnings
 
 __all__ = ["MODEL_REGISTRY", "load_model_for_eval", "load_artifact"]
 
@@ -60,11 +61,16 @@ def load_artifact(path, best_model_name=None):
                 return pickle.load(f)
         # cached-args may carry any best_model_name extension (the reference
         # synSys DCSFA args use dCSFA-NMF-best-model.pt); several may coexist
-        # (e.g. a stale .pkl next to the current .pt): newest first
+        # (e.g. a stale .pkl next to the current .pt). Order deterministically:
+        # .pt (the reference cached-args' recorded name) before other
+        # extensions, mtime only as a tie-break — mtimes are unreliable after
+        # copy/rsync/untar, so they must not decide between formats
         cands = [x for x in os.listdir(path)
                  if x.startswith("dCSFA-NMF-best-model")]
-        cands.sort(key=lambda x: os.path.getmtime(os.path.join(path, x)),
-                   reverse=True)
+        ext_rank = {".pt": 0, ".bin": 1, ".pkl": 2}
+        cands.sort(key=lambda x: (
+            ext_rank.get(os.path.splitext(x)[1], 3),
+            -os.path.getmtime(os.path.join(path, x))))
         if not cands:
             # non-standard best_model_name: accept a LONE pickle-like file
             # that is not one of the known non-model artifacts
@@ -79,6 +85,13 @@ def load_artifact(path, best_model_name=None):
         for name in names:
             cand = os.path.join(path, name)
             if os.path.isfile(cand):
+                if len(cands) > 1:
+                    # warn with the file actually chosen (final_best_model.bin
+                    # outranks the dCSFA candidates when both coexist)
+                    warnings.warn(
+                        f"multiple dCSFA-NMF-best-model artifacts in "
+                        f"{path!r}: {cands!r}; loading {name!r} (.pt "
+                        f"preferred over .pkl, mtime tie-break)")
                 path = cand
                 break
         else:

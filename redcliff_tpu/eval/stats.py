@@ -83,8 +83,13 @@ def compute_optimal_f1_stats(est_A, true_A):
     if labels is None:
         return {}
     thresh, f1 = compute_optimal_f1(labels, np.asarray(est_A).ravel())
-    return {"f1": f1, "decision_threshold": thresh,
-            "roc_auc": roc_auc(labels, np.asarray(est_A).ravel())}
+    # degrade to None rather than propagate, the same convention
+    # compute_key_stats applies to its constituent metrics
+    try:
+        auc = roc_auc(labels, np.asarray(est_A).ravel())
+    except Exception:
+        auc = None
+    return {"f1": f1, "decision_threshold": thresh, "roc_auc": auc}
 
 
 def compute_fixed_f1_stats(est_A, true_A, pred_cutoffs=DEFAULT_PRED_CUTOFFS):

@@ -231,7 +231,14 @@ def run_supervised_discovery_evaluation(samples, true_gc_factors,
     graphs (ref :250-258), run every algorithm per regime, score.  Returns
     {alg: {"preds": [...], "stats": {...}}} and optionally pickles it.
     ``maxlags=None`` keeps each algorithm's reference default (tidybench 1,
-    PCMCI tau_max=2)."""
+    PCMCI tau_max=2).  NB an explicit ``maxlags`` is shared by EVERY
+    algorithm in the sweep — including PCMCI, whose Table-2 tau_max=2 it
+    overrides (announced below so a tidybench-motivated maxlags=1 is not a
+    silent PCMCI behavior change)."""
+    if maxlags is not None and "PCMCI" in algorithms and maxlags != 2:
+        print(f"run_supervised_discovery_evaluation: explicit maxlags="
+              f"{maxlags} overrides PCMCI's reference tau_max=2",
+              flush=True)
     true_graphs = []
     for g in true_gc_factors:
         g = np.asarray(g, dtype=np.float64)

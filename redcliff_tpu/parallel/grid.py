@@ -466,11 +466,10 @@ class RedcliffGridRunner:
                 # (redcliff_trainer.py:336-346, ref :1466-1538): stopping
                 # coefficients x coefficient-normalized val means, plus the
                 # supervised pairwise-cosine term when
-                # num_supervised_factors > 1.  NB the grid always includes
-                # the cosine term (like the reference, whose fit always
-                # tracks GC); the trainer zeroes it when fit() is called
-                # without true_GC (no tracker) — parity holds on the
-                # reference-shaped path, which passes ground truth
+                # num_supervised_factors > 1. The trainer now also tracks
+                # cosines unconditionally (its tracker no longer requires
+                # ground truth), so grid and per-point criteria agree on
+                # labeled AND unlabeled runs
                 crit = (coeffs["stopping_criteria_forecast_coeff"]
                         * (forecast_sum / n))
                 if cfg.num_supervised_factors >= 1:

@@ -228,10 +228,15 @@ class RedcliffTrainer:
         freeze_by_batch = "FreezeByBatch" in mode
         freeze = "Freeze" in mode
 
+        # always track — the pairwise-cosine histories feed the stopping
+        # criterion and need no ground truth; the reference's fit tracks
+        # unconditionally (ref :1349-1403), and the grid engine always
+        # includes the cosine term, so criteria now agree across engines
+        # on unlabeled runs too (truth-dependent histories stay empty)
         tracker = GCProgressTracker(
             num_supervised_factors=cfg.num_supervised_factors,
             num_chans=cfg.num_chans, num_factors=cfg.num_factors,
-        ) if true_GC is not None else None
+        )
 
         histories = {
             "avg_forecasting_loss": [], "avg_factor_loss": [],

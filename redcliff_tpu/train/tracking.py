@@ -106,41 +106,49 @@ class GCProgressTracker:
         """Append one epoch of metrics. ``est_by_sample`` carries lagged (C, C, L)
         estimates (used for F1/AUC/deltacon after lag-summing, ref fit loop at
         redcliff_s_cmlp.py:1349-1400); ``est_by_sample_lagsummed`` optionally
-        carries the ignore_lag readouts used for the cosine histories."""
-        f1, auc = self._roc_stats(true_GC, est_by_sample, remove_self=False)
-        f1_od, auc_od = self._roc_stats(true_GC, est_by_sample, remove_self=True)
-        for t in self.f1_thresholds:
-            for i in range(self.S):
-                self.f1score_histories[t][i].append(f1[t][i])
-                self.roc_auc_histories[t][i].append(auc[t][i])
-                self.f1score_OffDiag_histories[t][i].append(f1_od[t][i])
-                self.roc_auc_OffDiag_histories[t][i].append(auc_od[t][i])
+        carries the ignore_lag readouts used for the cosine histories.
 
-        # deltacon0 family (ref model_utils.py:90-161); note reference argument
-        # order: similarity(truth, estimate)
-        n_est = min(len(est_by_sample[0]), len(true_GC))
+        ``true_GC=None`` skips the truth-dependent histories (F1/AUC/deltacon
+        family) while still tracking the truth-free ones — L1 norms and the
+        pairwise cosines the stopping criterion consumes. The reference's fit
+        always has ground truth in hand, so its tracking is unconditional
+        (ref :1349-1403); this keeps the same criteria semantics on unlabeled
+        runs."""
         n_s = len(est_by_sample)
-        dc0 = np.zeros(n_est)
-        dc0dd = np.zeros(n_est)
-        daf = np.zeros(n_est)
-        plm = {p: np.zeros(n_est) for p in self.path_length_mse_histories}
-        for sample in est_by_sample:
-            for i in range(n_est):
-                truth = _prep(true_GC[i], False)
-                est = _prep(sample[i], False)
-                dc0[i] += deltacon0(truth, est, self.deltacon_eps)
-                dc0dd[i] += deltacon0_with_directed_degrees(truth, est, self.deltacon_eps)
-                daf[i] += deltaffinity(truth, est, self.deltacon_eps)
-                _, per_k = path_length_mse(truth, est)
-                for p, mse in zip(range(1, self.num_chans), per_k):
-                    plm[p][i] += mse
-        for i in range(self.S):
-            src = 0 if n_est == 1 and self.S > 1 else min(i, n_est - 1)
-            self.deltacon0_histories[i].append(dc0[src] / n_s)
-            self.deltacon0_with_directed_degrees_histories[i].append(dc0dd[src] / n_s)
-            self.deltaffinity_histories[i].append(daf[src] / n_s)
-            for p in plm:
-                self.path_length_mse_histories[p][i].append(plm[p][src] / n_s)
+        if true_GC is not None:
+            f1, auc = self._roc_stats(true_GC, est_by_sample, remove_self=False)
+            f1_od, auc_od = self._roc_stats(true_GC, est_by_sample, remove_self=True)
+            for t in self.f1_thresholds:
+                for i in range(self.S):
+                    self.f1score_histories[t][i].append(f1[t][i])
+                    self.roc_auc_histories[t][i].append(auc[t][i])
+                    self.f1score_OffDiag_histories[t][i].append(f1_od[t][i])
+                    self.roc_auc_OffDiag_histories[t][i].append(auc_od[t][i])
+
+            # deltacon0 family (ref model_utils.py:90-161); note reference
+            # argument order: similarity(truth, estimate)
+            n_est = min(len(est_by_sample[0]), len(true_GC))
+            dc0 = np.zeros(n_est)
+            dc0dd = np.zeros(n_est)
+            daf = np.zeros(n_est)
+            plm = {p: np.zeros(n_est) for p in self.path_length_mse_histories}
+            for sample in est_by_sample:
+                for i in range(n_est):
+                    truth = _prep(true_GC[i], False)
+                    est = _prep(sample[i], False)
+                    dc0[i] += deltacon0(truth, est, self.deltacon_eps)
+                    dc0dd[i] += deltacon0_with_directed_degrees(truth, est, self.deltacon_eps)
+                    daf[i] += deltaffinity(truth, est, self.deltacon_eps)
+                    _, per_k = path_length_mse(truth, est)
+                    for p, mse in zip(range(1, self.num_chans), per_k):
+                        plm[p][i] += mse
+            for i in range(self.S):
+                src = 0 if n_est == 1 and self.S > 1 else min(i, n_est - 1)
+                self.deltacon0_histories[i].append(dc0[src] / n_s)
+                self.deltacon0_with_directed_degrees_histories[i].append(dc0dd[src] / n_s)
+                self.deltaffinity_histories[i].append(daf[src] / n_s)
+                for p in plm:
+                    self.path_length_mse_histories[p][i].append(plm[p][src] / n_s)
 
         # normalized L1 norms (ref model_utils.py:163-189)
         K_est = len(est_by_sample[0])
@@ -202,6 +210,8 @@ class GCProgressTracker:
             if self.deltacon0_histories[i]:
                 out[f"deltacon0_factor{i}"] = self.deltacon0_histories[i][-1]
                 out[f"deltaffinity_factor{i}"] = self.deltaffinity_histories[i][-1]
+            # tracked even without ground truth (unlabeled runs): own gate
+            if self.gc_factor_l1_loss_histories[i]:
                 out[f"gc_l1_factor{i}"] = self.gc_factor_l1_loss_histories[i][-1]
         for key, h in self.gc_factor_cosine_sim_histories.items():
             if h:

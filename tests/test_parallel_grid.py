@@ -179,12 +179,17 @@ def test_grid_freeze_matches_independent_trainers(mode):
                                        rtol=2e-3, atol=2e-5)
 
 
-def test_grid_selection_criteria_matches_trainer():
+@pytest.mark.parametrize("with_truth", [True, False])
+def test_grid_selection_criteria_matches_trainer(with_truth):
     """Grid best_epoch/best_criteria equal the per-point trainer's
     best_it/best_loss on the same data — per-point stopping coefficients
     applied to coefficient-normalized val means plus the supervised
     pairwise-cosine term (num_supervised_factors=2), exactly as
-    redcliff_trainer.py:336-346 / ref :1466-1538."""
+    redcliff_trainer.py:336-346 / ref :1466-1538. Parity must hold on BOTH
+    the labeled path (true_GC passed, the reference-shaped flow) and the
+    unlabeled path (no true_GC): the cosine stopping term compares the
+    model's own factor estimates to each other, so the trainer tracks it
+    unconditionally, like the reference's fit and the grid."""
     import dataclasses
 
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainer
@@ -203,9 +208,11 @@ def test_grid_selection_criteria_matches_trainer():
     res = runner.fit(key, ds, ds)
 
     cfg = model.config
-    # any truth works: the cosine stopping term compares estimates to each
-    # other, the tracker just has to exist (trainer gates the term on it)
-    true_GC = [np.eye(cfg.num_chans) for _ in range(cfg.num_supervised_factors)]
+    # any truth works on the labeled path: the cosine stopping term compares
+    # estimates to each other, not to the truth
+    true_GC = ([np.eye(cfg.num_chans)
+                for _ in range(cfg.num_supervised_factors)]
+               if with_truth else None)
     init_params, _, _ = runner.init_grid(key)  # same key -> same init as fit
     stop_keys = ("gen_lr", "embed_lr", "stopping_criteria_forecast_coeff",
                  "stopping_criteria_factor_coeff",
