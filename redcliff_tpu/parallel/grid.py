@@ -137,14 +137,28 @@ class RedcliffGridRunner:
         self._build()
 
     # ------------------------------------------------------------------
+    def _opt_states(self, params):
+        """Per-point optimizer state over a (G, ...)-stacked params tree."""
+        optA_state = jax.vmap(lambda p: self.optA.init(p["embedder"]))(params)
+        optB_state = jax.vmap(lambda p: self.optB.init(p["factors"]))(params)
+        return optA_state, optB_state
+
     def init_grid(self, key):
         """G independently-seeded parameter sets, stacked on axis 0."""
         G = len(self.spec.points)
         keys = jax.random.split(key, G)
         params = jax.vmap(self.model.init)(keys)
-        optA_state = jax.vmap(lambda p: self.optA.init(p["embedder"]))(params)
-        optB_state = jax.vmap(lambda p: self.optB.init(p["factors"]))(params)
-        return params, optA_state, optB_state
+        return (params,) + self._opt_states(params)
+
+    def init_grid_from(self, point_params):
+        """Replicate ONE parameter set across the grid axis — the SLURM-array
+        pattern's initialization, where every per-point process seeds
+        identically (ref train drivers fix all seeds to 0, ref :122-127), so
+        grid-vs-per-point comparisons share the exact same starting weights."""
+        G = len(self.spec.points)
+        params = jax.tree.map(
+            lambda x: jnp.stack([jnp.asarray(x)] * G), point_params)
+        return (params,) + self._opt_states(params)
 
     def _build(self):
         model = self.model
@@ -342,17 +356,26 @@ class RedcliffGridRunner:
         return dict(params, factors=factors)
 
     def fit(self, key, train_ds, val_ds, max_iter=None,
-            log_dir=None) -> GridResult:
+            log_dir=None, init_params=None) -> GridResult:
         with profiler_trace(self.tc.profile_dir):
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
-                             log_dir=log_dir)
+                             log_dir=log_dir, init_params=init_params)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
-             log_dir=None) -> GridResult:
+             log_dir=None, init_params=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
-        params, optA_state, optB_state = self.init_grid(key)
+        # init_params: pre-stacked (G, ...) state from init_grid/init_grid_from.
+        # Copy caller-supplied arrays — the train steps donate their buffers
+        # (donate_argnums), which would otherwise silently invalidate the
+        # caller's tuple on the first step (e.g. reusing one init for an A/B
+        # pair of fits)
+        if init_params is not None:
+            params, optA_state, optB_state = jax.tree.map(jnp.copy,
+                                                          init_params)
+        else:
+            params, optA_state, optB_state = self.init_grid(key)
         coeffs = self._shard(self.coeffs)
         params = self._shard(params)
         optA_state = self._shard(optA_state)

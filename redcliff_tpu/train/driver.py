@@ -146,13 +146,19 @@ def set_up_and_run_experiments(args_dict, files_of_cached_model_args,
 
 
 def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
-                         key=None, mesh=None, max_iter=None):
+                         key=None, mesh=None, max_iter=None,
+                         init_point_params=None):
     """Train G coefficient/optimizer variations of one REDCLIFF model
     concurrently on the device mesh (see parallel.grid.RedcliffGridRunner).
 
     grid_points: list of dicts over the grid axes (e.g. {"gen_lr": ...,
     "factor_cos_sim_coeff": ...}).  Returns the GridResult with per-point
     best params/criteria.
+
+    init_point_params: ONE unstacked parameter pytree replicated across the
+    grid axis — the SLURM-array pattern's initialization (every per-point
+    process seeds identically, ref :122-127); default = independent per-point
+    seeds from ``key``.
     """
     import jax
 
@@ -161,4 +167,7 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     spec = GridSpec(points=list(grid_points))
     runner = RedcliffGridRunner(model, train_config, spec, mesh=mesh)
     key = key if key is not None else jax.random.PRNGKey(train_config.seed)
-    return runner.fit(key, train_ds, val_ds, max_iter=max_iter)
+    init = (runner.init_grid_from(init_point_params)
+            if init_point_params is not None else None)
+    return runner.fit(key, train_ds, val_ds, max_iter=max_iter,
+                      init_params=init)

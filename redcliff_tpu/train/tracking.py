@@ -181,14 +181,21 @@ class GCProgressTracker:
             n_s += 1
             for i in range(len(sample)):
                 for j in range(i + 1, len(sample)):
-                    # float64 before the max-normalization: the reference's
-                    # 1e-300 floor (ref model_utils.py:191-209) underflows to
-                    # zero in the float32 arrays jax hands us, turning an
-                    # all-zero estimate into a divide-by-zero
+                    # DOCUMENTED DEVIATION from ref model_utils.py:191-209,
+                    # which divides by max(np.max(x), 1e-300): when an
+                    # estimate is all-non-positive (possible for conditional
+                    # GC modes with unrestricted, sign-free embedder
+                    # weightings) that floor scales entries by ~1e300 and the
+                    # cosine dot product overflows to +-inf — an -inf then
+                    # poisons the stopping criterion and auto-wins model
+                    # selection. Guard like the grid engine's point_cos
+                    # (parallel/grid.py): scale only by a strictly positive
+                    # max; cosine's own norm floor keeps the result finite.
                     a = np.asarray(sample[i], dtype=np.float64)
                     b = np.asarray(sample[j], dtype=np.float64)
-                    a = a / max(np.max(a), 1e-300)
-                    b = b / max(np.max(b), 1e-300)
+                    ma, mb = np.max(a), np.max(b)
+                    a = a / ma if ma > 0 else a
+                    b = b / mb if mb > 0 else b
                     key = f"{i + label_offset}and{j + label_offset}"
                     sums[key] = sums.get(key, 0.0) + compute_cosine_similarity(a, b)
         for key, total in sums.items():

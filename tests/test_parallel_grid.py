@@ -228,6 +228,32 @@ def test_grid_selection_criteria_matches_trainer(with_truth):
                                    rtol=2e-3)
 
 
+def test_init_grid_from_replicates_point_params():
+    """init_grid_from stacks ONE parameter set across the grid axis (the
+    SLURM-array pattern where every per-point process seeds identically) and
+    builds per-point optimizer state over it."""
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3},
+                            {"gen_lr": 2e-3}])
+    runner = RedcliffGridRunner(model, RedcliffTrainConfig(batch_size=16),
+                                spec)
+    p0 = model.init(jax.random.PRNGKey(3))
+    params, optA, optB = runner.init_grid_from(p0)
+    for leaf0, stacked in zip(jax.tree.leaves(p0), jax.tree.leaves(params)):
+        assert stacked.shape == (3,) + np.shape(leaf0)
+        for g in range(3):
+            np.testing.assert_array_equal(np.asarray(stacked[g]),
+                                          np.asarray(leaf0))
+    # optimizer state carries the grid axis too
+    assert all(l.shape[:1] == (3,) for l in jax.tree.leaves(optA)
+               if hasattr(l, "shape") and l.ndim > 0)
+    # and fit accepts the pre-stacked state
+    ds = _data(model, n=32)
+    res = runner.fit(jax.random.PRNGKey(0), ds, ds, max_iter=1,
+                     init_params=(params, optA, optB))
+    assert res.best_criteria.shape == (3,)
+
+
 def test_grid_scan_batches_matches_per_batch():
     """The lax.scan k-batch step reproduces the one-dispatch-per-batch path
     bit-for-bit on the same data/seed (dispatch amortization must not change

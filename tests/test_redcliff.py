@@ -358,3 +358,28 @@ def test_gc_tracker_zero_estimate_cosine_warning_free():
         t.update(true_GC=[truth, truth], est_by_sample=[[zero, est]],
                  est_by_sample_lagsummed=[[zero, est]])
     assert t.gc_factor_cosine_sim_histories["0and1"] == [0.0]
+
+
+def test_gc_tracker_all_negative_estimate_cosine_finite():
+    """An all-non-positive estimate (possible for conditional GC modes with
+    sign-free embedder weightings) must yield a FINITE cosine: the
+    reference's max(max, 1e-300) floor scales such estimates by ~1e300 and
+    the dot product overflows to +-inf, which then poisons the stopping
+    criterion and auto-wins model selection (regression from the grid-science
+    parity experiment)."""
+    import warnings
+
+    from redcliff_tpu.train.tracking import GCProgressTracker
+
+    t = GCProgressTracker(2, 4, num_factors=2)
+    rng = np.random.default_rng(1)
+    truth = (rng.uniform(size=(4, 4)) > 0.5).astype(np.float64)
+    neg = -rng.uniform(1.0, 2.0, size=(4, 4)).astype(np.float32)
+    est = rng.uniform(size=(4, 4)).astype(np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t.update(true_GC=[truth, truth], est_by_sample=[[neg, est]],
+                 est_by_sample_lagsummed=[[neg, est]])
+    val = t.gc_factor_cosine_sim_histories["0and1"][0]
+    assert np.isfinite(val)
+    assert -1.0 <= val <= 1.0
