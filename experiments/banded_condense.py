@@ -81,11 +81,14 @@ def collect_dynamic_summaries(eval_root):
     return out
 
 
-def aggregate_dynamic(dyn_by_system):
+def aggregate_dynamic(dyn_by_system, systems=None):
     """{alg: {metric: {mean, sem, n_systems}}} across systems (mean of the
-    per-system means; SEM across systems)."""
+    per-system means; SEM across systems). ``systems`` restricts the
+    aggregation (e.g. to one complexity band)."""
     accum = {}
-    for stats in dyn_by_system.values():
+    for sys_key, stats in dyn_by_system.items():
+        if systems is not None and sys_key not in systems:
+            continue
         for alg, metrics in stats.items():
             for metric, st in (metrics or {}).items():
                 if st is None or st.get("mean") is None:
@@ -134,6 +137,9 @@ def main():
         "banded_improvement": bands,
         "dynamic_readouts_by_system": dyn_by_system,
         "dynamic_readouts_aggregate": aggregate_dynamic(dyn_by_system),
+        "dynamic_readouts_by_band": {
+            band: aggregate_dynamic(dyn_by_system, systems=set(keys))
+            for band, keys in res["by_category"].items() if keys},
         "per_system": per_system,
         "by_category": res["by_category"],
     }

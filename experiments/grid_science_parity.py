@@ -79,7 +79,10 @@ def main():
     ap.add_argument("workdir")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
-    base = os.path.abspath(args.workdir)
+    # smoke and full runs use disjoint workdirs: run-dir names encode neither
+    # max_iter nor sample counts, so sharing one tree would let the per-point
+    # resume guard reuse smoke artifacts inside a full run (and vice versa)
+    base = os.path.abspath(args.workdir) + ("_smoke" if args.smoke else "")
     os.makedirs(base, exist_ok=True)
 
     # ---------------------------------------------------------------- data
@@ -184,11 +187,17 @@ def main():
         stopping_criteria_cosSim_coeff=args_dict[
             "stopping_criteria_cosSim_coeff"])
 
-    K = args_dict["num_factors"]
-    C = args_dict["num_channels"]
-    adj_scale = (1.0 / K) / np.sqrt(C ** 2.0 - 1.0)  # the driver's rescale
+    # rescale each point's ADJ_L1 through the driver's own helper so both
+    # legs share one formula by construction
+    def rescaled_adj(raw):
+        d = {"coeff_dict": {"ADJ_L1_REG_COEFF": raw},
+             "num_factors": args_dict["num_factors"],
+             "num_channels": args_dict["num_channels"]}
+        rescale_dataset_dependent_coefficients(d)
+        return d["coeff_dict"]["ADJ_L1_REG_COEFF"]
+
     grid_points = [{"gen_lr": pt["gen_lr"],
-                    "adj_l1_reg_coeff": pt["ADJ_L1_REG_COEFF"] * adj_scale}
+                    "adj_l1_reg_coeff": rescaled_adj(pt["ADJ_L1_REG_COEFF"])}
                    for pt in points]
     # the SLURM-array pattern seeds every per-point process identically
     # (ref :122-127 fixes all seeds to 0; call_model_fit_method inits from

@@ -356,24 +356,28 @@ class RedcliffGridRunner:
         return dict(params, factors=factors)
 
     def fit(self, key, train_ds, val_ds, max_iter=None,
-            log_dir=None, init_params=None) -> GridResult:
+            log_dir=None, init_params=None, copy_init=True) -> GridResult:
         with profiler_trace(self.tc.profile_dir):
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
-                             log_dir=log_dir, init_params=init_params)
+                             log_dir=log_dir, init_params=init_params,
+                             copy_init=copy_init)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
-             log_dir=None, init_params=None) -> GridResult:
+             log_dir=None, init_params=None, copy_init=True) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
         # init_params: pre-stacked (G, ...) state from init_grid/init_grid_from.
-        # Copy caller-supplied arrays — the train steps donate their buffers
-        # (donate_argnums), which would otherwise silently invalidate the
-        # caller's tuple on the first step (e.g. reusing one init for an A/B
-        # pair of fits)
+        # Copy caller-supplied arrays by default — the train steps donate
+        # their buffers (donate_argnums), which would otherwise silently
+        # invalidate the caller's tuple on the first step (e.g. reusing one
+        # init for an A/B pair of fits). copy_init=False hands ownership over
+        # (callers that built the init solely for this fit skip the 2x
+        # transient allocation)
         if init_params is not None:
-            params, optA_state, optB_state = jax.tree.map(jnp.copy,
-                                                          init_params)
+            if copy_init:
+                init_params = jax.tree.map(jnp.copy, init_params)
+            params, optA_state, optB_state = init_params
         else:
             params, optA_state, optB_state = self.init_grid(key)
         coeffs = self._shard(self.coeffs)

@@ -169,5 +169,7 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     key = key if key is not None else jax.random.PRNGKey(train_config.seed)
     init = (runner.init_grid_from(init_point_params)
             if init_point_params is not None else None)
+    # the stacked init is built here solely for this fit: hand ownership over
+    # instead of paying a defensive copy of the whole grid state
     return runner.fit(key, train_ds, val_ds, max_iter=max_iter,
-                      init_params=init)
+                      init_params=init, copy_init=False)

@@ -186,6 +186,24 @@ def test_normalized_split_datasets(tmp_path):
     assert val.X.shape == (2, 10, 3)
 
 
+def test_load_normalized_samples_matches_training_normalization(tmp_path):
+    """The eval-side recording loader must hand trained models EXACTLY the
+    z-scoring the training loaders applied (regression: raw-amplitude
+    recordings fed the dynamic-readout sweep out-of-distribution inputs)."""
+    from redcliff_tpu.data.shards import load_normalized_samples
+
+    rng = np.random.default_rng(11)
+    data = [[rng.uniform(1.0, 3.0, size=(10, 3)).astype(np.float32),
+             np.array([1.0, 0.0])] for _ in range(8)]
+    save_cv_split(data[:6], data[6:], 0, str(tmp_path))
+    _, val = load_normalized_split_datasets(
+        str(tmp_path / "fold_0"), shuffle=False, grid_search=False)
+    ds = load_normalized_samples(str(tmp_path / "fold_0" / "validation"))
+    np.testing.assert_allclose(np.asarray(ds.X), np.asarray(val.X),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ds.Y), np.asarray(val.Y))
+
+
 def test_apply_signal_format_flattened_and_vanilla_dirspec():
     rng = np.random.default_rng(5)
     X = rng.normal(size=(3, 64, 4)).astype(np.float32)
