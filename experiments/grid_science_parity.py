@@ -120,9 +120,22 @@ def main():
         save_root = os.path.join(pp_root, f"point{i}")
         os.makedirs(save_root, exist_ok=True)
         t0 = time.time()
-        done = [d for d in os.listdir(save_root) if os.path.isfile(
-            os.path.join(save_root, d,
-                         "training_meta_data_and_hyper_parameters.pkl"))]
+        # reuse a finished per-point run only when its recorded schedule
+        # matches this invocation: it must have trained past THIS config's
+        # pretrain+acclimation and not beyond max_iter (a stale smoke
+        # artifact, epoch ~11, can then never masquerade as a 300-epoch run)
+        expected_iters = int(base_margs["max_iter"])
+        min_epochs = (int(base_margs["num_pretrain_epochs"])
+                      + int(base_margs["num_acclimation_epochs"]))
+        done = []
+        for d in os.listdir(save_root):
+            meta_p = os.path.join(save_root, d,
+                                  "training_meta_data_and_hyper_parameters.pkl")
+            if os.path.isfile(meta_p):
+                with open(meta_p, "rb") as f:
+                    meta = pickle.load(f)
+                if min_epochs < meta.get("epoch", -1) + 1 <= expected_iters:
+                    done.append(d)
         if not done:
             set_up_and_run_experiments(
                 {"save_root_path": save_root}, [margs_file], [dargs_file],

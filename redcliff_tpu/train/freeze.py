@@ -19,11 +19,17 @@ __all__ = ["factor_decision_stats", "freeze_accept_vector", "swap_factors",
 
 
 def factor_decision_stats(model, params):
-    """Per-factor (normalized L1, mean pairwise cosine) of the unlagged factor
-    GC estimates (ref determine_which_factors_need_updates :1116-1156)."""
+    """Per-factor (matrix 1-norm, mean pairwise cosine) of the unlagged factor
+    GC estimates (ref determine_which_factors_need_updates :1116-1156).
+
+    NB the reference's np.linalg.norm(mat, ord=1) on the 2-D normalized
+    estimate is the MATRIX 1-norm — the max over columns of the column's
+    absolute row sum — not the entrywise L1 (an early version here summed all
+    entries; the direct A/B in test_reference_parity_training.py pins the
+    matrix norm)."""
     G = model.factor_gc(params, ignore_lag=True)  # (K, C, C)
     G = G / jnp.maximum(jnp.max(jnp.abs(G), axis=(1, 2), keepdims=True), 1e-12)
-    l1 = jnp.sum(jnp.abs(G), axis=(1, 2))  # (K,)
+    l1 = jnp.max(jnp.sum(jnp.abs(G), axis=1), axis=-1)  # (K,) max column sum
     flat = G.reshape(G.shape[0], -1)
     norms = jnp.maximum(jnp.linalg.norm(flat, axis=1), 1e-8)
     cos = (flat @ flat.T) / (norms[:, None] * norms[None, :])
@@ -49,16 +55,21 @@ def freeze_accept_vector(mode, new_stats, old_stats):
 def swap_factors(candidate, accepted, accept_vec):
     """accept_vec: (K,) bool — True takes the candidate factor into the
     accepted tree AND keeps it in the candidate; False reverts the candidate
-    factor to the accepted one. The embedder always follows the candidate."""
+    factor to the accepted one. The accepted tree's embedder follows the
+    candidate ONLY on rounds where at least one factor was accepted (ref
+    :880-885: update_cached_factor_score_embedder is set inside the accept
+    branch, so a zero-accept round leaves the cached embedder untouched)."""
 
     def pick(c_leaf, a_leaf):
         m = accept_vec.reshape((-1,) + (1,) * (c_leaf.ndim - 1))
         return jnp.where(m, c_leaf, a_leaf)
 
+    any_accept = jnp.any(accept_vec)
     merged = jax.tree.map(pick, candidate["factors"], accepted["factors"])
+    emb = jax.tree.map(lambda c, a: jnp.where(any_accept, c, a),
+                       candidate["embedder"], accepted["embedder"])
     new_candidate = dict(candidate, factors=merged)
-    new_accepted = dict(accepted, factors=merged,
-                        embedder=candidate["embedder"])
+    new_accepted = dict(accepted, factors=merged, embedder=emb)
     return new_candidate, new_accepted
 
 

@@ -196,12 +196,16 @@ def test_freeze_swap_accept_and_revert():
     model = RedcliffSCMLP(cfg)
     trainer = RedcliffTrainer(model, RedcliffTrainConfig())
     accepted = model.init(jax.random.PRNGKey(0))
-    # the decision compares L1 of max-normalized GC estimates: sparsify factor 0
-    # (normalized L1 drops -> accept) and flatten factor 1 to all-equal weights
-    # (normalized L1 becomes maximal -> revert)
+    # the decision compares the MATRIX 1-norm (max column sum, ref
+    # np.linalg.norm(x, ord=1)) of max-normalized GC estimates: concentrate
+    # factor 0 on a single edge (normalized matrix norm collapses to 1, the
+    # minimum -> accept) and flatten factor 1 to all-equal weights (every
+    # normalized entry 1, matrix norm = C, the maximum -> revert)
     candidate = jax.tree.map(lambda x: x, accepted)
     w = candidate["factors"][0]["w"]  # (K, C_out, H, C_in, L)
-    w = w.at[0, :, :, : w.shape[3] // 2, :].set(0.0)
+    keep = w[0, 0, :, 0, :]
+    w = w.at[0].set(0.0)
+    w = w.at[0, 0, :, 0, :].set(jnp.where(jnp.abs(keep) > 0, keep, 1.0))
     w = w.at[1].set(jnp.ones_like(w[1]))
     candidate["factors"][0] = dict(candidate["factors"][0], w=w)
     new_cand, new_acc = trainer._apply_freeze(candidate, accepted)

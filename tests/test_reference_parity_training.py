@@ -371,3 +371,147 @@ def test_prox_mode_trajectory_parity(ref):
     W1 = np.asarray(params[0]["w"])
     group_norms = np.sqrt((W1 ** 2).sum(axis=(1, 3)))
     assert (group_norms == 0.0).any()
+
+
+# ---------------------------------------------------------------------------
+# Freeze-mode choreography A/B vs the reference accept/revert logic
+# ---------------------------------------------------------------------------
+def _build_freeze_pair(ref, mode_suffix, num_factors=4):
+    """(ref_model, jax_model) in a Freeze training mode with identical
+    weights (cEmbedder; fixed_factor GC drives the decision statistic)."""
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+
+    mode = f"pretrain_embedder_then_post_train_factor_{mode_suffix}"
+    torch.manual_seed(13)
+    ECC = 10.0
+    ref_model = ref.REDCLIFF_S_CMLP(
+        num_chans=C, gen_lag=GEN_LAG, gen_hidden=list(GEN_HIDDEN),
+        embed_lag=EMBED_LAG, embed_hidden_sizes=list(EMBED_HIDDEN),
+        num_in_timesteps=MAX_LAG, num_out_timesteps=1,
+        num_factors=num_factors, num_supervised_factors=S,
+        coeff_dict=dict(COEFFS_TRAJ), use_sigmoid_restriction=True,
+        factor_score_embedder_type="cEmbedder",
+        factor_score_embedder_args=[("sigmoid_eccentricity_coeff", ECC),
+                                    ("embed_lag", EMBED_LAG),
+                                    ("hidden", list(EMBED_HIDDEN))],
+        primary_gc_est_mode="fixed_factor_exclusive",
+        forward_pass_mode="apply_factor_weights_at_each_sim_step",
+        num_sims=NUM_SIMS_TRAJ, training_mode=mode,
+        num_pretrain_epochs=2, num_acclimation_epochs=0,
+    )
+    jax_model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=C, gen_lag=GEN_LAG, gen_hidden=tuple(GEN_HIDDEN),
+        embed_lag=EMBED_LAG, embed_hidden_sizes=tuple(EMBED_HIDDEN),
+        num_factors=num_factors, num_supervised_factors=S,
+        forecast_coeff=COEFFS_TRAJ["FORECAST_COEFF"],
+        factor_score_coeff=COEFFS_TRAJ["FACTOR_SCORE_COEFF"],
+        factor_cos_sim_coeff=COEFFS_TRAJ["FACTOR_COS_SIM_COEFF"],
+        factor_weight_l1_coeff=COEFFS_TRAJ["FACTOR_WEIGHT_L1_COEFF"],
+        adj_l1_reg_coeff=COEFFS_TRAJ["ADJ_L1_REG_COEFF"],
+        use_sigmoid_restriction=True, sigmoid_eccentricity_coeff=10.0,
+        factor_score_embedder_type="cEmbedder",
+        primary_gc_est_mode="fixed_factor_exclusive",
+        forward_pass_mode="apply_factor_weights_at_each_sim_step",
+        num_sims=NUM_SIMS_TRAJ, training_mode=mode,
+        num_pretrain_epochs=2, num_acclimation_epochs=0,
+    ))
+    return ref_model, jax_model
+
+
+def _perturb_factors(ref_model, seed=2):
+    """Scale/noise the current model's factor weights so some factors shrink
+    (accepted) and some grow (reverted) relative to a cached copy."""
+    rng = np.random.default_rng(seed)
+    with torch.no_grad():
+        for k, factor in enumerate(ref_model.factors):
+            for net in factor.networks:
+                w = net.layers[0].weight
+                w.mul_(0.5 if k % 2 == 0 else 1.7)
+                w.add_(torch.from_numpy(
+                    rng.normal(0, 0.01, size=tuple(w.shape)).astype(
+                        np.float32)))
+
+
+def _ref_intent_need_updates(ref, ref_model, cached_model, mode):
+    """The reference decision rule (ref :1116-1156) computed with the
+    reference's OWN primitives on the squeezed 2-D estimates. The in-situ
+    function cannot run: REDCLIFF GC hands it (C, C, 1) tensors and
+    np.linalg.norm(x, ord=1) raises ValueError on 3-D input (pinned below),
+    so the evident intent — the matrix 1-norm of the max-normalized 2-D
+    estimate — is evaluated here directly."""
+    from general_utils.metrics import compute_cosine_similarity
+
+    def ests(m):
+        return [np.squeeze(_np(x), axis=-1) for x in m.GC(
+            "fixed_factor_exclusive", X=None, threshold=False,
+            ignore_lag=True, combine_wavelet_representations=False,
+            rank_wavelets=False)[0]]
+
+    cached = ests(cached_model)
+    curr = ests(ref_model)
+    K = ref_model.num_factors_nK
+    need = []
+    for f in range(K):
+        c_norm = cached[f] / np.max(cached[f])
+        n_norm = curr[f] / np.max(curr[f])
+        if "withComboCosSimL1" in mode:
+            cos_c = cos_n = 0.0
+            for o in range(K):
+                if o == f:
+                    continue
+                cos_c += compute_cosine_similarity(
+                    c_norm, cached[o] / np.max(cached[o]))
+                cos_n += compute_cosine_similarity(
+                    n_norm, curr[o] / np.max(curr[o]))
+            cos_c /= K - 1.0
+            cos_n /= K - 1.0
+            need.append(bool(cos_n * np.linalg.norm(n_norm, ord=1)
+                             < cos_c * np.linalg.norm(c_norm, ord=1)))
+        else:
+            need.append(bool(np.linalg.norm(n_norm, ord=1)
+                             < np.linalg.norm(c_norm, ord=1)))
+    return need
+
+
+def test_reference_freeze_decision_crashes_as_published(ref):
+    """The reference's determine_which_factors_need_updates raises ValueError
+    as published: REDCLIFF GC returns (C, C, 1) estimates and
+    np.linalg.norm(x, ord=1) rejects 3-D input (ref :1131,1149) — no shipped
+    cached-args use a Freeze mode, so the path was never executed upstream.
+    Pinned so any reference drift (or a fix) is noticed."""
+    import copy as copy_mod
+
+    ref_model, _ = _build_freeze_pair(ref, "withL1FreezeByBatch")
+    best = copy_mod.deepcopy(ref_model)
+    _perturb_factors(ref_model)
+    with pytest.raises(ValueError, match="Improper number of dimensions"):
+        ref_model.determine_which_factors_need_updates(
+            best, [True] * ref_model.num_factors_nK)
+
+
+@pytest.mark.parametrize("mode_suffix", ["withL1FreezeByBatch",
+                                         "withComboCosSimL1FreezeByBatch"])
+def test_freeze_decision_parity(ref, mode_suffix):
+    """Our freeze_accept_vector vs the reference decision rule (matrix 1-norm
+    of the max-normalized unlagged GC estimate, optionally weighted by the
+    mean cross-factor cosine), evaluated with the reference's own primitives
+    per _ref_intent_need_updates."""
+    import copy as copy_mod
+
+    from redcliff_tpu.train.freeze import (factor_decision_stats,
+                                           freeze_accept_vector)
+
+    ref_model, jax_model = _build_freeze_pair(ref, mode_suffix)
+    best = copy_mod.deepcopy(ref_model)
+    _perturb_factors(ref_model)
+    need = _ref_intent_need_updates(ref, ref_model, best,
+                                    jax_model.config.training_mode)
+
+    cand = _copy_params(ref_model, "cEmbedder")
+    acc = _copy_params(best, "cEmbedder")
+    accept = freeze_accept_vector(
+        jax_model.config.training_mode,
+        factor_decision_stats(jax_model, cand),
+        factor_decision_stats(jax_model, acc))
+    assert [bool(a) for a in np.asarray(accept)] == need
+    assert any(need) and not all(need)  # the fixture produces a real mix
