@@ -1,0 +1,313 @@
+"""D4IC-pattern accuracy study: the reference's OTHER headline benchmark flow.
+
+The reference's D4IC benchmark superimposes five DREAM4 InSilico-Size10 gene
+networks' signals per sample — one dominant, four background — with the
+(num_factors, 1) coefficient vector as the label, at three SNR tiers
+(ref data/dream4_insilicoCombo.py:83-151,156-198), and compares the full
+algorithm roster incl. the d4IC-only baselines NAVAR and DYNOTEARS
+(ref evaluate/eval_sysOptF1_crossAlg_d4IC_HSNR_...py). The original DREAM4
+TSV source data does NOT ship with the reference repository, so exact D4IC
+replication is impossible here; this experiment runs the SAME flow end to end
+on a synthetic-source analog:
+
+1. five 10-node single-state sVAR "networks", each with its own ground-truth
+   lagged graph (the DREAM4 gold-standard stand-ins), per-network recordings
+   curated into the per-network fold/split shard layout;
+2. `data.dream4.make_d4ic_fold` builds the actual D4IC mixture at a named
+   SNR tier (dominant/background coefficients, label = coefficient vector —
+   the exact reference mixing code path, exercising the (S, 1) label-shape
+   branch every model's loss dispatches on);
+3. every algorithm of the reference's d4IC roster trains through the real
+   array-task driver at the reference's own d4IC cached-args
+   (REDCLIFF_S_CMLP_d4IC_BSCgs1, cMLP/cLSTM_d4IC_BLgs1Parsim,
+   DGCNN_d4IC_BLgs1Parsim, DCSFANMF_d4IC_OBPgs1, NAVAR_CMLP/DYNOTEARS
+   d4IC Parsim — transcribed below, driver coefficient rescaling applied);
+4. the cross-algorithm optimal-F1 battery scores each run against the five
+   network graphs; results land in ACCURACY_D4IC_<tier>.json.
+
+Deviations from the reference data geometry, both documented and forced by
+the environment: recordings are 48 steps (DREAM4 perturbation rounds are 21;
+48 keeps the directed-spectrum features DCSFA consumes well-conditioned) and
+the per-network sample budget is 120 train / 30 val per fold (single CPU
+core). Dynamic readouts are NOT scored here: a D4IC recording's state is
+constant by construction (one dominant network per sample), so there are no
+within-recording dynamics to track.
+
+Run:  python experiments/accuracy_parity_d4ic.py <workdir> [--folds N]
+      [--snr HSNR|MSNR|LSNR] [--smoke]
+"""
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from redcliff_tpu.data import synthetic as S  # noqa: E402
+from redcliff_tpu.data.curation import (  # noqa: E402
+    save_cached_args_file_for_data)
+from redcliff_tpu.data.dream4 import make_d4ic_fold  # noqa: E402
+from redcliff_tpu.data.shards import load_normalized_samples  # noqa: E402
+from redcliff_tpu.eval.cross_alg import (  # noqa: E402
+    run_cross_algorithm_comparison)
+from redcliff_tpu.train.driver import set_up_and_run_experiments  # noqa: E402
+from redcliff_tpu.utils.config import load_true_gc_factors  # noqa: E402
+
+NUM_NETWORKS = 5
+NUM_NODES = 10
+RECORDING_LEN = 48
+
+# ref train/REDCLIFF_S_CMLP_d4IC_BSCgs1_cached_args.txt (transcribed)
+REDCLIFF_ARGS = {
+    "output_length": "1", "batch_size": "128", "max_iter": "1000",
+    "lookback": "1", "check_every": "10", "verbose": "0", "num_sims": "1",
+    "num_factors": "5", "num_supervised_factors": "5",
+    "wavelet_level": "None", "gen_hidden": "[25]", "gen_lr": "0.0005",
+    "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "gen_lag_and_input_len": "4", "FORECAST_COEFF": "10.0",
+    "FACTOR_SCORE_COEFF": "100.0", "FACTOR_COS_SIM_COEFF": "1.0",
+    "FACTOR_WEIGHT_L1_COEFF": "0.001", "ADJ_L1_REG_COEFF": "1.0",
+    "DAGNESS_REG_COEFF": "0.0", "DAGNESS_LAG_COEFF": "0.0",
+    "DAGNESS_NODE_COEFF": "0.0",
+    "primary_gc_est_mode": "conditional_factor_fixed_embedder",
+    "forward_pass_mode": "apply_factor_weights_after_sim_completion",
+    "training_mode": "pretrain_embedder_then_acclimate_factors_then_combined",
+    "num_pretrain_epochs": "50", "num_acclimation_epochs": "15",
+    "factor_score_embedder_type": "DGCNN", "embed_hidden_sizes": "[0]",
+    "embed_num_hidden_nodes": "100", "embed_num_graph_conv_layers": "3",
+    "embed_lr": "0.0002", "embed_eps": "0.0001",
+    "embed_weight_decay": "0.0001", "embed_lag": "16",
+    "use_sigmoid_restriction": "0", "sigmoid_eccentricity_coeff": "10.0",
+    "prior_factors_path": "None", "cost_criteria": "CosineSimilarity",
+    "unsupervised_start_index": "0", "max_factor_prior_batches": "10",
+    "stopping_criteria_forecast_coeff": "10.",
+    "stopping_criteria_factor_coeff": "100.",
+    "stopping_criteria_cosSim_coeff": "1.", "deltaConEps": "0.1",
+    "in_degree_coeff": "1.", "out_degree_coeff": "1.",
+}
+# ref train/cMLP_d4IC_BLgs1Parsim_cached_args.txt
+CMLP_ARGS = {
+    "output_length": "1", "num_sims": "1", "embed_hidden_sizes": "[60]",
+    "batch_size": "128", "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "max_iter": "1000", "lookback": "1", "check_every": "10", "verbose": "0",
+    "num_factors": "1", "num_supervised_factors": "0",
+    "wavelet_level": "None", "gen_hidden": "[50]", "gen_lr": "0.0005",
+    "gen_lag_and_input_len": "2", "FORECAST_COEFF": "1.0",
+    "FACTOR_SCORE_COEFF": "0.0", "ADJ_L1_REG_COEFF": "1.0",
+    "DAGNESS_REG_COEFF": "0.0", "DAGNESS_LAG_COEFF": "0.0",
+    "DAGNESS_NODE_COEFF": "0.0",
+}
+# ref train/cLSTM_d4IC_BLgs1Parsim_cached_args.txt
+CLSTM_ARGS = {
+    "output_length": "1", "num_sims": "1", "embed_hidden_sizes": "[10]",
+    "batch_size": "128", "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "max_iter": "1000", "lookback": "3", "check_every": "5", "verbose": "0",
+    "num_factors": "1", "num_supervised_factors": "0",
+    "wavelet_level": "None", "gen_hidden": "25", "gen_lr": "0.0005",
+    "context": "2", "max_input_length": "4", "FORECAST_COEFF": "1.0",
+    "FACTOR_SCORE_COEFF": "0.0", "ADJ_L1_REG_COEFF": "10.0",
+    "DAGNESS_REG_COEFF": "0.0", "DAGNESS_LAG_COEFF": "0.0",
+    "DAGNESS_NODE_COEFF": "0.0",
+}
+# ref train/DGCNN_d4IC_BLgs1Parsim_cached_args.txt
+DGCNN_ARGS = {
+    "batch_size": "128", "gen_eps": "0.0001", "gen_weight_decay": "0.0001",
+    "max_iter": "1000", "lookback": "1", "check_every": "10", "verbose": "0",
+    "num_channels": "10", "wavelet_level": "None",
+    "num_wavelets_per_chan": "1", "num_features_per_node": "2",
+    "num_graph_conv_layers": "1", "num_hidden_nodes": "100",
+    "num_classes": "5", "signal_format": "original flattened",
+    "gen_lr": "0.0001",
+}
+# ref train/DCSFANMF_d4IC_OBPgs1_cached_args.txt
+DCSFA_ARGS = {
+    "batch_size": "128", "num_high_level_node_features": "5",
+    "best_model_name": "dCSFA-NMF-best-model.pt", "num_node_features": "20",
+    "n_components": "5", "n_sup_networks": "5",
+    "signal_format": "original flattened directed_spectrum vanilla",
+    "h": "256", "momentum": "0.5", "lr": "0.001", "recon_weight": "1.0",
+    "sup_weight": "2.0", "sup_recon_weight": "1.0",
+    "sup_smoothness_weight": "2.0", "n_epochs": "1000",
+    "n_pre_epochs": "50", "nmf_max_iter": "20",
+}
+# NAVAR/DYNOTEARS are the reference's d4IC-only baselines; their transcribed
+# cached-args live in the synSys module (which borrows them from d4IC) — one
+# transcription, shared. NAVAR's num_nodes follows this dataset.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from accuracy_parity_synsys import DYNOTEARS_ARGS  # noqa: E402
+from accuracy_parity_synsys import NAVAR_ARGS as _NAVAR_SYNSYS  # noqa: E402
+
+NAVAR_ARGS = dict(_NAVAR_SYNSYS, num_nodes=str(NUM_NODES), epochs="1000",
+                  check_every="100")
+
+MODELS = (
+    ("REDCLIFF_S_CMLP", REDCLIFF_ARGS, "REDCLIFF_S_CMLP"),
+    ("cMLP", CMLP_ARGS, "CMLP"),
+    ("cLSTM", CLSTM_ARGS, "CLSTM"),
+    ("DGCNN", DGCNN_ARGS, "DGCNN"),
+    ("DCSFANMF", DCSFA_ARGS, "DCSFA"),
+    ("NAVAR_CMLP", NAVAR_ARGS, "NAVAR_CMLP"),
+    ("DYNOTEARS_Vanilla", DYNOTEARS_ARGS, "DYNOTEARS_Vanilla"),
+)
+
+
+def curate_network(nets_root, net_id, fold, n_train, n_val):
+    """One synthetic 'gene network': a single-state 10-node sVAR with its own
+    lagged graph; per-network recordings in the per-network shard layout the
+    D4IC builder consumes. Returns the network's (C, C, L) graph.
+
+    The five network GRAPHS are fixed across folds (seeded by net_id only),
+    matching the D4IC design where folds are CV resamplings of the same five
+    DREAM4 networks; only the recordings are redrawn per fold."""
+    p = S.reference_curation_params(NUM_NODES)
+    graph_seed = 17 * net_id + 1
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=NUM_NODES, num_lags=2, num_factors=1,
+        make_factors_orthogonal=False,
+        make_factors_singular_components=False, rand_seed=graph_seed,
+        off_diag_edge_strengths=p["off_diag_edge_strengths"],
+        diag_receiving_node_forgetting_coeffs=
+            p["diag_receiving_node_forgetting_coeffs"],
+        diag_sending_node_forgetting_coeffs=
+            p["diag_sending_node_forgetting_coeffs"],
+        num_edges_per_graph=13)
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(fold * 1000 + net_id), graphs, acts,
+        p["base_freqs"], p["noise_mu"], p["noise_var"], p["innovation_amp"],
+        num_samples=n_train + n_val, recording_length=RECORDING_LEN,
+        burnin_period=50, num_labeled_sys_states=1, label_type="Oracle",
+        noise_type="gaussian")
+    X = np.asarray(X)
+    for split, sl in (("train", slice(0, n_train)),
+                      ("validation", slice(n_train, None))):
+        d = os.path.join(nets_root, f"net{net_id}", f"fold_{fold}", split)
+        os.makedirs(d, exist_ok=True)
+        samples = [[X[i], np.zeros((1,))] for i in range(len(X))[sl]]
+        with open(os.path.join(d, "subset_0.pkl"), "wb") as f:
+            pickle.dump(samples, f)
+    return np.asarray(graphs[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--folds", type=int, default=3)
+    ap.add_argument("--snr", default="HSNR", choices=["HSNR", "MSNR", "LSNR"])
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--algs", default="all", choices=["all", "core"],
+                    help="'core' drops NAVAR/DYNOTEARS")
+    args = ap.parse_args()
+    base = os.path.abspath(args.workdir) + ("_smoke" if args.smoke else "")
+    os.makedirs(base, exist_ok=True)
+    n_train, n_val = (24, 8) if args.smoke else (120, 30)
+    models = MODELS if args.algs == "all" else tuple(
+        m for m in MODELS if m[0] not in ("NAVAR_CMLP", "DYNOTEARS_Vanilla"))
+
+    model_args = {name: dict(a) for name, a, _ in models}
+    if args.smoke:
+        model_args["REDCLIFF_S_CMLP"].update(
+            max_iter="12", num_pretrain_epochs="4",
+            num_acclimation_epochs="4", check_every="2")
+        for key in ("cMLP", "cLSTM", "DGCNN"):
+            model_args[key].update(max_iter="10", check_every="2")
+        model_args["DCSFANMF"].update(n_epochs="10", n_pre_epochs="4")
+        if "NAVAR_CMLP" in model_args:
+            model_args["NAVAR_CMLP"].update(epochs="40", check_every="20")
+
+    # ------------------------------------------------------------- curation
+    data_args_by_fold = {}
+    true_by_fold = {}
+    nets_root = os.path.join(base, "networks")
+    for fold in range(args.folds):
+        t0 = time.time()
+        graphs = [curate_network(nets_root, n, fold, n_train, n_val)
+                  for n in range(NUM_NETWORKS)]
+        fold_dir = os.path.join(base, "data", f"d4ic_{args.snr}",
+                                f"fold_{fold}")
+        make_d4ic_fold(nets_root, fold_dir, fold_id=fold,
+                       num_factors=NUM_NETWORKS, snr_tier=args.snr,
+                       shuffle_rng=np.random.default_rng(fold))
+        save_cached_args_file_for_data(
+            fold_dir, NUM_NODES, graphs, f"data_fold{fold}_cached_args.txt")
+        data_args_by_fold[fold] = os.path.join(
+            fold_dir, f"data_fold{fold}_cached_args.txt")
+        true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
+        print(f"[curate] fold {fold}: {time.time()-t0:.1f}s -> {fold_dir}",
+              flush=True)
+
+    # ------------------------------------------------------------- training
+    roots = {}
+    for model_type, _, alias in models:
+        margs_file = os.path.join(base, f"{model_type}_d4ic_cached_args.txt")
+        with open(margs_file, "w") as f:
+            json.dump(model_args[model_type], f)
+        # tier-namespaced: run folder names do not encode the SNR tier, so a
+        # shared runs/ dir would let a second tier resume the first's models
+        save_root = os.path.join(base, f"runs_{args.snr}", f"{alias}_models")
+        os.makedirs(save_root, exist_ok=True)
+        roots[alias] = save_root
+        for fold in range(args.folds):
+            t0 = time.time()
+            set_up_and_run_experiments(
+                {"save_root_path": save_root}, [margs_file],
+                [data_args_by_fold[fold]],
+                possible_model_types=[model_type],
+                possible_data_sets=[f"data_fold{fold}"], task_id=1)
+            print(f"[train] {model_type} fold {fold}: {time.time()-t0:.1f}s",
+                  flush=True)
+
+    # ----------------------------------------------------------------- eval
+    eval_inputs = {"data": {}}
+    for fold in range(args.folds):
+        val_dir = os.path.join(os.path.dirname(data_args_by_fold[fold]),
+                               "validation")
+        eval_inputs["data"][fold] = np.asarray(
+            load_normalized_samples(val_dir).X[:128])
+
+    full = run_cross_algorithm_comparison(
+        list(roots.values()), {"data": true_by_fold},
+        os.path.join(base, "evals", f"d4ic_{args.snr}"),
+        num_folds=args.folds, plot=not args.smoke,
+        algorithms=[alias for _, _, alias in models],
+        eval_inputs=eval_inputs)
+
+    paradigm = "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag"
+    out = {"dataset": f"synthetic-source D4IC analog, {args.snr} "
+                      f"({NUM_NETWORKS} x {NUM_NODES}-node networks, "
+                      f"T={RECORDING_LEN}, dominant/background mixing)",
+           "snr_tier": args.snr, "folds": args.folds,
+           "smoke": bool(args.smoke),
+           "train_samples_per_fold": n_train * NUM_NETWORKS,
+           "algorithms": {}}
+    for alg, stats in full["data"][paradigm].items():
+        out["algorithms"][alg] = {
+            "offdiag_optimal_f1_mean": stats["f1_mean_across_factors"],
+            "offdiag_optimal_f1_sem": stats["f1_mean_std_err_across_factors"],
+            "offdiag_roc_auc_mean": stats.get("roc_auc_mean_across_factors"),
+            "offdiag_roc_auc_sem": stats.get(
+                "roc_auc_mean_std_err_across_factors"),
+        }
+        print(f"[result] {alg}: optF1 "
+              f"{out['algorithms'][alg]['offdiag_optimal_f1_mean']:.3f} ± "
+              f"{out['algorithms'][alg]['offdiag_optimal_f1_sem']:.3f}  "
+              f"ROC-AUC {out['algorithms'][alg]['offdiag_roc_auc_mean']}",
+              flush=True)
+
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"ACCURACY_D4IC_{args.snr}.json" if not args.smoke
+                        else f"ACCURACY_D4IC_{args.snr}_smoke.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[done] wrote {dest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
