@@ -115,15 +115,9 @@ def main():
                 # previous watcher/bench process is just as fresh
                 cached = bench._load_tpu_cache()
                 if cached is not None:
-                    try:
-                        measured = datetime.datetime.strptime(
-                            cached["measured_at"], "%Y-%m-%dT%H:%M:%SZ"
-                        ).replace(tzinfo=datetime.timezone.utc)
-                        age = (datetime.datetime.now(datetime.timezone.utc)
-                               - measured).total_seconds()
-                        fresh_enough = age < REFRESH_MIN_S
-                    except (KeyError, ValueError):
-                        pass
+                    # age_hours is computed by the loader; a backfilled seed
+                    # is always old enough to re-measure on a live window
+                    fresh_enough = cached["age_hours"] * 3600.0 < REFRESH_MIN_S
             if fresh_enough:
                 _log("live window but cache is fresh; skipping re-measure")
             elif not bench._acquire_measure_lock(wait_s=0.0):
