@@ -543,6 +543,17 @@ class RedcliffGridRunner:
                            val_combo_loss=gather_to_host(val_history[-1]),
                            best_criteria=gather_to_host(best_crit),
                            num_active=int(gather_to_host(active).sum()))
+            # global early exit: once EVERY lane has hit its per-point
+            # patience, further epochs are pure masked compute (the per-point
+            # trainer would have broken out of each run long before, ref
+            # :1522-1538). Checked on the check_every cadence so the host
+            # sync amortizes; uniform across processes (gather_to_host is a
+            # collective on multi-host meshes)
+            if (it % tc.check_every == 0
+                    and it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs
+                    and not bool(np.any(gather_to_host(active)))):
+                logger.log("early_exit_all_inactive", epoch=it)
+                break
 
         # one gather each; shared by the fit_end record and the result
         final_crit = gather_to_host(best_crit)

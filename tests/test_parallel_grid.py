@@ -314,6 +314,24 @@ def test_grid_early_stop_lane_masking():
     assert np.allclose(res.val_history[1:, 1], res.val_history[1, 1])
 
 
+def test_grid_all_inactive_early_exit():
+    """Once EVERY lane has hit its patience the fit loop exits instead of
+    burning max_iter epochs of masked compute (the per-point trainer would
+    have broken out of each run long before)."""
+    model = _model()
+    # both points frozen at lr 0 -> criteria never improve -> all lanes
+    # inactive after stop_after=1 epoch -> exit at the next check
+    spec = GridSpec(points=[{"gen_lr": 0.0, "embed_lr": 0.0},
+                            {"gen_lr": 0.0, "embed_lr": 0.0}])
+    tc = RedcliffTrainConfig(max_iter=50, batch_size=32, lookback=1,
+                             check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(5), ds, ds)
+    assert not res.active.any()
+    assert res.val_history.shape[0] < 50
+
+
 def test_grid_step_lane_mask_freezes_point():
     """Direct check: active=False lanes keep params and opt state bit-identical."""
     model = _model()
