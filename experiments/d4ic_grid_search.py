@@ -252,7 +252,13 @@ def main():
         stats_by_fold = []
         for fold in range(args.folds):
             dargs = curate_tier_fold(base, snr, fold, n_train, n_val)
-            save_root = os.path.join(base, f"runs_winner_{snr}")
+            # winner-config-encoded save root: run_folder_name encodes only
+            # RESCALED coefficients (and not gen_lr at all, the reference
+            # layout's limitation), so a re-invocation selecting a different
+            # winner must land in its own tree rather than resume this one's
+            wtag = "_".join(f"{k[:3]}{v}" for k, v in sorted(
+                winner_raw.items())).replace(".", "-")
+            save_root = os.path.join(base, f"runs_winner_{snr}_{wtag}")
             os.makedirs(save_root, exist_ok=True)
             t0 = time.time()
             set_up_and_run_experiments(
@@ -261,9 +267,11 @@ def main():
                 possible_data_sets=[f"data_fold{fold}"], task_id=1)
             print(f"[winner] {snr} fold {fold}: {time.time()-t0:.1f}s",
                   flush=True)
-            run_dir = [os.path.join(save_root, d)
+            matches = [os.path.join(save_root, d)
                        for d in sorted(os.listdir(save_root))
-                       if f"data_fold{fold}" in d][0]
+                       if f"data_fold{fold}" in d]
+            assert len(matches) == 1, (save_root, fold, matches)
+            run_dir = matches[0]
             stats_by_fold.append(evaluate_algorithm_on_fold(
                 run_dir, "REDCLIFF_S_CMLP",
                 load_true_gc_factors(dargs)))

@@ -232,9 +232,11 @@ class RedcliffGridRunner:
                 return jnp.zeros(())
             sup = est[:, :S_eff]
             m = jnp.max(sup, axis=(-2, -1), keepdims=True)
-            # positive-max guard in f32 (the trainer's host-side 1e-300 floor
-            # underflows to 0 here); zero/negative-max estimates pass through
-            # unscaled and the norm floor below keeps the cosine finite
+            # positive-max guard, matching GCTracker._track_cosines'
+            # documented deviation from the reference's 1e-300 floor:
+            # all-non-positive estimates pass through unscaled and the norm
+            # floor below keeps the cosine finite (equivalence on this regime
+            # is pinned by test_grid_trainer_cosine_parity_nonpositive)
             sup = sup / jnp.where(m > 0, m, 1.0)
             flat = sup.reshape(sup.shape[0], S_eff, -1)
             norms = jnp.maximum(jnp.linalg.norm(flat, axis=-1), 1e-8)
@@ -537,23 +539,24 @@ class RedcliffGridRunner:
             # guard must be uniform across processes (logger.active is not:
             # typically only process 0 writes) — gather everywhere, write
             # wherever a logger is attached
-            if it % tc.check_every == 0 and (
-                    logger.active or jax.process_count() > 1):
-                logger.log("epoch", epoch=it, phases=list(phases),
-                           val_combo_loss=gather_to_host(val_history[-1]),
-                           best_criteria=gather_to_host(best_crit),
-                           num_active=int(gather_to_host(active).sum()))
-            # global early exit: once EVERY lane has hit its per-point
-            # patience, further epochs are pure masked compute (the per-point
-            # trainer would have broken out of each run long before, ref
-            # :1522-1538). Checked on the check_every cadence so the host
-            # sync amortizes; uniform across processes (gather_to_host is a
-            # collective on multi-host meshes)
-            if (it % tc.check_every == 0
-                    and it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs
-                    and not bool(np.any(gather_to_host(active)))):
-                logger.log("early_exit_all_inactive", epoch=it)
-                break
+            if it % tc.check_every == 0:
+                # one gather serves both the epoch log and the exit test
+                act_host = gather_to_host(active)
+                if logger.active or jax.process_count() > 1:
+                    logger.log("epoch", epoch=it, phases=list(phases),
+                               val_combo_loss=gather_to_host(val_history[-1]),
+                               best_criteria=gather_to_host(best_crit),
+                               num_active=int(act_host.sum()))
+                # global early exit: once EVERY lane has hit its per-point
+                # patience, further epochs are pure masked compute (the
+                # per-point trainer would have broken out of each run long
+                # before, ref :1522-1538). Checked on the check_every cadence
+                # so the host sync amortizes; uniform across processes
+                # (gather_to_host is a collective on multi-host meshes)
+                if (it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs
+                        and not bool(np.any(act_host))):
+                    logger.log("early_exit_all_inactive", epoch=it)
+                    break
 
         # one gather each; shared by the fit_end record and the result
         final_crit = gather_to_host(best_crit)

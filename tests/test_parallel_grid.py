@@ -314,6 +314,50 @@ def test_grid_early_stop_lane_masking():
     assert np.allclose(res.val_history[1:, 1], res.val_history[1, 1])
 
 
+def test_grid_trainer_cosine_parity_nonpositive():
+    """The all-non-positive-estimate regime (possible for conditional GC
+    modes with sign-free embedder weightings): the grid's in-jit cosine
+    stopping term and the trainer tracker's host-side cosine must agree —
+    both finite, both unscaled-pass-through — so criteria-based selection
+    cannot swap between engines on this regime (VERDICT r4 weak #6)."""
+    from redcliff_tpu.train.tracking import GCProgressTracker
+
+    model = _model()
+    cfg = model.config
+    rng = np.random.default_rng(11)
+    # fixed all-NEGATIVE per-factor estimates, identical for every sample
+    est = -np.abs(rng.normal(size=(cfg.num_factors, cfg.num_chans,
+                                   cfg.num_chans))).astype(np.float32) - 0.1
+
+    def fake_gc(params, mode, X=None, threshold=True, ignore_lag=True,
+                **kw):
+        # shape contract of RedcliffSCMLP.gc: (B, K, C, C, L) with L=1 when
+        # ignore_lag (point_cos slices the lag axis with [..., 0])
+        B = X.shape[0]
+        return jnp.asarray(est)[None].repeat(B, axis=0)[..., None]
+
+    model.gc = fake_gc
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 2e-3}])
+    tc = RedcliffTrainConfig(batch_size=8)
+    runner = RedcliffGridRunner(model, tc, spec)
+    params, _, _ = runner.init_grid(jax.random.PRNGKey(0))
+    X = rng.normal(size=(8, cfg.max_lag, cfg.num_chans)).astype(np.float32)
+    grid_cos = np.asarray(runner._cos(params, jnp.asarray(X)))
+    assert np.all(np.isfinite(grid_cos))
+
+    tracker = GCProgressTracker(num_supervised_factors=cfg.num_supervised_factors,
+                                num_chans=cfg.num_chans,
+                                num_factors=cfg.num_factors)
+    est_by_sample = [[est[k] for k in range(cfg.num_factors)]
+                     for _ in range(X.shape[0])]
+    tracker.update(true_GC=None, est_by_sample=est_by_sample,
+                   est_by_sample_lagsummed=est_by_sample)
+    trainer_cos = tracker.latest_mean_supervised_cosine()
+    assert np.isfinite(trainer_cos)
+    # same semantics -> same number (both lanes see identical estimates)
+    np.testing.assert_allclose(grid_cos, trainer_cos, rtol=1e-5, atol=1e-6)
+
+
 def test_grid_all_inactive_early_exit():
     """Once EVERY lane has hit its patience the fit loop exits instead of
     burning max_iter epochs of masked compute (the per-point trainer would
