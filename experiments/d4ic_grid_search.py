@@ -154,7 +154,11 @@ def main():
     read_in_data_args(args_dict)
     rescale_dataset_dependent_coefficients(args_dict)
     model = create_model_instance(args_dict)
-    train_ds, val_ds = get_data_for_model_training(args_dict)
+    # grid_search=False: the winner re-runs train through the driver on the
+    # full fold, so selection must see the same data (the default True keeps
+    # only a quarter — the reference's cheap-search subsampling)
+    train_ds, val_ds = get_data_for_model_training(args_dict,
+                                                   grid_search=False)
 
     tc = RedcliffTrainConfig(
         embed_lr=args_dict["embed_lr"], embed_eps=args_dict["embed_eps"],

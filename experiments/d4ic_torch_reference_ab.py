@@ -177,7 +177,11 @@ def run_reference_fold(base, dargs, fold, margs_file, max_iter_override=None):
     np.random.seed(0)
     _random.seed(0)
 
-    save_root = os.path.join(base, "runs_torch_ref")
+    # tier- and cap-namespaced: the reference run-dir name encodes neither,
+    # so a shared root would let one tier's run be reused for another's
+    snr = os.path.basename(os.path.dirname(os.path.dirname(dargs)))
+    save_root = os.path.join(
+        base, f"runs_torch_ref_{snr}_mi{max_iter_override or 'ref'}")
     os.makedirs(save_root, exist_ok=True)
     args_dict = {"save_root_path": save_root,
                  "model_type": "REDCLIFF_S_CMLP",
@@ -214,8 +218,12 @@ def run_reference_fold(base, dargs, fold, margs_file, max_iter_override=None):
     args_dict["save_path"] = save_dir
 
     final = os.path.join(save_dir, "final_best_model.bin")
-    if os.path.isfile(final):
-        # completed run from a previous invocation: score it as-is
+    done_marker = os.path.join(save_dir, "TORCH_AB_FIT_COMPLETE")
+    if os.path.isfile(final) and os.path.isfile(done_marker):
+        # the reference's save_checkpoint writes final_best_model.bin DURING
+        # training (ref models/redcliff_s_cmlp.py:902-903), so the file alone
+        # does not imply completion; only a marker written after
+        # call_model_fit_method returned marks a finished run
         print(f"[torch-ref] reusing completed run {save_dir}", flush=True)
         return torch.load(final, weights_only=False)
 
@@ -225,6 +233,8 @@ def run_reference_fold(base, dargs, fold, margs_file, max_iter_override=None):
                      y_val=y_val)
     model = _create_reference_redcliff(args_dict)
     ref_mu.call_model_fit_method(model, args_dict)
+    with open(done_marker, "w") as f:
+        f.write("fit returned\n")
 
     if os.path.isfile(final):
         model = torch.load(final, weights_only=False)

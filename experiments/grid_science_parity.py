@@ -52,6 +52,7 @@ import numpy as np  # noqa: E402
 from accuracy_parity_synsys import REDCLIFF_ARGS  # noqa: E402
 from redcliff_tpu.data.curation import curate_synthetic_fold  # noqa: E402
 from redcliff_tpu.eval.cross_alg import evaluate_algorithm_on_fold  # noqa: E402
+from redcliff_tpu.eval.edge_dynamics import vector_spearman  # noqa: E402
 from redcliff_tpu.eval.grid_selection import select_best_models  # noqa: E402
 from redcliff_tpu.train.driver import (  # noqa: E402
     run_coefficient_grid, set_up_and_run_experiments)
@@ -72,14 +73,13 @@ def _grid_points():
 
 
 def spearman(a, b):
-    """Spearman rank correlation of two score vectors (no scipy tie-handling
-    needed: criteria are continuous floats)."""
-    ra = np.argsort(np.argsort(a)).astype(np.float64)
-    rb = np.argsort(np.argsort(b)).astype(np.float64)
-    ra -= ra.mean()
-    rb -= rb.mean()
-    denom = np.sqrt((ra ** 2).sum() * (rb ** 2).sum())
-    return float((ra * rb).sum() / denom) if denom > 0 else 0.0
+    """Spearman rank correlation of two score vectors (the repo's canonical
+    tie-averaged implementation, one lane)."""
+    rho, _ = vector_spearman(np.asarray(a).reshape(-1, 1),
+                             np.asarray(b).reshape(-1, 1))
+    # constant score vectors have zero rank variance (rho undefined); report
+    # 0.0 rather than leaking NaN into the artifact
+    return float(rho[0]) if np.isfinite(rho[0]) else 0.0
 
 
 def _completed_run_dirs(save_root, min_epochs, expected_iters, lookback,
@@ -198,7 +198,11 @@ def run_fold(base, fold, base_margs, args_smoke, system):
     from redcliff_tpu.train.orchestration import (
         create_model_instance, get_data_for_model_training)
     model = create_model_instance(args_dict)
-    train_ds, val_ds = get_data_for_model_training(args_dict)
+    # grid_search=False: BOTH legs must train on the full fold — the default
+    # True applies the reference's quarter-subsampling for cheap searches,
+    # which silently handicapped the grid leg vs the per-point driver leg
+    train_ds, val_ds = get_data_for_model_training(args_dict,
+                                                   grid_search=False)
 
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
     tc = RedcliffTrainConfig(
@@ -343,9 +347,12 @@ def main():
     deltas = [f["winner_science_delta_optf1"] for f in folds]
     # preserve trained wall-clock across re-invocations: a resumed leg would
     # otherwise overwrite the measurement with the no-op resume scan time
+    # default system keeps the canonical artifact name; other systems get
+    # their own file so runs cannot overwrite each other
+    tag = "" if args.system == "6-2-2" else "_" + args.system.replace("-", "_")
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "GRID_SCIENCE_PARITY.json" if not args.smoke
-                        else "GRID_SCIENCE_PARITY_smoke.json")
+                        f"GRID_SCIENCE_PARITY{tag}.json" if not args.smoke
+                        else f"GRID_SCIENCE_PARITY{tag}_smoke.json")
     prev = None
     if os.path.isfile(dest):
         try:
@@ -353,8 +360,10 @@ def main():
                 prev = json.load(f)
         except (OSError, json.JSONDecodeError):
             prev = None
+    prev_same_system = (prev is not None
+                        and prev.get("system", "").startswith(args.system))
     for fr in folds:
-        if not fr["wall_clock_s"]["per_point_trained"] and prev is not None:
+        if not fr["wall_clock_s"]["per_point_trained"] and prev_same_system:
             for pfr in prev.get("folds", []):
                 if (pfr.get("fold") == fr["fold"]
                         and pfr.get("wall_clock_s", {}).get(

@@ -97,9 +97,13 @@ def apply_signal_format(X, signal_format, max_num_features_per_series=None,
     (ref dream4_datasets.py:120-151). Returns (N, F) features for flattened /
     dirspec formats, or X unchanged for "original"."""
     if signal_format in ("original", "wavelet_decomp"):
-        # wavelet decomposition happens inside the models via their
-        # config.wavelet_level (utils.time_series.swt), so the loader hands
-        # over raw windows for both formats
+        # "wavelet_decomp" inputs are decomposed by
+        # load_normalized_split_datasets BEFORE normalization (the reference
+        # stores the decomposition computed at curation time on the raw
+        # signal, sample entry X_WAV_DECOMP_IND, ref
+        # synthetic_datasets.py:28,102-103; this build decomposes at load
+        # instead of tripling the stored sample size); by this point X is
+        # already in its final (T, C*(level+1)) width either way
         return X
     if "directed_spectrum" in signal_format:
         assert dirspec_params is not None
@@ -132,17 +136,43 @@ def apply_signal_format(X, signal_format, max_num_features_per_series=None,
     raise ValueError(f"unknown signal_format: {signal_format!r}")
 
 
+def decompose_windows(X, wavelet_level, wavelet_type="db1"):
+    """Stationary-wavelet-decompose a batch of raw (N, T, C) windows into
+    (N, T, C*(level+1)), channel c's bands contiguous in
+    [cA, cD_level, ..., cD_1] order — the layout stored by the reference's
+    curation as sample entry X_WAV_DECOMP_IND (ref time_series.py:10-26,
+    synthetic_datasets.py:28) and consumed by the models' wavelet GC
+    condensation (models/cmlp.py condense_wavelet_gc)."""
+    from ..utils.time_series import swt
+
+    N, T, C = X.shape
+    assert T % (2 ** wavelet_level) == 0, (
+        f"swt needs T divisible by 2**level; got T={T}, "
+        f"level={wavelet_level}")
+    bands = swt(np.transpose(X, (0, 2, 1)), wavelet_type, wavelet_level)
+    stacked = np.stack(bands, axis=2)  # (N, C, level+1, T)
+    return np.transpose(
+        stacked.reshape(N, C * (wavelet_level + 1), T), (0, 2, 1)
+    ).astype(np.float32)
+
+
 def load_normalized_split_datasets(data_root_path, signal_format="original",
                                    shuffle=True, shuffle_seed=0,
                                    max_num_features_per_series=None,
                                    dirspec_params=None, grid_search=True,
-                                   average_region_map=None):
+                                   average_region_map=None,
+                                   wavelet_level=None):
     """(train, validation) ArrayDatasets from a fold directory, z-scored with
     per-split dataset-wide channel statistics like the reference loaders
     (ref dream4_datasets.py:168-190, local_field_potential_datasets.py:198-220).
 
     average_region_map ({region: [channel indices]}) averages channel groups
     before normalization (ref local_field_potential_datasets.py:118-133).
+
+    For "wavelet_decomp" formats the raw windows are swt-decomposed FIRST and
+    the per-series z-scoring applies to the decomposed representation —
+    the reference's order (decomposition at curation on the raw signal,
+    normalization of the stored decomposed entry at load).
     """
     out = []
     for split in ("train", "validation"):
@@ -152,6 +182,10 @@ def load_normalized_split_datasets(data_root_path, signal_format="original",
         if average_region_map is not None:
             X = np.stack([X[:, :, idxs].mean(axis=2)
                           for idxs in average_region_map.values()], axis=2)
+        if "wavelet_decomp" in signal_format:
+            assert wavelet_level, (
+                "signal_format 'wavelet_decomp' requires wavelet_level >= 1")
+            X = decompose_windows(X, wavelet_level)
         if shuffle:
             rng = np.random.default_rng(shuffle_seed)
             order = rng.permutation(len(X))

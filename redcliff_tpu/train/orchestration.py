@@ -266,7 +266,8 @@ def get_data_for_model_training(args_dict, grid_search=True, shuffle=True,
             args_dict.get("num_node_features")),
         dirspec_params=args_dict.get("dirspec_params"),
         grid_search=grid_search,
-        average_region_map=args_dict.get("average_region_map"))
+        average_region_map=args_dict.get("average_region_map"),
+        wavelet_level=args_dict.get("wavelet_level"))
 
 
 def call_model_fit_method(model, args_dict, train_ds, val_ds, save_dir=None,

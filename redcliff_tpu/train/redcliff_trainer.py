@@ -455,10 +455,16 @@ class RedcliffTrainer:
         tc = self.config
         for X, _ in val_ds.batches(tc.batch_size):
             Xw = jnp.asarray(X[: tc.max_samples_for_gc_tracking, : cfg.max_lag, :])
+            # condense wavelet-band blocks to channel granularity so tracking
+            # compares (C, C) against the true graphs (the reference's
+            # checkpoint tracking passes combine_wavelet_representations=True,
+            # ref redcliff_s_cmlp.py:1092-1107); a no-op for non-wavelet runs
             lagged = np.asarray(self.model.gc(params, cfg.primary_gc_est_mode, X=Xw,
-                                              threshold=False, ignore_lag=False))
+                                              threshold=False, ignore_lag=False,
+                                              combine_wavelet_representations=True))
             nolag = np.asarray(self.model.gc(params, cfg.primary_gc_est_mode, X=Xw,
-                                             threshold=False, ignore_lag=True))[..., 0]
+                                             threshold=False, ignore_lag=True,
+                                             combine_wavelet_representations=True))[..., 0]
             est_lagged = [[lagged[s, k] for k in range(lagged.shape[1])]
                           for s in range(lagged.shape[0])]
             est_nolag = [[nolag[s, k] for k in range(nolag.shape[1])]

@@ -145,6 +145,16 @@ class Trainer:
             kw = {"X": track_X}
         else:
             kw = {}
+        mcfg = self.model.config
+        if (getattr(mcfg, "wavelet_level", None) is not None
+                or getattr(mcfg, "num_wavelets_per_chan", 1) > 1):
+            # condense wavelet-band blocks so tracking compares (C, C)
+            # against the true graphs (same convention as the REDCLIFF
+            # trainer; ref checkpoint tracking passes
+            # combine_wavelet_representations=True). Covers both the
+            # wavelet_level families (cMLP/cLSTM FM) and DGCNN's
+            # num_wavelets_per_chan-expanded node axis
+            kw["combine_wavelet_representations"] = True
         ests = [np.asarray(g) for g in self.model.gc(params, ignore_lag=False, **kw)]
         ests_nolag = [np.asarray(g) for g in self.model.gc(params, ignore_lag=True, **kw)]
         tracker.update(true_GC, [ests], est_by_sample_lagsummed=[ests_nolag])

@@ -5,7 +5,12 @@ through the loopback coordinator, giving a 4-device global mesh spanning both
 processes — the same topology as two TPU slices over DCN, scaled down. Run by
 tests/test_multihost.py as:
 
-    python tests/multihost_worker.py <port> <process_id> <num_processes> <outdir>
+    python tests/multihost_worker.py <port> <process_id> <num_processes> \
+        <outdir> [local_devices]
+
+The optional local_devices argument (default 2) sets this worker's virtual
+device count, so the driver's dryrun can scale the same topology up
+(e.g. 2 processes x 4 devices = an 8-device DCN-spanning mesh).
 """
 import os
 import pickle
@@ -13,7 +18,7 @@ import sys
 
 PORT, PID, NPROC, OUTDIR = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
                             sys.argv[4])
-LOCAL_DEVICES = 2
+LOCAL_DEVICES = int(sys.argv[5]) if len(sys.argv) > 5 else 2
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -51,7 +56,7 @@ def main():
     assert is_distributed()
 
     # host-partitioned staging: this process feeds its contiguous block
-    G = 4
+    G = NPROC * LOCAL_DEVICES
     lo, hi = process_local_slice(G)
     assert hi - lo == G // NPROC
 
