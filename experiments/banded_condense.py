@@ -90,8 +90,12 @@ def aggregate_dynamic(dyn_by_system, systems=None):
         if systems is not None and sys_key not in systems:
             continue
         for alg, metrics in stats.items():
-            for metric, st in (metrics or {}).items():
-                if st is None or st.get("mean") is None:
+            if alg.startswith("_") or not isinstance(metrics, dict):
+                continue  # summary metadata (_conventions), not an algorithm
+            for metric, st in metrics.items():
+                # scalar convention fields (scoring_window) ride alongside
+                # the {mean, sem, n} metric dicts
+                if not isinstance(st, dict) or st.get("mean") is None:
                     continue
                 accum.setdefault(alg, {}).setdefault(metric, []).append(
                     st["mean"])

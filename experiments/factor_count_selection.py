@@ -1,0 +1,166 @@
+"""Factor-count selection by cross-validated stopping criteria — the
+notebook's model-selection flow, run on THIS build's own models.
+
+The reference selects num_factors by comparing cross-validated
+stopping-criteria minima across candidate factor counts (notebook cells
+34-35, rebuilt as eval/analysis.factor_selection_table and pinned against
+the notebook's hard-coded data by tests/test_analysis_notebook_parity.py) —
+its answer to systems where the factor count is not known a priori.
+VERDICT r4 flags the two worst Low-band systems of the banded study (3-1-2:
+REDCLIFF-S 0.460 vs DGCNN 0.722; 6-4-2: 0.397 vs 0.408) as exactly the cases
+this tool exists for, and notes it had never consumed a tree of this
+framework's trained runs.
+
+This experiment runs it end to end per system:
+1. curate the banded-study folds (same generator, sample budget, seeds);
+2. train REDCLIFF-S at num_factors K in {2..6} through the REAL driver
+   (num_supervised_factors stays at the dataset's labeled-state count, as
+   the reference holds it at TST's 3 states while sweeping K to 9);
+3. feed the run tree to factor_selection_table; select K by summed criteria
+   (forecast + factor minima, the notebook's comparison);
+4. score every K with the off-diag optimal-F1 battery, so the artifact shows
+   whether criteria-selected K improves on the banded table's K=2 default.
+
+Writes experiments/FACTOR_COUNT_SELECTION.json.
+
+Run:  python experiments/factor_count_selection.py <workdir> [--smoke]
+      [--systems 3-1-2,6-4-2] [--folds N]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from accuracy_parity_synsys import REDCLIFF_ARGS  # noqa: E402
+from redcliff_tpu.data.curation import curate_synthetic_fold  # noqa: E402
+from redcliff_tpu.eval.analysis import factor_selection_table  # noqa: E402
+from redcliff_tpu.eval.cross_alg import (  # noqa: E402
+    evaluate_algorithm_on_fold, find_run_directory)
+from redcliff_tpu.train.driver import set_up_and_run_experiments  # noqa: E402
+from redcliff_tpu.utils.config import load_true_gc_factors  # noqa: E402
+
+OFFDIAG = "key_stats_estGC_normOffDiag_vs_trueGC_normOffDiag"
+K_CANDIDATES = (2, 3, 4, 5, 6)
+
+
+def run_system(base, system, folds, smoke):
+    num_nodes, num_edges, num_states = (int(v) for v in system.split("-"))
+    n_train, n_val = (240, 96) if smoke else (1040, 240)
+    data_args_by_fold = {}
+    true_by_fold = {}
+    for fold in range(folds):
+        fold_dir, _ = curate_synthetic_fold(
+            os.path.join(base, "data"), fold_id=fold, num_nodes=num_nodes,
+            num_lags=2, num_factors=num_states,
+            num_supervised_factors=num_states,
+            num_edges_per_graph=num_edges, num_samples_in_train_set=n_train,
+            num_samples_in_val_set=n_val, sample_recording_len=100,
+            burnin_period=50, label_type_setting="OneHot",
+            noise_type="gaussian", noise_level=1.0,
+            folder_name=f"synSys{num_nodes}_{num_edges}_{num_states}")
+        data_args_by_fold[fold] = os.path.join(
+            fold_dir, f"data_fold{fold}_cached_args.txt")
+        true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
+
+    run_dirs_by_k = {}
+    science_by_k = {}
+    for K in K_CANDIDATES:
+        margs = dict(REDCLIFF_ARGS,
+                     num_factors=str(K),
+                     num_supervised_factors=str(num_states))
+        if smoke:
+            margs.update(max_iter="12", num_pretrain_epochs="4",
+                         num_acclimation_epochs="4", check_every="2")
+        margs_file = os.path.join(base, f"REDCLIFF_S_CMLP_K{K}_cached_args.txt")
+        with open(margs_file, "w") as f:
+            json.dump(margs, f)
+        save_root = os.path.join(base, f"runs_K{K}")
+        os.makedirs(save_root, exist_ok=True)
+        run_dirs = []
+        pooled = []
+        for fold in range(folds):
+            t0 = time.time()
+            set_up_and_run_experiments(
+                {"save_root_path": save_root}, [margs_file],
+                [data_args_by_fold[fold]],
+                possible_model_types=["REDCLIFF_S_CMLP"],
+                possible_data_sets=[f"data_fold{fold}"], task_id=1)
+            print(f"[{system} K={K}] fold {fold}: {time.time()-t0:.1f}s",
+                  flush=True)
+            run_dir = find_run_directory(save_root, "data", fold)
+            run_dirs.append(run_dir)
+            stats = evaluate_algorithm_on_fold(run_dir, "REDCLIFF_S_CMLP",
+                                               true_by_fold[fold])
+            pooled.extend(stats[OFFDIAG]["f1_vals_across_factors"])
+        run_dirs_by_k[K] = run_dirs
+        f1 = np.asarray(pooled, dtype=np.float64)
+        science_by_k[K] = {
+            "offdiag_optimal_f1_mean": float(f1.mean()),
+            "offdiag_optimal_f1_sem": float(f1.std(ddof=1) / np.sqrt(len(f1)))
+            if len(f1) > 1 else 0.0,
+        }
+        print(f"[{system} K={K}] optF1 "
+              f"{science_by_k[K]['offdiag_optimal_f1_mean']:.3f} ± "
+              f"{science_by_k[K]['offdiag_optimal_f1_sem']:.3f}", flush=True)
+
+    table = factor_selection_table(run_dirs_by_k)
+    # the notebook compares criteria minima across K; combine forecast +
+    # factor criteria exactly as the training criteria weight them is not
+    # defined there — select by the summed normalized minima, reporting both
+    # components so the choice is auditable
+    selectable = {K: (table[K].get("avg_forecasting_loss_mean", np.inf)
+                      + table[K].get("avg_factor_loss_mean", np.inf))
+                  for K in K_CANDIDATES}
+    selected = min(selectable, key=selectable.get)
+    print(f"[{system}] criteria-selected K = {selected} "
+          f"(sums: { {k: round(v, 3) for k, v in selectable.items()} })",
+          flush=True)
+    return {
+        "system": system,
+        "num_labeled_states": num_states,
+        "selection_table": table,
+        "criteria_sum_by_k": {str(k): float(v)
+                              for k, v in selectable.items()},
+        "selected_num_factors": int(selected),
+        "science_by_num_factors": {str(k): v
+                                   for k, v in science_by_k.items()},
+        "banded_study_default_k": 2,
+        "banded_study_redcliff_optf1": {"3-1-2": 0.460, "6-4-2": 0.397}.get(
+            system),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--systems", default="3-1-2,6-4-2")
+    ap.add_argument("--folds", type=int, default=3)
+    args = ap.parse_args()
+    out = {"folds": args.folds, "smoke": bool(args.smoke), "systems": {}}
+    for system in args.systems.split(","):
+        base = (os.path.abspath(args.workdir) + f"_{system}"
+                + ("_smoke" if args.smoke else ""))
+        os.makedirs(base, exist_ok=True)
+        out["systems"][system] = run_system(base, system, args.folds,
+                                            args.smoke)
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "FACTOR_COUNT_SELECTION.json" if not args.smoke
+                        else "FACTOR_COUNT_SELECTION_smoke.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[done] wrote {dest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

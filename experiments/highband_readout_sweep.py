@@ -1,0 +1,180 @@
+"""High-band dynamic-readout sweep: why per-edge tracking r ~ 0.03, and what
+convention fixes or explains it.
+
+Round-4's banded study scored the High band's dynamic readouts barely above
+zero (per-edge tracking r 0.030, BASELINE.md:107-108) — in the band where the
+paper's claim is strongest. Two confounds were identified (VERDICT r4 weak
+#5, ADVICE r4 #4):
+
+1. REDCLIFF was scored with history=embed_lag (16) while static baselines
+   used history=max(L,2)=2 — different window counts and label offsets of the
+   same recordings (ADVICE: score all algorithms on a common window grid);
+2. the window's label anchor was its LAST step, but High-band systems switch
+   states quickly: a 16-step window's content reflects its interior, so
+   anchoring truth at the trailing edge misaligns estimate and truth near
+   every transition.
+
+This experiment retrains the High-band factor-sweep systems (6-2-4 / 6-2-5 /
+6-2-6 — the banded-study configurations, same generator/seeds/budgets) with
+REDCLIFF-S and the two strongest static baselines, then scores the dynamic
+readouts under a convention sweep:
+
+* common window grid (ADVICE fix) x label_align in {last, center, majority};
+* the round-4 convention (per-algorithm windows, last-step anchor) re-scored
+  for continuity with BANDED_SYNSYS.json.
+
+Writes experiments/HIGHBAND_READOUT_SWEEP.json.
+
+Run:  python experiments/highband_readout_sweep.py <workdir> [--smoke]
+      [--systems 6-2-4,6-2-5,6-2-6] [--folds N]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from accuracy_parity_synsys import (  # noqa: E402
+    CMLP_ARGS, DGCNN_ARGS, REDCLIFF_ARGS)
+from redcliff_tpu.data.curation import curate_synthetic_fold  # noqa: E402
+from redcliff_tpu.eval.dynamic_readout import (  # noqa: E402
+    run_dynamic_readout_evaluation)
+from redcliff_tpu.train.driver import set_up_and_run_experiments  # noqa: E402
+from redcliff_tpu.utils.config import load_true_gc_factors  # noqa: E402
+
+CONDITIONS = (
+    {"name": "round4_convention", "common_window_grid": False,
+     "label_align": "last"},
+    {"name": "common_grid_last", "common_window_grid": True,
+     "label_align": "last"},
+    {"name": "common_grid_center", "common_window_grid": True,
+     "label_align": "center"},
+    {"name": "common_grid_majority", "common_window_grid": True,
+     "label_align": "majority"},
+)
+
+
+def run_system(base, system, folds, smoke):
+    num_nodes, num_edges, num_factors = (int(v) for v in system.split("-"))
+    n_train, n_val = (240, 96) if smoke else (1040, 240)
+    sys_folder = f"synSys{num_nodes}_{num_edges}_{num_factors}"
+
+    model_args = {
+        "REDCLIFF_S_CMLP": dict(REDCLIFF_ARGS,
+                                num_factors=str(num_factors),
+                                num_supervised_factors=str(num_factors)),
+        "cMLP": dict(CMLP_ARGS),
+        "DGCNN": dict(DGCNN_ARGS, num_classes=str(num_factors)),
+    }
+    if smoke:
+        model_args["REDCLIFF_S_CMLP"].update(
+            max_iter="12", num_pretrain_epochs="4",
+            num_acclimation_epochs="4", check_every="2")
+        model_args["cMLP"].update(max_iter="8", check_every="2")
+        model_args["DGCNN"].update(max_iter="8", check_every="2")
+
+    data_args_by_fold = {}
+    true_by_fold = {}
+    for fold in range(folds):
+        fold_dir, _ = curate_synthetic_fold(
+            os.path.join(base, "data"), fold_id=fold, num_nodes=num_nodes,
+            num_lags=2, num_factors=num_factors,
+            num_supervised_factors=num_factors,
+            num_edges_per_graph=num_edges, num_samples_in_train_set=n_train,
+            num_samples_in_val_set=n_val, sample_recording_len=100,
+            burnin_period=50, label_type_setting="OneHot",
+            noise_type="gaussian", noise_level=1.0, folder_name=sys_folder)
+        data_args_by_fold[fold] = os.path.join(
+            fold_dir, f"data_fold{fold}_cached_args.txt")
+        true_by_fold[fold] = load_true_gc_factors(data_args_by_fold[fold])
+
+    roots = {}
+    for model_type, margs in model_args.items():
+        margs_file = os.path.join(base, f"{model_type}_cached_args.txt")
+        with open(margs_file, "w") as f:
+            json.dump(margs, f)
+        alias = {"REDCLIFF_S_CMLP": "REDCLIFF_S_CMLP", "cMLP": "CMLP",
+                 "DGCNN": "DGCNN"}[model_type]
+        save_root = os.path.join(base, "runs", f"{alias}_models")
+        os.makedirs(save_root, exist_ok=True)
+        roots[alias] = save_root
+        for fold in range(folds):
+            t0 = time.time()
+            set_up_and_run_experiments(
+                {"save_root_path": save_root}, [margs_file],
+                [data_args_by_fold[fold]],
+                possible_model_types=[model_type],
+                possible_data_sets=[f"data_fold{fold}"], task_id=1)
+            print(f"[{system} train] {alias} fold {fold}: "
+                  f"{time.time()-t0:.1f}s", flush=True)
+
+    results = {}
+    for cond in CONDITIONS:
+        dyn = run_dynamic_readout_evaluation(
+            roots=roots, data_args_by_fold=data_args_by_fold,
+            true_by_fold=true_by_fold, num_folds=folds,
+            num_supervised_factors=num_factors,
+            save_root=os.path.join(base, "evals", "dynamic", cond["name"]),
+            common_window_grid=cond["common_window_grid"],
+            label_align=cond["label_align"])
+        results[cond["name"]] = dyn
+        r = dyn.get("REDCLIFF_S_CMLP", {})
+        print(f"[{system} {cond['name']}] REDCLIFF edge_tracking_r="
+              f"{(r.get('edge_tracking_r') or {}).get('mean')} "
+              f"dyn_optF1={(r.get('dynamic_optimal_f1') or {}).get('mean')} "
+              f"state_r={(r.get('state_score_r') or {}).get('mean')}",
+              flush=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--systems", default="6-2-4,6-2-5,6-2-6")
+    ap.add_argument("--folds", type=int, default=3)
+    args = ap.parse_args()
+    out = {"folds": args.folds, "smoke": bool(args.smoke),
+           "conditions": [c["name"] for c in CONDITIONS], "systems": {}}
+    for system in args.systems.split(","):
+        base = (os.path.abspath(args.workdir) + f"_{system}"
+                + ("_smoke" if args.smoke else ""))
+        os.makedirs(base, exist_ok=True)
+        out["systems"][system] = run_system(base, system, args.folds,
+                                            args.smoke)
+
+    # cross-system aggregate per condition (mean of per-system means)
+    agg = {}
+    for cond in CONDITIONS:
+        per_metric = {}
+        for system, res in out["systems"].items():
+            r = res[cond["name"]].get("REDCLIFF_S_CMLP", {})
+            for metric in ("edge_tracking_r", "dynamic_optimal_f1",
+                           "state_score_r", "dominant_state_acc"):
+                st = r.get(metric)
+                if isinstance(st, dict) and st.get("mean") is not None:
+                    per_metric.setdefault(metric, []).append(st["mean"])
+        agg[cond["name"]] = {
+            m: {"mean": float(np.mean(v)), "n_systems": len(v)}
+            for m, v in per_metric.items()}
+    out["redcliff_aggregate_by_condition"] = agg
+
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "HIGHBAND_READOUT_SWEEP.json" if not args.smoke
+                        else "HIGHBAND_READOUT_SWEEP_smoke.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[done] wrote {dest}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,8 @@ import numpy as np  # noqa: E402
 
 from accuracy_parity_synsys import CMLP_ARGS, REDCLIFF_ARGS  # noqa: E402
 from redcliff_tpu.data.curation import curate_synthetic_fold  # noqa: E402
-from redcliff_tpu.eval.cross_alg import evaluate_algorithm_on_fold  # noqa: E402
+from redcliff_tpu.eval.cross_alg import (  # noqa: E402
+    evaluate_algorithm_on_fold, find_run_directory)
 from redcliff_tpu.eval.model_io import load_model_for_eval  # noqa: E402
 from redcliff_tpu.eval.stats import three_view_optimal_f1_stats  # noqa: E402
 from redcliff_tpu.train.driver import set_up_and_run_experiments  # noqa: E402
@@ -160,11 +161,7 @@ def main():
                 possible_data_sets=[f"data_fold{fold}"], task_id=1)
             print(f"[train] {alias} fold {fold}: {time.time()-t0:.1f}s",
                   flush=True)
-            # trailing "_" pins the fold number (fold 1 must not match the
-            # data_fold10 run dir)
-            run_dir = [os.path.join(save_root, d)
-                       for d in sorted(os.listdir(save_root))
-                       if f"data_fold{fold}_" in d][0]
+            run_dir = find_run_directory(save_root, "data", fold)
             # alg dispatch: the Smooth control shares the REDCLIFF readout
             alg = "REDCLIFF_S_CMLP" if "REDCLIFF" in model_type else "CMLP"
             stats = evaluate_algorithm_on_fold(run_dir, alg,

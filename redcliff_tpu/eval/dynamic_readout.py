@@ -63,14 +63,34 @@ def lag_normed_graph(G):
     return G / m if m > 0 else G
 
 
-def _score_steps(recording_len, history):
-    """Number of scoreable windows and the label offset: window i covers
-    steps [i, i+history) and is scored against the label at its last step."""
+def _score_steps(recording_len, history, label_align="last"):
+    """Number of scoreable windows and the label offset. Window i covers
+    steps [i, i+history); its label anchor follows ``label_align``:
+    "last" (the original convention — the window's final step), or "center"
+    (step i + history//2 — for fast-switching systems the window's content
+    reflects its middle, not its edge)."""
     num = recording_len - history
-    return num, history - 1
+    off = history - 1 if label_align != "center" else history // 2
+    return num, off
 
 
-def true_dynamic_graph_history(Y, true_graphs, history):
+def _dominant_trace(Y, history, label_align):
+    """(T',) dominant-state index per scoreable window under the alignment
+    convention; "majority" votes over each window's steps (argmax per step,
+    then the window's most frequent state)."""
+    Y = np.asarray(Y)
+    num, off = _score_steps(Y.shape[1], history, label_align)
+    if label_align == "majority":
+        per_step = np.argmax(Y, axis=0)  # (T,)
+        win = np.lib.stride_tricks.sliding_window_view(per_step, history)
+        win = win[:num]
+        S = Y.shape[0]
+        counts = np.stack([(win == s).sum(axis=1) for s in range(S)])
+        return np.argmax(counts, axis=0)
+    return np.argmax(Y[:, off: off + num], axis=0)
+
+
+def true_dynamic_graph_history(Y, true_graphs, history, label_align="last"):
     """(T', C, C) truth: at each scoreable step, the dominant state's
     normalized graph. Y is the oracle (S, T) activation trace.
 
@@ -78,10 +98,9 @@ def true_dynamic_graph_history(Y, true_graphs, history):
     corresponding truth graph (the pooled unsupervised-states row the curation
     appends when num_supervised < num_factors) are marked invalid — their true
     graph is a mixture of unidentified factors, so they cannot be scored."""
-    Y = np.asarray(Y)
-    num, off = _score_steps(Y.shape[1], history)
+    num, _ = _score_steps(np.asarray(Y).shape[1], history, label_align)
     normed = np.stack([lag_normed_graph(g) for g in true_graphs])
-    dom = np.argmax(Y[:, off: off + num], axis=0)  # (T',)
+    dom = _dominant_trace(Y, history, label_align)  # (T',)
     valid = dom < len(true_graphs)
     return normed[np.minimum(dom, len(true_graphs) - 1)], dom, valid
 
@@ -94,7 +113,8 @@ def _sliding_windows(recording, history):
     return np.transpose(view[:num], (0, 2, 1))
 
 
-def score_state_tracking(weight_trace, Y, history, valid=None):
+def score_state_tracking(weight_trace, Y, history, valid=None,
+                         label_align="last"):
     """Embedder state-score tracking vs the oracle trace.
 
     weight_trace: (K, T') factor weightings per scoreable step;
@@ -102,15 +122,23 @@ def score_state_tracking(weight_trace, Y, history, valid=None):
     dominated by the pooled unsupervised row have no supervised truth and are
     excluded from BOTH metrics, same rule as the graph-tracking path).
     Returns {state_score_r, dominant_state_acc} (None when unscoreable).
+    ("majority" applies window-majority voting to the dominance
+    classification; the continuous trace correlates against the CENTER-step
+    activations in that mode, since a vote has no continuous analog.)
     """
     Y = np.asarray(Y, dtype=np.float64)
     w = np.asarray(weight_trace, dtype=np.float64)
-    num, off = _score_steps(Y.shape[1], history)
+    num, off = _score_steps(
+        Y.shape[1], history,
+        "center" if label_align == "majority" else label_align)
+    dom_truth = _dominant_trace(Y[: w.shape[0]], history, label_align)
     truth = Y[: w.shape[0], off: off + num]
     w = w[:, :num]
+    dom_truth = dom_truth[:num]
     if valid is not None:
         truth = truth[:, valid[:num]]
         w = w[:, valid[:num]]
+        dom_truth = dom_truth[valid[:num]]
     if truth.shape[1] == 0:
         return {"state_score_r": None, "dominant_state_acc": None}
     rs = []
@@ -122,7 +150,7 @@ def score_state_tracking(weight_trace, Y, history, valid=None):
             # handling on the graph side), rather than scoring it 0 or 1
             continue
         rs.append(float(np.corrcoef(a, b)[0, 1]) if np.std(a) > 0 else 0.0)
-    acc = float(np.mean(np.argmax(w, axis=0) == np.argmax(truth, axis=0)))
+    acc = float(np.mean(np.argmax(w, axis=0) == dom_truth))
     return {"state_score_r": float(np.mean(rs)) if rs else None,
             "dominant_state_acc": acc}
 
@@ -175,19 +203,38 @@ def _redcliff_conditional_history(model, params, windows):
     return G / np.where(m > 0, m, 1.0)
 
 
+def default_history(run_dir, alg_name, true_graphs):
+    """The per-algorithm window convention: REDCLIFF's embedder window
+    (embed_lag — its conditional readout needs full windows), a static
+    algorithm's lag depth (its estimate is window-independent)."""
+    if alg_name.startswith("REDCLIFF"):
+        model = load_model_for_eval(run_dir)[0]
+        return int(model.config.embed_lag)
+    return max(int(np.asarray(true_graphs[0]).shape[-1]), 2)
+
+
 def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
                                       num_supervised_factors,
-                                      max_recordings=16):
+                                      max_recordings=16, history=None,
+                                      label_align="last"):
     """Score one trained run's dynamic readouts over validation recordings.
 
     samples: sequence of (x (T, C), y (S, T)) oracle-labeled recordings.
     Returns per-recording metric lists, aggregated by the caller.
+
+    history: scoring window length; None = the per-algorithm default.
+    For REDCLIFF it cannot be smaller than embed_lag (the embedder consumes
+    full windows). label_align picks the window's label anchor ("last",
+    "center", "majority") — fast-switching systems blur under "last".
     """
     loaded = load_model_for_eval(run_dir)
     model, params = loaded[0], loaded[1]
     is_redcliff = alg_name.startswith("REDCLIFF")
-    history = int(model.config.embed_lag) if is_redcliff else \
-        max(int(np.asarray(true_graphs[0]).shape[-1]), 2)
+    if history is None:
+        history = default_history(run_dir, alg_name, true_graphs)
+    if is_redcliff:
+        assert history >= int(model.config.embed_lag), (
+            "REDCLIFF readout windows cannot be narrower than embed_lag")
 
     static_est = None
     if not is_redcliff:
@@ -206,14 +253,26 @@ def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
     for x, y in samples[:max_recordings]:
         x = np.asarray(x)
         y = np.asarray(y)
-        true_hist, _, valid = true_dynamic_graph_history(y, true_graphs,
-                                                         history)
+        true_hist, _, valid = true_dynamic_graph_history(
+            y, true_graphs, history, label_align=label_align)
         num_steps = true_hist.shape[0]
         if is_redcliff:
             windows = _sliding_windows(x, history)
+            # a common scoring grid wider than the embedder window trims each
+            # window to embed_lag steps, preserving the label anchor's
+            # RELATIVE position (last-anchor -> trailing slice, center-anchor
+            # -> centered slice) so the model observes the span the truth is
+            # anchored in
+            el = int(model.config.embed_lag)
+            if windows.shape[1] > el:
+                _, off = _score_steps(x.shape[0], history, label_align)
+                rel = (off % history) / max(history - 1, 1)
+                start = int(round(rel * (history - el)))
+                windows = windows[:, start: start + el, :]
             weightings, _ = model._embed(params, windows)
             w = np.asarray(weightings)[:, :num_supervised_factors].T
-            st = score_state_tracking(w, y, history, valid=valid)
+            st = score_state_tracking(w, y, history, valid=valid,
+                                      label_align=label_align)
             if st["state_score_r"] is not None:
                 metrics["state_score_r"].append(st["state_score_r"])
             if st["dominant_state_acc"] is not None:
@@ -236,13 +295,20 @@ def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
 def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
                                    num_folds, num_supervised_factors,
                                    save_root, max_recordings=16,
-                                   cv_dset_name="data"):
+                                   cv_dset_name="data",
+                                   common_window_grid=False,
+                                   label_align="last"):
     """Dynamic-readout comparison across all trained algorithms and folds.
 
     roots: {alg_alias: trained-models root}; the run directory per fold is
     located by the same folder-name convention as the static cross-alg eval.
     Returns {alg: {metric: {mean, sem, n}}} and writes it to
     ``save_root/dynamic_readout_summary.json``.
+
+    common_window_grid=True scores every algorithm over the SAME window
+    count and label offsets (the max of the per-algorithm window defaults)
+    so the cross-algorithm table compares like windows; False keeps the
+    per-algorithm conventions, recorded in the emitted summary either way.
     """
     import json
 
@@ -257,6 +323,11 @@ def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
         ds = load_normalized_samples(os.path.join(
             os.path.dirname(data_args_by_fold[fold]), "validation"))
         samples_by_fold[fold] = list(zip(ds.X, ds.Y))
+    hist_by_alg = {
+        alg: default_history(find_run_directory(alg_root, cv_dset_name, 0),
+                             alg, true_by_fold[0])
+        for alg, alg_root in roots.items()}
+    common = max(hist_by_alg.values()) if common_window_grid else None
     out = {}
     for alg, alg_root in roots.items():
         per_alg = {}
@@ -265,7 +336,8 @@ def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
             run_dir = find_run_directory(alg_root, cv_dset_name, fold)
             m = evaluate_dynamic_readouts_on_fold(
                 run_dir, alg, true_by_fold[fold], samples,
-                num_supervised_factors, max_recordings=max_recordings)
+                num_supervised_factors, max_recordings=max_recordings,
+                history=common, label_align=label_align)
             for key, vals in m.items():
                 per_alg.setdefault(key, []).extend(vals)
         out[alg] = {}
@@ -276,6 +348,14 @@ def run_dynamic_readout_evaluation(roots, data_args_by_fold, true_by_fold,
             s = summarize_values(vals)
             out[alg][key] = {"mean": s["mean"], "sem": s["mean_std_err"],
                              "n": len(vals)}
+        out[alg]["scoring_window"] = (common if common is not None
+                                      else hist_by_alg[alg])
+    out["_conventions"] = {
+        "common_window_grid": bool(common_window_grid),
+        "label_align": label_align,
+        "window_by_algorithm_default": hist_by_alg,
+        "common_window": common,
+    }
     with open(os.path.join(save_root, "dynamic_readout_summary.json"),
               "w") as f:
         json.dump(out, f, indent=2)

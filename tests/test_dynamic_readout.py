@@ -110,3 +110,46 @@ def test_degenerate_windows_are_skipped_not_crashed():
     assert out["dynamic_optimal_f1"] is None
     assert out["edge_tracking_r"] is None
     assert out["num_tracked_edges"] == 0
+
+
+def test_label_align_conventions():
+    """Window label anchors: "last" = trailing step, "center" = middle step,
+    "majority" = per-window vote — on a trace with one hard state switch the
+    three conventions disagree exactly around the transition."""
+    from redcliff_tpu.eval.dynamic_readout import _dominant_trace
+
+    T, history = 20, 8
+    Y = np.zeros((2, T))
+    Y[0, :10] = 1.0  # state 0 dominates steps 0..9
+    Y[1, 10:] = 1.0  # state 1 dominates steps 10..19
+    num = T - history  # 12 scoreable windows
+
+    last = _dominant_trace(Y, history, "last")      # anchor i+7
+    center = _dominant_trace(Y, history, "center")  # anchor i+4
+    maj = _dominant_trace(Y, history, "majority")
+    assert last.shape == center.shape == maj.shape == (num,)
+    # window i's last-step anchor flips at i+7 >= 10 -> i >= 3
+    np.testing.assert_array_equal(last, (np.arange(num) + 7 >= 10))
+    # center anchor flips at i+4 >= 10 -> i >= 6
+    np.testing.assert_array_equal(center, (np.arange(num) + 4 >= 10))
+    # majority flips when MORE than half the window's steps are state 1
+    # (argmax ties go to the lower index): window [i, i+8) has i-2 state-1
+    # steps for i >= 2; i-2 > 4 -> flip at i >= 7
+    np.testing.assert_array_equal(maj, (np.arange(num) >= 7))
+
+
+def test_state_tracking_majority_dominance():
+    """majority alignment votes dominance over the window, not a single
+    anchor step."""
+    T, history = 20, 8
+    Y = np.zeros((2, T))
+    Y[0, :10] = 1.0
+    Y[1, 10:] = 1.0
+    num = T - history
+    # a perfect majority-voting predictor (ties at the lower index)
+    w = np.zeros((2, num))
+    flip = np.arange(num) >= 7
+    w[0, ~flip] = 1.0
+    w[1, flip] = 1.0
+    st = score_state_tracking(w, Y, history, label_align="majority")
+    assert st["dominant_state_acc"] == pytest.approx(1.0)
