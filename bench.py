@@ -399,7 +399,7 @@ def _model_config():
     )
 
 
-def _make_runner(jax, model, G, B):
+def _make_runner(jax, model, G, B, matmul_precision=None):
     from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
 
@@ -408,14 +408,28 @@ def _make_runner(jax, model, G, B):
          "factor_cos_sim_coeff": 0.05 * (i % 3)}
         for i in range(G)
     ])
-    return RedcliffGridRunner(model, RedcliffTrainConfig(batch_size=B), spec,
-                              mesh=None)
+    return RedcliffGridRunner(
+        model, RedcliffTrainConfig(batch_size=B,
+                                   matmul_precision=matmul_precision),
+        spec, mesh=None)
 
 
-def _bench_grid(jax, model, G, B, steps, scan_k):
-    """Per-batch and scanned throughput (+FLOPs) of the G-point grid step."""
+def _mfu_pct(scan_flops, scan_dispatch_s, peak):
+    """Cost-analysis FLOPs / measured scanned-dispatch time vs chip peak."""
+    if not (scan_flops and peak):
+        return None
+    return round(100.0 * scan_flops / scan_dispatch_s / peak, 2)
+
+
+def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
+                scan_only=False):
+    """Per-batch and scanned throughput (+FLOPs) of the G-point grid step.
+
+    scan_only skips the per-batch compile + timing (the scanned dispatch is
+    the production execution mode and the headline number) — used by the
+    bf16 variant so it costs one compile, not two."""
     cfg = model.config
-    runner = _make_runner(jax, model, G, B)
+    runner = _make_runner(jax, model, G, B, matmul_precision=matmul_precision)
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
     X = jax.device_put(rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32))
@@ -425,21 +439,25 @@ def _bench_grid(jax, model, G, B, steps, scan_k):
     params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
     coeffs = runner.coeffs
     active = jax.numpy.ones((G,), dtype=bool)
-    step = runner._steps["combined"]
 
-    # AOT-compile ONCE and time through the compiled object (calling the jit
-    # wrapper after .lower().compile() would compile a second time — the jit
-    # executable cache is not populated by AOT compilation)
-    compiled = step.lower(params, optA, optB, coeffs, active, X, Y).compile()
-    flops = _flops_of(compiled)
-    p, a, b, _ = compiled(params, optA, optB, coeffs, active, X, Y)  # warm dispatch
-    jax.block_until_ready(p)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        p, a, b, _ = compiled(p, a, b, coeffs, active, X, Y)
-    jax.block_until_ready(p)
-    dt = time.perf_counter() - t0
-    wps = G * B * steps / dt
+    wps = flops = dt = None
+    p, a, b = params, optA, optB
+    if not scan_only:
+        step = runner._steps["combined"]
+        # AOT-compile ONCE and time through the compiled object (calling the
+        # jit wrapper after .lower().compile() would compile a second time —
+        # the jit executable cache is not populated by AOT compilation)
+        compiled = step.lower(params, optA, optB, coeffs, active, X,
+                              Y).compile()
+        flops = _flops_of(compiled)
+        p, a, b, _ = compiled(params, optA, optB, coeffs, active, X, Y)
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, a, b, _ = compiled(p, a, b, coeffs, active, X, Y)
+        jax.block_until_ready(p)
+        dt = time.perf_counter() - t0
+        wps = G * B * steps / dt
 
     # scanned k-batch dispatch: same update semantics (grid scan test pins
     # bit-parity), one host dispatch per k batches
@@ -460,7 +478,8 @@ def _bench_grid(jax, model, G, B, steps, scan_k):
     scan_dispatch_s = sdt / sdispatches
 
     return {
-        "wps": wps, "flops": flops, "step_s": dt / steps,
+        "wps": wps, "flops": flops,
+        "step_s": dt / steps if dt is not None else None,
         "scan_wps": scan_wps, "scan_flops": sflops,
         "scan_dispatch_s": scan_dispatch_s,
         "runner": runner, "state": (p, a, b, coeffs, X, Y),
@@ -541,6 +560,7 @@ def _measure(platform):
     budget_s = 180.0 if on_cpu else 420.0
     g_scaling = {}
     headline = None
+    bf16 = None
     # each extra G costs two compiles (~40s each on TPU); keep the sweep small
     # enough that the whole bench stays under the measurement timeout
     extra_g = (1, 4) if on_cpu else (1, 4, 128, 256)
@@ -550,23 +570,33 @@ def _measure(platform):
             continue
         print(f"bench: measuring G={G}", file=sys.stderr, flush=True)
         r = _bench_grid(jax, model, G, B, steps, scan_k)
-        mfu = (100.0 * r["scan_flops"] / r["scan_dispatch_s"] / peak
-               if (r["scan_flops"] and peak and not on_cpu) else None)
         g_scaling[str(G)] = {
             "wps": round(r["wps"], 1),
             "wps_scan": round(r["scan_wps"], 1),
-            "mfu_pct": round(mfu, 2) if mfu is not None else None,
+            "mfu_pct": _mfu_pct(r["scan_flops"], r["scan_dispatch_s"], peak)
+            if not on_cpu else None,
         }
         if G == G_HEAD:
             headline = r
+            if not on_cpu:
+                # bf16 MXU headline, measured RIGHT AFTER the f32 G_HEAD run
+                # (before the sweep can exhaust the budget): params stay f32,
+                # matmul passes run bfloat16 — the standard TPU trade. Scan
+                # dispatch only (one compile)
+                print(f"bench: measuring bf16 G={G}", file=sys.stderr,
+                      flush=True)
+                rb = _bench_grid(jax, model, G, B, steps, scan_k,
+                                 matmul_precision="bfloat16", scan_only=True)
+                bf16 = {"wps_scan": round(rb["scan_wps"], 1),
+                        "mfu_pct": _mfu_pct(rb["scan_flops"],
+                                            rb["scan_dispatch_s"], peak)}
 
     seq_steps = max(steps // 3, 3)
     seq_wps = _bench_sequential(jax, model, headline["runner"],
                                 headline["state"], G_HEAD, B, seq_steps)
 
-    mfu_head = (100.0 * headline["scan_flops"] / headline["scan_dispatch_s"]
-                / peak
-                if (headline["scan_flops"] and peak and not on_cpu) else None)
+    mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
+                         peak) if not on_cpu else None)
     _emit({
         "metric": METRIC,
         "value": round(headline["scan_wps"], 1),
@@ -579,8 +609,9 @@ def _measure(platform):
         "scan_batches": scan_k,
         "per_step_wps": round(headline["wps"], 1),
         "flops_per_step": headline["flops"],
-        "mfu_pct": round(mfu_head, 2) if mfu_head is not None else None,
+        "mfu_pct": mfu_head,
         "g_scaling": g_scaling,
+        "bf16": bf16,
         "error": None,
     })
 
