@@ -153,3 +153,44 @@ def test_state_tracking_majority_dominance():
     w[1, flip] = 1.0
     st = score_state_tracking(w, Y, history, label_align="majority")
     assert st["dominant_state_acc"] == pytest.approx(1.0)
+
+
+def test_edge_tracking_bounded_by_weighting_sharpness():
+    """The High-band mechanism note (round 5): per-edge tracking r of a
+    conditional mixture readout is governed by the SHARPNESS of the factor
+    weightings, not by the quality of the per-factor graphs. With perfect
+    graphs and sharp (one-hot) weightings the mixture tracks the switching
+    truth nearly perfectly; even FAINT weightings track well as long as
+    their ordering is right (Pearson is scale-invariant) — but weightings
+    that are UNINFORMATIVE about the active state (what the trained embedder
+    produces on 4+-factor High-band systems, where dominant-state accuracy
+    sits near 1/K chance) collapse r toward 0. Static baselines remain at
+    the structural 0."""
+    rng = np.random.default_rng(3)
+    C, K, T = 6, 4, 80
+    # K disjoint-ish random graphs
+    graphs = (rng.uniform(size=(K, C, C)) < 0.15).astype(np.float64)
+    for g in graphs:
+        np.fill_diagonal(g, 0.0)
+    # hard-switching truth: state t//20 dominates
+    dom = (np.arange(T) // (T // K)).clip(max=K - 1)
+    true_hist = graphs[dom]
+
+    def mixture_history(sharpness):
+        # weightings: softmax of sharpness * one-hot(dom) + noise
+        logits = sharpness * np.eye(K)[dom] + rng.normal(scale=0.1,
+                                                         size=(T, K))
+        w = np.exp(logits)
+        w /= w.sum(axis=1, keepdims=True)
+        return np.einsum("tk,kij->tij", w, graphs)
+
+    sharp = score_dynamic_graph_tracking(mixture_history(8.0), true_hist)
+    faint = score_dynamic_graph_tracking(mixture_history(0.1), true_hist)
+    uninformative = score_dynamic_graph_tracking(mixture_history(0.0),
+                                                 true_hist)
+    assert sharp["edge_tracking_r"] > 0.8
+    # faint-but-correctly-ordered modulation still tracks (scale-invariance)
+    assert faint["edge_tracking_r"] > 0.3
+    # state-uninformative weightings are what kill tracking
+    assert abs(uninformative["edge_tracking_r"]) < 0.2
+    assert sharp["edge_tracking_r"] > uninformative["edge_tracking_r"] + 0.6
