@@ -157,6 +157,18 @@ def main():
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "FACTOR_COUNT_SELECTION.json" if not args.smoke
                         else "FACTOR_COUNT_SELECTION_smoke.json")
+    # merge with prior invocations' systems (separate --systems runs build
+    # one artifact; a rerun of the same system replaces its entry)
+    if os.path.isfile(dest):
+        try:
+            with open(dest) as f:
+                prev = json.load(f)
+            if prev.get("smoke") == out["smoke"]:
+                merged = dict(prev.get("systems", {}))
+                merged.update(out["systems"])
+                out["systems"] = merged
+        except (OSError, json.JSONDecodeError):
+            pass
     with open(dest, "w") as f:
         json.dump(out, f, indent=2)
     print(f"[done] wrote {dest}", flush=True)
