@@ -13,6 +13,7 @@ a compiled program; callers group points by shape and run one GridRun per group
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
@@ -357,54 +358,165 @@ class RedcliffGridRunner:
             params["factors"])
         return dict(params, factors=factors)
 
+    # ------------------------------------------------------------------
+    # checkpoint/resume: the grid analog of the per-point trainer's
+    # resume-from-checkpoint (ref redcliff_s_cmlp.py fit/save_checkpoint) —
+    # a long grid fit survives preemption and resumes BIT-IDENTICALLY
+    # (optimizer moments, best-trees, lane masks, and the batch-shuffle rng
+    # state are all captured)
+    CHECKPOINT_NAME = "grid_checkpoint.pkl"
+
+    @staticmethod
+    def _to_host(v):
+        """Gather a device value to a full host array; restored-checkpoint
+        entries are already host numpy and must NOT be re-gathered (the
+        multi-host allgather would tile a full array per process)."""
+        if isinstance(v, np.ndarray):
+            return v
+        return np.asarray(gather_to_host(v))
+
+    def _save_checkpoint(self, checkpoint_dir, state):
+        """Gather the full fit state to host and write atomically (process 0
+        writes; the gathers are collectives and run on every process)."""
+        import pickle
+
+        host = {
+            k: (jax.tree.map(self._to_host, v) if v is not None else None)
+            for k, v in state.items()
+            if k not in ("epoch", "aligned", "rng_state", "val_history")
+        }
+        host["epoch"] = state["epoch"]
+        host["aligned"] = state["aligned"]
+        host["rng_state"] = state["rng_state"]
+        host["val_history"] = [self._to_host(v)
+                               for v in state["val_history"]]
+        # compatibility fingerprint: a checkpoint must only resume the fit
+        # that wrote it
+        host["meta"] = {"points": list(self.spec.points),
+                        "seed": self.tc.seed,
+                        "training_mode": self.model.config.training_mode}
+        if jax.process_index() != 0:
+            return
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, self.CHECKPOINT_NAME)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(host, f)
+        os.replace(tmp, path)
+
+    def _load_checkpoint(self, checkpoint_dir):
+        import pickle
+
+        path = os.path.join(checkpoint_dir, self.CHECKPOINT_NAME)
+        have = os.path.isfile(path)
+        if jax.process_count() > 1:
+            # all processes must take the same branch or the in-loop
+            # collectives deadlock; process 0's view decides, and a process
+            # that cannot see the file it decided on fails loudly
+            from jax.experimental import multihost_utils
+
+            have0 = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(have)))
+            if have0 and not have:
+                raise FileNotFoundError(
+                    f"process {jax.process_index()} cannot read the grid "
+                    f"checkpoint process 0 found — checkpoint_dir must be "
+                    f"on storage shared by every process: {path}")
+            have = have0
+        if not have:
+            return None
+        with open(path, "rb") as f:
+            ckpt = pickle.load(f)
+        meta = ckpt.get("meta", {})
+        want = {"points": list(self.spec.points), "seed": self.tc.seed,
+                "training_mode": self.model.config.training_mode}
+        if meta != want:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir!r} was written by a "
+                f"different fit (saved {meta}, current {want}); point "
+                f"checkpoint_dir elsewhere or delete the stale checkpoint")
+        return ckpt
+
     def fit(self, key, train_ds, val_ds, max_iter=None,
-            log_dir=None, init_params=None, copy_init=True) -> GridResult:
+            log_dir=None, init_params=None, copy_init=True,
+            checkpoint_dir=None, checkpoint_every=None) -> GridResult:
+        """checkpoint_dir + checkpoint_every enable periodic fit-state
+        checkpoints; a fit pointed at a directory holding one resumes from
+        it (bit-identically) instead of starting over."""
         with profiler_trace(self.tc.profile_dir):
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
                              log_dir=log_dir, init_params=init_params,
-                             copy_init=copy_init)
+                             copy_init=copy_init,
+                             checkpoint_dir=checkpoint_dir,
+                             checkpoint_every=checkpoint_every)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
-             log_dir=None, init_params=None, copy_init=True) -> GridResult:
+             log_dir=None, init_params=None, copy_init=True,
+             checkpoint_dir=None, checkpoint_every=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
-        # init_params: pre-stacked (G, ...) state from init_grid/init_grid_from.
-        # Copy caller-supplied arrays by default — the train steps donate
-        # their buffers (donate_argnums), which would otherwise silently
-        # invalidate the caller's tuple on the first step (e.g. reusing one
-        # init for an A/B pair of fits). copy_init=False hands ownership over
-        # (callers that built the init solely for this fit skip the 2x
-        # transient allocation)
-        if init_params is not None:
-            if copy_init:
-                init_params = jax.tree.map(jnp.copy, init_params)
-            params, optA_state, optB_state = init_params
-        else:
-            params, optA_state, optB_state = self.init_grid(key)
-        coeffs = self._shard(self.coeffs)
-        params = self._shard(params)
-        optA_state = self._shard(optA_state)
-        optB_state = self._shard(optB_state)
-
         G = len(self.spec.points)
-        best_crit = jnp.full((G,), jnp.inf)
-        best_epoch = jnp.zeros((G,), dtype=jnp.int32)
-        # materialize a copy: the train steps donate (consume) the live params
-        # buffers, so best_params must never alias them
-        best_params = jax.tree.map(jnp.copy, params)
-        # Freeze-mode accepted tree (the per-point trainer's "accepted")
-        accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
-        # per-point early-stop lane mask: converged points stop updating
-        active = self._shard(jnp.ones((G,), dtype=bool))
         stop_after = tc.lookback * tc.check_every
-        val_history = []
-        aligned = False
+        coeffs = self._shard(self.coeffs)
+        ckpt = (self._load_checkpoint(checkpoint_dir)
+                if checkpoint_dir is not None else None)
+        if ckpt is not None:
+            # resume: the full fit state comes from the checkpoint; the
+            # (expensive) fresh grid init is skipped entirely
+            params = self._shard(jax.tree.map(jnp.asarray, ckpt["params"]))
+            optA_state = self._shard(jax.tree.map(jnp.asarray,
+                                                  ckpt["optA_state"]))
+            optB_state = self._shard(jax.tree.map(jnp.asarray,
+                                                  ckpt["optB_state"]))
+            best_params = self._shard(jax.tree.map(jnp.asarray,
+                                                   ckpt["best_params"]))
+            best_crit = jnp.asarray(ckpt["best_crit"])
+            best_epoch = jnp.asarray(ckpt["best_epoch"])
+            active = self._shard(jnp.asarray(ckpt["active"]))
+            accepted = (self._shard(jax.tree.map(jnp.asarray,
+                                                 ckpt["accepted"]))
+                        if ckpt["accepted"] is not None else None)
+            val_history = list(ckpt["val_history"])
+            aligned = ckpt["aligned"]
+            rng.bit_generator.state = ckpt["rng_state"]
+            start_it = ckpt["epoch"] + 1
+        else:
+            # init_params: pre-stacked (G, ...) state from
+            # init_grid/init_grid_from. Copy caller-supplied arrays by
+            # default — the train steps donate their buffers
+            # (donate_argnums), which would otherwise silently invalidate
+            # the caller's tuple on the first step (e.g. reusing one init
+            # for an A/B pair of fits). copy_init=False hands ownership
+            # over (callers that built the init solely for this fit skip
+            # the 2x transient allocation)
+            if init_params is not None:
+                if copy_init:
+                    init_params = jax.tree.map(jnp.copy, init_params)
+                params, optA_state, optB_state = init_params
+            else:
+                params, optA_state, optB_state = self.init_grid(key)
+            params = self._shard(params)
+            optA_state = self._shard(optA_state)
+            optB_state = self._shard(optB_state)
+            best_crit = jnp.full((G,), jnp.inf)
+            best_epoch = jnp.zeros((G,), dtype=jnp.int32)
+            # materialize a copy: the train steps donate (consume) the live
+            # params buffers, so best_params must never alias them
+            best_params = jax.tree.map(jnp.copy, params)
+            # Freeze-mode accepted tree (the per-point trainer's "accepted")
+            accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
+            # per-point early-stop lane mask: converged points stop updating
+            active = self._shard(jnp.ones((G,), dtype=bool))
+            val_history = []
+            aligned = False
+            start_it = 0
         logger = MetricLogger(log_dir)
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
                    training_mode=self.model.config.training_mode,
+                   resumed_from_epoch=start_it - 1 if ckpt else None,
                    points=list(self.spec.points))
-        for it in range(max_iter):
+        for it in range(start_it, max_iter):
             cfg0 = self.model.config
             if (not aligned and "pretrain_factor" in cfg0.training_mode
                     and it == cfg0.num_pretrain_epochs
@@ -558,6 +670,17 @@ class RedcliffGridRunner:
                     logger.log("early_exit_all_inactive", epoch=it)
                     break
 
+            if (checkpoint_dir is not None and checkpoint_every
+                    and (it + 1) % checkpoint_every == 0):
+                self._save_checkpoint(checkpoint_dir, {
+                    "params": params, "optA_state": optA_state,
+                    "optB_state": optB_state, "best_params": best_params,
+                    "best_crit": best_crit, "best_epoch": best_epoch,
+                    "active": active, "accepted": accepted,
+                    "val_history": val_history, "aligned": aligned,
+                    "rng_state": rng.bit_generator.state, "epoch": it,
+                })
+
         # one gather each; shared by the fit_end record and the result
         final_crit = gather_to_host(best_crit)
         final_epoch = gather_to_host(best_epoch)
@@ -570,7 +693,7 @@ class RedcliffGridRunner:
             best_params=gather_to_host(best_params),
             best_criteria=final_crit,
             best_epoch=final_epoch,
-            val_history=np.stack([gather_to_host(v) for v in val_history]),
+            val_history=np.stack([self._to_host(v) for v in val_history]),
             coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
             active=final_active,
         )
