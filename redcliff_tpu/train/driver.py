@@ -147,7 +147,8 @@ def set_up_and_run_experiments(args_dict, files_of_cached_model_args,
 
 def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
                          key=None, mesh=None, max_iter=None,
-                         init_point_params=None):
+                         init_point_params=None, checkpoint_dir=None,
+                         checkpoint_every=None):
     """Train G coefficient/optimizer variations of one REDCLIFF model
     concurrently on the device mesh (see parallel.grid.RedcliffGridRunner).
 
@@ -159,6 +160,10 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     grid axis — the SLURM-array pattern's initialization (every per-point
     process seeds identically, ref :122-127); default = independent per-point
     seeds from ``key``.
+
+    checkpoint_dir + checkpoint_every: periodic full-state checkpoints with
+    bit-identical resume (RedcliffGridRunner.fit) — the preemption story for
+    long grid runs.
     """
     import jax
 
@@ -172,4 +177,6 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     # the stacked init is built here solely for this fit: hand ownership over
     # instead of paying a defensive copy of the whole grid state
     return runner.fit(key, train_ds, val_ds, max_iter=max_iter,
-                      init_params=init, copy_init=False)
+                      init_params=init, copy_init=False,
+                      checkpoint_dir=checkpoint_dir,
+                      checkpoint_every=checkpoint_every)
