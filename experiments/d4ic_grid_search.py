@@ -24,10 +24,14 @@ with the axes the reference's own configs span:
    the round-4 ACCURACY_D4IC tables — so the winner's row is directly
    comparable.
 
-Writes experiments/D4IC_GRID_SEARCH.json.
+Writes experiments/D4IC_GRID_SEARCH.json (--arch bscgs1, default) or
+experiments/D4IC_GRID_SEARCH_SMOOTH.json (--arch smooth — the same
+coefficient axes on the Smooth gs4 architecture; shapes cannot share one
+vmapped program, so each architecture runs as its own grid, the
+group_configs_by_shape contract).
 
 Run:  python experiments/d4ic_grid_search.py <workdir> [--smoke]
-      [--max-iter N] [--folds N]
+      [--max-iter N] [--folds N] [--arch bscgs1|smooth]
 """
 import argparse
 import json
@@ -46,7 +50,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from accuracy_parity_d4ic import (  # noqa: E402
-    NUM_NETWORKS, NUM_NODES, REDCLIFF_ARGS, curate_network)
+    NUM_NETWORKS, NUM_NODES, REDCLIFF_ARGS, SMOOTH_ARGS, curate_network)
 from redcliff_tpu.data.curation import (  # noqa: E402
     save_cached_args_file_for_data)
 from redcliff_tpu.data.dream4 import make_d4ic_fold  # noqa: E402
@@ -116,12 +120,24 @@ def main():
                          "reference max_iter=1000; the all-inactive early "
                          "exit usually stops far earlier)")
     ap.add_argument("--folds", type=int, default=3)
+    ap.add_argument("--arch", default="bscgs1", choices=["bscgs1", "smooth"],
+                    help="architecture to search: the non-Smooth BSCgs1 "
+                         "shape or the Smooth gs4 shape (the reference's "
+                         "gs1 -> gs4 progression searched across BOTH; "
+                         "different shapes cannot share one vmapped program, "
+                         "so each runs as its own grid — the "
+                         "group_configs_by_shape contract)")
     args = ap.parse_args()
+    # curation is fully seeded and architecture-independent: share the
+    # workdir across archs and isolate only the run roots / args files
     base = os.path.abspath(args.workdir) + ("_smoke" if args.smoke else "")
     os.makedirs(base, exist_ok=True)
+    arch_tag = "_smooth" if args.arch == "smooth" else ""
     n_train, n_val = (24, 8) if args.smoke else (120, 30)
 
-    margs = dict(REDCLIFF_ARGS)
+    model_type = ("REDCLIFF_S_CMLP_Smooth" if args.arch == "smooth"
+                  else "REDCLIFF_S_CMLP")
+    margs = dict(SMOOTH_ARGS if args.arch == "smooth" else REDCLIFF_ARGS)
     if args.smoke:
         margs.update(max_iter="12", num_pretrain_epochs="4",
                      num_acclimation_epochs="4", check_every="2")
@@ -142,18 +158,19 @@ def main():
     # args/coefficients through the driver's own read/rescale path, so the
     # grid's base config matches what a per-job run would build (the grid
     # points then override the searched axes per point)
-    margs_file = os.path.join(base, "REDCLIFF_S_CMLP_gs_cached_args.txt")
+    margs_file = os.path.join(base, f"{model_type}_gs_cached_args.txt")
     with open(margs_file, "w") as f:
         json.dump(margs, f)
-    args_dict = {"save_root_path": os.path.join(base, "runs_grid"),
-                 "model_type": "REDCLIFF_S_CMLP",
+    args_dict = {"save_root_path": os.path.join(base, f"runs_grid{arch_tag}"),
+                 "model_type": model_type,
                  "model_cached_args_file": margs_file,
                  "data_set_name": "data_fold0",
                  "data_cached_args_file": dargs_file}
     read_in_model_args(args_dict)
     read_in_data_args(args_dict)
     rescale_dataset_dependent_coefficients(args_dict)
-    model = create_model_instance(args_dict)
+    model = create_model_instance(
+        args_dict, employ_version_with_smoothing_loss="Smooth" in model_type)
     # grid_search=False: the winner re-runs train through the driver on the
     # full fold, so selection must see the same data (the default True keeps
     # only a quarter — the reference's cheap-search subsampling)
@@ -214,7 +231,8 @@ def main():
     # --------------------------------------- score EVERY point on fold 0
     per_point = []
     for i, (raw, gp) in enumerate(zip(points_raw, grid_points)):
-        run_dir = os.path.join(base, "runs_grid", f"grid_point{i}")
+        run_dir = os.path.join(base, f"runs_grid{arch_tag}",
+                               f"grid_point{i}")
         os.makedirs(run_dir, exist_ok=True)
         pt_params = jax.tree.map(lambda x: np.asarray(x)[i], res.best_params)
         with open(os.path.join(run_dir, "final_best_model.bin"), "wb") as f:
@@ -246,7 +264,7 @@ def main():
               gen_lr=repr(winner_raw["gen_lr"]),
               ADJ_L1_REG_COEFF=repr(winner_raw["ADJ_L1_REG_COEFF"]),
               FACTOR_COS_SIM_COEFF=repr(winner_raw["FACTOR_COS_SIM_COEFF"]))
-    wm_file = os.path.join(base, "REDCLIFF_S_CMLP_winner_cached_args.txt")
+    wm_file = os.path.join(base, f"{model_type}_winner_cached_args.txt")
     with open(wm_file, "w") as f:
         json.dump(wm, f)
 
@@ -262,12 +280,13 @@ def main():
             # winner must land in its own tree rather than resume this one's
             wtag = "_".join(f"{k[:3]}{v}" for k, v in sorted(
                 winner_raw.items())).replace(".", "-")
-            save_root = os.path.join(base, f"runs_winner_{snr}_{wtag}")
+            save_root = os.path.join(
+                base, f"runs_winner{arch_tag}_{snr}_{wtag}")
             os.makedirs(save_root, exist_ok=True)
             t0 = time.time()
             set_up_and_run_experiments(
                 {"save_root_path": save_root}, [wm_file], [dargs],
-                possible_model_types=["REDCLIFF_S_CMLP"],
+                possible_model_types=[model_type],
                 possible_data_sets=[f"data_fold{fold}"], task_id=1)
             print(f"[winner] {snr} fold {fold}: {time.time()-t0:.1f}s",
                   flush=True)
@@ -287,6 +306,7 @@ def main():
     out = {
         "dataset": "synthetic-source D4IC analog (accuracy_parity_d4ic "
                    "curation), selection on HSNR fold 0",
+        "architecture": args.arch,
         "smoke": bool(args.smoke),
         "axes_raw": {"gen_lr": list(gen_axis),
                      "ADJ_L1_REG_COEFF": list(adj_axis),
@@ -299,15 +319,20 @@ def main():
         "selected_optf1_fold0": per_point[sel]["optf1_fold0"],
         "oracle_point": points_raw[oracle],
         "oracle_optf1_fold0": per_point[oracle]["optf1_fold0"],
-        "transcribed_bscgs1_round4": {
-            "HSNR": 0.178, "MSNR": 0.177, "LSNR": 0.178,
-            "note": "round-4 ACCURACY_D4IC tables, the un-searched "
-                    "transcription (gen_lr 5e-4, ADJ_L1 1.0, COS_SIM 1.0)"},
+        "transcribed_round4_baseline": (
+            {"HSNR": 0.315, "MSNR": 0.319, "LSNR": 0.211,
+             "note": "round-4 ACCURACY_D4IC tables, the un-searched Smooth "
+                     "gs4 transcription"}
+            if args.arch == "smooth" else
+            {"HSNR": 0.178, "MSNR": 0.177, "LSNR": 0.178,
+             "note": "round-4 ACCURACY_D4IC tables, the un-searched BSCgs1 "
+                     "transcription (gen_lr 5e-4, ADJ_L1 1.0, COS_SIM 1.0)"}),
         "winner_rows": winner_rows,
     }
+    tag = "_SMOOTH" if args.arch == "smooth" else ""
     dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "D4IC_GRID_SEARCH.json" if not args.smoke
-                        else "D4IC_GRID_SEARCH_smoke.json")
+                        f"D4IC_GRID_SEARCH{tag}.json" if not args.smoke
+                        else f"D4IC_GRID_SEARCH{tag}_smoke.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=2)
     print(f"[done] wrote {dest}", flush=True)
