@@ -22,6 +22,10 @@ Extra context fields (so "fast" is judgeable against hardware capability):
   g_scaling       — {G: {wps, wps_scan, mfu_pct}} over grid sizes
   probe_log       — every accelerator probe attempt (the axon TPU tunnel hangs
                     intermittently for minutes; attempts spread with backoff)
+  probe_retry     — fixed-schema outcome of the shared probe retry policy
+                    (redcliff_tpu/runtime/retry.py: policy knobs, per-attempt
+                    backoff actually waited, deadline_hit), so artifacts
+                    distinguish "tunnel dead" from "policy too impatient"
   device / error  — backend actually used; error non-null if the TPU was
                     unavailable and the bench fell back to CPU
   cached / measured_at / live_fallback — when live TPU probes fail but a cached
@@ -39,15 +43,23 @@ hangs mid-run is killed and retried instead of wedging the bench. The reference
 repository publishes no benchmark numbers (BASELINE.md), so the
 sequential-vs-grid ratio on identical hardware is the honest comparable.
 """
+import dataclasses
 import datetime
 import json
 import os
+import random
 import subprocess
 import sys
 import time
 import traceback
 
 import numpy as np
+
+# stdlib-only module (never initializes a jax backend — safe in this parent
+# process, which must stay killable): the shared probe retry/backoff policy
+# all accelerator-probing entry points (bench.py, tpu_watch.py, the DCN dry
+# run) now route through, replacing the hand-rolled PROBE_WAITS spread
+from redcliff_tpu.runtime.retry import PROBE_RETRY_POLICY, GiveUp, retry
 
 # newest successful TPU measurement, written here by this script on a live TPU
 # run and by tpu_watch.py's opportunistic background measurements; embedded in
@@ -83,9 +95,6 @@ PEAK_FLOPS = {
 
 METRIC = "redcliff_s_grid_train_windows_per_sec_per_chip"
 
-# probe schedule: wait this long before each successive attempt (seconds);
-# spread so a minutes-long tunnel outage is sampled at distinct times
-PROBE_WAITS = (0.0, 15.0, 45.0, 105.0, 225.0)
 PROBE_TIMEOUT_S = 75.0
 MEASURE_TIMEOUT_S = 1500.0
 
@@ -159,13 +168,19 @@ def _write_tpu_cache(payload, source="bench.py live run", extras=None):
     Shared by bench.py (live runs) and tpu_watch.py (opportunistic windows) so
     there is exactly one writer implementation for the schema
     _load_tpu_cache validates. Unique tmp per pid keeps concurrent writers'
-    os.replace promotions atomic."""
+    os.replace promotions atomic.
+
+    bench.py records the fixed-schema probe/retry outcome
+    (runtime.retry.RetryOutcome.log(): policy knobs, per-attempt backoff and
+    result, deadline_hit) via ``extras={"probe_retry": ...}`` so future BENCH
+    artifacts can distinguish "tunnel dead" from "policy too impatient"."""
     try:
         cache = {
             "measured_at": _utcnow_iso(),
             "source": source,
             "git_commit": _git_head(),
-            "result": {k: v for k, v in payload.items() if k != "probe_log"},
+            "result": {k: v for k, v in payload.items()
+                       if k not in ("probe_log", "probe_retry")},
         }
         if extras:
             cache.update(extras)
@@ -275,26 +290,24 @@ MAX_MEASURE_ATTEMPTS = 2
 def _orchestrate():
     t0 = time.monotonic()
     probe_log = []
-    measure_attempts = 0
-    for i, wait in enumerate(PROBE_WAITS):
-        if wait:
-            time.sleep(wait)
+    state = {"measure_attempts": 0}
+
+    def probe_round(attempt):
+        """One probe attempt; on a live tunnel, one measurement attempt.
+        Returns the measured payload (success) or None (back off + retry)."""
         ok, info = _probe_accelerator()
-        probe_log.append({"attempt": i, "t_offset_s": round(time.monotonic() - t0, 1),
+        probe_log.append({"attempt": attempt,
+                          "t_offset_s": round(time.monotonic() - t0, 1),
                           "ok": ok, "info": info})
-        print(f"bench: probe {i} at +{probe_log[-1]['t_offset_s']}s -> {info}",
-              file=sys.stderr, flush=True)
+        print(f"bench: probe {attempt} at +{probe_log[-1]['t_offset_s']}s "
+              f"-> {info}", file=sys.stderr, flush=True)
         if not ok:
-            continue
-        if measure_attempts >= MAX_MEASURE_ATTEMPTS:
+            return None
+        if state["measure_attempts"] >= MAX_MEASURE_ATTEMPTS:
             # a tunnel that probes OK but hangs mid-measure must not keep
             # burning 25-minute measurement timeouts; bound the total
-            probe_log.append({"attempt": i,
-                              "t_offset_s": round(time.monotonic() - t0, 1),
-                              "ok": False,
-                              "info": "measurement attempt budget exhausted"})
-            break
-        measure_attempts += 1
+            raise GiveUp("measurement attempt budget exhausted")
+        state["measure_attempts"] += 1
         # if tpu_watch.py is mid-measurement on the chip, wait for it (its
         # result lands in the cache); proceed regardless after the wait so a
         # wedged-but-not-yet-stale lock can't deadlock the round's bench run
@@ -305,22 +318,48 @@ def _orchestrate():
             if got_lock:
                 _release_measure_lock()
         if payload is not None and payload.get("value"):
-            payload["probe_log"] = probe_log
-            _write_tpu_cache(payload)
-            _emit(payload)
-            return
+            return payload
         # tunnel dropped mid-measurement: log and keep probing
-        probe_log.append({"attempt": i, "t_offset_s": round(time.monotonic() - t0, 1),
+        probe_log.append({"attempt": attempt,
+                          "t_offset_s": round(time.monotonic() - t0, 1),
                           "ok": False, "info": f"measure: {minfo}"})
         print(f"bench: TPU measurement failed ({minfo}); continuing probes",
               file=sys.stderr, flush=True)
+        return None
 
-    if measure_attempts > 0:
-        err = (f"accelerator probed OK but {measure_attempts} measurement "
-               f"attempt(s) failed/hung (see probe_log); ran on cpu")
+    # PROBE_RETRY_POLICY's 15-min deadline budgets pure probing; here each
+    # attempt may embed a full measurement (MEASURE_TIMEOUT_S) plus a wait on
+    # tpu_watch's measure lock, so widen the deadline to cover the
+    # MAX_MEASURE_ATTEMPTS budget — otherwise one hung measurement would
+    # consume the whole loop and the second attempt could never run. The
+    # jittered rng spreads fleet-synchronized bench runs apart.
+    policy = PROBE_RETRY_POLICY
+    if policy.deadline_s is not None:
+        policy = dataclasses.replace(
+            policy, deadline_s=(policy.deadline_s + MAX_MEASURE_ATTEMPTS
+                                * (MEASURE_TIMEOUT_S + 300.0)))
+    outcome = retry(probe_round, policy,
+                    is_success=lambda p: p is not None,
+                    info_of=lambda p: ("measured" if p is not None
+                                       else "no measurement this attempt"),
+                    rng=random.Random())
+    retry_log = outcome.log()
+    if outcome.ok:
+        payload = outcome.value
+        payload["probe_log"] = probe_log
+        payload["probe_retry"] = retry_log
+        _write_tpu_cache(payload, extras={"probe_retry": retry_log})
+        _emit(payload)
+        return
+
+    if state["measure_attempts"] > 0:
+        err = (f"accelerator probed OK but {state['measure_attempts']} "
+               f"measurement attempt(s) failed/hung (see probe_log); "
+               f"ran on cpu")
     else:
-        err = (f"accelerator unavailable across {len(PROBE_WAITS)} spread "
-               f"probe attempts over {round(time.monotonic() - t0)}s; "
+        err = (f"accelerator unavailable across {len(outcome.attempts)} "
+               f"backoff probe attempts over {round(time.monotonic() - t0)}s"
+               f"{' (probe deadline hit)' if outcome.deadline_hit else ''}; "
                f"ran on cpu")
     payload, minfo = _run_measure_child("cpu", timeout_s=900.0)
     if payload is not None:
@@ -361,10 +400,12 @@ def _orchestrate():
         out["live_fallback"] = {k: v for k, v in payload.items()
                                 if k != "probe_log"}
         out["probe_log"] = probe_log
+        out["probe_retry"] = retry_log
         _emit(out)
         return
 
     payload["probe_log"] = probe_log
+    payload["probe_retry"] = retry_log
     _emit(payload)
 
 

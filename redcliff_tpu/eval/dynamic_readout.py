@@ -265,7 +265,14 @@ def evaluate_dynamic_readouts_on_fold(run_dir, alg_name, true_graphs, samples,
             # anchored in
             el = int(model.config.embed_lag)
             if windows.shape[1] > el:
-                _, off = _score_steps(x.shape[0], history, label_align)
+                # the trim anchor must use the SAME offset mapping as
+                # score_state_tracking: "majority" has no continuous analog,
+                # so its continuous truth is anchored at the window CENTER —
+                # a trailing-slice trim there would score the model on a span
+                # the truth is not anchored in (ADVICE r5 item 1)
+                _, off = _score_steps(
+                    x.shape[0], history,
+                    "center" if label_align == "majority" else label_align)
                 rel = (off % history) / max(history - 1, 1)
                 start = int(round(rel * (history - el)))
                 windows = windows[:, start: start + el, :]

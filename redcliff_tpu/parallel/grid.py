@@ -25,6 +25,9 @@ import optax
 from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
+from redcliff_tpu.runtime import checkpoint as durable_ckpt
+from redcliff_tpu.runtime import faultinject
+from redcliff_tpu.runtime.preempt import Preempted, PreemptionGuard
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 from redcliff_tpu.utils.precision import matmul_precision_ctx
@@ -93,6 +96,10 @@ class GridResult:
     val_history: np.ndarray    # (epochs, G) validation combo loss
     coeffs: dict
     active: np.ndarray = None  # (G,) bool; False = point early-stopped
+    # quarantined grid points: lanes whose validation loss went non-finite
+    # were frozen (skip further updates, rest of the grid keeps training);
+    # one {"point", "epoch", "hparams"} record each
+    failures: list = field(default_factory=list)
 
 
 def group_configs_by_shape(config_dicts, shape_keys):
@@ -363,7 +370,9 @@ class RedcliffGridRunner:
     # resume-from-checkpoint (ref redcliff_s_cmlp.py fit/save_checkpoint) —
     # a long grid fit survives preemption and resumes BIT-IDENTICALLY
     # (optimizer moments, best-trees, lane masks, and the batch-shuffle rng
-    # state are all captured)
+    # state are all captured). Durability (atomic writes, CRC header, .prev
+    # generation, quarantine of corrupt files) lives in runtime/checkpoint.py;
+    # this class owns the resume-compatibility fingerprint.
     CHECKPOINT_NAME = "grid_checkpoint.pkl"
 
     @staticmethod
@@ -375,11 +384,39 @@ class RedcliffGridRunner:
             return v
         return np.asarray(gather_to_host(v))
 
-    def _save_checkpoint(self, checkpoint_dir, state):
-        """Gather the full fit state to host and write atomically (process 0
-        writes; the gathers are collectives and run on every process)."""
-        import pickle
+    def _checkpoint_meta(self, train_ds, val_ds):
+        """The COMPLETE resume-compatibility fingerprint: every knob whose
+        change would make "resume" silently mean "train something else" —
+        grid points, seed, training mode, the RedcliffTrainConfig fields that
+        shape the batch/epoch stream (a restored rng state replays a
+        DIFFERENT batch sequence under a new batch_size), and the train/val
+        dataset shapes. Deliberately absent: the mesh — checkpoints hold
+        gathered host state, so a fit may resume on a smaller/larger device
+        mesh (graceful degradation after losing part of a slice) — and the
+        per-call ``fit(max_iter=...)`` override: the epoch stream is
+        horizon-invariant (no phase schedule or early-stop term reads
+        max_iter), so training the first N epochs and resuming toward a
+        different horizon is bit-safe; only a changed tc.max_iter is treated
+        as a different configured fit."""
+        tc = self.tc
+        return {
+            "points": list(self.spec.points),
+            "seed": tc.seed,
+            "training_mode": self.model.config.training_mode,
+            "batch_size": tc.batch_size,
+            "check_every": tc.check_every,
+            "lookback": tc.lookback,
+            "scan_batches": tc.scan_batches,
+            "max_iter": tc.max_iter,
+            "train_data": durable_ckpt.dataset_fingerprint(train_ds),
+            "val_data": durable_ckpt.dataset_fingerprint(val_ds),
+        }
 
+    def _save_checkpoint(self, checkpoint_dir, state, meta):
+        """Gather the full fit state to host and write durably — atomic
+        tmp+replace with CRC/format-version header and a trailing .prev
+        generation (process 0 writes; the gathers are collectives and run on
+        every process)."""
         host = {
             k: (jax.tree.map(self._to_host, v) if v is not None else None)
             for k, v in state.items()
@@ -390,77 +427,117 @@ class RedcliffGridRunner:
         host["rng_state"] = state["rng_state"]
         host["val_history"] = [self._to_host(v)
                                for v in state["val_history"]]
-        # compatibility fingerprint: a checkpoint must only resume the fit
-        # that wrote it
-        host["meta"] = {"points": list(self.spec.points),
-                        "seed": self.tc.seed,
-                        "training_mode": self.model.config.training_mode}
+        host["meta"] = meta
         if jax.process_index() != 0:
             return
         os.makedirs(checkpoint_dir, exist_ok=True)
-        path = os.path.join(checkpoint_dir, self.CHECKPOINT_NAME)
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            pickle.dump(host, f)
-        os.replace(tmp, path)
+        durable_ckpt.write_checkpoint(
+            os.path.join(checkpoint_dir, self.CHECKPOINT_NAME), host)
 
-    def _load_checkpoint(self, checkpoint_dir):
-        import pickle
-
+    def _load_checkpoint(self, checkpoint_dir, want_meta):
+        """Load the newest usable checkpoint generation, or None for a fresh
+        start. Corrupt generations are quarantined to *.bad (head falls back
+        to .prev); a readable checkpoint from a DIFFERENT fit is rejected
+        loudly. Returns (ckpt, source_path)."""
         path = os.path.join(checkpoint_dir, self.CHECKPOINT_NAME)
-        have = os.path.isfile(path)
-        if jax.process_count() > 1:
+        if jax.process_count() == 1:
+            ckpt, src = durable_ckpt.load_checkpoint(path)
+        else:
             # all processes must take the same branch or the in-loop
-            # collectives deadlock; process 0's view decides, and a process
-            # that cannot see the file it decided on fails loudly
+            # collectives deadlock; process 0's view (including which
+            # generation survived quarantine) decides, and a process that
+            # cannot read the generation it decided on fails loudly
             from jax.experimental import multihost_utils
 
-            have0 = bool(multihost_utils.broadcast_one_to_all(
-                np.asarray(have)))
-            if have0 and not have:
-                raise FileNotFoundError(
-                    f"process {jax.process_index()} cannot read the grid "
-                    f"checkpoint process 0 found — checkpoint_dir must be "
-                    f"on storage shared by every process: {path}")
-            have = have0
-        if not have:
-            return None
-        with open(path, "rb") as f:
-            ckpt = pickle.load(f)
+            src_code = 0
+            ckpt = None
+            if jax.process_index() == 0:
+                ckpt, src = durable_ckpt.load_checkpoint(path)
+                src_code = 0 if src is None else (1 if src == path else 2)
+            src_code = int(multihost_utils.broadcast_one_to_all(
+                np.asarray(src_code)))
+            src = (None, path, path + ".prev")[src_code]
+            if src is not None and jax.process_index() != 0:
+                try:
+                    ckpt = durable_ckpt.read_checkpoint(src)
+                except (OSError, durable_ckpt.CheckpointCorruptError) as e:
+                    raise FileNotFoundError(
+                        f"process {jax.process_index()} cannot read the grid "
+                        f"checkpoint process 0 loaded ({src}: {e}) — "
+                        f"checkpoint_dir must be on storage shared by every "
+                        f"process")
+        if ckpt is None:
+            return None, None
         meta = ckpt.get("meta", {})
-        want = {"points": list(self.spec.points), "seed": self.tc.seed,
-                "training_mode": self.model.config.training_mode}
-        if meta != want:
+        if not any(k in meta for k in ("batch_size", "train_data")):
+            # pre-durability meta ({points, seed, training_mode} only): the
+            # state dict also predates the quarantine bookkeeping, so it
+            # cannot resume under this code — say so, not "different fit"
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir!r} predates the durable "
+                f"checkpoint format (no compatibility fingerprint or "
+                f"quarantine state); it cannot be resumed by this version — "
+                f"delete it (or finish the fit with the code that wrote it) "
+                f"and rerun.")
+        diff = ([k for k in want_meta if meta.get(k) != want_meta[k]]
+                + [k for k in meta if k not in want_meta])
+        if diff:
+            detail = ", ".join(
+                f"{k}: saved={meta.get(k)!r} current={want_meta.get(k)!r}"
+                for k in diff)
             raise ValueError(
                 f"checkpoint in {checkpoint_dir!r} was written by a "
-                f"different fit (saved {meta}, current {want}); point "
-                f"checkpoint_dir elsewhere or delete the stale checkpoint")
-        return ckpt
+                f"different fit — resuming it would silently train something "
+                f"else. Mismatched fields: {detail}. Point checkpoint_dir "
+                f"elsewhere, delete the stale checkpoint, or rerun with the "
+                f"original configuration.")
+        return ckpt, src
 
     def fit(self, key, train_ds, val_ds, max_iter=None,
             log_dir=None, init_params=None, copy_init=True,
             checkpoint_dir=None, checkpoint_every=None) -> GridResult:
         """checkpoint_dir + checkpoint_every enable periodic fit-state
         checkpoints; a fit pointed at a directory holding one resumes from
-        it (bit-identically) instead of starting over."""
-        with profiler_trace(self.tc.profile_dir):
+        it (bit-identically) instead of starting over.
+
+        Fault tolerance (docs/ARCHITECTURE.md "Fault tolerance & resume
+        semantics"): checkpoints are written atomically with a CRC header and
+        a trailing .prev generation; corrupt files are quarantined to *.bad
+        and the fit restarts cleanly; a checkpoint from an incompatible fit
+        (different points/seed/batch stream/dataset shapes) is REJECTED with
+        the mismatching fields. While checkpointing is enabled, SIGTERM/
+        SIGINT triggers one final checkpoint at the end of the in-flight
+        epoch and raises :class:`~redcliff_tpu.runtime.preempt.Preempted`.
+        Grid points whose validation loss goes non-finite are quarantined
+        (lane frozen, recorded in ``GridResult.failures``) while the rest of
+        the grid keeps training. Because checkpoints store gathered host
+        state, a fit may resume on a different (e.g. smaller) device mesh
+        than the one that wrote the checkpoint."""
+        # the guard wraps the whole fit so a signal during compile/data
+        # staging is latched too; _fit polls it at epoch boundaries
+        guard = PreemptionGuard(enabled=checkpoint_dir is not None)
+        with guard, profiler_trace(self.tc.profile_dir):
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
                              log_dir=log_dir, init_params=init_params,
                              copy_init=copy_init,
                              checkpoint_dir=checkpoint_dir,
-                             checkpoint_every=checkpoint_every)
+                             checkpoint_every=checkpoint_every,
+                             guard=guard)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
              log_dir=None, init_params=None, copy_init=True,
-             checkpoint_dir=None, checkpoint_every=None) -> GridResult:
+             checkpoint_dir=None, checkpoint_every=None,
+             guard=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
         G = len(self.spec.points)
         stop_after = tc.lookback * tc.check_every
         coeffs = self._shard(self.coeffs)
-        ckpt = (self._load_checkpoint(checkpoint_dir)
-                if checkpoint_dir is not None else None)
+        ckpt = ck_src = ck_meta = None
+        if checkpoint_dir is not None:
+            ck_meta = self._checkpoint_meta(train_ds, val_ds)
+            ckpt, ck_src = self._load_checkpoint(checkpoint_dir, ck_meta)
         if ckpt is not None:
             # resume: the full fit state comes from the checkpoint; the
             # (expensive) fresh grid init is skipped entirely
@@ -479,6 +556,7 @@ class RedcliffGridRunner:
                         if ckpt["accepted"] is not None else None)
             val_history = list(ckpt["val_history"])
             aligned = ckpt["aligned"]
+            failed_epoch = self._shard(jnp.asarray(ckpt["failed_epoch"]))
             rng.bit_generator.state = ckpt["rng_state"]
             start_it = ckpt["epoch"] + 1
         else:
@@ -508,6 +586,10 @@ class RedcliffGridRunner:
             accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
             # per-point early-stop lane mask: converged points stop updating
             active = self._shard(jnp.ones((G,), dtype=bool))
+            # non-finite quarantine bookkeeping: epoch a lane's val loss went
+            # non-finite (-1 = healthy); quarantined lanes freeze like
+            # early-stopped ones but are reported as failures, not results
+            failed_epoch = self._shard(jnp.full((G,), -1, jnp.int32))
             val_history = []
             aligned = False
             start_it = 0
@@ -515,6 +597,7 @@ class RedcliffGridRunner:
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
                    training_mode=self.model.config.training_mode,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
+                   resumed_from=ck_src,
                    points=list(self.spec.points))
         for it in range(start_it, max_iter):
             cfg0 = self.model.config
@@ -601,6 +684,16 @@ class RedcliffGridRunner:
                     "val_fraction or dataset size")
             # keep per-epoch losses device-resident; one host transfer at the end
             val_history.append(combo_sum / n)
+            # graceful degradation: a point whose val loss went non-finite
+            # (diverged step, poisoned hyperparameters) is quarantined — its
+            # lane freezes via the active mask while the REST of the grid
+            # keeps training. Pure device compute (no host sync); the failed
+            # epochs surface in GridResult.failures and failures.json
+            finite = jnp.isfinite(val_history[-1])
+            failed_epoch = jnp.where(
+                jnp.logical_and(active, jnp.logical_not(finite)),
+                jnp.int32(it), failed_epoch)
+            active = jnp.logical_and(active, finite)
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
                 # per-point stopping criteria, the trainer's branches
@@ -642,8 +735,14 @@ class RedcliffGridRunner:
                 active = jnp.logical_and(
                     active, (jnp.int32(it) - best_epoch) < stop_after)
             else:
-                best_params = jax.tree.map(jnp.copy, params)
-                best_epoch = jnp.full((G,), it, jnp.int32)
+                # pretrain/acclimation epochs track the live params as best —
+                # but only for healthy lanes: a quarantined point keeps its
+                # last finite snapshot instead of copying NaN params forward
+                best_params = jax.tree.map(
+                    lambda b, c: jnp.where(
+                        active.reshape((-1,) + (1,) * (c.ndim - 1)), c, b),
+                    best_params, params)
+                best_epoch = jnp.where(active, jnp.int32(it), best_epoch)
 
             # structured per-epoch record; syncing the grid losses to host
             # costs one transfer, so only do it on the check_every cadence.
@@ -655,10 +754,12 @@ class RedcliffGridRunner:
                 # one gather serves both the epoch log and the exit test
                 act_host = gather_to_host(active)
                 if logger.active or jax.process_count() > 1:
+                    failed_host = gather_to_host(failed_epoch)
                     logger.log("epoch", epoch=it, phases=list(phases),
                                val_combo_loss=gather_to_host(val_history[-1]),
                                best_criteria=gather_to_host(best_crit),
-                               num_active=int(act_host.sum()))
+                               num_active=int(act_host.sum()),
+                               num_quarantined=int((failed_host >= 0).sum()))
                 # global early exit: once EVERY lane has hit its per-point
                 # patience, further epochs are pure masked compute (the
                 # per-point trainer would have broken out of each run long
@@ -670,24 +771,63 @@ class RedcliffGridRunner:
                     logger.log("early_exit_all_inactive", epoch=it)
                     break
 
-            if (checkpoint_dir is not None and checkpoint_every
-                    and (it + 1) % checkpoint_every == 0):
-                self._save_checkpoint(checkpoint_dir, {
+            if checkpoint_dir is not None:
+                snap = {
                     "params": params, "optA_state": optA_state,
                     "optB_state": optB_state, "best_params": best_params,
                     "best_crit": best_crit, "best_epoch": best_epoch,
                     "active": active, "accepted": accepted,
+                    "failed_epoch": failed_epoch,
                     "val_history": val_history, "aligned": aligned,
                     "rng_state": rng.bit_generator.state, "epoch": it,
-                })
+                }
+                saved = False
+                if checkpoint_every and (it + 1) % checkpoint_every == 0:
+                    self._save_checkpoint(checkpoint_dir, snap, ck_meta)
+                    saved = True
+                    faultinject.crash_point("checkpoint_saved", epoch=it)
+                # preemption: the guard latched SIGTERM/SIGINT; write one
+                # final checkpoint at this epoch boundary and stop. Multi-host
+                # meshes must decide uniformly (the save runs collectives) —
+                # a notice landing on ANY host preempts the whole fit. The
+                # uniformity allgather is itself a cross-host sync, so it
+                # rides the existing checkpoint/check_every cadences instead
+                # of adding a per-epoch collective (at most check_every
+                # epochs of latency on a save that waits for an epoch
+                # boundary anyway); single-host polls the flag every epoch
+                # for free
+                preempted = bool(guard is not None and guard.preempted)
+                if jax.process_count() > 1:
+                    if saved or (it + 1) % tc.check_every == 0:
+                        from jax.experimental import multihost_utils
+
+                        preempted = bool(np.any(
+                            multihost_utils.process_allgather(
+                                np.asarray(preempted))))
+                    else:
+                        preempted = False
+                if preempted:
+                    if not saved:
+                        self._save_checkpoint(checkpoint_dir, snap, ck_meta)
+                    logger.log("preempted_final_checkpoint", epoch=it,
+                               signum=guard.signum if guard else None)
+                    logger.close()
+                    raise Preempted(guard.signum if guard else None,
+                                    epoch=it)
+            faultinject.crash_point("epoch_end", epoch=it)
 
         # one gather each; shared by the fit_end record and the result
         final_crit = gather_to_host(best_crit)
         final_epoch = gather_to_host(best_epoch)
         final_active = gather_to_host(active)
+        final_failed = np.asarray(gather_to_host(failed_epoch))
+        failures = [{"point": int(g), "epoch": int(e),
+                     "hparams": dict(self.spec.points[g])}
+                    for g, e in enumerate(final_failed) if e >= 0]
         logger.log("fit_end", best_epoch=final_epoch,
                    best_criteria=final_crit,
-                   num_active=int(final_active.sum()))
+                   num_active=int(final_active.sum()),
+                   failures=failures)
         logger.close()
         return GridResult(
             best_params=gather_to_host(best_params),
@@ -696,4 +836,5 @@ class RedcliffGridRunner:
             val_history=np.stack([self._to_host(v) for v in val_history]),
             coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
             active=final_active,
+            failures=failures,
         )

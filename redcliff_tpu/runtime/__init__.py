@@ -1,0 +1,43 @@
+"""Fault-tolerance runtime: the layer between "the math is right" and "the fit
+survives the machine".
+
+Round 5 showed the hardware, not the model, is the unreliable component of
+this stack (BENCH_r05.json: 5/5 TPU probes hung over 765 s), and the grid
+engine's "bit-identical resume" had a fingerprint hole plus non-atomic pickle
+writes (ADVICE.md). Large-system practice (TensorFlow, arXiv:1605.08695)
+treats checkpoint durability and worker failure as first-class design inputs;
+this package does the same:
+
+- :mod:`~redcliff_tpu.runtime.checkpoint` — durable checkpoint files: atomic
+  tmp+``os.replace`` writes, a trailing ``.prev`` generation, CRC/format
+  version header, quarantine of corrupt files to ``*.bad``, and dataset
+  fingerprints for resume-compatibility checks;
+- :mod:`~redcliff_tpu.runtime.retry` — one retry/backoff/deadline policy
+  object shared by every accelerator-probe loop (bench.py, tpu_watch.py,
+  the DCN dry run), with a fixed-schema outcome log;
+- :mod:`~redcliff_tpu.runtime.preempt` — SIGTERM/SIGINT capture that turns a
+  preemption notice into a final checkpoint instead of lost work;
+- :mod:`~redcliff_tpu.runtime.faultinject` — fault-injection hooks + child
+  fit used by tests/test_fault_injection.py to SIGKILL fits mid-run, corrupt
+  checkpoints, and inject probe failures.
+
+None of these modules import jax at module scope: bench.py's parent process
+must stay backend-free (a hung TPU tunnel would wedge it in a C call), so it
+can import the retry primitives safely.
+"""
+from redcliff_tpu.runtime.checkpoint import (  # noqa: F401
+    CheckpointCorruptError,
+    dataset_fingerprint,
+    load_checkpoint,
+    quarantine,
+    read_checkpoint,
+    write_checkpoint,
+)
+from redcliff_tpu.runtime.preempt import Preempted, PreemptionGuard  # noqa: F401
+from redcliff_tpu.runtime.retry import (  # noqa: F401
+    PROBE_RETRY_POLICY,
+    GiveUp,
+    RetryOutcome,
+    RetryPolicy,
+    retry,
+)

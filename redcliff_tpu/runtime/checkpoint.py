@@ -1,0 +1,160 @@
+"""Durable checkpoint files: atomic writes, generations, CRC, quarantine.
+
+The grid engine's original checkpoints were bare ``pickle.dump`` to a tmp file
+plus ``os.replace`` — atomic against a crash between bytes, but with no way to
+*detect* a torn/corrupt file (a truncated pickle raises deep inside
+``pickle.load``), no previous generation to fall back to, and no format
+version to evolve against. This module owns the file format; policy about
+WHAT goes in a checkpoint (and which fits may resume it) stays with callers.
+
+Format (version 1)::
+
+    RTCK | u32 version | u32 crc32(payload) | u64 payload_len | payload
+
+``payload`` is a pickle. A file failing any header/CRC/unpickle check raises
+:class:`CheckpointCorruptError`; :func:`load_checkpoint` turns that into
+quarantine-and-fall-back: the corrupt file is renamed to ``*.bad`` (preserved
+for forensics, never re-read), the trailing ``*.prev`` generation is tried
+next, and only if both generations are unusable does the caller see "no
+checkpoint" (fresh start) — corrupt state never crashes a fit and never
+silently resumes wrong.
+
+Legacy headerless pickles (written before this module) are still readable:
+they carry no CRC, so they are verified only by unpickling.
+
+stdlib + numpy only — no jax at module scope (bench.py's parent imports the
+runtime package and must never initialize a backend).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import warnings
+import zlib
+
+import numpy as np
+
+__all__ = ["CheckpointCorruptError", "write_checkpoint", "read_checkpoint",
+           "load_checkpoint", "quarantine", "dataset_fingerprint",
+           "FORMAT_VERSION"]
+
+MAGIC = b"RTCK"
+FORMAT_VERSION = 1
+_HEADER = struct.Struct("<4sIIQ")  # magic, version, crc32, payload_len
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file exists but fails header/CRC/unpickle validation."""
+
+
+def write_checkpoint(path, obj):
+    """Atomically write ``obj`` to ``path`` with header+CRC, keeping the
+    previous file as ``path + '.prev'``.
+
+    The tmp file is fsynced before promotion, so after ``os.replace`` returns
+    the new generation is on disk; a crash between the two replaces leaves
+    only ``.prev``, which :func:`load_checkpoint` restores from.
+    """
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    header = _HEADER.pack(MAGIC, FORMAT_VERSION,
+                          zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+
+
+def read_checkpoint(path):
+    """Read + verify one checkpoint file. Raises FileNotFoundError if absent,
+    :class:`CheckpointCorruptError` on any validation failure."""
+    with open(path, "rb") as f:
+        head = f.read(_HEADER.size)
+        if head[:4] != MAGIC:
+            # legacy headerless pickle: no CRC to check; unpickle IS the test
+            try:
+                return pickle.loads(head + f.read())
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"{path}: neither a versioned checkpoint (bad magic "
+                    f"{head[:4]!r}) nor a loadable legacy pickle ({e!r})")
+        if len(head) < _HEADER.size:
+            raise CheckpointCorruptError(
+                f"{path}: truncated header ({len(head)} bytes)")
+        _, version, crc, length = _HEADER.unpack(head)
+        if version > FORMAT_VERSION:
+            raise CheckpointCorruptError(
+                f"{path}: format version {version} is newer than supported "
+                f"({FORMAT_VERSION})")
+        payload = f.read(length + 1)  # +1 detects trailing garbage
+        if len(payload) != length:
+            raise CheckpointCorruptError(
+                f"{path}: payload length {len(payload)} != header {length} "
+                f"(truncated or overwritten)")
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            raise CheckpointCorruptError(f"{path}: CRC mismatch")
+        try:
+            return pickle.loads(payload)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"{path}: CRC-valid payload failed to unpickle ({e!r})")
+
+
+def quarantine(path, reason):
+    """Move a corrupt checkpoint aside to ``path + '.bad'`` with a structured
+    warning (never deleted: the bytes are evidence)."""
+    bad = path + ".bad"
+    try:
+        os.replace(path, bad)
+        action = f"quarantined to {bad}"
+    except OSError as e:
+        action = f"could not quarantine ({e})"
+    warnings.warn(
+        f"corrupt checkpoint {path}: {reason}; {action}",
+        RuntimeWarning, stacklevel=3)
+    return bad
+
+
+def load_checkpoint(path, allow_quarantine=True):
+    """Load the newest usable generation of ``path``.
+
+    Tries ``path`` then ``path + '.prev'``; a corrupt generation is moved to
+    ``*.bad`` (when ``allow_quarantine`` — multi-process callers restrict the
+    rename to one process) and the next one is tried. Returns
+    ``(obj, source_path)`` or ``(None, None)`` when no usable generation
+    exists — corrupt state degrades to a fresh start, never a crash.
+    """
+    for cand in (path, path + ".prev"):
+        try:
+            return read_checkpoint(cand), cand
+        except FileNotFoundError:
+            continue
+        except CheckpointCorruptError as e:
+            if allow_quarantine:
+                quarantine(cand, str(e))
+            else:
+                warnings.warn(f"corrupt checkpoint {cand}: {e} (skipped)",
+                              RuntimeWarning, stacklevel=2)
+    return None, None
+
+
+def dataset_fingerprint(ds):
+    """A cheap shape-level identity for a dataset: enough to catch "resumed
+    against different data" (the rng state would replay a different batch
+    stream) without hashing the arrays. Works with ArrayDataset-style objects
+    (``.X``/``.Y``) and falls back to ``len`` for anything else."""
+    X = getattr(ds, "X", None)
+    if X is not None:
+        Y = getattr(ds, "Y", None)
+        return {"X_shape": tuple(int(s) for s in np.shape(X)),
+                "Y_shape": (None if Y is None
+                            else tuple(int(s) for s in np.shape(Y)))}
+    try:
+        return {"len": len(ds)}
+    except TypeError:
+        return {"type": type(ds).__name__}

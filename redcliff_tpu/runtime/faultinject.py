@@ -1,0 +1,202 @@
+"""Fault-injection harness: kill fits mid-run, corrupt checkpoints, flake probes.
+
+tests/test_fault_injection.py drives the preemption story end-to-end with this
+module: a tiny-but-real grid fit (`child fit`, run via
+``python -m redcliff_tpu.runtime.faultinject``) is SIGKILLed mid-epoch in a
+subprocess, resumed, and compared bit-for-bit against an uninterrupted run;
+checkpoint files are truncated/bit-flipped to prove quarantine-not-crash; and
+deterministic flaky probes assert the retry policy's backoff schedule without
+sleeping through it.
+
+Fault points are env-gated (``REDCLIFF_FAULT_INJECT``) so the hooks compiled
+into the training loop cost one dict lookup when unarmed. Grammar: a
+comma-separated list of ``name:arg``:
+
+- ``sigkill_after_checkpoint:N`` — SIGKILL this process immediately after the
+  checkpoint for epoch N is written (the preemption-without-grace case);
+- ``marker_after_epoch:N`` — write the file named by
+  ``REDCLIFF_FAULT_MARKER`` at the end of epoch N (lets a parent process
+  synchronize a SIGTERM with a known fit phase).
+
+jax is imported lazily: the module is importable by backend-free processes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import signal
+import sys
+
+__all__ = ["crash_point", "corrupt_checkpoint", "flaky", "tiny_grid_fit"]
+
+ENV_SPEC = "REDCLIFF_FAULT_INJECT"
+ENV_MARKER = "REDCLIFF_FAULT_MARKER"
+PREEMPTED_EXIT_CODE = 17
+
+
+def _active_faults():
+    spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        return ()
+    out = []
+    for part in spec.split(","):
+        name, _, arg = part.strip().partition(":")
+        if name:
+            out.append((name, arg))
+    return tuple(out)
+
+
+def crash_point(stage, epoch=None):
+    """Hook called by the training loop at named stages; inert unless a fault
+    matching (stage, epoch) is armed via the environment."""
+    for name, arg in _active_faults():
+        if (name == "sigkill_after_checkpoint" and stage == "checkpoint_saved"
+                and epoch == int(arg)):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if (name == "marker_after_epoch" and stage == "epoch_end"
+                and epoch == int(arg)):
+            marker = os.environ.get(ENV_MARKER)
+            if marker and not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write(str(epoch))
+
+
+def corrupt_checkpoint(path, mode="truncate"):
+    """Damage a checkpoint file in a controlled way.
+
+    ``truncate`` cuts the file to half its length (torn write / full disk);
+    ``flip_payload`` inverts a byte past the header (silent media corruption
+    the CRC must catch); ``zero_header`` wipes the magic+version header.
+    """
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if mode == "truncate":
+            f.truncate(max(size // 2, 1))
+        elif mode == "flip_payload":
+            off = min(40, size - 1)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+        elif mode == "zero_header":
+            f.write(b"\0" * min(8, size))
+        else:
+            raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def flaky(n_failures, value=True, exc=None):
+    """A probe-shaped callable that fails ``n_failures`` times then succeeds:
+    returns ``(False, 'injected failure k')`` (or raises ``exc``) while
+    failing, then ``(value, 'ok')``. For asserting retry/backoff schedules."""
+    state = {"calls": 0}
+
+    def probe(_attempt=None):
+        state["calls"] += 1
+        if state["calls"] <= n_failures:
+            if exc is not None:
+                raise exc
+            return False, f"injected failure {state['calls']}"
+        return value, "ok"
+
+    probe.calls = lambda: state["calls"]
+    return probe
+
+
+# ---------------------------------------------------------------------------
+# the child fit: one small deterministic grid fit, identical whether run
+# in-process or as a subprocess, so killed/resumed/uninterrupted legs are
+# directly comparable
+# ---------------------------------------------------------------------------
+def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
+                  bad_point=False):
+    """Run the harness's canonical small grid fit and return its GridResult.
+
+    ``bad_point`` swaps point 1's learning rate for an absurd value that
+    drives its loss non-finite within an epoch (exercises the non-finite
+    quarantine path). Everything is seeded; two invocations with the same
+    arguments produce bit-identical results on the same backend.
+    """
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+    from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    # 1e20 (not merely "large"): Adam-normalized updates bound the step to
+    # ~lr, so the poison lr must push params past sqrt(f32 max) for the
+    # squared forecast error to overflow to inf within an epoch
+    points = [{"gen_lr": 1e-3},
+              ({"gen_lr": 1e20, "embed_lr": 1e20} if bad_point
+               else {"gen_lr": 3e-3})]
+    tc = RedcliffTrainConfig(max_iter=max_iter, batch_size=16, check_every=1,
+                             seed=0)
+    runner = RedcliffGridRunner(model, tc, GridSpec(points=points))
+    cfg = model.config
+    rng = np.random.default_rng(0)
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(48, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(48, 3, 1)).astype(np.float32)
+    ds = ArrayDataset(X, Y)
+    return runner.fit(jax.random.PRNGKey(2), ds, ds,
+                      checkpoint_dir=checkpoint_dir,
+                      checkpoint_every=checkpoint_every)
+
+
+def _result_blob(result):
+    import jax
+    import numpy as np
+
+    return {
+        "val_history": np.asarray(result.val_history),
+        "best_criteria": np.asarray(result.best_criteria),
+        "best_epoch": np.asarray(result.best_epoch),
+        "active": np.asarray(result.active),
+        "failures": result.failures,
+        "best_params_leaves": [np.asarray(l)
+                               for l in jax.tree.leaves(result.best_params)],
+    }
+
+
+def _child_main(argv):
+    ap = argparse.ArgumentParser(prog="faultinject-child")
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--max-iter", type=int, default=4)
+    ap.add_argument("--checkpoint-every", type=int, default=1)
+    ap.add_argument("--bad-point", action="store_true")
+    ap.add_argument("--result", default=None,
+                    help="write the finished fit's result blob here")
+    args = ap.parse_args(argv)
+
+    from redcliff_tpu.runtime.preempt import Preempted
+
+    try:
+        result = tiny_grid_fit(args.checkpoint_dir,
+                               max_iter=args.max_iter,
+                               checkpoint_every=args.checkpoint_every,
+                               bad_point=args.bad_point)
+    except Preempted as e:
+        print(f"faultinject child: {e}", file=sys.stderr)
+        with open(os.path.join(args.checkpoint_dir, "preempted.json"),
+                  "w") as f:
+            f.write(f'{{"signum": {e.signum}, "epoch": {e.epoch}}}')
+        raise SystemExit(PREEMPTED_EXIT_CODE)
+    if args.result:
+        with open(args.result, "wb") as f:
+            pickle.dump(_result_blob(result), f)
+
+
+if __name__ == "__main__":
+    _child_main(sys.argv[1:])

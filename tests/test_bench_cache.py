@@ -14,6 +14,8 @@ import types
 
 import pytest
 
+from redcliff_tpu.runtime.retry import RetryPolicy
+
 REPO = __file__.rsplit("/tests/", 1)[0]
 
 
@@ -27,7 +29,10 @@ def bench_mod(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "TPU_CACHE_SEED_PATH",
                         str(tmp_path / "cache_seed.json"))
     monkeypatch.setattr(mod, "TPU_MEASURE_LOCK", str(tmp_path / "cache.lock"))
-    monkeypatch.setattr(mod, "PROBE_WAITS", (0.0,))
+    # one immediate probe attempt: the production PROBE_RETRY_POLICY backs
+    # off for minutes, which is exactly what these tests must not do
+    monkeypatch.setattr(mod, "PROBE_RETRY_POLICY",
+                        RetryPolicy(max_attempts=1, base_delay_s=0.0))
     return mod
 
 
@@ -253,3 +258,46 @@ def test_live_tpu_success_writes_cache(bench_mod, monkeypatch):
     assert cache["result"]["value"] == 5e7
     assert cache["source"] == "bench.py live run"
     assert "probe_log" not in cache["result"]
+    assert "probe_retry" not in cache["result"]
+
+
+def test_probe_retry_outcome_recorded_fixed_schema(bench_mod, monkeypatch):
+    """Every orchestrate outcome carries the shared retry policy's
+    fixed-schema log (policy knobs, per-attempt backoff, deadline_hit) so a
+    BENCH artifact distinguishes "tunnel dead" from "policy too impatient"."""
+    # failure path: probes exhausted -> probe_retry rides on the CPU payload
+    emitted = _capture_emits(bench_mod, monkeypatch)
+    monkeypatch.setattr(bench_mod, "_probe_accelerator",
+                        lambda timeout_s=1.0: (False, "tunnel hung"))
+    cpu_payload = {"metric": bench_mod.METRIC, "value": 999.0,
+                   "unit": "windows/s/chip", "vs_baseline": 0.8,
+                   "platform": "cpu", "error": None}
+    monkeypatch.setattr(
+        bench_mod, "_run_measure_child",
+        lambda platform, timeout_s=1.0: (dict(cpu_payload), "ok")
+        if platform == "cpu" else (None, "no tpu"))
+    bench_mod._orchestrate()
+    pr = emitted[0]["probe_retry"]
+    assert pr["ok"] is False
+    assert pr["num_attempts"] == len(pr["attempts"]) == 1
+    assert set(pr["attempts"][0]) >= {"attempt", "backoff_s", "t_offset_s",
+                                      "ok"}
+    assert pr["policy"]["max_attempts"] == 1
+    assert pr["deadline_hit"] is False
+
+    # success path: probe_retry lands in the emitted payload AND the cache
+    emitted.clear()
+    monkeypatch.setattr(bench_mod, "_probe_accelerator",
+                        lambda timeout_s=1.0: (True, "tpu"))
+    tpu_payload = {"metric": bench_mod.METRIC, "value": 5e7,
+                   "unit": "windows/s/chip", "vs_baseline": 70.0,
+                   "platform": "tpu", "device": "TPU v5e", "error": None}
+    monkeypatch.setattr(
+        bench_mod, "_run_measure_child",
+        lambda platform, timeout_s=1.0: (dict(tpu_payload), "ok"))
+    bench_mod._orchestrate()
+    assert emitted[0]["probe_retry"]["ok"] is True
+    with open(bench_mod.TPU_CACHE_PATH) as f:
+        cache = json.load(f)
+    assert cache["probe_retry"]["ok"] is True
+    assert cache["probe_retry"]["attempts"][0]["ok"] is True

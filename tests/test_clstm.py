@@ -83,6 +83,7 @@ def test_arrange_input_matches_reference_semantics():
     np.testing.assert_array_equal(np.asarray(inp[T - ctx]), np.asarray(X[1, :ctx]))
 
 
+@pytest.mark.slow
 def test_clstm_fm_end_to_end_recovers_structure():
     D = 5
     p = S.reference_curation_params(D)

@@ -1,0 +1,419 @@
+"""Fault-injection suite: preemption resilience of the grid engine.
+
+Acceptance battery for the runtime layer (redcliff_tpu/runtime/): a grid fit
+SIGKILLed mid-run in a subprocess resumes BIT-IDENTICALLY; truncated/corrupted
+checkpoints are quarantined to *.bad and the fit restarts cleanly; resuming
+against a changed batch stream or dataset is explicitly rejected; SIGTERM
+triggers one final checkpoint; injected probe failures follow the retry
+policy's backoff schedule exactly. All CPU — no accelerator needed.
+"""
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.runtime import checkpoint as rck
+from redcliff_tpu.runtime.faultinject import (PREEMPTED_EXIT_CODE,
+                                              corrupt_checkpoint, flaky,
+                                              tiny_grid_fit)
+from redcliff_tpu.runtime.preempt import PreemptionGuard
+from redcliff_tpu.runtime.retry import (GiveUp, RetryPolicy, retry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = [sys.executable, "-m", "redcliff_tpu.runtime.faultinject"]
+CKPT_NAME = "grid_checkpoint.pkl"
+
+
+def _run_child(checkpoint_dir, *extra, fault=None, marker=None, timeout=240):
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    if fault:
+        env["REDCLIFF_FAULT_INJECT"] = fault
+    if marker:
+        env["REDCLIFF_FAULT_MARKER"] = marker
+    return subprocess.run(
+        CHILD + ["--checkpoint-dir", str(checkpoint_dir)] + list(extra),
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# durable checkpoint format
+# ---------------------------------------------------------------------------
+def test_checkpoint_roundtrip_and_prev_generation(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    rck.write_checkpoint(path, {"gen": 1})
+    rck.write_checkpoint(path, {"gen": 2})
+    assert rck.read_checkpoint(path) == {"gen": 2}
+    assert rck.read_checkpoint(path + ".prev") == {"gen": 1}
+
+
+def test_truncated_head_falls_back_to_prev(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    rck.write_checkpoint(path, {"gen": 1})
+    rck.write_checkpoint(path, {"gen": 2})
+    corrupt_checkpoint(path, "truncate")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        obj, src = rck.load_checkpoint(path)
+    assert obj == {"gen": 1} and src == path + ".prev"
+    # the corrupt head was preserved as evidence, not deleted
+    assert os.path.exists(path + ".bad") and not os.path.exists(path)
+
+
+def test_both_generations_corrupt_degrades_to_fresh_start(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    rck.write_checkpoint(path, {"gen": 1})
+    rck.write_checkpoint(path, {"gen": 2})
+    corrupt_checkpoint(path, "truncate")
+    corrupt_checkpoint(path + ".prev", "zero_header")
+    with pytest.warns(RuntimeWarning):
+        obj, src = rck.load_checkpoint(path)
+    assert obj is None and src is None
+    assert os.path.exists(path + ".bad")
+    assert os.path.exists(path + ".prev.bad")
+
+
+def test_crc_catches_silent_bit_flip(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    rck.write_checkpoint(path, {"weights": list(range(100))})
+    corrupt_checkpoint(path, "flip_payload")
+    with pytest.raises(rck.CheckpointCorruptError, match="CRC"):
+        rck.read_checkpoint(path)
+
+
+def test_legacy_headerless_pickle_still_reads(tmp_path):
+    path = str(tmp_path / "legacy.pkl")
+    with open(path, "wb") as f:
+        pickle.dump({"old": True}, f)
+    assert rck.read_checkpoint(path) == {"old": True}
+
+
+# ---------------------------------------------------------------------------
+# (a) SIGKILL mid-fit -> bit-identical resume
+# ---------------------------------------------------------------------------
+def test_sigkill_mid_fit_resume_bit_identical(tmp_path):
+    """A grid fit SIGKILLed right after its epoch-1 checkpoint (no grace, the
+    preemption-without-warning case) resumes to results bit-identical to an
+    uninterrupted run — params, best criteria/epochs, lane masks, history."""
+    ck = tmp_path / "ck"
+    killed = _run_child(ck, "--max-iter", "4",
+                        fault="sigkill_after_checkpoint:1")
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    ckpt = rck.read_checkpoint(str(ck / CKPT_NAME))
+    assert ckpt["epoch"] == 1  # died mid-fit, after the epoch-1 save
+
+    res_path = tmp_path / "resumed.pkl"
+    resumed = _run_child(ck, "--max-iter", "4", "--result", str(res_path))
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+
+    full_path = tmp_path / "full.pkl"
+    uninterrupted = _run_child(tmp_path / "ck_full", "--max-iter", "4",
+                               "--result", str(full_path))
+    assert uninterrupted.returncode == 0, uninterrupted.stderr[-2000:]
+
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    with open(full_path, "rb") as f:
+        want = pickle.load(f)
+    np.testing.assert_array_equal(got["val_history"], want["val_history"])
+    np.testing.assert_array_equal(got["best_criteria"],
+                                  want["best_criteria"])
+    np.testing.assert_array_equal(got["best_epoch"], want["best_epoch"])
+    np.testing.assert_array_equal(got["active"], want["active"])
+    for a, b in zip(got["best_params_leaves"], want["best_params_leaves"]):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# (b) corrupt checkpoint -> quarantine, clean restart
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["truncate", "flip_payload"])
+def test_corrupt_checkpoint_quarantined_fit_restarts(tmp_path, mode):
+    """A fit pointed at a corrupt checkpoint (no usable .prev) quarantines it
+    to *.bad and restarts from scratch — no crash, results identical to a
+    fresh run."""
+    ck = str(tmp_path / "ck")
+    fresh = tiny_grid_fit(None, max_iter=2)
+    tiny_grid_fit(ck, max_iter=2)
+    head = os.path.join(ck, CKPT_NAME)
+    corrupt_checkpoint(head, mode)
+    os.remove(head + ".prev")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        restarted = tiny_grid_fit(ck, max_iter=2)
+    assert os.path.exists(head + ".bad")
+    np.testing.assert_array_equal(restarted.val_history, fresh.val_history)
+
+
+# ---------------------------------------------------------------------------
+# (c) changed config/data -> explicit rejection
+# ---------------------------------------------------------------------------
+def _mismatch_fit(ck, **tc_overrides):
+    import dataclasses
+
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    from test_parallel_grid import _data, _model
+
+    model = _model()
+    tc = dataclasses.replace(
+        RedcliffTrainConfig(max_iter=2, batch_size=32, check_every=1),
+        **tc_overrides)
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 3e-3}])
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    return runner, ds
+
+
+def test_resume_rejects_changed_batch_size(tmp_path):
+    """Regression for the old silent-wrong-resume: the restored rng state
+    would replay a DIFFERENT batch stream under a new batch_size, so the
+    fingerprint now rejects it with the mismatching field named."""
+    import jax
+
+    ck = str(tmp_path / "ck")
+    runner, ds = _mismatch_fit(ck)
+    runner.fit(jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+               checkpoint_every=1)
+    runner2, ds2 = _mismatch_fit(ck, batch_size=16)
+    with pytest.raises(ValueError, match="batch_size"):
+        runner2.fit(jax.random.PRNGKey(0), ds2, ds2, checkpoint_dir=ck,
+                    checkpoint_every=1)
+
+
+def test_resume_rejects_predurability_checkpoint_with_clear_message(tmp_path):
+    """A checkpoint written by the pre-durability code (bare pickle, old
+    {points, seed, training_mode} meta) is rejected as a format upgrade, not
+    misreported as 'a different fit'."""
+    import jax
+
+    ck = str(tmp_path / "ck")
+    runner, ds = _mismatch_fit(ck)
+    os.makedirs(ck)
+    legacy = {"meta": {"points": list(runner.spec.points), "seed": 0,
+                       "training_mode": "combined"}}
+    with open(os.path.join(ck, CKPT_NAME), "wb") as f:
+        pickle.dump(legacy, f)
+    with pytest.raises(ValueError, match="predates the durable"):
+        runner.fit(jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+                   checkpoint_every=1)
+
+
+def test_resume_rejects_changed_dataset_shape(tmp_path):
+    import jax
+
+    from test_parallel_grid import _data
+
+    ck = str(tmp_path / "ck")
+    runner, ds = _mismatch_fit(ck)
+    runner.fit(jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+               checkpoint_every=1)
+    runner2, _ = _mismatch_fit(ck)
+    ds_small = _data(runner2.model, n=32)
+    with pytest.raises(ValueError, match="train_data"):
+        runner2.fit(jax.random.PRNGKey(0), ds_small, ds_small,
+                    checkpoint_dir=ck, checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> one final checkpoint (the SLURM/TPU-VM preemption notice)
+# ---------------------------------------------------------------------------
+def test_sigterm_triggers_final_checkpoint(tmp_path):
+    """checkpoint_every is set far beyond the run, so the ONLY way a
+    checkpoint can appear is the preemption path: marker file says epoch 1
+    finished, parent sends SIGTERM, child saves at the next epoch boundary
+    and exits with the preempted code."""
+    ck = tmp_path / "ck"
+    marker = str(tmp_path / "epoch1.marker")
+    env = dict(os.environ,
+               REDCLIFF_FAULT_INJECT="marker_after_epoch:1",
+               REDCLIFF_FAULT_MARKER=marker)
+    proc = subprocess.Popen(
+        CHILD + ["--checkpoint-dir", str(ck), "--max-iter", "500",
+                 "--checkpoint-every", "100000"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = time.monotonic() + 180
+        while not os.path.exists(marker):
+            assert proc.poll() is None, proc.communicate()[1][-2000:]
+            assert time.monotonic() < deadline, "child never reached epoch 1"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        _, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == PREEMPTED_EXIT_CODE, err[-2000:]
+    assert os.path.exists(ck / "preempted.json")
+    ckpt = rck.read_checkpoint(str(ck / CKPT_NAME))
+    assert ckpt["epoch"] >= 1
+    assert ckpt["meta"]["batch_size"] == 16  # full fingerprint rode along
+
+
+def test_preemption_guard_latches_and_restores():
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):
+            if g.preempted:
+                break
+            time.sleep(0.01)
+        assert g.preempted and g.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: non-finite points quarantined, grid keeps training
+# ---------------------------------------------------------------------------
+def test_nonfinite_point_quarantined_rest_of_grid_trains():
+    res = tiny_grid_fit(None, max_iter=3, bad_point=True)
+    assert [f["point"] for f in res.failures] == [1]
+    assert res.failures[0]["epoch"] >= 0
+    assert res.failures[0]["hparams"]["gen_lr"] == 1e20
+    assert not res.active[1]
+    # the healthy point trained through all epochs, unaffected
+    assert res.active[0]
+    assert np.isfinite(res.val_history[:, 0]).all()
+    assert np.isfinite(res.best_criteria[0])
+    # the quarantined lane froze: its val loss stops changing after failure
+    e = res.failures[0]["epoch"]
+    if e + 2 < res.val_history.shape[0]:
+        np.testing.assert_array_equal(res.val_history[e + 1, 1],
+                                      res.val_history[e + 2, 1])
+
+
+def test_driver_writes_failures_json(tmp_path):
+    import json
+
+    import jax
+
+    from redcliff_tpu.train.driver import run_coefficient_grid
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    from test_parallel_grid import _data, _model
+
+    model = _model()
+    ds = _data(model, n=32)
+    run_dir = str(tmp_path / "run")
+    res = run_coefficient_grid(
+        model, RedcliffTrainConfig(max_iter=2, batch_size=16, check_every=1),
+        [{"gen_lr": 1e-3}, {"gen_lr": 1e20, "embed_lr": 1e20}],
+        ds, ds, key=jax.random.PRNGKey(0), run_dir=run_dir)
+    assert res.failures
+    with open(os.path.join(run_dir, "failures.json")) as f:
+        blob = json.load(f)
+    assert blob["grid_size"] == 2
+    assert blob["failures"][0]["point"] == 1
+
+
+# ---------------------------------------------------------------------------
+# resume onto a different (smaller) device mesh
+# ---------------------------------------------------------------------------
+def test_resume_on_smaller_mesh(tmp_path):
+    """Checkpoints hold gathered host state, so a fit that lost half its
+    devices resumes on a smaller mesh — and still matches the uninterrupted
+    big-mesh run (per-point compute is mesh-placement-invariant)."""
+    import jax
+
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.parallel.mesh import grid_mesh
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    from test_parallel_grid import _data, _model
+
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3 * (i + 1)} for i in range(8)])
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=32, check_every=1)
+    ds = _data(model, n=32)
+
+    full = RedcliffGridRunner(model, tc, spec, mesh=grid_mesh(8)).fit(
+        jax.random.PRNGKey(3), ds, ds)
+
+    ck = str(tmp_path / "ck")
+    RedcliffGridRunner(model, tc, spec, mesh=grid_mesh(8)).fit(
+        jax.random.PRNGKey(3), ds, ds, max_iter=2, checkpoint_dir=ck,
+        checkpoint_every=1)
+    resumed = RedcliffGridRunner(model, tc, spec, mesh=grid_mesh(4)).fit(
+        jax.random.PRNGKey(3), ds, ds, checkpoint_dir=ck,
+        checkpoint_every=1)
+    np.testing.assert_allclose(resumed.val_history, full.val_history,
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_array_equal(resumed.best_epoch, full.best_epoch)
+
+
+# ---------------------------------------------------------------------------
+# injected probe failures follow the policy's backoff schedule
+# ---------------------------------------------------------------------------
+def test_injected_probe_failures_follow_backoff_schedule():
+    policy = RetryPolicy(max_attempts=5, base_delay_s=3.0, multiplier=2.0,
+                         max_delay_s=10.0)
+    slept = []
+    out = retry(flaky(3), policy, is_success=lambda r: r[0],
+                info_of=lambda r: r[1], sleep=slept.append)
+    # exact exponential schedule, capped: 3, 6, 10 (not 12)
+    assert slept == [3.0, 6.0, 10.0]
+    assert out.ok and out.value == (True, "ok")
+    log = out.log()
+    assert [a["backoff_s"] for a in log["attempts"]] == [0.0, 3.0, 6.0, 10.0]
+    assert [a["ok"] for a in log["attempts"]] == [False, False, False, True]
+    assert log["deadline_hit"] is False
+    assert log["policy"]["max_attempts"] == 5
+
+
+def test_retry_deadline_cuts_schedule():
+    clock = {"t": 0.0}
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    policy = RetryPolicy(max_attempts=10, base_delay_s=10.0, multiplier=1.0,
+                         max_delay_s=10.0, deadline_s=25.0)
+    out = retry(flaky(100), policy, is_success=lambda r: r[0],
+                sleep=fake_sleep, monotonic=lambda: clock["t"])
+    # attempts at t=0, 10, 20; the t=30 attempt would cross the deadline
+    assert len(out.attempts) == 3
+    assert out.deadline_hit and not out.ok
+
+
+def test_retry_giveup_aborts_immediately():
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        raise GiveUp("budget exhausted")
+
+    out = retry(fn, RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                sleep=lambda s: None)
+    assert calls == [0]
+    assert not out.ok and "budget exhausted" in out.error
+
+
+def test_retry_exception_classification():
+    # non-retryable exceptions surface immediately
+    def boom(attempt):
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        retry(boom, RetryPolicy(max_attempts=3, base_delay_s=0.0),
+              retryable=lambda e: isinstance(e, OSError),
+              sleep=lambda s: None)
+
+    # retryable exceptions burn attempts, then the last one re-raises
+    probe = flaky(100, exc=OSError("bind failed"))
+    with pytest.raises(OSError):
+        retry(probe, RetryPolicy(max_attempts=3, base_delay_s=0.0),
+              retryable=lambda e: isinstance(e, OSError),
+              sleep=lambda s: None)
+    assert probe.calls() == 3
+
+    # a retryable failure followed by success recovers
+    probe2 = flaky(2, exc=OSError("bind failed"))
+    out = retry(probe2, RetryPolicy(max_attempts=5, base_delay_s=0.0),
+                is_success=lambda r: r[0],
+                retryable=lambda e: isinstance(e, OSError),
+                sleep=lambda s: None)
+    assert out.ok and probe2.calls() == 3

@@ -52,11 +52,14 @@ def test_grid_runner_trains_all_points():
 
 
 def test_grid_points_diverge_with_different_hyperparams():
+    # parity is not the point here (just "different lrs -> different
+    # weights"), so the fixture matches the smaller batch-16 shape family
+    # other tests in this file compile anyway
     model = _model()
     spec = GridSpec(points=[{"gen_lr": 1e-4}, {"gen_lr": 1e-2}])
-    tc = RedcliffTrainConfig(max_iter=2, batch_size=32)
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=16)
     runner = RedcliffGridRunner(model, tc, spec)
-    ds = _data(model)
+    ds = _data(model, n=32)
     res = runner.fit(jax.random.PRNGKey(1), ds, ds)
     w0 = np.asarray(jax.tree.leaves(res.best_params)[0])
     # different lrs must produce different trained weights
@@ -145,6 +148,7 @@ def _freeze_model(mode, **over):
     return RedcliffSCMLP(RedcliffSCMLPConfig(**kw))
 
 
+@pytest.mark.slow  # dual grid + G independent trainer fits: ~26s of compile
 @pytest.mark.parametrize("mode", [
     "pretrain_embedder_then_post_train_factor_withL1FreezeByBatch",
     "pretrain_embedder_then_post_train_factor_withComboCosSimL1FreezeByEpoch",
@@ -254,6 +258,7 @@ def test_init_grid_from_replicates_point_params():
     assert res.best_criteria.shape == (3,)
 
 
+@pytest.mark.slow  # two full fits (scan + per-batch) just to compare: ~18s
 def test_grid_scan_batches_matches_per_batch():
     """The lax.scan k-batch step reproduces the one-dispatch-per-batch path
     bit-for-bit on the same data/seed (dispatch amortization must not change

@@ -33,6 +33,7 @@ import sys
 import time
 
 import bench  # reuse the killable probe/measure children + cache writer/lock
+from redcliff_tpu.runtime.retry import RetryPolicy, retry
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_PATH = bench.TPU_CACHE_PATH
@@ -97,56 +98,71 @@ def main():
 
     t0 = time.monotonic()
     started_at = _utcnow()
-    attempts = 0
-    successes = 0
-    last_success_mono = None
+    state = {"successes": 0, "last_success_mono": None}
     _log(f"tpu_watch start: duration={args.duration_s:.0f}s "
          f"interval={args.interval_s:.0f}s cache={CACHE_PATH}")
 
-    while time.monotonic() - t0 < args.duration_s:
-        attempts += 1
+    def watch_tick(attempt):
+        """One cadence tick: probe; on a live window, measure+cache.
+        Returns a status string for the retry attempt log."""
         ok, info = bench._probe_accelerator()
-        _log(f"probe {attempts}: ok={ok} {info}")
-        if ok:
-            fresh_enough = (last_success_mono is not None and
-                            time.monotonic() - last_success_mono < REFRESH_MIN_S)
-            if not fresh_enough:
-                # survive watcher restarts: a cache written minutes ago by a
-                # previous watcher/bench process is just as fresh
-                cached = bench._load_tpu_cache()
-                if cached is not None:
-                    # age_hours is computed by the loader; a backfilled seed
-                    # is always old enough to re-measure on a live window
-                    fresh_enough = cached["age_hours"] * 3600.0 < REFRESH_MIN_S
-            if fresh_enough:
-                _log("live window but cache is fresh; skipping re-measure")
-            elif not bench._acquire_measure_lock(wait_s=0.0):
-                # a live bench.py run owns the chip; its result lands in the
-                # same cache, so this window is covered either way
-                _log("live window but another measurement holds the lock")
-            else:
-                try:
-                    _log("tunnel LIVE -> running full TPU bench measurement")
-                    payload, minfo = bench._run_measure_child("tpu")
-                    if payload is not None and payload.get("value"):
-                        pallas = _pallas_check()
-                        bench._write_tpu_cache(
-                            payload, source="tpu_watch.py opportunistic window",
-                            extras={"watch_started_at": started_at,
-                                    "probe_attempts_before_success": attempts,
-                                    "pallas_prox_check": pallas})
-                        successes += 1
-                        last_success_mono = time.monotonic()
-                        _log(f"MEASUREMENT CACHED: value={payload.get('value')} "
-                             f"vs_baseline={payload.get('vs_baseline')} "
-                             f"device={payload.get('device')} pallas={pallas}")
-                    else:
-                        _log(f"measurement failed mid-window: {minfo}")
-                finally:
-                    bench._release_measure_lock()
-        time.sleep(args.interval_s)
+        _log(f"probe {attempt + 1}: ok={ok} {info}")
+        if not ok:
+            return "no tunnel"
+        last = state["last_success_mono"]
+        fresh_enough = (last is not None
+                        and time.monotonic() - last < REFRESH_MIN_S)
+        if not fresh_enough:
+            # survive watcher restarts: a cache written minutes ago by a
+            # previous watcher/bench process is just as fresh
+            cached = bench._load_tpu_cache()
+            if cached is not None:
+                # age_hours is computed by the loader; a backfilled seed
+                # is always old enough to re-measure on a live window
+                fresh_enough = cached["age_hours"] * 3600.0 < REFRESH_MIN_S
+        if fresh_enough:
+            _log("live window but cache is fresh; skipping re-measure")
+            return "live; cache fresh"
+        if not bench._acquire_measure_lock(wait_s=0.0):
+            # a live bench.py run owns the chip; its result lands in the
+            # same cache, so this window is covered either way
+            _log("live window but another measurement holds the lock")
+            return "live; lock held elsewhere"
+        try:
+            _log("tunnel LIVE -> running full TPU bench measurement")
+            payload, minfo = bench._run_measure_child("tpu")
+            if payload is not None and payload.get("value"):
+                pallas = _pallas_check()
+                bench._write_tpu_cache(
+                    payload, source="tpu_watch.py opportunistic window",
+                    extras={"watch_started_at": started_at,
+                            "probe_attempts_before_success": attempt + 1,
+                            "pallas_prox_check": pallas})
+                state["successes"] += 1
+                state["last_success_mono"] = time.monotonic()
+                _log(f"MEASUREMENT CACHED: value={payload.get('value')} "
+                     f"vs_baseline={payload.get('vs_baseline')} "
+                     f"device={payload.get('device')} pallas={pallas}")
+                return "measured"
+            _log(f"measurement failed mid-window: {minfo}")
+            return f"measure failed: {minfo}"
+        finally:
+            bench._release_measure_lock()
 
-    _log(f"tpu_watch done: {attempts} probes, {successes} cached measurements")
+    # the watcher is a constant-cadence instance of the shared retry
+    # primitive: multiplier 1.0 = steady interval, the deadline is the watch
+    # duration, and is_success is never True because a measurement does NOT
+    # end the watch (a later live window refreshes the cache again)
+    policy = RetryPolicy(
+        max_attempts=max(1, int(args.duration_s // args.interval_s) + 1),
+        base_delay_s=args.interval_s, multiplier=1.0,
+        max_delay_s=args.interval_s, jitter_frac=0.0,
+        deadline_s=args.duration_s)
+    outcome = retry(watch_tick, policy, is_success=lambda r: False,
+                    info_of=lambda r: r)
+    _log(f"tpu_watch done: {len(outcome.attempts)} probes, "
+         f"{state['successes']} cached measurements")
+    _log("retry outcome: " + json.dumps(outcome.log()))
 
 
 if __name__ == "__main__":
