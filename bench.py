@@ -477,9 +477,12 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
     Y = jax.device_put(
         rng.uniform(size=(B, cfg.num_supervised_factors, 1)).astype(np.float32))
 
+    from redcliff_tpu.runtime.numerics import init_numerics_state
+
     params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
     coeffs = runner.coeffs
     active = jax.numpy.ones((G,), dtype=bool)
+    ns = init_numerics_state(lanes=G)
 
     wps = flops = dt = None
     p, a, b = params, optA, optB
@@ -488,14 +491,15 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
         # AOT-compile ONCE and time through the compiled object (calling the
         # jit wrapper after .lower().compile() would compile a second time —
         # the jit executable cache is not populated by AOT compilation)
-        compiled = step.lower(params, optA, optB, coeffs, active, X,
+        compiled = step.lower(params, optA, optB, ns, coeffs, active, X,
                               Y).compile()
         flops = _flops_of(compiled)
-        p, a, b, _ = compiled(params, optA, optB, coeffs, active, X, Y)
+        p, a, b, ns, _ = compiled(params, optA, optB, ns, coeffs, active,
+                                  X, Y)
         jax.block_until_ready(p)
         t0 = time.perf_counter()
         for _ in range(steps):
-            p, a, b, _ = compiled(p, a, b, coeffs, active, X, Y)
+            p, a, b, ns, _ = compiled(p, a, b, ns, coeffs, active, X, Y)
         jax.block_until_ready(p)
         dt = time.perf_counter() - t0
         wps = G * B * steps / dt
@@ -505,14 +509,14 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
     Xs = jax.numpy.stack([X] * scan_k)
     Ys = jax.numpy.stack([Y] * scan_k)
     sstep = runner._scan_steps["combined"]
-    scompiled = sstep.lower(p, a, b, coeffs, active, Xs, Ys).compile()
+    scompiled = sstep.lower(p, a, b, ns, coeffs, active, Xs, Ys).compile()
     sflops = _flops_of(scompiled)
-    p, a, b, _ = scompiled(p, a, b, coeffs, active, Xs, Ys)  # warm dispatch
+    p, a, b, ns, _ = scompiled(p, a, b, ns, coeffs, active, Xs, Ys)  # warm
     jax.block_until_ready(p)
     sdispatches = max(2, steps // scan_k)
     t0 = time.perf_counter()
     for _ in range(sdispatches):
-        p, a, b, _ = scompiled(p, a, b, coeffs, active, Xs, Ys)
+        p, a, b, ns, _ = scompiled(p, a, b, ns, coeffs, active, Xs, Ys)
     jax.block_until_ready(p)
     sdt = time.perf_counter() - t0
     scan_wps = G * B * scan_k * sdispatches / sdt

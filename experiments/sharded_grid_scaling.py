@@ -65,12 +65,14 @@ Y = jax.device_put(rng.uniform(
 params, optA, optB = runner.init_grid(jax.random.PRNGKey(0))
 coeffs = runner.coeffs
 active = jax.numpy.ones((G,), dtype=bool)
+from redcliff_tpu.runtime.numerics import init_numerics_state
+ns = init_numerics_state(lanes=G)
 step = runner._steps["combined"]
-p, a, b, _ = step(params, optA, optB, coeffs, active, X, Y)  # compile+warm
+p, a, b, ns, _ = step(params, optA, optB, ns, coeffs, active, X, Y)  # compile+warm
 jax.block_until_ready(p)
 t0 = time.perf_counter()
 for _ in range(STEPS):
-    p, a, b, _ = step(p, a, b, coeffs, active, X, Y)
+    p, a, b, ns, _ = step(p, a, b, ns, coeffs, active, X, Y)
 jax.block_until_ready(p)
 dt = time.perf_counter() - t0
 # fingerprint for cross-device-count equivalence of the program's output

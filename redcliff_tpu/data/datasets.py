@@ -7,12 +7,23 @@ arrays. Per-channel z-score statistics are computed dataset-wide at construction
 exactly like NormalizedSyntheticWVARDataset (ref synthetic_datasets.py:89-118);
 the grid_search flag keeps only the first quarter of samples
 (ref synthetic_datasets.py:126-129).
+Input contracts: construction validates shape/dtype (a ragged or non-(N,T,C)
+input raises :class:`InputContractError` naming the violation) and
+quarantines non-finite samples with a COUNT (``quarantined_samples``) plus a
+RuntimeWarning — never a silent drop, and never a NaN row silently poisoning
+the normalization statistics and every batch downstream.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
-__all__ = ["ArrayDataset", "train_val_split"]
+__all__ = ["ArrayDataset", "InputContractError", "train_val_split"]
+
+
+class InputContractError(ValueError):
+    """Input data violates the dataset contract (shape/dtype/label length)."""
 
 
 class ArrayDataset:
@@ -20,14 +31,56 @@ class ArrayDataset:
 
     Batches are yielded as plain numpy slices; callers hand them to jit'd steps
     (jax transfers once per batch — or pre-shard via parallel.grid for multi-chip).
+
+    ``contract=True`` (default) enforces the input contract: X must be a
+    dense rank-3 float-convertible array, Y (when given) must have matching
+    length, and samples containing non-finite values are quarantined (dropped
+    BEFORE normalization statistics, counted in ``quarantined_samples``, and
+    warned about — the trainers' numerics sentinel then never sees NaN data
+    that the loader could have caught).
     """
 
     _dev = None  # lazily-populated device-resident (X, Y) cache
     supports_device_batches = True  # trainers probe this before device=True
+    quarantined_samples = 0
 
-    def __init__(self, X, Y=None, normalize=True, stats=None, grid_search=False):
+    def __init__(self, X, Y=None, normalize=True, stats=None, grid_search=False,
+                 contract=True):
+        X = np.asarray(X)
+        if contract:
+            if X.dtype == object:
+                raise InputContractError(
+                    "X is an object array (ragged sample list?); the dataset "
+                    "contract requires a dense (N, T, C) numeric array")
+            if X.ndim != 3:
+                raise InputContractError(
+                    f"X must be (num_samples, num_timesteps, num_channels); "
+                    f"got shape {X.shape}")
+            if not np.issubdtype(X.dtype, np.floating) \
+                    and not np.issubdtype(X.dtype, np.integer):
+                raise InputContractError(
+                    f"X dtype {X.dtype} is not numeric")
         X = np.asarray(X, dtype=np.float32)
         Y = None if Y is None else np.asarray(Y, dtype=np.float32)
+        if contract and Y is not None and len(Y) != len(X):
+            raise InputContractError(
+                f"label length {len(Y)} != sample count {len(X)}")
+        if contract and len(X):
+            good = np.isfinite(X).all(axis=(1, 2))
+            if Y is not None:
+                good &= np.isfinite(Y.reshape(len(Y), -1)).all(axis=1)
+            n_bad = int(len(X) - good.sum())
+            if n_bad:
+                # quarantine BEFORE stats: one NaN sample would otherwise
+                # poison the channel mean/std and normalize every clean
+                # sample to NaN
+                warnings.warn(
+                    f"ArrayDataset: quarantined {n_bad}/{len(X)} samples "
+                    f"containing non-finite values", RuntimeWarning,
+                    stacklevel=2)
+                X = X[good]
+                Y = None if Y is None else Y[good]
+            self.quarantined_samples = n_bad
         if normalize:
             # stats come from the FULL dataset even under grid_search subsetting,
             # matching the reference's order of operations

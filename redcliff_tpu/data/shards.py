@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import warnings
 
 import numpy as np
 
@@ -44,26 +45,36 @@ def save_cv_split(train_data, val_data, cv_id, save_path):
         pickle.dump(val_data, f)
 
 
-def load_shard_samples(data_path, drop_nan=True):
+def load_shard_samples(data_path, drop_nan=True, report=None):
     """Load every ``subset_*.pkl`` under a split dir into a [[x, y], ...] list,
-    skipping NaN-contaminated samples like the reference loaders
-    (ref dream4_datasets.py:50-70)."""
+    quarantining non-finite-contaminated samples like the reference loaders
+    (ref dream4_datasets.py:50-70) — but as a COUNTED quarantine (per-file
+    tallies in ``report`` when a dict is passed, plus a RuntimeWarning), not
+    a silent drop. inf counts as contamination too: a non-finite sample
+    poisons normalization statistics exactly like a NaN one."""
     files = sorted(x for x in os.listdir(data_path)
                    if "subset_" in x and x.endswith(".pkl")
                    and "metadata" not in x)
     samples = []
     skipped = 0
+    per_file = {}
     for name in files:
         with open(os.path.join(data_path, name), "rb") as f:
             for pair in pickle.load(f):
                 x = np.asarray(pair[0], dtype=np.float32)
-                if drop_nan and np.isnan(x).any():
+                if drop_nan and not np.isfinite(x).all():
                     skipped += 1
+                    per_file[name] = per_file.get(name, 0) + 1
                     continue
                 samples.append([x, np.asarray(pair[1], dtype=np.float32)])
+    if report is not None:
+        report["quarantined"] = skipped
+        report["loaded"] = len(samples)
+        report["quarantined_by_file"] = per_file
     if skipped:
-        print(f"load_shard_samples: skipped {skipped} NaN samples under "
-              f"{data_path}", flush=True)
+        warnings.warn(
+            f"load_shard_samples: quarantined {skipped} non-finite samples "
+            f"under {data_path} ({per_file})", RuntimeWarning, stacklevel=2)
     return samples
 
 
@@ -177,7 +188,8 @@ def load_normalized_split_datasets(data_root_path, signal_format="original",
     out = []
     for split in ("train", "validation"):
         split_dir = os.path.join(data_root_path, split)
-        samples = load_shard_samples(split_dir)
+        report = {}
+        samples = load_shard_samples(split_dir, report=report)
         X, Y = samples_to_arrays(samples)
         if average_region_map is not None:
             X = np.stack([X[:, :, idxs].mean(axis=2)
@@ -191,6 +203,9 @@ def load_normalized_split_datasets(data_root_path, signal_format="original",
             order = rng.permutation(len(X))
             X, Y = X[order], Y[order]
         ds = ArrayDataset(X, Y, normalize=True, grid_search=grid_search)
+        # surface the loader's quarantine tally on the dataset (the in-memory
+        # contract check may add post-transform quarantines of its own)
+        ds.source_quarantine_report = report
         if signal_format != "original":
             feats = apply_signal_format(
                 ds.X, signal_format,

@@ -32,6 +32,7 @@ import shutil
 
 import numpy as np
 
+from ..runtime.checkpoint import read_checkpoint
 from .summaries import (OFFDIAG_PARADIGM, load_full_comparison_summary,
                         summarize_off_diag_f1, write_cross_experiment_report)
 
@@ -384,8 +385,8 @@ def factor_selection_table(run_dirs_by_num_factors,
         for run_dir in run_dirs:
             meta_path = os.path.join(
                 run_dir, "training_meta_data_and_hyper_parameters.pkl")
-            with open(meta_path, "rb") as f:
-                meta = pickle.load(f)
+            # format-aware read: durable-header metas and legacy pickles
+            meta = read_checkpoint(meta_path)
             for k in criteria_keys:
                 hist = meta.get(k)
                 if hist:

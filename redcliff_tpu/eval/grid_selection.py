@@ -11,9 +11,10 @@ combinations), reporting the best run and best epoch per criterion.
 from __future__ import annotations
 
 import os
-import pickle
 
 import numpy as np
+
+from ..runtime.checkpoint import read_checkpoint
 
 __all__ = [
     "load_grid_summaries",
@@ -43,8 +44,8 @@ def load_grid_summaries(trained_models_root_path):
         p = os.path.join(trained_models_root_path, name,
                          "training_meta_data_and_hyper_parameters.pkl")
         if os.path.isfile(p):
-            with open(p, "rb") as f:
-                out[name] = pickle.load(f)
+            # format-aware read: durable-header metas and legacy pickles
+            out[name] = read_checkpoint(p)
     return out
 
 

@@ -6,12 +6,18 @@ hyperparameters :846-876).  This build's artifacts are {model_class, config,
 params} pickles written by redcliff_tpu.train.trainer.save_model /
 RedcliffTrainer._save_checkpoint and the dCSFA fit loop, so loading is a
 registry lookup + reconstruction — no folder-name parsing required.
+
+Artifacts written since the durable-checkpoint migration carry the runtime
+checkpoint header (CRC + format version); ``runtime.checkpoint.read_checkpoint``
+reads those AND legacy headerless pickles, so every loader below routes
+through it.
 """
 from __future__ import annotations
 
 import os
-import pickle
 import warnings
+
+from ..runtime.checkpoint import read_checkpoint
 
 __all__ = ["MODEL_REGISTRY", "load_model_for_eval", "load_artifact"]
 
@@ -56,9 +62,7 @@ def load_artifact(path, best_model_name=None):
                 raise FileNotFoundError(
                     f"best_model_name {best_model_name!r} not found in "
                     f"{path!r}")
-            path = named
-            with open(path, "rb") as f:
-                return pickle.load(f)
+            return read_checkpoint(named)
         # cached-args may carry any best_model_name extension (the reference
         # synSys DCSFA args use dCSFA-NMF-best-model.pt); several may coexist
         # (e.g. a stale .pkl next to the current .pt). Order deterministically:
@@ -98,8 +102,7 @@ def load_artifact(path, best_model_name=None):
             raise FileNotFoundError(
                 f"no model artifact (final_best_model.bin / "
                 f"dCSFA-NMF-best-model*) in {path!r}")
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return read_checkpoint(path)
 
 
 def _migrate_config(config):

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..runtime.checkpoint import write_checkpoint
 from ..utils.metrics import roc_auc
 from ..utils.misc import unflatten_directed_spectrum_features
 
@@ -574,10 +575,11 @@ class DcsfaNmf:
                                 state=state, val_recon=val_mse,
                                 val_aucs=val_aucs)
                     if save_folder:
-                        with open(os.path.join(save_folder, best_model_name),
-                                  "wb") as f:
-                            pickle.dump(self._artifact_payload(params, state),
-                                        f)
+                        # durable write: a preemption mid-save can't tear
+                        # the best-model artifact
+                        write_checkpoint(
+                            os.path.join(save_folder, best_model_name),
+                            self._artifact_payload(params, state))
             if verbose:
                 print(f"dCSFA-NMF epoch {epoch}: loss "
                       f"{histories['training'][-1]:.6f}", flush=True)

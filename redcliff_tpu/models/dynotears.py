@@ -22,13 +22,14 @@ expression per call rather than the reference's per-block assembly.
 from __future__ import annotations
 
 import os
-import pickle
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
 import scipy.linalg as slin
 import scipy.optimize as sopt
+
+from ..runtime.checkpoint import write_checkpoint
 
 __all__ = [
     "reshape_wa", "dynotears_h_constraint", "dynotears_objective",
@@ -295,15 +296,17 @@ class DynotearsModel:
         os.makedirs(save_dir, exist_ok=True)
         state = state if state is not None else self.state
         d_vars, p_orders, n = shape or (self.d_vars, self.p_orders, self.n)
-        with open(os.path.join(save_dir, "final_best_model.bin"), "wb") as f:
-            pickle.dump({"model_class": type(self).__name__,
-                         "config": self.config, "state": state,
-                         "d_vars": d_vars, "p_orders": p_orders,
-                         "n": n}, f)
-        with open(os.path.join(save_dir,
-                  "training_meta_data_and_hyper_parameters.pkl"), "wb") as f:
-            pickle.dump({"epoch": it, "val_avg_loss_history": val_history,
-                         "best_loss": best_loss, "best_it": best_it}, f)
+        # durable checkpoint writes (atomic + CRC + .prev), like the trainers
+        write_checkpoint(os.path.join(save_dir, "final_best_model.bin"),
+                         {"model_class": type(self).__name__,
+                          "config": self.config, "state": state,
+                          "d_vars": d_vars, "p_orders": p_orders,
+                          "n": n})
+        write_checkpoint(
+            os.path.join(save_dir,
+                         "training_meta_data_and_hyper_parameters.pkl"),
+            {"epoch": it, "val_avg_loss_history": val_history,
+             "best_loss": best_loss, "best_it": best_it})
 
     def fit(self, train_ds, val_ds, save_dir=None, max_data_iter=10,
             batch_size=32, num_iters_prior_to_stop=10, check_every=5,
@@ -386,8 +389,7 @@ class DynotearsVanillaModel:
         self.a_est = acc / (1.0 * num_nodes)
         if save_dir is not None:
             os.makedirs(save_dir, exist_ok=True)
-            with open(os.path.join(save_dir, "final_best_model.bin"),
-                      "wb") as f:
-                pickle.dump({"model_class": type(self).__name__,
-                             "config": self.config, "a_est": self.a_est}, f)
+            write_checkpoint(os.path.join(save_dir, "final_best_model.bin"),
+                             {"model_class": type(self).__name__,
+                              "config": self.config, "a_est": self.a_est})
         return self.a_est

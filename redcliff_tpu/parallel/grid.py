@@ -14,7 +14,7 @@ a compiled program; callers group points by shape and run one GridRun per group
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Any, Sequence
 
 import jax
@@ -26,7 +26,7 @@ from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
-from redcliff_tpu.runtime import faultinject
+from redcliff_tpu.runtime import faultinject, numerics
 from redcliff_tpu.runtime.preempt import Preempted, PreemptionGuard
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
@@ -96,9 +96,11 @@ class GridResult:
     val_history: np.ndarray    # (epochs, G) validation combo loss
     coeffs: dict
     active: np.ndarray = None  # (G,) bool; False = point early-stopped
-    # quarantined grid points: lanes whose validation loss went non-finite
-    # were frozen (skip further updates, rest of the grid keeps training);
-    # one {"point", "epoch", "hparams"} record each
+    # quarantined grid points: lanes whose validation loss went non-finite,
+    # or whose in-graph numerics guard skipped max_consecutive_skips steps in
+    # a row, were frozen (skip further updates, rest of the grid keeps
+    # training); one {"point", "epoch", "cause", "hparams"} record each,
+    # cause in {"nonfinite_grad", "nonfinite_val"}
     failures: list = field(default_factory=list)
 
 
@@ -139,6 +141,14 @@ class RedcliffGridRunner:
         self.coeffs = spec.stacked(model.config, train_config)
         self._need_gc = spec.needs_gc(model.config)
         self._need_gc_lagged = spec.needs_gc_lagged(model.config)
+        # numerics sentinel: per-lane in-graph non-finite guard + skip
+        # counters; a lane stuck past max_consecutive_skips is quarantined
+        # with cause "nonfinite_grad" (vs "nonfinite_val" for a validation
+        # blow-up with finite steps)
+        self._guard = (train_config.numerics is not None
+                       and train_config.numerics.enabled)
+        self._numerics_k = (train_config.numerics.max_consecutive_skips
+                            if self._guard else 0)
         # lr/eps handled per-point; scale_by_adam is shared
         self.optA = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.embed_eps)
         self.optB = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.gen_eps)
@@ -171,10 +181,12 @@ class RedcliffGridRunner:
     def _build(self):
         model = self.model
         need_gc, need_gc_lagged = self._need_gc, self._need_gc_lagged
+        guard = self._guard
 
         precision = self.tc.matmul_precision
 
-        def point_step(params, optA_state, optB_state, coeffs, active, X, Y, phase):
+        def point_step(params, optA_state, optB_state, nstate, coeffs, active,
+                       X, Y, phase):
             def loss_fn(p):
                 return model.loss_for_phase(
                     p, X, Y, phase, coeffs=coeffs,
@@ -184,16 +196,27 @@ class RedcliffGridRunner:
                 (combo, _), grads = jax.value_and_grad(loss_fn,
                                                        has_aux=True)(params)
 
+            # per-lane numerics guard: a non-finite loss/gradient makes this
+            # lane's update a no-op (SPMD stays uniform — compute runs, the
+            # result is discarded) and bumps its device-side skip counters
+            if guard:
+                gnorm = numerics.global_norm(grads)
+                ok = jnp.logical_and(jnp.isfinite(combo), jnp.isfinite(gnorm))
+                nstate = numerics.update_numerics_state(nstate, ok, gnorm,
+                                                        count=active)
+                gate = jnp.logical_and(active, ok)
+            else:
+                gate = active
+
             def apply_group(group, grads_g, opt, opt_state, lr, wd):
                 g = jax.tree.map(lambda gr, pa: gr + wd * pa, grads_g, params[group])
                 upd, new_state = opt.update(g, opt_state)
                 upd = jax.tree.map(lambda u: -lr * u, upd)
                 new_p = optax.apply_updates(params[group], upd)
-                # per-point early-stop lane mask: a converged point keeps its
-                # params/opt state unchanged (compute still runs — SPMD lanes
-                # stay uniform — but the update is discarded)
+                # per-point early-stop/numerics lane mask: a converged or
+                # guarded point keeps its params/opt state unchanged
                 keep = lambda n, o: jax.tree.map(
-                    lambda a, b: jnp.where(active, a, b), n, o)
+                    lambda a, b: jnp.where(gate, a, b), n, o)
                 return keep(new_p, params[group]), keep(new_state, opt_state)
 
             new = dict(params)
@@ -205,7 +228,7 @@ class RedcliffGridRunner:
                 new["factors"], optB_state = apply_group(
                     "factors", grads["factors"], self.optB, optB_state,
                     coeffs["gen_lr"], coeffs["gen_weight_decay"])
-            return new, optA_state, optB_state, combo
+            return new, optA_state, optB_state, nstate, combo
 
         def point_val(params, coeffs, X, Y):
             with matmul_precision_ctx(precision):
@@ -260,31 +283,34 @@ class RedcliffGridRunner:
         self._scan_steps = {}
         for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
             vstep = jax.vmap(
-                lambda p, a, b, c, act, X, Y, ph=phase: point_step(
-                    p, a, b, c, act, X, Y, ph),
-                in_axes=(0, 0, 0, 0, 0, None, None))
-            # donate params + opt states: they are consumed and rebound every
-            # step, so XLA can update them in place instead of round-tripping
-            # a second copy of the whole grid state through HBM
-            self._steps[phase] = jax.jit(vstep, donate_argnums=(0, 1, 2))
+                lambda p, a, b, ns, c, act, X, Y, ph=phase: point_step(
+                    p, a, b, ns, c, act, X, Y, ph),
+                in_axes=(0, 0, 0, 0, 0, 0, None, None))
+            # donate params + opt states + numerics counters: they are
+            # consumed and rebound every step, so XLA can update them in
+            # place instead of round-tripping a second copy of the whole
+            # grid state through HBM
+            self._steps[phase] = jax.jit(vstep, donate_argnums=(0, 1, 2, 3))
 
             # k-batch scanned variant: one dispatch drives lax.scan over k
             # pre-staged device-resident batches (Xs (k, B, T, C), Ys
             # (k, ...)), amortizing the per-step dispatch overhead that
             # dominates wall-clock at large G (BASELINE.md: ~0.24 ms/step
             # floor past G~64)
-            def scan_step(params, optA_state, optB_state, coeffs, active,
-                          Xs, Ys, _vstep=vstep):
+            def scan_step(params, optA_state, optB_state, nstate, coeffs,
+                          active, Xs, Ys, _vstep=vstep):
                 def body(carry, xy):
-                    p, a, b = carry
-                    p, a, b, combo = _vstep(p, a, b, coeffs, active, *xy)
-                    return (p, a, b), combo
+                    p, a, b, ns = carry
+                    p, a, b, ns, combo = _vstep(p, a, b, ns, coeffs, active,
+                                                *xy)
+                    return (p, a, b, ns), combo
 
-                (p, a, b), combos = jax.lax.scan(
-                    body, (params, optA_state, optB_state), (Xs, Ys))
-                return p, a, b, combos
+                (p, a, b, ns), combos = jax.lax.scan(
+                    body, (params, optA_state, optB_state, nstate), (Xs, Ys))
+                return p, a, b, ns, combos
 
-            self._scan_steps[phase] = jax.jit(scan_step, donate_argnums=(0, 1, 2))
+            self._scan_steps[phase] = jax.jit(scan_step,
+                                              donate_argnums=(0, 1, 2, 3))
 
         # Freeze-mode accept/revert choreography: the shared trainer logic
         # (train/freeze.py), vmapped over the grid axis
@@ -408,6 +434,10 @@ class RedcliffGridRunner:
             "lookback": tc.lookback,
             "scan_batches": tc.scan_batches,
             "max_iter": tc.max_iter,
+            # the numerics guard gates every update and decides lane
+            # quarantine, so a changed/disabled policy is a different fit
+            "numerics": (None if tc.numerics is None
+                         else asdict(tc.numerics)),
             "train_data": durable_ckpt.dataset_fingerprint(train_ds),
             "val_data": durable_ckpt.dataset_fingerprint(val_ds),
         }
@@ -479,6 +509,14 @@ class RedcliffGridRunner:
                 f"quarantine state); it cannot be resumed by this version — "
                 f"delete it (or finish the fit with the code that wrote it) "
                 f"and rerun.")
+        want_meta = dict(want_meta)
+        if "numerics" not in meta and want_meta.get("numerics") == asdict(
+                numerics.NumericsPolicy()):
+            # pre-sentinel checkpoint (no numerics key): the default guard
+            # does not change healthy-lane update math, so resuming it under
+            # the DEFAULT policy is sound (the loop backfills the sentinel
+            # state); resuming under a non-default policy still rejects
+            want_meta.pop("numerics")
         diff = ([k for k in want_meta if meta.get(k) != want_meta[k]]
                 + [k for k in meta if k not in want_meta])
         if diff:
@@ -508,9 +546,11 @@ class RedcliffGridRunner:
         the mismatching fields. While checkpointing is enabled, SIGTERM/
         SIGINT triggers one final checkpoint at the end of the in-flight
         epoch and raises :class:`~redcliff_tpu.runtime.preempt.Preempted`.
-        Grid points whose validation loss goes non-finite are quarantined
-        (lane frozen, recorded in ``GridResult.failures``) while the rest of
-        the grid keeps training. Because checkpoints store gathered host
+        Grid points whose validation loss goes non-finite — or whose
+        in-graph numerics guard reports max_consecutive_skips straight
+        non-finite-gradient steps — are quarantined (lane frozen, recorded
+        with a cause in ``GridResult.failures``) while the rest of the grid
+        keeps training. Because checkpoints store gathered host
         state, a fit may resume on a different (e.g. smaller) device mesh
         than the one that wrote the checkpoint."""
         # the guard wraps the whole fit so a signal during compile/data
@@ -557,6 +597,17 @@ class RedcliffGridRunner:
             val_history = list(ckpt["val_history"])
             aligned = ckpt["aligned"]
             failed_epoch = self._shard(jnp.asarray(ckpt["failed_epoch"]))
+            ns = ckpt.get("nstate")
+            nstate = (self._shard(jax.tree.map(jnp.asarray, ns))
+                      if ns is not None
+                      else self._shard(numerics.init_numerics_state(lanes=G)))
+            fc = ckpt.get("failed_cause")
+            if fc is None:
+                # pre-sentinel checkpoint: every already-quarantined lane was
+                # a validation-loss quarantine by construction
+                fc = np.where(np.asarray(ckpt["failed_epoch"]) >= 0,
+                              numerics.CAUSE_NONFINITE_VAL, 0).astype(np.int32)
+            failed_cause = self._shard(jnp.asarray(fc, jnp.int32))
             rng.bit_generator.state = ckpt["rng_state"]
             start_it = ckpt["epoch"] + 1
         else:
@@ -586,10 +637,13 @@ class RedcliffGridRunner:
             accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
             # per-point early-stop lane mask: converged points stop updating
             active = self._shard(jnp.ones((G,), dtype=bool))
-            # non-finite quarantine bookkeeping: epoch a lane's val loss went
-            # non-finite (-1 = healthy); quarantined lanes freeze like
-            # early-stopped ones but are reported as failures, not results
+            # non-finite quarantine bookkeeping: epoch a lane went bad
+            # (-1 = healthy) plus its cause code; quarantined lanes freeze
+            # like early-stopped ones but are reported as failures, not
+            # results. The numerics sentinel counters ride per-lane
             failed_epoch = self._shard(jnp.full((G,), -1, jnp.int32))
+            failed_cause = self._shard(jnp.zeros((G,), jnp.int32))
+            nstate = self._shard(numerics.init_numerics_state(lanes=G))
             val_history = []
             aligned = False
             start_it = 0
@@ -608,6 +662,10 @@ class RedcliffGridRunner:
                 params = self._shard(params)
                 aligned = True
             phases = self.phase_for_epoch(it)
+            # per-epoch skip baseline for quarantine-cause attribution
+            # (jnp.copy: the train steps donate nstate's buffers, so the
+            # original reference would be invalidated by the first dispatch)
+            epoch_skip_base = jnp.copy(nstate["skipped"])
             # device-resident batches (HBM copy + per-batch device gather),
             # replicated over the mesh; ArrayDataset itself falls back to
             # host numpy in multi-process runs
@@ -628,7 +686,7 @@ class RedcliffGridRunner:
                 # which would break jnp.stack's uniform shapes) and
                 # label-less batches take the per-batch step in order
                 phase = phases[0]
-                state = (params, optA_state, optB_state)
+                state = (params, optA_state, optB_state, nstate)
                 group = []
 
                 def run_group(state, group):
@@ -639,10 +697,10 @@ class RedcliffGridRunner:
                         Xs = jnp.stack([jnp.asarray(x) for x, _ in group])
                         Ys = jnp.stack([jnp.asarray(y) for _, y in group])
                         return self._scan_steps[phase](*state, coeffs, active,
-                                                       Xs, Ys)[:3]
+                                                       Xs, Ys)[:4]
                     for X, Y in group:
                         state = self._steps[phase](*state, coeffs, active,
-                                                   X, Y)[:3]
+                                                   X, Y)[:4]
                     return state
 
                 for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
@@ -650,19 +708,20 @@ class RedcliffGridRunner:
                         state = run_group(state, group)
                         group = []
                         state = self._steps[phase](*state, coeffs, active,
-                                                   X, Y)[:3]
+                                                   X, Y)[:4]
                         continue
                     group.append((X, Y))
                     if len(group) == k:
                         state = run_group(state, group)
                         group = []
                 state = run_group(state, group)
-                params, optA_state, optB_state = state
+                params, optA_state, optB_state, nstate = state
             else:
                 for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
                     for phase in phases:
-                        params, optA_state, optB_state, _ = self._steps[phase](
-                            params, optA_state, optB_state, coeffs, active, X, Y)
+                        params, optA_state, optB_state, nstate, _ = \
+                            self._steps[phase](params, optA_state, optB_state,
+                                               nstate, coeffs, active, X, Y)
                     if self._freeze_by_batch:
                         params, accepted = self._freeze_step(params, accepted)
             combo_sum = 0.0
@@ -684,16 +743,32 @@ class RedcliffGridRunner:
                     "val_fraction or dataset size")
             # keep per-epoch losses device-resident; one host transfer at the end
             val_history.append(combo_sum / n)
-            # graceful degradation: a point whose val loss went non-finite
-            # (diverged step, poisoned hyperparameters) is quarantined — its
-            # lane freezes via the active mask while the REST of the grid
-            # keeps training. Pure device compute (no host sync); the failed
-            # epochs surface in GridResult.failures and failures.json
-            finite = jnp.isfinite(val_history[-1])
-            failed_epoch = jnp.where(
-                jnp.logical_and(active, jnp.logical_not(finite)),
-                jnp.int32(it), failed_epoch)
-            active = jnp.logical_and(active, finite)
+            # graceful degradation: a point whose val loss went non-finite,
+            # OR whose in-graph guard skipped max_consecutive_skips steps in
+            # a row (the lane is stuck on poisoned gradients), is quarantined
+            # — its lane freezes via the active mask while the REST of the
+            # grid keeps training. Pure device compute (no host sync); the
+            # failed epochs + causes surface in GridResult.failures and
+            # failures.json
+            bad = jnp.logical_not(jnp.isfinite(val_history[-1]))
+            if self._guard:
+                bad = jnp.logical_or(
+                    bad, nstate["consecutive"] >= self._numerics_k)
+                # implicate gradients only when THIS epoch skipped steps: a
+                # transient skip epochs ago must not relabel a later pure
+                # validation blow-up as nonfinite_grad
+                grad_implicated = (nstate["skipped"] - epoch_skip_base) > 0
+            else:
+                grad_implicated = jnp.zeros_like(active)
+            newly_failed = jnp.logical_and(active, bad)
+            failed_epoch = jnp.where(newly_failed, jnp.int32(it), failed_epoch)
+            failed_cause = jnp.where(
+                newly_failed,
+                jnp.where(grad_implicated,
+                          jnp.int32(numerics.CAUSE_NONFINITE_GRAD),
+                          jnp.int32(numerics.CAUSE_NONFINITE_VAL)),
+                failed_cause)
+            active = jnp.logical_and(active, jnp.logical_not(bad))
             cfg = self.model.config
             if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
                 # per-point stopping criteria, the trainer's branches
@@ -755,11 +830,14 @@ class RedcliffGridRunner:
                 act_host = gather_to_host(active)
                 if logger.active or jax.process_count() > 1:
                     failed_host = gather_to_host(failed_epoch)
+                    skipped_host = np.asarray(
+                        gather_to_host(nstate["skipped"]))
                     logger.log("epoch", epoch=it, phases=list(phases),
                                val_combo_loss=gather_to_host(val_history[-1]),
                                best_criteria=gather_to_host(best_crit),
                                num_active=int(act_host.sum()),
-                               num_quarantined=int((failed_host >= 0).sum()))
+                               num_quarantined=int((failed_host >= 0).sum()),
+                               guarded_steps_skipped=int(skipped_host.sum()))
                 # global early exit: once EVERY lane has hit its per-point
                 # patience, further epochs are pure masked compute (the
                 # per-point trainer would have broken out of each run long
@@ -778,6 +856,7 @@ class RedcliffGridRunner:
                     "best_crit": best_crit, "best_epoch": best_epoch,
                     "active": active, "accepted": accepted,
                     "failed_epoch": failed_epoch,
+                    "failed_cause": failed_cause, "nstate": nstate,
                     "val_history": val_history, "aligned": aligned,
                     "rng_state": rng.bit_generator.state, "epoch": it,
                 }
@@ -821,9 +900,13 @@ class RedcliffGridRunner:
         final_epoch = gather_to_host(best_epoch)
         final_active = gather_to_host(active)
         final_failed = np.asarray(gather_to_host(failed_epoch))
+        final_cause = np.asarray(gather_to_host(failed_cause))
         failures = [{"point": int(g), "epoch": int(e),
+                     "cause": numerics.QUARANTINE_CAUSES.get(
+                         int(c), "nonfinite_val"),
                      "hparams": dict(self.spec.points[g])}
-                    for g, e in enumerate(final_failed) if e >= 0]
+                    for g, (e, c) in enumerate(zip(final_failed, final_cause))
+                    if e >= 0]
         logger.log("fit_end", best_epoch=final_epoch,
                    best_criteria=final_crit,
                    num_active=int(final_active.sum()),

@@ -17,6 +17,12 @@ this package does the same:
   the DCN dry run), with a fixed-schema outcome log;
 - :mod:`~redcliff_tpu.runtime.preempt` — SIGTERM/SIGINT capture that turns a
   preemption notice into a final checkpoint instead of lost work;
+- :mod:`~redcliff_tpu.runtime.numerics` — the numerics sentinel: in-graph
+  non-finite loss/gradient guards (``lax.cond`` inside the compiled step, no
+  per-step host sync), device-side skip counters, and the host-side
+  :class:`~redcliff_tpu.runtime.numerics.DivergenceMonitor` that rolls a
+  diverged fit back to its last good snapshot with the learning rate backed
+  off;
 - :mod:`~redcliff_tpu.runtime.faultinject` — fault-injection hooks + child
   fit used by tests/test_fault_injection.py to SIGKILL fits mid-run, corrupt
   checkpoints, and inject probe failures.
@@ -32,6 +38,16 @@ from redcliff_tpu.runtime.checkpoint import (  # noqa: F401
     quarantine,
     read_checkpoint,
     write_checkpoint,
+)
+from redcliff_tpu.runtime.numerics import (  # noqa: F401
+    DivergenceMonitor,
+    NumericsAction,
+    NumericsPolicy,
+    global_norm,
+    guarded_update,
+    init_numerics_state,
+    numerics_summary,
+    scale_learning_rate,
 )
 from redcliff_tpu.runtime.preempt import Preempted, PreemptionGuard  # noqa: F401
 from redcliff_tpu.runtime.retry import (  # noqa: F401

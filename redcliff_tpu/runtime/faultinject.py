@@ -18,6 +18,20 @@ comma-separated list of ``name:arg``:
   ``REDCLIFF_FAULT_MARKER`` at the end of epoch N (lets a parent process
   synchronize a SIGTERM with a known fit phase).
 
+Numerical fault points (consumed through :func:`poison_batch` /
+:func:`skip_update`, called by the trainers with a global step index; step
+specs are either one step ``"5"`` or an inclusive range ``"5-8"``):
+
+- ``nan_batch:SPEC`` — replace the training batch at the matching step(s)
+  with all-NaN input (the classic poisoned-batch event the in-graph
+  numerics guard must catch);
+- ``grad_blowup:SPEC`` — scale the batch by 1e30 so the loss/gradients
+  overflow to inf at the matching step(s) (exploding-gradient event);
+- ``skip_update:SPEC`` — make the trainer skip the parameter update for the
+  matching step(s) entirely. This is the *reference semantics* for the
+  guard: a guarded fit with ``nan_batch:K`` must end bit-identical to a
+  clean fit with ``skip_update:K``.
+
 jax is imported lazily: the module is importable by backend-free processes.
 """
 from __future__ import annotations
@@ -28,7 +42,8 @@ import pickle
 import signal
 import sys
 
-__all__ = ["crash_point", "corrupt_checkpoint", "flaky", "tiny_grid_fit"]
+__all__ = ["crash_point", "poison_batch", "skip_update", "corrupt_checkpoint",
+           "flaky", "tiny_grid_fit"]
 
 ENV_SPEC = "REDCLIFF_FAULT_INJECT"
 ENV_MARKER = "REDCLIFF_FAULT_MARKER"
@@ -60,6 +75,44 @@ def crash_point(stage, epoch=None):
             if marker and not os.path.exists(marker):
                 with open(marker, "w") as f:
                     f.write(str(epoch))
+
+
+def _step_match(spec, step):
+    """``"5"`` matches step 5; ``"5-8"`` matches steps 5..8 inclusive."""
+    lo, sep, hi = spec.partition("-")
+    if sep:
+        return int(lo) <= step <= int(hi)
+    return step == int(lo)
+
+
+def poison_batch(X, step):
+    """Numerical fault point: trainers pass every training batch through this
+    with their global step index. Inert (returns ``X`` untouched, one env
+    lookup) unless a ``nan_batch``/``grad_blowup`` fault matches ``step``."""
+    faults = _active_faults()
+    if not faults:
+        return X
+    import numpy as np
+
+    for name, arg in faults:
+        if name == "nan_batch" and _step_match(arg, step):
+            bad = np.array(X, dtype=np.float32, copy=True)
+            bad[...] = np.nan
+            return bad
+        if name == "grad_blowup" and _step_match(arg, step):
+            # 1e30 overflows the squared-error loss/grads to inf in f32
+            return np.array(X, dtype=np.float32) * np.float32(1e30)
+    return X
+
+
+def skip_update(step):
+    """True when a ``skip_update`` fault matches ``step`` — the trainer skips
+    the parameter update entirely (batch drawn, rng advanced). Reference
+    semantics for the in-graph guard's skip."""
+    for name, arg in _active_faults():
+        if name == "skip_update" and _step_match(arg, step):
+            return True
+    return False
 
 
 def corrupt_checkpoint(path, mode="truncate"):

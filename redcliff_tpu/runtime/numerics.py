@@ -1,0 +1,387 @@
+"""Numerics sentinel: in-graph non-finite guards + host-side divergence policy.
+
+PR 1 made the runtime survive the *machine* (preemption, torn checkpoints);
+this module makes it survive the *math*. REDCLIFF-S fits are long grid
+searches over proximal-regularized factor models whose losses go non-finite
+at hot learning rates, and before this module a single NaN batch silently
+poisoned ``params`` for every remaining step of an epoch — validation only
+noticed after the damage was done. Large-scale training systems keep this
+guard INSIDE the compiled step (cf. the TPU performance-model line of work:
+host-side syncs serialize the device stream), so:
+
+* :func:`guarded_update` wraps the optimizer-apply half of a train step in a
+  ``lax.cond`` on loss + global-gradient finiteness. A poisoned step is
+  skipped — params and optimizer state pass through untouched — and
+  device-side counters (total/consecutive skips, gradient-norm running
+  stats) are carried in a :func:`init_numerics_state` pytree. No per-step
+  host sync; the host reads the counters once per epoch.
+* :class:`NumericsPolicy` is the declarative knob set (skip thresholds,
+  divergence factor, learning-rate backoff, rollback/abort budgets).
+* :class:`DivergenceMonitor` is the host-side half: it snapshots the last
+  known-good (params, opt_state) each healthy epoch, and on K consecutive
+  in-graph skips or a validation-criteria blow-up past ``factor x best``
+  rolls the fit back to that snapshot with the learning rate backed off
+  (via :func:`scale_learning_rate` over ``optax.inject_hyperparams`` state).
+  When no good snapshot exists (the fit never produced a finite epoch) or
+  the rollback budget is spent, it aborts with a recorded cause instead of
+  burning the remaining epoch budget on garbage.
+
+Like the rest of :mod:`redcliff_tpu.runtime`, nothing here imports jax at
+module scope — bench.py's backend-free parent imports this package.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "NumericsPolicy", "NumericsAction", "DivergenceMonitor",
+    "init_numerics_state", "update_numerics_state", "guarded_update",
+    "global_norm", "numerics_summary", "reset_consecutive",
+    "scale_learning_rate", "CAUSE_NONFINITE_GRAD", "CAUSE_NONFINITE_VAL",
+    "QUARANTINE_CAUSES",
+]
+
+# grid-lane quarantine cause codes (device-side int32; decoded into
+# GridResult.failures / failures.json records)
+CAUSE_NONFINITE_GRAD = 1
+CAUSE_NONFINITE_VAL = 2
+QUARANTINE_CAUSES = {CAUSE_NONFINITE_GRAD: "nonfinite_grad",
+                     CAUSE_NONFINITE_VAL: "nonfinite_val"}
+
+
+@dataclass(frozen=True)
+class NumericsPolicy:
+    """Declarative numerical-fault policy shared by the trainers and the grid.
+
+    ``enabled=False`` removes the in-graph guard entirely (the step compiles
+    exactly as before). The proximal step keeps its configured learning rate
+    across backoffs — lr backoff applies to the gradient step only (the prox
+    scale is baked into the compiled step; re-jitting mid-fit would cost more
+    than the slightly-too-strong shrinkage).
+    """
+
+    enabled: bool = True
+    # K consecutive in-graph skipped steps => the fit is stuck on poisoned
+    # state; roll back (trainers) / quarantine the lane (grid)
+    max_consecutive_skips: int = 3
+    # validation criteria blowing past
+    # ``best + divergence_factor * max(|best|, divergence_atol)`` (best
+    # finite) is a divergence even when every step stayed finite; the
+    # absolute floor keeps near-zero best criteria (a well-converged fit)
+    # from turning routine noise into spurious rollbacks
+    divergence_factor: float = 10.0
+    divergence_atol: float = 1e-2
+    # learning-rate multiplier applied on each rollback
+    lr_backoff: float = 0.5
+    # rollbacks after which the fit aborts instead of thrashing
+    max_rollbacks: int = 3
+    # consecutive epochs of non-finite validation criteria (with no finite
+    # epoch ever seen) after which the fit aborts — the all-NaN stall that
+    # previously burned all of max_iter because ``best_it`` never set
+    max_nonfinite_epochs: int = 3
+
+
+@dataclass(frozen=True)
+class NumericsAction:
+    """Verdict of :meth:`DivergenceMonitor.check` for one epoch."""
+
+    kind: str          # "ok" | "rollback" | "abort"
+    cause: str | None = None
+
+
+# ---------------------------------------------------------------------------
+# in-graph half: finiteness guard + device-side counters
+# ---------------------------------------------------------------------------
+def init_numerics_state(lanes=None):
+    """Device-side sentinel counters; ``lanes=G`` makes every field per-lane
+    (the grid engine's layout), ``None`` keeps scalars (the trainers)."""
+    import jax.numpy as jnp
+
+    shape = () if lanes is None else (int(lanes),)
+    # one distinct buffer per field: the grid engine donates this dict to its
+    # train step, and donating one buffer aliased across fields is an error
+    z = lambda: jnp.zeros(shape, jnp.int32)
+    f = lambda: jnp.zeros(shape, jnp.float32)
+    return {
+        "skipped": z(),            # total guarded steps skipped
+        "consecutive": z(),        # current run of consecutive skips
+        "checked": z(),            # guarded steps seen
+        "grad_norm_last": f(),     # last observed global grad norm (may be inf)
+        "grad_norm_sum": f(),      # running sum of FINITE grad norms
+        "grad_norm_sq_sum": f(),   # ... and of their squares (for std)
+        "grad_norm_max": f(),      # max finite grad norm
+    }
+
+
+def global_norm(tree):
+    """Global L2 norm over every leaf of a gradient pytree (f32 accumulate).
+    Any non-finite leaf propagates to a non-finite norm, so one
+    ``isfinite`` on the result checks the whole tree."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [jnp.asarray(l) for l in jax.tree.leaves(tree)]
+    total = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(total)
+
+
+def update_numerics_state(state, ok, grad_norm, count=None):
+    """Advance the sentinel counters for one guarded step (jit-safe).
+
+    ``ok`` is the step's finiteness verdict; ``count`` optionally masks
+    which lanes actually trained this step (the grid's ``active`` mask —
+    frozen lanes neither accumulate skips nor reset their streak)."""
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(ok)
+    if count is None:
+        count = jnp.ones_like(ok)
+    count = jnp.asarray(count, bool)
+    counted_skip = jnp.logical_and(count, jnp.logical_not(ok))
+    finite_norm = jnp.where(jnp.isfinite(grad_norm), grad_norm, 0.0)
+    # stats cover exactly the APPLIED steps (count & ok), matching the
+    # (checked - skipped) denominator in numerics_summary — a skipped step
+    # with finite grads but NaN loss must not inflate the mean
+    seen = jnp.logical_and(count, ok)
+    return {
+        "skipped": state["skipped"] + counted_skip.astype(jnp.int32),
+        "consecutive": jnp.where(
+            count,
+            jnp.where(ok, 0, state["consecutive"] + 1),
+            state["consecutive"]),
+        "checked": state["checked"] + count.astype(jnp.int32),
+        "grad_norm_last": jnp.where(count, grad_norm,
+                                    state["grad_norm_last"]),
+        "grad_norm_sum": state["grad_norm_sum"]
+        + jnp.where(seen, finite_norm, 0.0),
+        "grad_norm_sq_sum": state["grad_norm_sq_sum"]
+        + jnp.where(seen, jnp.square(finite_norm), 0.0),
+        "grad_norm_max": jnp.maximum(
+            state["grad_norm_max"], jnp.where(seen, finite_norm, 0.0)),
+    }
+
+
+def reset_consecutive(state):
+    """Zero the consecutive-skip streak (host-side, after a rollback consumed
+    it — otherwise the restored fit would immediately re-trigger)."""
+    import jax.numpy as jnp
+
+    return dict(state, consecutive=jnp.zeros_like(state["consecutive"]))
+
+
+def guarded_update(state_tree, grads, loss, apply_fn, numerics_state):
+    """Apply ``apply_fn(state_tree)`` only when ``loss`` and the global
+    gradient norm are both finite — inside the compiled step, via
+    ``lax.cond`` so the skip branch pays for no optimizer math and there is
+    no host sync. Returns ``(new_state_tree, new_numerics_state, ok)``.
+
+    ``state_tree`` is whatever the caller's update consumes and rebinds
+    (params + optimizer state(s)); ``apply_fn`` closes over grads/batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gnorm = global_norm(grads)
+    ok = jnp.logical_and(jnp.isfinite(jnp.asarray(loss)),
+                         jnp.isfinite(gnorm))
+    new_tree = jax.lax.cond(ok, apply_fn, lambda t: t, state_tree)
+    return new_tree, update_numerics_state(numerics_state, ok, gnorm), ok
+
+
+def numerics_summary(numerics_state):
+    """One host transfer of the sentinel counters -> plain-python dict
+    (scalars for trainer state, lists for per-lane grid state)."""
+    host = {k: np.asarray(v) for k, v in numerics_state.items()}
+    checked = np.maximum(host["checked"] - host["skipped"], 1)
+    mean = host["grad_norm_sum"] / checked
+    var = np.maximum(host["grad_norm_sq_sum"] / checked - mean ** 2, 0.0)
+
+    def py(v):
+        v = np.asarray(v)
+        return v.item() if v.ndim == 0 else v.tolist()
+
+    return {
+        "skipped": py(host["skipped"]),
+        "consecutive": py(host["consecutive"]),
+        "checked": py(host["checked"]),
+        "grad_norm_last": py(host["grad_norm_last"].astype(np.float64)),
+        "grad_norm_mean": py(mean.astype(np.float64)),
+        "grad_norm_std": py(np.sqrt(var).astype(np.float64)),
+        "grad_norm_max": py(host["grad_norm_max"].astype(np.float64)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# host half: learning-rate backoff + rollback/abort policy
+# ---------------------------------------------------------------------------
+def scale_learning_rate(opt_state, factor):
+    """Multiply every ``optax.inject_hyperparams`` ``learning_rate`` found in
+    an optimizer-state tree by ``factor`` (recursing through namedtuples,
+    tuples, lists and dicts). States without injected hyperparams pass
+    through unchanged — callers need not know their optimizer's nesting."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if isinstance(hp, dict) and "learning_rate" in hp:
+        new_hp = dict(hp, learning_rate=hp["learning_rate"] * factor)
+        inner = scale_learning_rate(opt_state.inner_state, factor)
+        return opt_state._replace(hyperparams=new_hp, inner_state=inner)
+    if isinstance(opt_state, tuple) and hasattr(opt_state, "_fields"):
+        return type(opt_state)(*(scale_learning_rate(getattr(opt_state, f),
+                                                     factor)
+                                 for f in opt_state._fields))
+    if isinstance(opt_state, tuple):
+        return tuple(scale_learning_rate(s, factor) for s in opt_state)
+    if isinstance(opt_state, list):
+        return [scale_learning_rate(s, factor) for s in opt_state]
+    if isinstance(opt_state, dict):
+        return {k: scale_learning_rate(v, factor)
+                for k, v in opt_state.items()}
+    return opt_state
+
+
+def adopt_legacy_opt_state(opt, params, restored):
+    """Migrate an optimizer state checkpointed before the
+    ``inject_hyperparams`` change (a bare optax state with no
+    ``hyperparams`` wrapper) into the new state structure: a fresh template
+    from ``opt.init(params)`` carries the configured hyperparams (legacy
+    checkpoints stored no learning-rate state, so the configured rate is the
+    right one) and the restored moments become its ``inner_state``.
+    States already in the new structure pass through untouched."""
+    if hasattr(restored, "hyperparams"):
+        return restored
+    template = opt.init(params)
+    return template._replace(inner_state=restored)
+
+
+def current_learning_rates(opt_state):
+    """Every injected ``learning_rate`` in an optimizer-state tree, as
+    floats (for the ``numerics`` rollback event log)."""
+    out = []
+    hp = getattr(opt_state, "hyperparams", None)
+    if isinstance(hp, dict) and "learning_rate" in hp:
+        out.append(float(np.asarray(hp["learning_rate"])))
+        out.extend(current_learning_rates(opt_state.inner_state))
+        return out
+    if isinstance(opt_state, tuple):
+        for s in opt_state:
+            out.extend(current_learning_rates(s))
+    elif isinstance(opt_state, list):
+        for s in opt_state:
+            out.extend(current_learning_rates(s))
+    elif isinstance(opt_state, dict):
+        for s in opt_state.values():
+            out.extend(current_learning_rates(s))
+    return out
+
+
+class DivergenceMonitor:
+    """Host-side divergence policy for one fit.
+
+    Call :meth:`check` once per epoch with the epoch's
+    :func:`numerics_summary` and validation criteria; it returns a
+    :class:`NumericsAction`:
+
+    * ``ok`` — call :meth:`note_good` with the live state tree to refresh
+      the rollback snapshot;
+    * ``rollback`` — call :meth:`rollback` for the restored tree; any
+      ``optax.inject_hyperparams`` learning rates inside it come back
+      already backed off (compounding across consecutive rollbacks of the
+      same snapshot: the k-th restore of one snapshot applies
+      ``lr_backoff**k``, so repeated divergence keeps deepening the backoff
+      instead of resetting to the snapshot's original rate);
+    * ``abort`` — stop the fit and record ``action.cause``.
+
+    Divergence triggers: ``consecutive >= policy.max_consecutive_skips``
+    (the in-graph guard is skipping everything — cause ``nonfinite_grad``);
+    a finite criteria blowing past
+    ``best + divergence_factor * max(|best|, divergence_atol)`` (cause
+    ``divergence``); or criteria going non-finite after a finite best was
+    seen (cause ``nonfinite_val``). A fit whose criteria was NEVER finite
+    aborts after ``max_nonfinite_epochs`` epochs (cause
+    ``all_nonfinite_validation``) instead of stalling to max_iter.
+    """
+
+    def __init__(self, policy: NumericsPolicy):
+        self.policy = policy
+        self.rollbacks = 0
+        self.lr_scale = 1.0
+        self.best = math.inf
+        self.snapshot_epoch = None
+        self._snapshot = None
+        self._snapshot_rollbacks = 0
+        self._nonfinite_epochs = 0
+
+    # -- snapshots ---------------------------------------------------------
+    def note_good(self, epoch, state_tree):
+        """Record ``state_tree`` (any pytree of arrays) as the rollback
+        target. Copied to host numpy so donated device buffers can never
+        invalidate it."""
+        import jax
+
+        self._snapshot = jax.tree.map(
+            lambda x: np.array(x) if hasattr(x, "ndim") else x, state_tree)
+        self.snapshot_epoch = epoch
+        # the snapshot embeds its own (possibly already-backed-off) learning
+        # rate; remember its rollback generation so repeated restores of the
+        # SAME snapshot keep compounding instead of resetting
+        self._snapshot_rollbacks = self.rollbacks
+
+    def rollback(self):
+        """Return the last known-good tree (device arrays) with injected
+        learning rates backed off, consuming one unit of the rollback
+        budget."""
+        import jax
+        import jax.numpy as jnp
+
+        assert self._snapshot is not None, "rollback without a snapshot"
+        self.rollbacks += 1
+        self.lr_scale *= self.policy.lr_backoff
+        # a rolled-back fit starts a fresh divergence observation window
+        self._nonfinite_epochs = 0
+        restored = jax.tree.map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+            self._snapshot)
+        factor = self.policy.lr_backoff ** (self.rollbacks
+                                            - self._snapshot_rollbacks)
+        return scale_learning_rate(restored, factor)
+
+    # -- the per-epoch verdict --------------------------------------------
+    def _diverge_action(self, cause):
+        if self._snapshot is None or self.rollbacks >= self.policy.max_rollbacks:
+            return NumericsAction("abort", cause)
+        return NumericsAction("rollback", cause)
+
+    def check(self, epoch, numerics, criteria) -> NumericsAction:
+        """``numerics`` is :func:`numerics_summary` output (scalar layout);
+        ``criteria`` is this epoch's validation criteria, or None when the
+        fit phase defines no criteria yet (pretrain epochs)."""
+        del epoch
+        if numerics is not None and (
+                numerics["consecutive"] >= self.policy.max_consecutive_skips):
+            # _diverge_action aborts when no good epoch exists to roll back to
+            return self._diverge_action("nonfinite_grad")
+        if criteria is None:
+            return NumericsAction("ok")
+        crit = float(criteria)
+        if not math.isfinite(crit):
+            if math.isfinite(self.best):
+                return self._diverge_action("nonfinite_val")
+            self._nonfinite_epochs += 1
+            if self._nonfinite_epochs >= self.policy.max_nonfinite_epochs:
+                return NumericsAction("abort", "all_nonfinite_validation")
+            return NumericsAction("ok")
+        self._nonfinite_epochs = 0
+        if math.isfinite(self.best):
+            # blow-up threshold, continuous in best: an excursion of
+            # factor x the criteria's own scale (floored by divergence_atol
+            # so near-zero and negative best — cosine-dominated criteria —
+            # keep a meaningful, non-degenerate trigger)
+            f = self.policy.divergence_factor
+            threshold = self.best + f * max(abs(self.best),
+                                            self.policy.divergence_atol)
+            if crit > threshold:
+                return self._diverge_action("divergence")
+        self.best = min(self.best, crit)
+        return NumericsAction("ok")

@@ -168,11 +168,14 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     fingerprint, and SIGTERM/SIGINT triggers a final checkpoint
     (runtime/preempt.py) before raising ``Preempted``.
 
-    Graceful degradation: grid points whose validation loss goes non-finite
-    are quarantined (lane frozen; the rest of the grid keeps training) and
-    recorded to ``failures.json`` in ``run_dir`` (default: checkpoint_dir) —
-    one {"point", "epoch", "hparams"} record per quarantined point, plus the
-    run context. No file is written when the run has no failures.
+    Graceful degradation: grid points whose validation loss goes non-finite,
+    or whose in-graph numerics guard reports a stuck lane (consecutive
+    non-finite gradients), are quarantined (lane frozen; the rest of the
+    grid keeps training) and recorded to ``failures.json`` in ``run_dir``
+    (default: checkpoint_dir) — one {"point", "epoch", "cause", "hparams"}
+    record per quarantined point (cause: ``nonfinite_grad`` vs
+    ``nonfinite_val``), plus the run context. No file is written when the
+    run has no failures.
     """
     import jax
 

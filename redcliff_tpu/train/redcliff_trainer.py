@@ -20,8 +20,7 @@ functional trainer:
 from __future__ import annotations
 
 import os
-import pickle
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +28,9 @@ import numpy as np
 import optax
 
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
+from redcliff_tpu.runtime import checkpoint as durable_ckpt
+from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
@@ -69,6 +71,10 @@ class RedcliffTrainConfig:
     # overhead at large G); <= 1 keeps the one-dispatch-per-batch path.
     # Ignored in FreezeByBatch modes (accept/revert runs between batches)
     scan_batches: int = 0
+    # numerical fault policy (in-graph non-finite skip guard; divergence
+    # rollback + lr backoff in the per-point trainer, per-lane quarantine
+    # causes in the grid engine); None disables the sentinel
+    numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
 
 
 @dataclass
@@ -79,16 +85,25 @@ class RedcliffFitResult:
     histories: dict
     tracker: GCProgressTracker
     final_val_loss: float
+    # non-None when the numerics sentinel aborted the fit (recorded cause,
+    # e.g. "all_nonfinite_validation")
+    aborted: str | None = None
 
 
 def _torch_style_adam(lr, eps, weight_decay):
-    """torch.optim.Adam semantics: weight decay added to the gradient BEFORE the
-    moment updates (coupled, not AdamW)."""
-    chain = []
-    if weight_decay > 0:
-        chain.append(optax.add_decayed_weights(weight_decay))
-    chain.append(optax.adam(lr, b1=0.9, b2=0.999, eps=eps))
-    return optax.chain(*chain)
+    """torch.optim.Adam semantics: weight decay added to the gradient BEFORE
+    the moment updates (coupled, not AdamW). Wrapped in
+    ``optax.inject_hyperparams`` so the learning rate lives in the optimizer
+    STATE and the DivergenceMonitor can back it off without recompiling."""
+
+    def make(learning_rate):
+        chain = []
+        if weight_decay > 0:
+            chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(optax.adam(learning_rate, b1=0.9, b2=0.999, eps=eps))
+        return optax.chain(*chain)
+
+    return optax.inject_hyperparams(make)(learning_rate=lr)
 
 
 class RedcliffTrainer:
@@ -99,6 +114,7 @@ class RedcliffTrainer:
                                       config.embed_weight_decay)
         self.optB = _torch_style_adam(config.gen_lr, config.gen_eps,
                                       config.gen_weight_decay)
+        self._guard = config.numerics is not None and config.numerics.enabled
         self._steps = {}
         self._build_steps()
 
@@ -112,34 +128,50 @@ class RedcliffTrainer:
 
         precision = self.config.matmul_precision
 
+        guard = self._guard
+
         def make_step(phase):
-            def step(params, optA_state, optB_state, X, Y):
+            def step(params, optA_state, optB_state, X, Y, nstate):
                 with matmul_precision_ctx(precision):
                     (combo, parts), grads = jax.value_and_grad(
                         lambda p: model.loss_for_phase(p, X, Y, phase),
                         has_aux=True,
                     )(params)
-                if phase == "embedder_pretrain":
-                    upd, optA_state = self.optA.update(
-                        grads["embedder"], optA_state, params["embedder"])
-                    params = dict(params,
-                                  embedder=optax.apply_updates(params["embedder"], upd))
-                elif phase in ("factor_pretrain", "post_train"):
-                    upd, optB_state = self.optB.update(
-                        grads["factors"], optB_state, params["factors"])
-                    params = dict(params,
-                                  factors=optax.apply_updates(params["factors"], upd))
-                else:  # combined
-                    updA, optA_state = self.optA.update(
-                        grads["embedder"], optA_state, params["embedder"])
-                    updB, optB_state = self.optB.update(
-                        grads["factors"], optB_state, params["factors"])
-                    params = dict(
-                        params,
-                        embedder=optax.apply_updates(params["embedder"], updA),
-                        factors=optax.apply_updates(params["factors"], updB),
-                    )
-                return params, optA_state, optB_state, combo, parts
+
+                def apply(tree):
+                    params, optA_state, optB_state = tree
+                    if phase == "embedder_pretrain":
+                        upd, optA_state = self.optA.update(
+                            grads["embedder"], optA_state, params["embedder"])
+                        params = dict(params,
+                                      embedder=optax.apply_updates(params["embedder"], upd))
+                    elif phase in ("factor_pretrain", "post_train"):
+                        upd, optB_state = self.optB.update(
+                            grads["factors"], optB_state, params["factors"])
+                        params = dict(params,
+                                      factors=optax.apply_updates(params["factors"], upd))
+                    else:  # combined
+                        updA, optA_state = self.optA.update(
+                            grads["embedder"], optA_state, params["embedder"])
+                        updB, optB_state = self.optB.update(
+                            grads["factors"], optB_state, params["factors"])
+                        params = dict(
+                            params,
+                            embedder=optax.apply_updates(params["embedder"], updA),
+                            factors=optax.apply_updates(params["factors"], updB),
+                        )
+                    return params, optA_state, optB_state
+
+                tree = (params, optA_state, optB_state)
+                if guard:
+                    # numerics sentinel: skip the whole two-optimizer update
+                    # in-graph when the loss or any gradient is non-finite
+                    tree, nstate, _ = numerics.guarded_update(
+                        tree, grads, combo, apply, nstate)
+                else:
+                    tree = apply(tree)
+                params, optA_state, optB_state = tree
+                return params, optA_state, optB_state, combo, parts, nstate
 
             return jax.jit(step)
 
@@ -258,9 +290,12 @@ class RedcliffTrainer:
         aligned = False
 
         ckpt_path = os.path.join(save_dir, "trainer_checkpoint.pkl") if save_dir else None
-        if resume and ckpt_path and os.path.exists(ckpt_path):
-            with open(ckpt_path, "rb") as f:
-                ck = pickle.load(f)
+        ck = None
+        if resume and ckpt_path:
+            # durable load: CRC-verified, corrupt generations quarantined to
+            # *.bad with .prev fallback; legacy raw pickles still read
+            ck, _src = durable_ckpt.load_checkpoint(ckpt_path)
+        if ck is not None:
             params = jax.tree.map(jnp.asarray, ck["params"])
             best_params = jax.tree.map(jnp.asarray, ck["best_params"])
             accepted = jax.tree.map(jnp.asarray, ck["accepted"])
@@ -268,6 +303,12 @@ class RedcliffTrainer:
                 lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, ck["optA_state"])
             optB_state = jax.tree.map(
                 lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, ck["optB_state"])
+            # checkpoints from before the inject_hyperparams migration hold
+            # bare chain states; wrap them so resume keeps working
+            optA_state = numerics.adopt_legacy_opt_state(
+                self.optA, params["embedder"], optA_state)
+            optB_state = numerics.adopt_legacy_opt_state(
+                self.optB, params["factors"], optB_state)
             histories = ck["histories"]
             best_it, best_loss = ck["best_it"], ck["best_loss"]
             iter_start = ck["epoch"] + 1
@@ -295,114 +336,183 @@ class RedcliffTrainer:
                 optA_state = jax.tree.map(put(rep), optA_state)
 
         last_it = iter_start - 1
+        policy = tc.numerics if self._guard else None
+        monitor = (numerics.DivergenceMonitor(policy)
+                   if policy is not None else None)
+        nstate = numerics.init_numerics_state()
+        prev_skipped = 0
+        step_counter = 0
+        aborted = None
         logger = MetricLogger(save_dir)
-        logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
-                   train_config=tc, resume_epoch=iter_start)
-        for it in range(iter_start, tc.max_iter):
-            last_it = it
-            # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
-            if (not aligned and "pretrain_factor" in mode
-                    and it == cfg.num_pretrain_epochs and cfg.num_supervised_factors > 0):
-                params = self.align_factors_with_labels(params, train_ds)
-                aligned = True
+        # try/finally: an exception mid-fit must still close the jsonl
+        # handle (otherwise buffered context is lost and the fd leaks)
+        try:
+            logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
+                       train_config=tc, resume_epoch=iter_start)
+            for it in range(iter_start, tc.max_iter):
+                last_it = it
+                # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
+                if (not aligned and "pretrain_factor" in mode
+                        and it == cfg.num_pretrain_epochs and cfg.num_supervised_factors > 0):
+                    params = self.align_factors_with_labels(params, train_ds)
+                    aligned = True
 
-            phases = self.phase_for_epoch(it)
-            conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
-                        if cfg.num_supervised_factors > 0 else None)
+                phases = self.phase_for_epoch(it)
+                conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
+                            if cfg.num_supervised_factors > 0 else None)
 
-            # device-resident batches when the dataset supports them; plain
-            # call otherwise so duck-typed batches() implementations work
-            dev_kw = ({"device": True}
-                      if getattr(train_ds, "supports_device_batches", False)
-                      else {})
-            for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
-                for phase in phases:
-                    params, optA_state, optB_state, _, _ = self._steps[phase](
-                        params, optA_state, optB_state, X, Y)
-                    if conf_mat is not None and phase in ("embedder_pretrain", "combined"):
-                        conf_mat += self._confusion(params, X, Y)
-                if freeze_by_batch:
-                    params, accepted = self._apply_freeze(params, accepted)
+                # device-resident batches when the dataset supports them; plain
+                # call otherwise so duck-typed batches() implementations work
+                dev_kw = ({"device": True}
+                          if getattr(train_ds, "supports_device_batches", False)
+                          else {})
+                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                    X = faultinject.poison_batch(X, step_counter)
+                    skip = faultinject.skip_update(step_counter)
+                    step_counter += 1
+                    if skip:
+                        continue
+                    for phase in phases:
+                        params, optA_state, optB_state, _, _, nstate = \
+                            self._steps[phase](params, optA_state, optB_state,
+                                               X, Y, nstate)
+                        if conf_mat is not None and phase in ("embedder_pretrain", "combined"):
+                            conf_mat += self._confusion(params, X, Y)
+                    if freeze_by_batch:
+                        params, accepted = self._apply_freeze(params, accepted)
 
-            if conf_mat is not None and conf_mat.sum() > 0:
-                self._append_conf_stats(conf_mat, histories, "train")
+                if conf_mat is not None and conf_mat.sum() > 0:
+                    self._append_conf_stats(conf_mat, histories, "train")
 
-            # per-epoch GC tracking on the first val batch (ref :1349-1403)
-            if tracker is not None:
-                self._epoch_gc_tracking(params, val_ds, tracker)
+                # per-epoch GC tracking on the first val batch (ref :1349-1403)
+                if tracker is not None:
+                    self._epoch_gc_tracking(params, val_ds, tracker)
 
-            val = self.validate(params, val_ds, histories)
-            histories["avg_forecasting_loss"].append(val["forecasting_loss"])
-            histories["avg_factor_loss"].append(val["factor_loss"])
-            histories["avg_factor_cos_sim_penalty"].append(val["factor_cos_sim_penalty"])
-            histories["avg_fw_l1_penalty"].append(val["fw_l1_penalty"])
-            histories["avg_adj_penalty"].append(val["adj_l1_penalty"])
-            histories["avg_fw_smoothing_penalty"].append(val.get("fw_smoothing_penalty", 0.0))
-            histories["avg_combo_loss"].append(val["combo_loss"])
+                val = self.validate(params, val_ds, histories)
+                histories["avg_forecasting_loss"].append(val["forecasting_loss"])
+                histories["avg_factor_loss"].append(val["factor_loss"])
+                histories["avg_factor_cos_sim_penalty"].append(val["factor_cos_sim_penalty"])
+                histories["avg_fw_l1_penalty"].append(val["fw_l1_penalty"])
+                histories["avg_adj_penalty"].append(val["adj_l1_penalty"])
+                histories["avg_fw_smoothing_penalty"].append(val.get("fw_smoothing_penalty", 0.0))
+                histories["avg_combo_loss"].append(val["combo_loss"])
 
-            # early stopping (ref :1466-1538)
-            criteria = None
-            stop_early = False
-            if it >= cfg.num_pretrain_epochs + cfg.num_acclimation_epochs:
-                cos_mean = tracker.latest_mean_supervised_cosine() if tracker else 0.0
-                if cfg.num_supervised_factors > 1:
-                    criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
-                                + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
-                                + tc.stopping_criteria_cosSim_coeff * cos_mean)
-                elif cfg.num_supervised_factors == 1:
-                    criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
-                                + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"])
-                else:
-                    criteria = tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
+                # stopping criteria (ref :1466-1538) — computed BEFORE any
+                # best/freeze bookkeeping so the numerics sentinel can veto a
+                # diverged epoch outright
+                criteria = None
+                stop_early = False
+                past_pretrain = (it >= cfg.num_pretrain_epochs
+                                 + cfg.num_acclimation_epochs)
+                if past_pretrain:
+                    cos_mean = tracker.latest_mean_supervised_cosine() if tracker else 0.0
+                    if cfg.num_supervised_factors > 1:
+                        criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
+                                    + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
+                                    + tc.stopping_criteria_cosSim_coeff * cos_mean)
+                    elif cfg.num_supervised_factors == 1:
+                        criteria = (tc.stopping_criteria_factor_coeff * val["factor_loss"]
+                                    + tc.stopping_criteria_forecast_coeff * val["forecasting_loss"])
+                    else:
+                        criteria = tc.stopping_criteria_forecast_coeff * val["forecasting_loss"]
 
-                if freeze:
-                    params, accepted = self._apply_freeze(params, accepted)
-                    if criteria < best_loss:
-                        best_loss = criteria
-                        best_it = it
-                    elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
-                        # deliberate deviation: the reference's Freeze-mode
-                        # stop rule (ref :1510-1515) is inert because the
-                        # factor-status update above it is debug-disabled
-                        # (ref :1490 "FOR DEBUGGING"), so Freeze runs always
-                        # hit max_iter; we apply the standard lookback rule
-                        # in all modes so Freeze runs terminate too
-                        if tc.verbose:
-                            print("Stopping early")
-                        stop_early = True
-                    best_params = accepted
-                else:
-                    if criteria < best_loss:
-                        best_loss = criteria
+                # numerics sentinel: anomaly accounting + rollback/abort
+                # verdict for this epoch (all phases route through the same
+                # guarded steps, so the counters cover every phase)
+                rolled_back = False
+                if monitor is not None:
+                    nhost = numerics.numerics_summary(nstate)
+                    if nhost["skipped"] > prev_skipped:
+                        logger.log("anomaly", epoch=it, cause="nonfinite_grad",
+                                   epoch_skipped_steps=nhost["skipped"]
+                                   - prev_skipped, **nhost)
+                    prev_skipped = nhost["skipped"]
+                    action = monitor.check(
+                        it, nhost,
+                        None if criteria is None else float(criteria))
+                    if action.kind == "rollback":
+                        # rollback() returns the snapshot with both injected
+                        # learning rates already backed off (compounding
+                        # across repeated rollbacks of the same snapshot)
+                        snap = monitor.rollback()
+                        params = snap["params"]
+                        accepted = snap["accepted"]
+                        optA_state = snap["optA_state"]
+                        optB_state = snap["optB_state"]
+                        nstate = numerics.reset_consecutive(nstate)
+                        logger.log(
+                            "numerics", kind="rollback", epoch=it,
+                            cause=action.cause,
+                            restored_epoch=monitor.snapshot_epoch,
+                            lr_scale=monitor.lr_scale,
+                            learning_rates=numerics.current_learning_rates(
+                                (optA_state, optB_state)),
+                            rollbacks=monitor.rollbacks)
+                        rolled_back = True
+                    elif action.kind == "abort":
+                        aborted = action.cause
+                        logger.log("numerics", kind="abort", epoch=it,
+                                   cause=action.cause, **nhost)
+                    elif criteria is None or np.isfinite(criteria):
+                        monitor.note_good(
+                            it, {"params": params, "accepted": accepted,
+                                 "optA_state": optA_state,
+                                 "optB_state": optB_state})
+
+                if not rolled_back and aborted is None:
+                    if past_pretrain:
+                        if freeze:
+                            params, accepted = self._apply_freeze(params, accepted)
+                            if criteria < best_loss:
+                                best_loss = criteria
+                                best_it = it
+                            elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
+                                # deliberate deviation: the reference's Freeze-mode
+                                # stop rule (ref :1510-1515) is inert because the
+                                # factor-status update above it is debug-disabled
+                                # (ref :1490 "FOR DEBUGGING"), so Freeze runs always
+                                # hit max_iter; we apply the standard lookback rule
+                                # in all modes so Freeze runs terminate too
+                                if tc.verbose:
+                                    print("Stopping early")
+                                stop_early = True
+                            best_params = accepted
+                        else:
+                            if criteria < best_loss:
+                                best_loss = criteria
+                                best_it = it
+                                best_params = params
+                            elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
+                                if tc.verbose:
+                                    print("Stopping early")
+                                stop_early = True
+                    else:
                         best_it = it
                         best_params = params
-                    elif best_it is not None and (it - best_it) == tc.lookback * tc.check_every:
-                        if tc.verbose:
-                            print("Stopping early")
-                        stop_early = True
-            else:
-                best_it = it
-                best_params = params
 
-            # log before honoring the early stop so the stopping epoch's
-            # record (criteria included) lands in metrics.jsonl
-            logger.log("epoch", epoch=it, phases=list(phases), criteria=criteria,
-                       **val, **(tracker.latest_as_dict() if tracker else {}))
-            if stop_early:
-                break
+                # log before honoring the early stop so the stopping epoch's
+                # record (criteria included) lands in metrics.jsonl
+                logger.log("epoch", epoch=it, phases=list(phases), criteria=criteria,
+                           **val, **(tracker.latest_as_dict() if tracker else {}))
+                if stop_early or aborted is not None:
+                    break
+                if rolled_back:
+                    continue  # the restored epoch takes no best/ckpt updates
 
-            if it % tc.check_every == 0 and save_dir:
-                self._save_checkpoint(save_dir, it, best_params, accepted, params,
-                                      optA_state, optB_state, histories, best_it,
-                                      best_loss, tracker, aligned)
-            if tc.verbose and it % max(1, tc.check_every) == 0:
-                print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
+                if it % tc.check_every == 0 and save_dir:
+                    self._save_checkpoint(save_dir, it, best_params, accepted, params,
+                                          optA_state, optB_state, histories, best_it,
+                                          best_loss, tracker, aligned)
+                if tc.verbose and it % max(1, tc.check_every) == 0:
+                    print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
 
-        final_val = self.validate(best_params, val_ds, None)
-        logger.log("fit_end", best_it=best_it if best_it is not None else 0,
-                   best_loss=float(best_loss),
-                   final_val_loss=final_val["combo_loss"])
-        logger.close()
+            final_val = self.validate(best_params, val_ds, None)
+            logger.log("fit_end", best_it=best_it if best_it is not None else 0,
+                       best_loss=float(best_loss),
+                       final_val_loss=final_val["combo_loss"],
+                       aborted=aborted)
+        finally:
+            logger.close()
         if save_dir:
             self._save_checkpoint(save_dir, last_it, best_params, accepted, params,
                                   optA_state, optB_state, histories, best_it,
@@ -410,7 +520,7 @@ class RedcliffTrainer:
         return RedcliffFitResult(
             params=best_params, best_it=best_it if best_it is not None else 0,
             best_loss=float(best_loss), histories=histories, tracker=tracker,
-            final_val_loss=final_val["combo_loss"],
+            final_val_loss=final_val["combo_loss"], aborted=aborted,
         )
 
     # ----------------------------------------------------------------- helpers
@@ -504,23 +614,29 @@ class RedcliffTrainer:
     def _save_checkpoint(self, save_dir, it, best_params, accepted, params,
                          optA_state, optB_state, histories, best_it, best_loss,
                          tracker, aligned):
+        # all three artifacts ride the durable checkpoint writer (atomic
+        # tmp+replace, CRC header, .prev generation): a preemption mid-write
+        # can no longer tear the resume state
         os.makedirs(save_dir, exist_ok=True)
-        with open(os.path.join(save_dir, "final_best_model.bin"), "wb") as f:
-            pickle.dump({
+        durable_ckpt.write_checkpoint(
+            os.path.join(save_dir, "final_best_model.bin"),
+            {
                 "model_class": "RedcliffSCMLP",
                 "config": self.model.config,
                 "params": jax.tree.map(np.asarray, best_params),
-            }, f)
+            })
         meta = {"epoch": it, "best_loss": float(best_loss), "best_it": best_it,
                 **histories}
         if tracker is not None:
             meta.update(tracker.as_dict())
-        with open(os.path.join(save_dir, "training_meta_data_and_hyper_parameters.pkl"), "wb") as f:
-            pickle.dump(meta, f)
+        durable_ckpt.write_checkpoint(
+            os.path.join(save_dir,
+                         "training_meta_data_and_hyper_parameters.pkl"), meta)
         to_np = lambda t: jax.tree.map(
             lambda x: np.asarray(x) if isinstance(x, jnp.ndarray) else x, t)
-        with open(os.path.join(save_dir, "trainer_checkpoint.pkl"), "wb") as f:
-            pickle.dump({
+        durable_ckpt.write_checkpoint(
+            os.path.join(save_dir, "trainer_checkpoint.pkl"),
+            {
                 "epoch": it,
                 "params": to_np(params),
                 "best_params": to_np(best_params),
@@ -532,4 +648,4 @@ class RedcliffTrainer:
                 "best_loss": float(best_loss),
                 "aligned": aligned,
                 "tracker_state": None if tracker is None else dict(tracker.__dict__),
-            }, f)
+            })

@@ -13,11 +13,17 @@ dgcnn.py:122-199, ...). This build factors that into one functional trainer:
 
 Checkpoints fix the reference's no-optimizer-resume gap
 (ref redcliff_s_cmlp.py:245): optimizer state is saved and restored exactly.
+All checkpoint artifacts are written through the durable
+:mod:`redcliff_tpu.runtime.checkpoint` format (atomic + CRC + ``.prev``
+generation); :func:`load_model` reads both the durable format and legacy
+headerless pickles. The train step carries the numerics sentinel
+(:mod:`redcliff_tpu.runtime.numerics`): non-finite loss/gradients skip the
+update in-graph, and the host-side DivergenceMonitor rolls back / backs off
+the learning rate / aborts per the configured :class:`NumericsPolicy`.
 """
 from __future__ import annotations
 
 import os
-import pickle
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -26,6 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu.runtime import checkpoint as durable_ckpt
+from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 
@@ -44,6 +53,9 @@ class TrainConfig:
     prox_lam: float = 0.0
     verbose: int = 0
     profile_dir: str | None = None  # opt-in jax.profiler trace output dir
+    # numerical fault policy (in-graph skip guard + divergence rollback);
+    # None disables the sentinel entirely
+    numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
 
 
 @dataclass
@@ -54,10 +66,16 @@ class FitResult:
     histories: dict
     tracker: GCProgressTracker | None
     final_val_loss: float
+    # non-None when the numerics sentinel aborted the fit (e.g.
+    # "all_nonfinite_validation"); params are still best_params — the
+    # best-criteria iterate seen, which is the initial params when no
+    # epoch's criteria ever went finite
+    aborted: str | None = None
 
 
 def save_model(save_dir, model, params, extra=None):
-    """Persist {config, params} under the reference's artifact name."""
+    """Persist {config, params} under the reference's artifact name (durable
+    checkpoint format: atomic write, CRC header, ``.prev`` generation)."""
     os.makedirs(save_dir, exist_ok=True)
     payload = {
         "model_class": type(model).__name__,
@@ -66,16 +84,18 @@ def save_model(save_dir, model, params, extra=None):
     }
     if extra:
         payload.update(extra)
-    with open(os.path.join(save_dir, "final_best_model.bin"), "wb") as f:
-        pickle.dump(payload, f)
+    durable_ckpt.write_checkpoint(
+        os.path.join(save_dir, "final_best_model.bin"), payload)
 
 
 def load_model(save_dir_or_file):
+    """Read a model artifact — durable-format and legacy raw-pickle files
+    both load (the runtime reader falls back on unpickling when the CRC
+    header is absent)."""
     path = save_dir_or_file
     if os.path.isdir(path):
         path = os.path.join(path, "final_best_model.bin")
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    return durable_ckpt.read_checkpoint(path)
 
 
 class Trainer:
@@ -83,7 +103,12 @@ class Trainer:
         self.model = model
         self.config = config
         self.has_labels = has_labels
-        self.optimizer = optax.adam(config.learning_rate)
+        # inject_hyperparams makes the learning rate part of the optimizer
+        # STATE, so the DivergenceMonitor can back it off on rollback without
+        # recompiling the step
+        self.optimizer = optax.inject_hyperparams(optax.adam)(
+            learning_rate=config.learning_rate)
+        self._guard = config.numerics is not None and config.numerics.enabled
         self._build_steps()
 
     def _build_steps(self):
@@ -98,15 +123,30 @@ class Trainer:
                 return model.loss(params, X, rng=rng)
             return model.loss(params, X)
 
-        def train_step(params, opt_state, X, Y, rng):
+        guard = self._guard
+
+        def train_step(params, opt_state, X, Y, rng, nstate):
             (combo, parts), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, X, Y, rng)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            if cfg.prox_penalty is not None:
-                params = model.apply_prox(params, cfg.prox_lam, cfg.learning_rate,
-                                          cfg.prox_penalty)
-            return params, opt_state, combo, parts
+
+            def apply(tree):
+                p, o = tree
+                updates, o = self.optimizer.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                if cfg.prox_penalty is not None:
+                    p = model.apply_prox(p, cfg.prox_lam, cfg.learning_rate,
+                                         cfg.prox_penalty)
+                return p, o
+
+            if guard:
+                # in-graph numerics sentinel: a non-finite loss or gradient
+                # skips the whole update via lax.cond (no host sync); the
+                # counters ride along device-side
+                (params, opt_state), nstate, _ = numerics.guarded_update(
+                    (params, opt_state), grads, combo, apply, nstate)
+            else:
+                params, opt_state = apply((params, opt_state))
+            return params, opt_state, combo, parts, nstate
 
         def eval_step(params, X, Y):
             return loss_fn(params, X, Y, None)
@@ -181,14 +221,22 @@ class Trainer:
         iter_start = 0
 
         ckpt_path = os.path.join(save_dir, "trainer_checkpoint.pkl") if save_dir else None
-        if resume and ckpt_path and os.path.exists(ckpt_path):
-            with open(ckpt_path, "rb") as f:
-                ck = pickle.load(f)
+        if resume and ckpt_path:
+            # durable load: CRC-verified, quarantines corrupt generations to
+            # *.bad, falls back to .prev, and still reads legacy raw pickles
+            ck, _src = durable_ckpt.load_checkpoint(ckpt_path)
+        else:
+            ck = None
+        if ck is not None:
             params = jax.tree.map(jnp.asarray, ck["params"])
             opt_state = jax.tree.map(
                 lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
                 ck["opt_state"],
             )
+            # checkpoints from before the inject_hyperparams migration hold
+            # a bare adam state; wrap it so resume keeps working
+            opt_state = numerics.adopt_legacy_opt_state(self.optimizer,
+                                                        params, opt_state)
             histories = ck["histories"]
             best_it, best_loss = ck["best_it"], ck["best_loss"]
             best_params = jax.tree.map(jnp.asarray, ck["best_params"])
@@ -215,56 +263,103 @@ class Trainer:
         dev_kw = ({"device": True}
                   if getattr(train_ds, "supports_device_batches", False)
                   else {})
+        policy = cfg.numerics if self._guard else None
+        monitor = (numerics.DivergenceMonitor(policy)
+                   if policy is not None else None)
+        nstate = numerics.init_numerics_state()
+        prev_skipped = 0
+        aborted = None
         logger = MetricLogger(save_dir)
-        logger.log("fit_start", model=type(self.model).__name__,
-                   train_config=cfg, resume_epoch=iter_start)
-        with profiler_trace(cfg.profile_dir):
-            for it in range(iter_start, cfg.max_iter):
-                last_it = it
-                for X, Y in train_ds.batches(cfg.batch_size, rng=rng,
-                                             **dev_kw):
-                    step_rng = (jax.random.fold_in(step_key, step_counter)
-                                if self._wants_rng else None)
-                    step_counter += 1
-                    params, opt_state, _, _ = self._train_step(params, opt_state,
-                                                               X, Y, step_rng)
+        # try/finally: an exception mid-fit must still close the jsonl handle
+        # (otherwise buffered context is lost and the fd leaks)
+        try:
+            logger.log("fit_start", model=type(self.model).__name__,
+                       train_config=cfg, resume_epoch=iter_start)
+            with profiler_trace(cfg.profile_dir):
+                for it in range(iter_start, cfg.max_iter):
+                    last_it = it
+                    for X, Y in train_ds.batches(cfg.batch_size, rng=rng,
+                                                 **dev_kw):
+                        step_rng = (jax.random.fold_in(step_key, step_counter)
+                                    if self._wants_rng else None)
+                        X = faultinject.poison_batch(X, step_counter)
+                        skip = faultinject.skip_update(step_counter)
+                        step_counter += 1
+                        if skip:
+                            continue
+                        params, opt_state, _, _, nstate = self._train_step(
+                            params, opt_state, X, Y, step_rng, nstate)
 
-                if tracker is not None:
-                    self._epoch_gc_tracking(params, tracker, true_GC, track_X)
+                    if tracker is not None:
+                        self._epoch_gc_tracking(params, tracker, true_GC, track_X)
 
-                val = self.validate(params, val_ds)
-                histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))
-                histories["avg_adj_penalty"].append(val.get("adj_l1_penalty", 0.0))
-                histories["avg_combo_loss"].append(val["combo_loss"])
+                    val = self.validate(params, val_ds)
+                    histories["avg_forecasting_loss"].append(val.get("forecasting_loss", 0.0))
+                    histories["avg_adj_penalty"].append(val.get("adj_l1_penalty", 0.0))
+                    histories["avg_combo_loss"].append(val["combo_loss"])
 
-                if hasattr(self.model, "validation_criteria"):
-                    criteria = float(self.model.validation_criteria(params, val))
-                else:
-                    criteria = val["combo_loss"]
+                    if hasattr(self.model, "validation_criteria"):
+                        criteria = float(self.model.validation_criteria(params, val))
+                    else:
+                        criteria = val["combo_loss"]
 
-                logger.log("epoch", epoch=it, criteria=criteria, **val,
-                           **(tracker.latest_as_dict() if tracker else {}))
+                    logger.log("epoch", epoch=it, criteria=criteria, **val,
+                               **(tracker.latest_as_dict() if tracker else {}))
 
-                if criteria < best_loss:
-                    best_loss = criteria
-                    best_it = it
-                    best_params = params
-                elif best_it is not None and (it - best_it) == cfg.lookback * cfg.check_every:
-                    if cfg.verbose:
-                        print("Stopping early")
-                    break
+                    if monitor is not None:
+                        nhost = numerics.numerics_summary(nstate)
+                        if nhost["skipped"] > prev_skipped:
+                            logger.log("anomaly", epoch=it,
+                                       cause="nonfinite_grad",
+                                       epoch_skipped_steps=nhost["skipped"]
+                                       - prev_skipped, **nhost)
+                        prev_skipped = nhost["skipped"]
+                        action = monitor.check(it, nhost, criteria)
+                        if action.kind == "rollback":
+                            # rollback() returns the snapshot with its
+                            # injected learning rates already backed off
+                            # (compounding across repeated rollbacks)
+                            params, opt_state = monitor.rollback()
+                            nstate = numerics.reset_consecutive(nstate)
+                            logger.log(
+                                "numerics", kind="rollback", epoch=it,
+                                cause=action.cause,
+                                restored_epoch=monitor.snapshot_epoch,
+                                lr_scale=monitor.lr_scale,
+                                learning_rates=numerics.current_learning_rates(
+                                    opt_state),
+                                rollbacks=monitor.rollbacks)
+                            continue  # re-run from the snapshot; no best/ckpt update
+                        if action.kind == "abort":
+                            aborted = action.cause
+                            logger.log("numerics", kind="abort", epoch=it,
+                                       cause=action.cause, **nhost)
+                            break
+                        if np.isfinite(criteria):
+                            monitor.note_good(it, (params, opt_state))
 
-                if it % cfg.check_every == 0 and save_dir:
-                    self._save_checkpoint(save_dir, it, best_params, opt_state, params,
-                                          histories, best_it, best_loss, tracker)
-                if cfg.verbose and it % max(1, cfg.check_every) == 0:
-                    print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
+                    if criteria < best_loss:
+                        best_loss = criteria
+                        best_it = it
+                        best_params = params
+                    elif best_it is not None and (it - best_it) == cfg.lookback * cfg.check_every:
+                        if cfg.verbose:
+                            print("Stopping early")
+                        break
 
-        final_val = self.validate(best_params, val_ds)
-        logger.log("fit_end", best_it=best_it if best_it is not None else 0,
-                   best_loss=float(best_loss),
-                   final_val_loss=final_val["combo_loss"])
-        logger.close()
+                    if it % cfg.check_every == 0 and save_dir:
+                        self._save_checkpoint(save_dir, it, best_params, opt_state, params,
+                                              histories, best_it, best_loss, tracker)
+                    if cfg.verbose and it % max(1, cfg.check_every) == 0:
+                        print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
+
+            final_val = self.validate(best_params, val_ds)
+            logger.log("fit_end", best_it=best_it if best_it is not None else 0,
+                       best_loss=float(best_loss),
+                       final_val_loss=final_val["combo_loss"],
+                       aborted=aborted)
+        finally:
+            logger.close()
         if save_dir:
             # stamp the actual last trained epoch so a later resume with a larger
             # max_iter continues from where training really stopped; the resumable
@@ -276,11 +371,14 @@ class Trainer:
         return FitResult(
             params=params, best_it=best_it if best_it is not None else 0,
             best_loss=float(best_loss), histories=histories, tracker=tracker,
-            final_val_loss=final_val["combo_loss"],
+            final_val_loss=final_val["combo_loss"], aborted=aborted,
         )
 
     def _save_checkpoint(self, save_dir, it, best_params, opt_state, params,
                          histories, best_it, best_loss, tracker):
+        """All three artifacts go through the durable checkpoint writer
+        (atomic tmp+replace, CRC header, trailing .prev generation) — a
+        preemption mid-write can no longer tear the resume state."""
         os.makedirs(save_dir, exist_ok=True)
         save_model(save_dir, self.model, best_params)
         meta = {
@@ -291,22 +389,22 @@ class Trainer:
         }
         if tracker is not None:
             meta.update(tracker.as_dict())
-        with open(os.path.join(save_dir, "training_meta_data_and_hyper_parameters.pkl"), "wb") as f:
-            pickle.dump(meta, f)
-        with open(os.path.join(save_dir, "trainer_checkpoint.pkl"), "wb") as f:
-            pickle.dump(
-                {
-                    "epoch": it,
-                    "params": jax.tree.map(np.asarray, params),
-                    "best_params": jax.tree.map(np.asarray, best_params),
-                    "opt_state": jax.tree.map(
-                        lambda x: np.asarray(x) if isinstance(x, jnp.ndarray) else x,
-                        opt_state,
-                    ),
-                    "histories": histories,
-                    "best_it": best_it,
-                    "best_loss": float(best_loss),
-                    "tracker_state": None if tracker is None else dict(tracker.__dict__),
-                },
-                f,
-            )
+        durable_ckpt.write_checkpoint(
+            os.path.join(save_dir,
+                         "training_meta_data_and_hyper_parameters.pkl"), meta)
+        durable_ckpt.write_checkpoint(
+            os.path.join(save_dir, "trainer_checkpoint.pkl"),
+            {
+                "epoch": it,
+                "params": jax.tree.map(np.asarray, params),
+                "best_params": jax.tree.map(np.asarray, best_params),
+                "opt_state": jax.tree.map(
+                    lambda x: np.asarray(x) if isinstance(x, jnp.ndarray) else x,
+                    opt_state,
+                ),
+                "histories": histories,
+                "best_it": best_it,
+                "best_loss": float(best_loss),
+                "tracker_state": None if tracker is None else dict(tracker.__dict__),
+            },
+        )

@@ -18,12 +18,26 @@ jsonl schema: every line is one JSON object with at least ``{"event": str,
 * ``epoch``      — epoch index, phase list, per-term validation losses,
                    stopping criteria, latest GC-vs-oracle metrics when a
                    tracker is active
-* ``fit_end``    — best_it, best_loss, final validation loss
+* ``anomaly``    — the numerics sentinel skipped step(s) this epoch:
+                   ``cause`` (``nonfinite_grad``), the epoch's skipped-step
+                   count, and the gradient-norm running stats
+                   (``grad_norm_mean/std/max/last``)
+* ``numerics``   — a sentinel intervention: ``kind`` is ``rollback``
+                   (``cause``, ``restored_epoch``, ``lr_scale``, the new
+                   ``learning_rates``, cumulative ``rollbacks``) or
+                   ``abort`` (``cause``, e.g. ``all_nonfinite_validation``)
+* ``fit_end``    — best_it, best_loss, final validation loss, abort cause
+                   (None for a clean fit)
+
+Records are STRICT JSON: non-finite floats are mapped to ``null`` by
+``jsonable`` (any standards-compliant consumer can read the file), so a
+missing value in a plot is a recorded anomaly, not a parser crash.
 """
 from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import time
 from dataclasses import asdict, is_dataclass
@@ -34,12 +48,15 @@ __all__ = ["MetricLogger", "profiler_trace", "jsonable", "read_jsonl"]
 
 
 def jsonable(v):
-    """Recursively coerce numpy/jax scalars and arrays into JSON-encodable
-    Python values. Arrays become (nested) lists; NaN/inf survive as the
-    JSON-standard-breaking tokens Python's json emits, which ``read_jsonl``
-    reads back."""
-    if v is None or isinstance(v, (bool, int, float, str)):
+    """Recursively coerce numpy/jax scalars and arrays into STRICT
+    JSON-encodable Python values. Arrays become (nested) lists; non-finite
+    floats (NaN/inf, scalar or array element) become ``None`` — the emitted
+    lines never contain the JSON-standard-breaking ``NaN``/``Infinity``
+    tokens."""
+    if isinstance(v, bool) or v is None or isinstance(v, (int, str)):
         return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
     if is_dataclass(v) and not isinstance(v, type):
         return {k: jsonable(x) for k, x in asdict(v).items()}
     if isinstance(v, dict):
@@ -49,10 +66,13 @@ def jsonable(v):
     if isinstance(v, (np.integer,)):
         return int(v)
     if isinstance(v, (np.floating,)):
-        return float(v)
+        f = float(v)
+        return f if math.isfinite(f) else None
     if hasattr(v, "ndim"):  # numpy / jax arrays without importing jax here
         arr = np.asarray(v)
-        return arr.item() if arr.ndim == 0 else arr.tolist()
+        if arr.ndim == 0:
+            return jsonable(arr.item())
+        return [jsonable(x) for x in arr.tolist()]
     return str(v)
 
 
@@ -89,7 +109,9 @@ class MetricLogger:
             return
         rec = {"event": event, "wall_time": time.time()}
         rec.update({k: jsonable(v) for k, v in fields.items()})
-        self._fh.write(json.dumps(rec) + "\n")
+        # allow_nan=False is the strictness backstop: jsonable already maps
+        # non-finite floats to null, so a violation here is a bug, not data
+        self._fh.write(json.dumps(rec, allow_nan=False) + "\n")
         self._fh.flush()
 
     def close(self):

@@ -137,9 +137,11 @@ def test_old_artifact_config_migration(tmp_path):
     save_model(str(tmp_path), model, params)
     # simulate an old artifact: strip the newest config field's instance
     # value (fields with plain defaults still resolve via the class
-    # attribute; _migrate_config covers default_factory fields too)
-    with open(tmp_path / "final_best_model.bin", "rb") as f:
-        payload = pickle.load(f)
+    # attribute; _migrate_config covers default_factory fields too) and
+    # rewrite it as a legacy raw pickle — loaders must read both formats
+    from redcliff_tpu.runtime.checkpoint import read_checkpoint
+
+    payload = read_checkpoint(str(tmp_path / "final_best_model.bin"))
     object.__delattr__(payload["config"], "factor_network_type")
     assert "factor_network_type" not in payload["config"].__dict__
     with open(tmp_path / "final_best_model.bin", "wb") as f:

@@ -309,6 +309,8 @@ def test_driver_writes_failures_json(tmp_path):
         blob = json.load(f)
     assert blob["grid_size"] == 2
     assert blob["failures"][0]["point"] == 1
+    # the quarantine cause rides into failures.json (numerics sentinel)
+    assert blob["failures"][0]["cause"] in ("nonfinite_grad", "nonfinite_val")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,636 @@
+"""Numerics sentinel suite: in-graph non-finite guards, divergence rollback,
+input contracts, strict-JSON metrics, and the no-raw-pickle-checkpoint scan.
+
+Acceptance battery for runtime/numerics.py and its wiring through the
+trainers, the grid engine, and the data layer:
+
+* a fault-injected NaN batch mid-fit is skipped in-graph and the final
+  params are BIT-IDENTICAL to a clean run minus that batch (skip semantics);
+* an injected gradient blowup triggers checkpoint rollback + learning-rate
+  backoff, visible as a ``numerics`` event in metrics.jsonl;
+* an all-NaN validation fit aborts with a recorded cause instead of burning
+  max_iter;
+* grid lane quarantine records its cause (nonfinite_grad vs nonfinite_val);
+* datasets enforce shape/dtype/finite input contracts with quarantine counts;
+* metrics.jsonl is strict JSON (non-finite floats -> null);
+* no raw pickle.dump checkpoint write exists outside runtime/checkpoint.py.
+
+All CPU — no accelerator needed.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from redcliff_tpu.data.datasets import ArrayDataset, InputContractError
+from redcliff_tpu.runtime import numerics
+from redcliff_tpu.runtime import checkpoint as rck
+from redcliff_tpu.runtime.numerics import (DivergenceMonitor, NumericsPolicy,
+                                           guarded_update,
+                                           init_numerics_state,
+                                           numerics_summary,
+                                           scale_learning_rate)
+from redcliff_tpu.utils.observability import read_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# in-graph guard unit tests
+# ---------------------------------------------------------------------------
+def _apply_add_one(tree):
+    return jax.tree.map(lambda x: x + 1.0, tree)
+
+
+def test_guarded_update_applies_when_finite():
+    ns = init_numerics_state()
+    tree = {"w": jnp.zeros(3)}
+    grads = {"w": jnp.ones(3)}
+    new, ns, ok = jax.jit(
+        lambda t, g, n: guarded_update(t, g, jnp.float32(1.0),
+                                       _apply_add_one, n))(tree, grads, ns)
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(new["w"]), np.ones(3))
+    s = numerics_summary(ns)
+    assert s["skipped"] == 0 and s["consecutive"] == 0 and s["checked"] == 1
+    assert s["grad_norm_last"] == pytest.approx(np.sqrt(3.0))
+
+
+@pytest.mark.parametrize("loss,gradval", [
+    (np.nan, 1.0),      # non-finite loss
+    (1.0, np.nan),      # NaN gradient leaf
+    (1.0, np.inf),      # inf gradient leaf
+])
+def test_guarded_update_skips_poisoned_step(loss, gradval):
+    ns = init_numerics_state()
+    tree = {"w": jnp.zeros(3)}
+    grads = {"w": jnp.full(3, gradval)}
+    new, ns, ok = guarded_update(tree, grads, jnp.float32(loss),
+                                 _apply_add_one, ns)
+    assert not bool(ok)
+    # the update was skipped: params pass through bit-identical
+    np.testing.assert_array_equal(np.asarray(new["w"]), np.zeros(3))
+    s = numerics_summary(ns)
+    assert s["skipped"] == 1 and s["consecutive"] == 1
+
+
+def test_consecutive_counter_resets_on_good_step():
+    ns = init_numerics_state()
+    tree = {"w": jnp.zeros(1)}
+    bad = {"w": jnp.full(1, np.nan)}
+    good = {"w": jnp.ones(1)}
+    tree, ns, _ = guarded_update(tree, bad, jnp.float32(1.0), _apply_add_one, ns)
+    tree, ns, _ = guarded_update(tree, bad, jnp.float32(1.0), _apply_add_one, ns)
+    assert numerics_summary(ns)["consecutive"] == 2
+    tree, ns, _ = guarded_update(tree, good, jnp.float32(1.0), _apply_add_one, ns)
+    s = numerics_summary(ns)
+    assert s["consecutive"] == 0 and s["skipped"] == 2 and s["checked"] == 3
+
+
+def test_scale_learning_rate_walks_injected_state():
+    opt = optax.inject_hyperparams(optax.adam)(learning_rate=1e-3)
+    state = opt.init({"w": jnp.zeros(3)})
+    scaled = scale_learning_rate(state, 0.5)
+    assert float(scaled.hyperparams["learning_rate"]) == pytest.approx(5e-4)
+    # untouched trees pass through
+    assert numerics.current_learning_rates(scaled) == [pytest.approx(5e-4)]
+    plain = optax.adam(1e-3).init({"w": jnp.zeros(3)})
+    assert numerics.current_learning_rates(
+        scale_learning_rate(plain, 0.5)) == []
+
+
+# ---------------------------------------------------------------------------
+# DivergenceMonitor policy unit tests
+# ---------------------------------------------------------------------------
+def test_monitor_rolls_back_on_criteria_blowup():
+    mon = DivergenceMonitor(NumericsPolicy(divergence_factor=10.0))
+    clean = {"skipped": 0, "consecutive": 0}
+    assert mon.check(0, clean, 1.0).kind == "ok"
+    mon.note_good(0, {"w": jnp.ones(2)})
+    assert mon.check(1, clean, 0.9).kind == "ok"
+    mon.note_good(1, {"w": jnp.full(2, 2.0)})
+    action = mon.check(2, clean, 1e6)
+    assert action.kind == "rollback" and action.cause == "divergence"
+    restored = mon.rollback()
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.full(2, 2.0))
+    assert mon.lr_scale == pytest.approx(0.5)
+
+
+def test_rollback_lr_backoff_compounds_without_new_snapshot():
+    """Repeated rollbacks of the SAME snapshot must deepen the backoff
+    (0.5x, 0.25x, ...), not reset to the snapshot's original rate; a new
+    snapshot embedding an already-backed-off rate must not double-count."""
+    opt = optax.inject_hyperparams(optax.adam)(learning_rate=1e-2)
+    state = opt.init({"w": jnp.zeros(2)})
+    mon = DivergenceMonitor(NumericsPolicy(max_rollbacks=5, lr_backoff=0.5))
+    mon.note_good(0, {"opt": state})
+    r1 = mon.rollback()
+    assert numerics.current_learning_rates(r1) == [pytest.approx(5e-3)]
+    r2 = mon.rollback()
+    assert numerics.current_learning_rates(r2) == [pytest.approx(2.5e-3)]
+    mon.note_good(1, r2)  # fresh snapshot at the backed-off rate
+    r3 = mon.rollback()
+    assert numerics.current_learning_rates(r3) == [pytest.approx(1.25e-3)]
+
+
+def test_monitor_near_zero_best_tolerates_noise():
+    """A well-converged fit (best ~ 0) must not turn routine noise into a
+    spurious divergence: the threshold has an absolute floor."""
+    mon = DivergenceMonitor(NumericsPolicy(divergence_factor=10.0,
+                                           divergence_atol=1e-2))
+    clean = {"skipped": 0, "consecutive": 0}
+    mon.check(0, clean, 1e-6)
+    mon.note_good(0, {"w": jnp.zeros(1)})
+    # 5e-5 >> 10 x best, but far under the atol-floored threshold
+    assert mon.check(1, clean, 5e-5).kind == "ok"
+    # a genuine blow-up still trips it
+    assert mon.check(2, clean, 1.0).kind == "rollback"
+
+
+def test_monitor_rollback_budget_exhaustion_aborts():
+    mon = DivergenceMonitor(NumericsPolicy(max_rollbacks=1))
+    clean = {"skipped": 0, "consecutive": 0}
+    mon.check(0, clean, 1.0)
+    mon.note_good(0, {"w": jnp.zeros(1)})
+    assert mon.check(1, clean, 1e9).kind == "rollback"
+    mon.rollback()
+    assert mon.check(2, clean, 1e9).kind == "abort"
+
+
+def test_monitor_consecutive_skips_without_snapshot_aborts():
+    mon = DivergenceMonitor(NumericsPolicy(max_consecutive_skips=3))
+    action = mon.check(0, {"skipped": 3, "consecutive": 3}, np.nan)
+    assert action.kind == "abort" and action.cause == "nonfinite_grad"
+
+
+def test_monitor_all_nonfinite_validation_aborts():
+    mon = DivergenceMonitor(NumericsPolicy(max_nonfinite_epochs=3))
+    clean = {"skipped": 0, "consecutive": 0}
+    assert mon.check(0, clean, np.nan).kind == "ok"
+    assert mon.check(1, clean, np.nan).kind == "ok"
+    action = mon.check(2, clean, np.nan)
+    assert action.kind == "abort"
+    assert action.cause == "all_nonfinite_validation"
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: fault-injected NaN batch / gradient blowup
+# ---------------------------------------------------------------------------
+def _tiny_trainer(max_iter=4, **cfg_kw):
+    from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+    from redcliff_tpu.train.trainer import TrainConfig, Trainer
+
+    model = CMLPFM(CMLPFMConfig(num_chans=3, gen_lag=2, gen_hidden=(8,),
+                                input_length=6, forecast_coeff=1.0,
+                                adj_l1_coeff=1e-3))
+    trainer = Trainer(model, TrainConfig(learning_rate=1e-2, max_iter=max_iter,
+                                         batch_size=16, check_every=1,
+                                         **cfg_kw))
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(48, 12, 3)).astype(np.float32)
+    ds = ArrayDataset(X, None)  # 3 steps/epoch at batch_size=16
+    params = model.init(jax.random.PRNGKey(0))
+    return trainer, params, ds
+
+
+def test_nan_batch_skip_semantics_bit_identical(tmp_path, monkeypatch):
+    """A guarded fit with a NaN batch injected at step 4 must end bit-identical
+    to a clean fit that skips exactly that update — the guard's skip IS the
+    reference semantics, and the poison never touches params."""
+    trainer, params, ds = _tiny_trainer()
+
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "nan_batch:4")
+    poisoned = trainer.fit(params, ds, ds, save_dir=str(tmp_path / "poisoned"))
+
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "skip_update:4")
+    reference = trainer.fit(params, ds, ds, save_dir=str(tmp_path / "ref"))
+
+    for a, b in zip(jax.tree.leaves(poisoned.params),
+                    jax.tree.leaves(reference.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(np.asarray(jax.tree.leaves(poisoned.params)[0])).all()
+    assert poisoned.aborted is None
+
+    # the skip surfaced as an anomaly event with the step count
+    anomalies = read_jsonl(str(tmp_path / "poisoned"), event="anomaly")
+    assert len(anomalies) == 1
+    assert anomalies[0]["cause"] == "nonfinite_grad"
+    assert anomalies[0]["epoch_skipped_steps"] == 1
+    assert not read_jsonl(str(tmp_path / "ref"), event="anomaly")
+
+
+def test_grad_blowup_triggers_rollback_and_lr_backoff(tmp_path, monkeypatch):
+    """An entire epoch of exploding gradients (steps 6-8 = epoch 2) trips the
+    consecutive-skip threshold: the monitor restores the epoch-1 snapshot and
+    halves the learning rate, all recorded as a ``numerics`` event."""
+    trainer, params, ds = _tiny_trainer(
+        max_iter=5, numerics=NumericsPolicy(max_consecutive_skips=3,
+                                            lr_backoff=0.5))
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "grad_blowup:6-8")
+    res = trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+
+    assert res.aborted is None
+    for leaf in jax.tree.leaves(res.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    events = read_jsonl(str(tmp_path), event="numerics")
+    rollbacks = [e for e in events if e["kind"] == "rollback"]
+    assert len(rollbacks) == 1
+    rb = rollbacks[0]
+    assert rb["cause"] == "nonfinite_grad"
+    assert rb["epoch"] == 2 and rb["restored_epoch"] == 1
+    assert rb["lr_scale"] == pytest.approx(0.5)
+    assert rb["learning_rates"] == [pytest.approx(5e-3)]  # 1e-2 backed off
+    assert rb["rollbacks"] == 1
+    # the poisoned epoch also logged its skipped steps
+    anomalies = read_jsonl(str(tmp_path), event="anomaly")
+    assert anomalies and anomalies[0]["epoch_skipped_steps"] == 3
+
+
+class _NaNCriteriaModel:
+    """Finite loss, but a validation criteria that is always NaN — the
+    all-NaN stall that used to burn max_iter (best_it never set)."""
+
+    def __init__(self):
+        from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+
+        self._inner = CMLPFM(CMLPFMConfig(num_chans=3, gen_lag=2,
+                                          gen_hidden=(4,), input_length=6))
+        self.config = self._inner.config
+
+    def init(self, key):
+        return self._inner.init(key)
+
+    def loss(self, params, X, Y=None):
+        return self._inner.loss(params, X)
+
+    def gc(self, params, **kw):
+        return self._inner.gc(params, **kw)
+
+    def validation_criteria(self, params, val):
+        return float("nan")
+
+
+def test_all_nan_validation_aborts_with_recorded_cause(tmp_path):
+    from redcliff_tpu.train.trainer import TrainConfig, Trainer
+
+    model = _NaNCriteriaModel()
+    trainer = Trainer(model, TrainConfig(
+        learning_rate=1e-3, max_iter=50, batch_size=16, check_every=1,
+        numerics=NumericsPolicy(max_nonfinite_epochs=3)))
+    rng = np.random.default_rng(3)
+    ds = ArrayDataset(rng.normal(size=(32, 12, 3)).astype(np.float32), None)
+    params = model.init(jax.random.PRNGKey(1))
+    res = trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+
+    assert res.aborted == "all_nonfinite_validation"
+    # the fit stopped at the abort threshold, nowhere near max_iter
+    epochs = read_jsonl(str(tmp_path), event="epoch")
+    assert len(epochs) == 3
+    aborts = read_jsonl(str(tmp_path), event="numerics")
+    assert aborts[-1]["kind"] == "abort"
+    assert aborts[-1]["cause"] == "all_nonfinite_validation"
+    # strict JSON: the NaN criteria serialized as null
+    assert all(e["criteria"] is None for e in epochs)
+
+
+# ---------------------------------------------------------------------------
+# grid lane quarantine cause
+# ---------------------------------------------------------------------------
+def test_grid_lane_quarantine_records_grad_cause():
+    from redcliff_tpu.runtime.faultinject import tiny_grid_fit
+
+    res = tiny_grid_fit(None, max_iter=3, bad_point=True)
+    assert [f["point"] for f in res.failures] == [1]
+    # the poisoned-lr lane exploded through its own gradients: the in-graph
+    # guard observed the non-finite steps, so the cause is attributed to them
+    assert res.failures[0]["cause"] == "nonfinite_grad"
+    assert res.active[0] and not res.active[1]
+
+
+# ---------------------------------------------------------------------------
+# durable trainer checkpoints (the torn-write hole, both trainers)
+# ---------------------------------------------------------------------------
+def test_trainer_checkpoints_are_durable_format(tmp_path):
+    trainer, params, ds = _tiny_trainer(max_iter=2)
+    trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+    for name in ("final_best_model.bin", "trainer_checkpoint.pkl",
+                 "training_meta_data_and_hyper_parameters.pkl"):
+        with open(tmp_path / name, "rb") as f:
+            assert f.read(4) == b"RTCK", f"{name} is not a durable checkpoint"
+
+
+def test_trainer_resume_survives_torn_checkpoint(tmp_path):
+    """Truncating the checkpoint head (torn write) must fall back to the
+    .prev generation with a quarantine warning — not crash, not restart."""
+    from redcliff_tpu.runtime.faultinject import corrupt_checkpoint
+
+    trainer, params, ds = _tiny_trainer(max_iter=3)
+    trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+    head = str(tmp_path / "trainer_checkpoint.pkl")
+    corrupt_checkpoint(head, "truncate")
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        res = trainer.fit(params, ds, ds, save_dir=str(tmp_path), resume=True)
+    assert os.path.exists(head + ".bad")
+    assert res.aborted is None
+
+
+def test_redcliff_trainer_checkpoints_are_durable_format(tmp_path):
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+    from redcliff_tpu.train.redcliff_trainer import (RedcliffTrainConfig,
+                                                     RedcliffTrainer)
+
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=16, check_every=1)
+    trainer = RedcliffTrainer(model, tc)
+    rng = np.random.default_rng(0)
+    cfg = model.config
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(32, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(32, 3, 1)).astype(np.float32)
+    ds = ArrayDataset(X, Y)
+    params = model.init(jax.random.PRNGKey(2))
+    res = trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+    assert res.aborted is None
+    for name in ("final_best_model.bin", "trainer_checkpoint.pkl",
+                 "training_meta_data_and_hyper_parameters.pkl"):
+        with open(tmp_path / name, "rb") as f:
+            assert f.read(4) == b"RTCK", f"{name} is not a durable checkpoint"
+
+
+def test_trainer_resumes_pre_inject_hyperparams_checkpoint(tmp_path):
+    """A checkpoint written before the optimizer switched to
+    inject_hyperparams holds a bare adam state; resume must wrap it (with
+    the configured learning rate) instead of crashing in update()."""
+    import pickle
+
+    trainer, params, ds = _tiny_trainer(max_iter=2)
+    trainer.fit(params, ds, ds, save_dir=str(tmp_path))
+    ck = rck.read_checkpoint(str(tmp_path / "trainer_checkpoint.pkl"))
+    # strip the inject wrapper AND the durable header: the legacy layout
+    assert hasattr(ck["opt_state"], "inner_state")
+    ck["opt_state"] = ck["opt_state"].inner_state
+    with open(tmp_path / "trainer_checkpoint.pkl", "wb") as f:
+        pickle.dump(ck, f)
+    os.remove(tmp_path / "trainer_checkpoint.pkl.prev")
+
+    trainer2, _, _ = _tiny_trainer(max_iter=4)
+    res = trainer2.fit(params, ds, ds, save_dir=str(tmp_path), resume=True)
+    assert res.aborted is None
+    for leaf in jax.tree.leaves(res.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_grid_resume_rejects_changed_numerics_policy(tmp_path):
+    """The numerics guard gates every grid update, so resuming under a
+    different policy must be rejected by the fingerprint, not silently
+    train different semantics."""
+    import dataclasses
+
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    rng = np.random.default_rng(0)
+    T = model.config.max_lag + model.config.num_sims
+    ds = ArrayDataset(rng.normal(size=(32, T, 4)).astype(np.float32),
+                      rng.uniform(size=(32, 3, 1)).astype(np.float32))
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 3e-3}])
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=16, check_every=1)
+    ck = str(tmp_path / "ck")
+    RedcliffGridRunner(model, tc, spec).fit(
+        jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck, checkpoint_every=1)
+    tc2 = dataclasses.replace(
+        tc, numerics=NumericsPolicy(max_consecutive_skips=7))
+    with pytest.raises(ValueError, match="numerics"):
+        RedcliffGridRunner(model, tc2, spec).fit(
+            jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+            checkpoint_every=1)
+
+
+def test_grid_resume_accepts_pre_sentinel_checkpoint_under_default_policy(
+        tmp_path):
+    """A grid checkpoint written before the sentinel (no numerics
+    fingerprint, no per-lane counters) must still resume under the DEFAULT
+    policy — the guard doesn't change healthy-lane math — with the sentinel
+    state backfilled."""
+    import jax as _jax
+
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    rng = np.random.default_rng(0)
+    T = model.config.max_lag + model.config.num_sims
+    ds = ArrayDataset(rng.normal(size=(32, T, 4)).astype(np.float32),
+                      rng.uniform(size=(32, 3, 1)).astype(np.float32))
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 3e-3}])
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=16, check_every=1)
+    ck = str(tmp_path / "ck")
+    RedcliffGridRunner(model, tc, spec).fit(
+        _jax.random.PRNGKey(0), ds, ds, max_iter=2, checkpoint_dir=ck,
+        checkpoint_every=1)
+    # rewrite the checkpoint as a pre-sentinel one: drop the numerics
+    # fingerprint and the per-lane sentinel state
+    path = os.path.join(ck, "grid_checkpoint.pkl")
+    blob = rck.read_checkpoint(path)
+    del blob["meta"]["numerics"]
+    del blob["nstate"]
+    del blob["failed_cause"]
+    rck.write_checkpoint(path, blob)
+    res = RedcliffGridRunner(model, tc, spec).fit(
+        _jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+        checkpoint_every=1)
+    assert res.val_history.shape[0] == 3  # resumed epoch 2, not rejected
+
+
+# ---------------------------------------------------------------------------
+# data input contracts
+# ---------------------------------------------------------------------------
+def test_dataset_quarantines_nonfinite_samples():
+    X = np.ones((6, 4, 2), dtype=np.float32)
+    X[1, 0, 0] = np.nan
+    X[4, 3, 1] = np.inf
+    with pytest.warns(RuntimeWarning, match="quarantined 2/6"):
+        ds = ArrayDataset(X, None)
+    assert ds.quarantined_samples == 2
+    assert len(ds) == 4
+    # quarantine ran BEFORE normalization stats: clean samples stay finite
+    assert np.isfinite(ds.X).all()
+
+
+def test_dataset_quarantines_nonfinite_labels():
+    X = np.ones((4, 3, 2), dtype=np.float32)
+    Y = np.ones((4, 2), dtype=np.float32)
+    Y[2, 1] = np.nan
+    with pytest.warns(RuntimeWarning, match="quarantined 1/4"):
+        ds = ArrayDataset(X, Y)
+    assert ds.quarantined_samples == 1 and len(ds) == 3
+
+
+def test_dataset_shape_contract():
+    with pytest.raises(InputContractError, match="num_samples"):
+        ArrayDataset(np.ones((4, 6), dtype=np.float32))
+
+
+def test_dataset_ragged_input_contract():
+    ragged = np.empty(2, dtype=object)
+    ragged[0] = np.ones((3, 2))
+    ragged[1] = np.ones((4, 2))
+    with pytest.raises(InputContractError, match="object array"):
+        ArrayDataset(ragged)
+
+
+def test_dataset_label_length_contract():
+    with pytest.raises(InputContractError, match="label length"):
+        ArrayDataset(np.ones((4, 3, 2), dtype=np.float32),
+                     np.ones((3, 2), dtype=np.float32))
+
+
+def test_dataset_contract_escape_hatch():
+    # contract=False restores permissive construction for exotic callers
+    ds = ArrayDataset(np.ones((4, 6), dtype=np.float32), contract=False,
+                      normalize=False)
+    assert ds.X.shape == (4, 6)
+
+
+def test_shard_loader_reports_quarantine(tmp_path):
+    import pickle
+
+    from redcliff_tpu.data.shards import load_shard_samples
+
+    good = np.ones((5, 2), dtype=np.float32)
+    bad = good.copy()
+    bad[0, 0] = np.inf
+    split = tmp_path / "train"
+    os.makedirs(split)
+    with open(split / "subset_0.pkl", "wb") as f:
+        pickle.dump([[good, np.ones(1)], [bad, np.ones(1)],
+                     [good, np.ones(1)]], f)
+    report = {}
+    with pytest.warns(RuntimeWarning, match="quarantined 1"):
+        samples = load_shard_samples(str(split), report=report)
+    assert len(samples) == 2
+    assert report["quarantined"] == 1 and report["loaded"] == 2
+    assert report["quarantined_by_file"] == {"subset_0.pkl": 1}
+
+
+# ---------------------------------------------------------------------------
+# strict-JSON metrics round trip
+# ---------------------------------------------------------------------------
+def test_jsonable_maps_nonfinite_to_null_strict_roundtrip(tmp_path):
+    from redcliff_tpu.utils.observability import MetricLogger
+
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricLogger(path) as logger:
+        logger.log("epoch", epoch=0, criteria=float("nan"),
+                   loss=np.float32(np.inf),
+                   history=[1.0, float("-inf"), 2.0],
+                   arr=np.asarray([np.nan, 3.0]),
+                   nested={"v": np.float64("nan")})
+
+    def _no_constants(name):
+        raise AssertionError(f"non-strict JSON token {name!r} in metrics")
+
+    with open(path) as f:
+        for line in f:
+            json.loads(line, parse_constant=_no_constants)
+
+    [rec] = read_jsonl(path, event="epoch")
+    assert rec["criteria"] is None
+    assert rec["loss"] is None
+    assert rec["history"] == [1.0, None, 2.0]
+    assert rec["arr"] == [None, 3.0]
+    assert rec["nested"]["v"] is None
+
+
+# ---------------------------------------------------------------------------
+# CI guard: no raw pickle checkpoint writes outside runtime/checkpoint.py
+# ---------------------------------------------------------------------------
+CHECKPOINT_ARTIFACT_NAMES = (
+    "final_best_model",
+    "training_meta_data_and_hyper_parameters",
+    "trainer_checkpoint",
+    "grid_checkpoint",
+    "best_model_name",
+    "dCSFA-NMF-best-model",
+)
+# modules allowed to contain pickle.dump in the checkpoint-owning layers:
+# checkpoint.py OWNS the durable format; faultinject.py writes a
+# test-harness result blob (not a resume artifact)
+PICKLE_DUMP_ALLOWLIST = {
+    os.path.join("runtime", "checkpoint.py"),
+    os.path.join("runtime", "faultinject.py"),
+}
+
+
+def _package_sources():
+    pkg = os.path.join(REPO, "redcliff_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for name in files:
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                yield os.path.relpath(full, pkg), open(full).read()
+
+
+def test_no_raw_pickle_dump_in_checkpoint_layers():
+    """train/, parallel/ and runtime/ own checkpoint-shaped state; any
+    pickle.dump there (outside the durable writer itself) is a regression
+    toward non-durable checkpoints."""
+    offenders = []
+    for rel, src in _package_sources():
+        top = rel.split(os.sep)[0]
+        if top not in ("train", "parallel", "runtime"):
+            continue
+        if rel in PICKLE_DUMP_ALLOWLIST:
+            continue
+        if "pickle.dump" in src:
+            offenders.append(rel)
+    assert not offenders, (
+        f"raw pickle.dump in checkpoint-owning modules {offenders}; route "
+        f"checkpoint writes through runtime.checkpoint.write_checkpoint "
+        f"(atomic + CRC + .prev) instead")
+
+
+def test_no_pickle_dump_near_checkpoint_artifact_names():
+    """Package-wide: a pickle.dump within a few lines of a checkpoint
+    artifact name is a non-durable checkpoint write sneaking back in."""
+    offenders = []
+    for rel, src in _package_sources():
+        if rel in PICKLE_DUMP_ALLOWLIST:
+            continue
+        lines = src.splitlines()
+        for i, line in enumerate(lines):
+            if "pickle.dump" not in line:
+                continue
+            window = "\n".join(lines[max(0, i - 8): i + 1])
+            hits = [n for n in CHECKPOINT_ARTIFACT_NAMES if n in window]
+            if hits:
+                offenders.append((rel, i + 1, hits))
+    assert not offenders, (
+        f"raw pickle.dump writing checkpoint artifacts at {offenders}; use "
+        f"runtime.checkpoint.write_checkpoint")

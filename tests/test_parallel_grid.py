@@ -394,8 +394,10 @@ def test_grid_step_lane_mask_freezes_point():
     X = rng.normal(size=(8, cfg.max_lag + cfg.num_sims, cfg.num_chans)).astype(np.float32)
     Y = rng.uniform(size=(8, 3, 1)).astype(np.float32)
     active = jnp.asarray([True, False])
-    new, _, _, _ = runner._steps["combined"](
-        params, optA, optB, runner.coeffs, active, X, Y)
+    from redcliff_tpu.runtime.numerics import init_numerics_state
+    new, _, _, _, _ = runner._steps["combined"](
+        params, optA, optB, init_numerics_state(lanes=2), runner.coeffs,
+        active, X, Y)
     for b, n in zip(jax.tree.leaves(before), jax.tree.leaves(new)):
         np.testing.assert_array_equal(np.asarray(b)[1], np.asarray(n)[1])
         assert not np.allclose(np.asarray(b)[0], np.asarray(n)[0])

@@ -168,6 +168,9 @@ def test_training_trajectory_parity(ref):
     stream = _batch_stream(EPOCHS, BATCHES)
     probe_X, probe_Y = _batch_stream(1, 1, batch=8)[0][0]
 
+    from redcliff_tpu.runtime.numerics import init_numerics_state
+
+    ns = init_numerics_state()
     ref_hist, jax_hist = [], []
     phases_seen = set()
     for epoch in range(EPOCHS):
@@ -180,8 +183,8 @@ def test_training_trajectory_parity(ref):
                                    output_length=1)
             # ours: the trainer's jit step(s) for the schedule's phase(s)
             for phase in phases:
-                params, sA, sB, _, _ = trainer._steps[phase](
-                    params, sA, sB, jnp.asarray(X), jnp.asarray(Y))
+                params, sA, sB, _, _, ns = trainer._steps[phase](
+                    params, sA, sB, jnp.asarray(X), jnp.asarray(Y), ns)
         ref_hist.append(_ref_probe_loss(ref_model, probe_X, probe_Y))
         jax_hist.append(float(jax_model.loss_for_phase(
             params, jnp.asarray(probe_X), jnp.asarray(probe_Y),
