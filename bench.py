@@ -19,7 +19,16 @@ Extra context fields (so "fast" is judgeable against hardware capability):
   flops_per_step  — XLA cost-analysis FLOPs of one compiled per-batch grid step
   mfu_pct         — chip utilization vs dense peak, from the SCANNED dispatch
                     (dispatch overhead amortized over k batches — honest MFU)
-  g_scaling       — {G: {wps, wps_scan, mfu_pct}} over grid sizes
+  g_scaling       — {G: {wps, wps_scan, epoch_scan, mfu_pct}} over grid sizes
+                    (epoch_scan = the single-dispatch epoch engine,
+                    parallel/grid.py auto mode)
+  epoch_scan_wps  — headline-G throughput of the epoch engine dispatch
+  dispatches_per_epoch — the dispatch-count contract per mode for a nominal
+                    32-batch epoch (data/pipeline.py dispatch_budget — the
+                    same helper the tier-1 tripwire test asserts against)
+  ckpt_stall_ms   — measured main-thread checkpoint cost on the headline
+                    grid state: async hand-off (what the train loop now
+                    stalls) vs the synchronous gather+write it replaced
   probe_log       — every accelerator probe attempt (the axon TPU tunnel hangs
                     intermittently for minutes; attempts spread with backoff)
   probe_retry     — fixed-schema outcome of the shared probe retry policy
@@ -485,6 +494,7 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
     ns = init_numerics_state(lanes=G)
 
     wps = flops = dt = None
+    epoch_wps = None
     p, a, b = params, optA, optB
     if not scan_only:
         step = runner._steps["combined"]
@@ -522,11 +532,35 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
     scan_wps = G * B * scan_k * sdispatches / sdt
     scan_dispatch_s = sdt / sdispatches
 
+    if not scan_only:
+        # epoch engine (parallel/grid.py _epoch_steps): one dispatch gathers
+        # + scans an epoch chunk from the HBM-resident dataset by index —
+        # the auto-mode production path; timed over the same scan_k batches
+        # so wps_epoch is directly comparable to wps_scan
+        Xfull = jax.device_put(np.concatenate([np.asarray(X)] * scan_k))
+        Yfull = jax.device_put(np.concatenate([np.asarray(Y)] * scan_k))
+        idx = jax.device_put(
+            np.arange(B * scan_k, dtype=np.int32).reshape(scan_k, B))
+        estep = runner._epoch_steps["combined"]
+        ecompiled = estep.lower(p, a, b, ns, coeffs, active, Xfull, Yfull,
+                                idx).compile()
+        p, a, b, ns, _ = ecompiled(p, a, b, ns, coeffs, active, Xfull,
+                                   Yfull, idx)  # warm
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for _ in range(sdispatches):
+            p, a, b, ns, _ = ecompiled(p, a, b, ns, coeffs, active, Xfull,
+                                       Yfull, idx)
+        jax.block_until_ready(p)
+        edt = time.perf_counter() - t0
+        epoch_wps = G * B * scan_k * sdispatches / edt
+
     return {
         "wps": wps, "flops": flops,
         "step_s": dt / steps if dt is not None else None,
         "scan_wps": scan_wps, "scan_flops": sflops,
         "scan_dispatch_s": scan_dispatch_s,
+        "epoch_wps": epoch_wps,
         "runner": runner, "state": (p, a, b, coeffs, X, Y),
     }
 
@@ -571,6 +605,45 @@ def _bench_sequential(jax, model, runner, grid_state, G, B, steps):
     jax.block_until_ready(pp)
     dt = time.perf_counter() - t0
     return G * B * steps / dt
+
+
+def _bench_ckpt_stall(jax, grid_state):
+    """Main-thread checkpoint cost, async hand-off vs synchronous write, on
+    the headline grid state: async_ms is what the train loop actually stalls
+    (snapshot + submit), sync_ms is the full gather+pickle+CRC+fsync the
+    old path paid in-line. Written to a throwaway dir."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from redcliff_tpu.runtime.checkpoint import (AsyncCheckpointWriter,
+                                                 write_checkpoint)
+
+    params, optA, optB = grid_state[0], grid_state[1], grid_state[2]
+    state = {"params": params, "optA_state": optA, "optB_state": optB}
+    tmpdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        path = os.path.join(tmpdir, "bench_checkpoint.pkl")
+        to_host = lambda t: jax.tree.map(np.asarray, t)
+        t0 = time.perf_counter()
+        write_checkpoint(path, to_host(state))
+        sync_ms = (time.perf_counter() - t0) * 1e3
+        # same hand-off the grid engine performs: one fused snapshot
+        # dispatch + async D2H kickoff + thread submit
+        snapshot = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+        snapshot(state)  # compile outside the timed region
+        with AsyncCheckpointWriter() as w:
+            t0 = time.perf_counter()
+            snap = snapshot(state)
+            for leaf in jax.tree.leaves(snap):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            w.submit(lambda: write_checkpoint(path, to_host(snap)))
+            async_ms = (time.perf_counter() - t0) * 1e3
+        return {"async_ms": round(async_ms, 2), "sync_ms": round(sync_ms, 2)}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _measure(platform):
@@ -618,6 +691,10 @@ def _measure(platform):
         g_scaling[str(G)] = {
             "wps": round(r["wps"], 1),
             "wps_scan": round(r["scan_wps"], 1),
+            # the epoch-scan engine entry: same k batches, one dispatch
+            # gathering+scanning them from device-resident data by index
+            "epoch_scan": (round(r["epoch_wps"], 1)
+                           if r["epoch_wps"] is not None else None),
             "mfu_pct": _mfu_pct(r["scan_flops"], r["scan_dispatch_s"], peak)
             if not on_cpu else None,
         }
@@ -640,6 +717,24 @@ def _measure(platform):
     seq_wps = _bench_sequential(jax, model, headline["runner"],
                                 headline["state"], G_HEAD, B, seq_steps)
 
+    # dispatch-count contract per single-phase epoch (shared helper with the
+    # tier-1 tripwire test) for a nominal 32-full-batch epoch, plus the
+    # measured main-thread checkpoint stall (async hand-off vs sync write)
+    from redcliff_tpu.data.pipeline import dispatch_budget
+
+    nominal_nb = 32
+    dispatches_per_epoch = {
+        "num_full_batches": nominal_nb,
+        "per_batch": dispatch_budget(nominal_nb, mode="per_batch"),
+        "kscan": dispatch_budget(nominal_nb, scan_batches=scan_k,
+                                 mode="kscan"),
+        "epoch_scan": dispatch_budget(nominal_nb, mode="epoch"),
+    }
+    try:
+        ckpt_stall_ms = _bench_ckpt_stall(jax, headline["state"])
+    except Exception as e:  # never fail the bench over the stall probe
+        ckpt_stall_ms = {"error": f"{type(e).__name__}: {e}"}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -653,9 +748,13 @@ def _measure(platform):
         "batch_size": B,
         "scan_batches": scan_k,
         "per_step_wps": round(headline["wps"], 1),
+        "epoch_scan_wps": (round(headline["epoch_wps"], 1)
+                           if headline["epoch_wps"] is not None else None),
         "flops_per_step": headline["flops"],
         "mfu_pct": mfu_head,
         "g_scaling": g_scaling,
+        "dispatches_per_epoch": dispatches_per_epoch,
+        "ckpt_stall_ms": ckpt_stall_ms,
         "bf16": bf16,
         "error": None,
     })

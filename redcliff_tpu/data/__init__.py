@@ -2,6 +2,12 @@
 preprocessing, and device-resident dataset containers
 (rebuilds /root/reference/data/, SURVEY.md §2.4)."""
 from .datasets import ArrayDataset, train_val_split
+from .pipeline import (
+    choose_stream_mode,
+    dispatch_budget,
+    epoch_batch_plan,
+    prefetch_batches,
+)
 from .dream4 import (
     D4IC_SNR_TIERS,
     make_d4ic_fold,
@@ -18,6 +24,7 @@ from .lfp import (
     preprocess_tst_raw_lfps_for_windowed_training,
 )
 from .shards import (
+    ShardedBatchDataset,
     apply_signal_format,
     load_normalized_split_datasets,
     load_shard_samples,
@@ -27,6 +34,8 @@ from .shards import (
 
 __all__ = [
     "ArrayDataset", "train_val_split",
+    "choose_stream_mode", "dispatch_budget", "epoch_batch_plan",
+    "prefetch_batches", "ShardedBatchDataset",
     "D4IC_SNR_TIERS", "make_d4ic_fold", "make_dream4_combo_dataset",
     "make_dream4_individual_dataset",
     "make_dream4_single_dominant_superpositional_dataset",

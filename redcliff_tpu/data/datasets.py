@@ -113,10 +113,13 @@ class ArrayDataset:
     def num_timesteps(self):
         return self.X.shape[1]
 
-    def _device_arrays(self, sharding=None):
-        # keyed by sharding so a dataset shared between a single-device
-        # trainer and a mesh grid runner keeps one correctly-placed copy per
-        # placement instead of silently reusing the first caller's
+    def device_arrays(self, sharding=None):
+        """The one HBM-resident (X, Y) copy per placement — the backing store
+        for both ``batches(device=True)`` gathers and the epoch-scan batch
+        stream (data/pipeline.py), which scans over *index* arrays into these.
+        Keyed by sharding so a dataset shared between a single-device trainer
+        and a mesh grid runner keeps one correctly-placed copy per placement
+        instead of silently reusing the first caller's."""
         if self._dev is None:
             self._dev = {}
         if sharding not in self._dev:
@@ -157,7 +160,7 @@ class ArrayDataset:
             # multi-process guard lives here, not at call sites: committed
             # per-host arrays cannot replicate across hosts
             device = jax.process_count() == 1
-        Xs, Ys = (self._device_arrays(sharding) if device
+        Xs, Ys = (self.device_arrays(sharding) if device
                   else (self.X, self.Y))
         stop = (n // batch_size) * batch_size if drop_remainder else n
         for start in range(0, stop, batch_size):

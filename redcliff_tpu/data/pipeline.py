@@ -1,0 +1,232 @@
+"""Epoch-granular batch streams: device-resident index plans + host prefetch.
+
+REDCLIFF-S fitting is a grid of many small models, so per-dispatch overhead —
+not FLOPs — dominates the step budget (BASELINE.md: ~0.24 ms/step floor past
+G~64; BENCH_r05 shows the k-batch scan already matters at G=1). Classic
+dataflow systems keep the accelerator fed by an asynchronous host pipeline
+(TensorFlow, arXiv:1605.08695), and TPU cost models confirm utilization at
+these shapes is gated by launch/infeed overhead (arXiv:2008.01040). This
+module owns the data half of that story; the engines (parallel/grid.py, the
+trainers) own the compute half.
+
+Three stream modes, resolved by :func:`choose_stream_mode`:
+
+``"epoch"``
+    The dataset lives in HBM (``ArrayDataset.device_arrays``); the epoch's
+    shuffled batch order becomes a *device* permutation array and ONE jit'd
+    dispatch gathers the permuted epoch in-graph and scans the whole epoch's
+    updates (plus one per-batch step for the epoch remainder). Bit-identical
+    to the per-batch path: :func:`epoch_batch_plan` consumes the shuffle rng
+    exactly like ``ArrayDataset.batches``, and the engine gathers *outside*
+    the scan so the scanned step math compiles identically to the k-batch
+    scan (an in-body per-iteration gather lets XLA fuse differently and
+    drift by 1 ulp).
+``"kscan"``
+    The pre-existing k-batch ``lax.scan`` over stacked batch *data*
+    (``scan_batches`` groups) — still the mode for freeze-by-batch-free fits
+    whose data cannot stay device-resident.
+``"per_batch"``
+    One dispatch per batch; host-resident streams ride the double-buffered
+    :func:`prefetch_batches` so host assembly + ``device_put`` of batch t+1
+    overlaps compute of batch t.
+
+Nothing here imports jax at module scope (bench.py's backend-free parent may
+import the data package); jax is pulled in lazily where a backend is already
+live.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = [
+    "epoch_batch_plan",
+    "choose_stream_mode",
+    "dataset_device_bytes",
+    "prefetch_batches",
+    "dispatch_budget",
+    "DEFAULT_MAX_DEVICE_DATASET_BYTES",
+    "STREAM_MODES",
+]
+
+STREAM_MODES = ("auto", "epoch", "kscan", "per_batch")
+
+# HBM-residency ceiling for the epoch stream: datasets beyond this stay host
+# resident (prefetched). The epoch dispatch materializes one transient
+# permuted copy of the epoch in HBM (the out-of-scan gather that buys
+# bit-identity with the per-batch path), so the true high-water mark is
+# ~2x this value — 2 GiB keeps that comfortably inside any real chip's HBM
+# alongside the grid state; every dataset in this repo is orders of
+# magnitude smaller anyway.
+DEFAULT_MAX_DEVICE_DATASET_BYTES = 2 << 30
+
+
+def epoch_batch_plan(n, batch_size, rng=None):
+    """One epoch's batch order as index arrays: ``(full_idx, rem_idx)``.
+
+    ``full_idx`` is ``(num_full_batches, batch_size)`` int32 — the scan axis
+    of the epoch-scan dispatch; ``rem_idx`` is the trailing short batch's
+    indices (possibly empty). CONTRACT: consumes ``rng`` exactly like
+    ``ArrayDataset.batches`` (one ``rng.shuffle`` of ``arange(n)``), so a
+    checkpointed rng state replays the same stream regardless of stream mode
+    — pinned by tests/test_data_pipeline.py.
+    """
+    idx = np.arange(n)
+    if rng is not None:
+        rng.shuffle(idx)
+    nb = n // batch_size
+    full = idx[: nb * batch_size].astype(np.int32).reshape(nb, batch_size)
+    rem = idx[nb * batch_size :].astype(np.int32)
+    return full, rem
+
+
+def dataset_device_bytes(ds):
+    """Estimated HBM footprint of caching ``ds`` device-resident (X + Y),
+    or None when the dataset doesn't expose dense arrays."""
+    X = getattr(ds, "X", None)
+    if X is None:
+        return None
+    total = int(np.asarray(X).nbytes)
+    Y = getattr(ds, "Y", None)
+    if Y is not None:
+        total += int(np.asarray(Y).nbytes)
+    return total
+
+
+def choose_stream_mode(mode, train_ds, *, scan_batches=0, batch_size=1,
+                       single_phase=True, freeze_by_batch=False,
+                       max_device_bytes=None, labels_required=True):
+    """Resolve a configured stream mode ("auto" included) against what the
+    dataset/engine can actually support. Returns one of
+    ``"epoch" | "kscan" | "per_batch"``.
+
+    Epoch streaming needs: a device-batch-capable dataset small enough for
+    HBM (``max_device_bytes``), a single-process run (committed device arrays
+    cannot replicate across hosts), labels (the grid step signature), at
+    least one full batch, single-phase epochs, and no per-batch freeze
+    choreography. ``"auto"`` degrades epoch -> kscan (when ``scan_batches >
+    1``) -> per_batch; an explicitly requested mode that is ineligible
+    degrades the same way rather than erroring (the eligibility can depend on
+    runtime facts like process count).
+    """
+    if mode not in STREAM_MODES:
+        raise ValueError(
+            f"unknown stream_mode {mode!r}; valid: {STREAM_MODES}")
+    limit = (DEFAULT_MAX_DEVICE_DATASET_BYTES
+             if max_device_bytes is None else max_device_bytes)
+
+    def epoch_ok():
+        if freeze_by_batch or not single_phase:
+            return False
+        if not getattr(train_ds, "supports_device_batches", False):
+            return False
+        if labels_required and getattr(train_ds, "Y", None) is None:
+            return False
+        try:
+            if len(train_ds) < batch_size:
+                return False
+        except TypeError:
+            return False
+        nbytes = dataset_device_bytes(train_ds)
+        if nbytes is None or nbytes > limit:
+            return False
+        import jax
+
+        return jax.process_count() == 1
+
+    def kscan_ok():
+        return scan_batches and scan_batches > 1 and not freeze_by_batch \
+            and single_phase
+
+    if mode in ("auto", "epoch") and epoch_ok():
+        return "epoch"
+    if mode in ("auto", "epoch", "kscan") and kscan_ok():
+        return "kscan"
+    return "per_batch"
+
+
+def dispatch_budget(num_full_batches, num_remainder_batches=0,
+                    scan_batches=0, mode="per_batch"):
+    """Expected TRAIN dispatches per single-phase epoch for a stream mode —
+    the contract the dispatch-tripwire test and bench.py both assert against.
+    ``num_remainder_batches`` counts trailing short/label-less batches that
+    always take the per-batch step."""
+    if mode == "epoch":
+        return (1 if num_full_batches else 0) + num_remainder_batches
+    if mode == "kscan" and scan_batches and scan_batches > 1:
+        k = scan_batches
+        # full k-groups scan; the partial trailing group flushes per-batch
+        return (num_full_batches // k
+                + num_full_batches % k + num_remainder_batches)
+    return num_full_batches + num_remainder_batches
+
+
+class _PrefetchCancelled(Exception):
+    pass
+
+
+def prefetch_batches(iterator, depth=2, put=None):
+    """Double-buffered background prefetch: a daemon thread drains
+    ``iterator`` up to ``depth`` items ahead, applying ``put`` (e.g.
+    ``jax.device_put``) in the thread, so host batch assembly + H2D transfer
+    of item t+1 overlap the consumer's compute on item t. ``depth=2`` is
+    classic double buffering; ``put=None`` keeps items host-side (multi-host
+    runs, where inputs must stay uncommitted numpy) and still overlaps the
+    host-side slicing.
+
+    Order-preserving and exception-transparent: an error raised by the
+    source (or ``put``) re-raises at the consumer's ``next()``. Abandoning
+    the generator (consumer exception / early ``close``) cancels the thread
+    promptly instead of leaking it blocked on a full queue.
+    """
+    if depth < 1:
+        yield from iterator
+        return
+    q = queue.Queue(maxsize=depth)
+    cancel = threading.Event()
+    END, ERR = object(), object()
+
+    def put_blocking(item):
+        """Enqueue, waiting out a full queue unless cancelled. EVERY
+        enqueue — items, END, and ERR alike — must use this: dropping the
+        END/ERR sentinel when the queue happens to be full would leave the
+        consumer blocked on q.get() forever with the real error lost."""
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in iterator:
+                if put is not None:
+                    item = tuple(None if x is None else put(x) for x in item)
+                if not put_blocking(item):
+                    return
+            put_blocking(END)
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            put_blocking((ERR, e))
+
+    t = threading.Thread(target=worker, name="batch-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
+                raise item[1]
+            yield item
+    finally:
+        cancel.set()
+        # unblock a producer waiting on a full queue, then let it exit
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

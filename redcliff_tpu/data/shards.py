@@ -31,6 +31,7 @@ __all__ = [
     "samples_to_arrays",
     "apply_signal_format",
     "load_normalized_split_datasets",
+    "ShardedBatchDataset",
 ]
 
 
@@ -76,6 +77,146 @@ def load_shard_samples(data_path, drop_nan=True, report=None):
             f"load_shard_samples: quarantined {skipped} non-finite samples "
             f"under {data_path} ({per_file})", RuntimeWarning, stacklevel=2)
     return samples
+
+
+class ShardedBatchDataset:
+    """Streaming batch source for split dirs too large to materialize: holds
+    ONE shard file in memory at a time instead of the whole fold.
+
+    The train engines duck-type on ``supports_device_batches`` — this class
+    reports False, so the grid runner / trainers route it through the
+    host-side per-batch path behind the double-buffered prefetcher
+    (data/pipeline.py): shard unpickling + normalization + slicing of batch
+    t+1 overlap device compute of batch t.
+
+    Construction makes one streaming statistics pass (per-channel sum /
+    sum-of-squares over every shard) so batches z-score with the SAME
+    dataset-wide channel stats the in-memory loaders use; non-finite samples
+    are quarantined with a counted RuntimeWarning exactly like
+    :func:`load_shard_samples`. Shuffling (``rng`` passed to ``batches``)
+    permutes the shard ORDER and the samples within each shard — a bounded-
+    memory approximation of a global shuffle (documented deviation from
+    ``ArrayDataset``'s exact permutation); unshuffled iteration matches the
+    concatenated-shard order bit-for-bit, which tests pin against
+    ``ArrayDataset``.
+    """
+
+    supports_device_batches = False
+
+    def __init__(self, split_dir, normalize=True):
+        self.split_dir = split_dir
+        self.files = sorted(
+            x for x in os.listdir(split_dir)
+            if "subset_" in x and x.endswith(".pkl") and "metadata" not in x)
+        if not self.files:
+            raise FileNotFoundError(f"no subset_*.pkl shards under {split_dir}")
+        self.normalize = normalize
+        self.quarantined_samples = 0
+        self._shape_tc = None
+        n = 0
+        s = ss = None
+        for name in self.files:
+            X, _ = self._load_shard(name, count_quarantine=True)
+            if not len(X):
+                continue  # fully-quarantined shard
+            if self._shape_tc is None:
+                self._shape_tc = X.shape[1:]
+            elif X.shape[1:] != self._shape_tc:
+                raise ValueError(
+                    f"shard {name} window shape {X.shape[1:]} != first "
+                    f"shard's {self._shape_tc}")
+            n += X.shape[0]
+            # f64 accumulators: a streaming f32 sum over a big fold drifts
+            part = X.astype(np.float64)
+            s = part.sum(axis=(0, 1)) if s is None else s + part.sum(axis=(0, 1))
+            ss = ((part ** 2).sum(axis=(0, 1)) if ss is None
+                  else ss + (part ** 2).sum(axis=(0, 1)))
+        self._n = n
+        if self._shape_tc is None:
+            raise ValueError(
+                f"every sample under {split_dir} was quarantined as "
+                f"non-finite — nothing to train on")
+        shape_tc = self._shape_tc
+        if normalize:
+            cnt = max(n * shape_tc[0], 1)
+            mean = s / cnt
+            var = np.maximum(ss / cnt - mean ** 2, 0.0)
+            std = np.sqrt(var)
+            std = np.where(std == 0.0, 1.0, std)
+            self.stats = (mean.astype(np.float32), std.astype(np.float32))
+        else:
+            self.stats = None
+        if self.quarantined_samples:
+            warnings.warn(
+                f"ShardedBatchDataset: quarantined {self.quarantined_samples} "
+                f"non-finite samples under {split_dir}", RuntimeWarning,
+                stacklevel=2)
+
+    def _load_shard(self, name, count_quarantine=False):
+        with open(os.path.join(self.split_dir, name), "rb") as f:
+            pairs = pickle.load(f)
+        keep = []
+        for pair in pairs:
+            x = np.asarray(pair[0], dtype=np.float32)
+            y = np.asarray(pair[1], dtype=np.float32)
+            # quarantine on non-finite X OR Y — the same per-sample
+            # contract ArrayDataset enforces, so the shard stream and the
+            # in-memory path train on identical sample sets
+            if not np.isfinite(x).all() or not np.isfinite(y).all():
+                if count_quarantine:
+                    self.quarantined_samples += 1
+                continue
+            keep.append([x, pair[1]])
+        return samples_to_arrays(keep) if keep else (
+            np.zeros((0,) + (self._shape_tc or (0, 0)), np.float32),
+            np.zeros((0, 1), np.float32))
+
+    def __len__(self):
+        return self._n
+
+    @property
+    def num_timesteps(self):
+        return self._shape_tc[0]
+
+    @property
+    def num_channels(self):
+        return self._shape_tc[1]
+
+    def batches(self, batch_size, rng=None, drop_remainder=False):
+        """Yield normalized (X, Y) minibatches, streaming one shard at a
+        time; samples left over from a shard carry into the next shard's
+        pool, so only the final batch of the epoch can be short.
+
+        One concatenation per shard (the short carry-over head is prepended
+        once), then batches are yielded as views via a cursor — no
+        per-batch recopying of the remaining buffer."""
+        files = list(self.files)
+        if rng is not None:
+            rng.shuffle(files)
+        carry_X = carry_Y = None
+        for name in files:
+            X, Y = self._load_shard(name)
+            if not len(X):
+                continue  # fully-quarantined shard: nothing to buffer
+            if rng is not None:
+                order = rng.permutation(len(X))
+                X, Y = X[order], Y[order]
+            if self.normalize:
+                X = (X - self.stats[0]) / self.stats[1]
+            if carry_X is not None and len(carry_X):
+                X = np.concatenate([carry_X, X])
+                Y = np.concatenate([carry_Y, Y])
+            stop = (len(X) // batch_size) * batch_size
+            for start in range(0, stop, batch_size):
+                yield X[start : start + batch_size], \
+                    Y[start : start + batch_size]
+            carry_X, carry_Y = X[stop:], Y[stop:]
+        if carry_X is not None and len(carry_X) and not drop_remainder:
+            yield carry_X, carry_Y
+
+    def num_batches(self, batch_size, drop_remainder=False):
+        n = self._n
+        return n // batch_size if drop_remainder else int(np.ceil(n / batch_size))
 
 
 def load_normalized_samples(split_dir):

@@ -10,10 +10,23 @@ same axis over DCN.
 Shape-changing hyperparameters (hidden sizes, lags, factor counts) cannot share
 a compiled program; callers group points by shape and run one GridRun per group
 — the grouping helper below does this from a list of config dicts.
+
+Execution engine (data/pipeline.py stream modes): with the default
+``stream_mode="auto"`` an eligible fit runs the EPOCH engine — the dataset
+stays HBM-resident, each epoch's shuffled batch order becomes a device index
+array, and one jit'd dispatch scans the whole epoch's updates (validation is
+one scanned dispatch too, and periodic checkpoints hand their device->host
+gather + durable write to a background thread). Per-dispatch overhead, not
+FLOPs, dominates at these model shapes (BASELINE.md, arXiv:2008.01040), so
+one-epoch~=one-dispatch is the production mode; the k-batch scan and the
+per-batch step remain as bit-identical fallbacks (``RedcliffGridRunner.
+dispatch_stats`` records what actually ran).
 """
 from __future__ import annotations
 
+import contextlib
 import os
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Sequence
 
@@ -22,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
@@ -125,6 +139,22 @@ class RedcliffGridRunner:
     weight decay to the gradients — torch.optim.Adam semantics
     (ref model_utils.py:749-762).
     """
+
+    # per-fit execution accounting, (re)set by _fit: stream mode actually
+    # run, epochs completed, train/val dispatch counts, and the main-thread
+    # checkpoint stall in ms (bench.py and the dispatch-budget tripwire
+    # test read this)
+    dispatch_stats = None
+    # fused one-dispatch state snapshot for async saves: a per-leaf
+    # jnp.copy loop would cost one dispatch per leaf and dominate the
+    # hand-off it is supposed to make cheap. Jitted once, pre-warmed by
+    # _fit so the first save's stall excludes the compile
+    _snapshot_fn = None
+
+    def _ensure_snapshot_fn(self):
+        if self._snapshot_fn is None:
+            self._snapshot_fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+        return self._snapshot_fn
 
     def __init__(self, model, train_config, spec: GridSpec, mesh=None):
         self.model = model
@@ -281,6 +311,7 @@ class RedcliffGridRunner:
 
         self._steps = {}
         self._scan_steps = {}
+        self._epoch_steps = {}
         for phase in ("embedder_pretrain", "factor_pretrain", "combined", "post_train"):
             vstep = jax.vmap(
                 lambda p, a, b, ns, c, act, X, Y, ph=phase: point_step(
@@ -312,6 +343,34 @@ class RedcliffGridRunner:
             self._scan_steps[phase] = jax.jit(scan_step,
                                               donate_argnums=(0, 1, 2, 3))
 
+            # epoch-granular variant (data/pipeline.py "epoch" stream mode):
+            # ONE dispatch gathers the epoch's shuffled batch order from the
+            # HBM-resident dataset (idx (num_batches, B)) and scans the
+            # whole epoch of updates. The gather runs OUTSIDE the scan —
+            # the scan then consumes stacked batches exactly like the
+            # k-batch scan step, which is what keeps this path bit-identical
+            # to the per-batch path (a per-iteration in-body gather lets
+            # XLA fuse it into the step and round a few weights 1 ulp
+            # differently). Costs one transient epoch-sized device buffer,
+            # bounded by the pipeline's HBM-residency cap.
+            def epoch_step(params, optA_state, optB_state, nstate, coeffs,
+                           active, Xfull, Yfull, idx, _vstep=vstep):
+                Xs = jnp.take(Xfull, idx, axis=0)
+                Ys = jnp.take(Yfull, idx, axis=0)
+
+                def body(carry, xy):
+                    p, a, b, ns = carry
+                    p, a, b, ns, combo = _vstep(p, a, b, ns, coeffs, active,
+                                                *xy)
+                    return (p, a, b, ns), combo
+
+                (p, a, b, ns), combos = jax.lax.scan(
+                    body, (params, optA_state, optB_state, nstate), (Xs, Ys))
+                return p, a, b, ns, combos
+
+            self._epoch_steps[phase] = jax.jit(epoch_step,
+                                               donate_argnums=(0, 1, 2, 3))
+
         # Freeze-mode accept/revert choreography: the shared trainer logic
         # (train/freeze.py), vmapped over the grid axis
         mode = model.config.training_mode
@@ -325,7 +384,32 @@ class RedcliffGridRunner:
             self._freeze_step = jax.jit(
                 jax.vmap(freeze_point, in_axes=(0, 0)),
                 donate_argnums=(0, 1))
-        self._val = jax.jit(jax.vmap(point_val, in_axes=(0, 0, None, None)))
+        vval = jax.vmap(point_val, in_axes=(0, 0, None, None))
+        self._val = jax.jit(vval)
+
+        # whole-validation-set dispatch for the epoch stream: scan the vmapped
+        # point_val over batch indices, accumulating the per-batch sums in
+        # the carry IN ORDER (sequential adds from zero — bit-identical to
+        # the per-batch val loop's `0.0 + combo_1 + combo_2 + ...`)
+        def val_scan(params, coeffs, Xfull, Yfull, idx):
+            # gather-outside-the-scan for the same reason as the epoch
+            # train step: the scan consumes stacked batches, keeping the
+            # per-batch loss math (and therefore the ordered sums)
+            # bit-identical to the per-batch val loop
+            Xs = jnp.take(Xfull, idx, axis=0)
+            Ys = jnp.take(Yfull, idx, axis=0)
+
+            def body(carry, xy):
+                cs, fs, fas = carry
+                c, fo, fa = vval(params, coeffs, *xy)
+                return (cs + c, fs + fo, fas + fa), None
+
+            zero = jnp.zeros(coeffs["embed_lr"].shape, jnp.float32)
+            (cs, fs, fas), _ = jax.lax.scan(body, (zero, zero, zero),
+                                            (Xs, Ys))
+            return cs, fs, fas
+
+        self._val_scan = jax.jit(val_scan)
 
         def select_best(best_params, best_crit, best_epoch, params, crit, epoch):
             better = crit < best_crit
@@ -433,6 +517,13 @@ class RedcliffGridRunner:
             "check_every": tc.check_every,
             "lookback": tc.lookback,
             "scan_batches": tc.scan_batches,
+            # stream-mode/prefetch knobs: every mode replays the SAME batch
+            # sequence today (epoch_batch_plan consumes the shuffle rng
+            # exactly like batches()), but the fingerprint pins them so a
+            # future mode that diverges can never silently replay a
+            # different stream on resume
+            "stream_mode": tc.stream_mode,
+            "prefetch_batches": tc.prefetch_batches,
             "max_iter": tc.max_iter,
             # the numerics guard gates every update and decides lane
             # quarantine, so a changed/disabled policy is a different fit
@@ -442,27 +533,69 @@ class RedcliffGridRunner:
             "val_data": durable_ckpt.dataset_fingerprint(val_ds),
         }
 
-    def _save_checkpoint(self, checkpoint_dir, state, meta):
-        """Gather the full fit state to host and write durably — atomic
-        tmp+replace with CRC/format-version header and a trailing .prev
-        generation (process 0 writes; the gathers are collectives and run on
-        every process)."""
+    # device trees the jit'd train steps DONATE: the next dispatch
+    # invalidates their buffers, so an asynchronous save must snapshot them
+    # (cheap in-device jnp.copy) before the train loop moves on
+    _DONATED_STATE_KEYS = ("params", "optA_state", "optB_state", "nstate",
+                           "accepted")
+
+    @staticmethod
+    def _hostify(snap, meta, to_host):
+        """Snapshot dict -> the checkpoint payload (device->host gathers
+        included). Runs on the background writer thread in async mode."""
         host = {
-            k: (jax.tree.map(self._to_host, v) if v is not None else None)
-            for k, v in state.items()
+            k: (jax.tree.map(to_host, v) if v is not None else None)
+            for k, v in snap.items()
             if k not in ("epoch", "aligned", "rng_state", "val_history")
         }
-        host["epoch"] = state["epoch"]
-        host["aligned"] = state["aligned"]
-        host["rng_state"] = state["rng_state"]
-        host["val_history"] = [self._to_host(v)
-                               for v in state["val_history"]]
+        host["epoch"] = snap["epoch"]
+        host["aligned"] = snap["aligned"]
+        host["rng_state"] = snap["rng_state"]
+        host["val_history"] = [to_host(v) for v in snap["val_history"]]
         host["meta"] = meta
-        if jax.process_index() != 0:
+        return host
+
+    def _save_checkpoint(self, checkpoint_dir, state, meta, writer=None):
+        """Write the fit state durably — atomic tmp+replace with CRC header
+        and a trailing .prev generation.
+
+        ``writer`` (an :class:`~redcliff_tpu.runtime.checkpoint
+        .AsyncCheckpointWriter`, single-process only) makes the save
+        asynchronous: the main thread only snapshots the donated device
+        trees (in-device ``jnp.copy`` — the next train dispatch would
+        invalidate the originals under the background reader) and kicks off
+        the device->host copies; the blocking gather + pickle + CRC + fsync
+        all run on the writer thread, overlapping the next training epoch.
+        Multi-host saves stay synchronous: the gathers are collectives and
+        must run on every process's main thread (process 0 writes)."""
+        if writer is None or jax.process_count() > 1:
+            host = self._hostify(state, meta, self._to_host)
+            if jax.process_index() != 0:
+                return
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            durable_ckpt.write_checkpoint(
+                os.path.join(checkpoint_dir, self.CHECKPOINT_NAME), host)
             return
+        donated = {k: state[k] for k in self._DONATED_STATE_KEYS
+                   if state.get(k) is not None}
+        donated = self._ensure_snapshot_fn()(donated)
+        snap = {}
+        for k, v in state.items():
+            if k == "val_history":
+                snap[k] = list(v)  # the live list keeps growing
+            else:
+                snap[k] = donated.get(k, v) if k in self._DONATED_STATE_KEYS \
+                    else v
+        # start the D2H copies now (non-blocking) so the writer thread's
+        # np.asarray calls mostly find the host values already materialized
+        for leaf in jax.tree.leaves(snap):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
         os.makedirs(checkpoint_dir, exist_ok=True)
-        durable_ckpt.write_checkpoint(
-            os.path.join(checkpoint_dir, self.CHECKPOINT_NAME), host)
+        path = os.path.join(checkpoint_dir, self.CHECKPOINT_NAME)
+        meta = dict(meta)
+        writer.submit(lambda: durable_ckpt.write_checkpoint(
+            path, self._hostify(snap, meta, self._to_host)))
 
     def _load_checkpoint(self, checkpoint_dir, want_meta):
         """Load the newest usable checkpoint generation, or None for a fresh
@@ -517,6 +650,15 @@ class RedcliffGridRunner:
             # the DEFAULT policy is sound (the loop backfills the sentinel
             # state); resuming under a non-default policy still rejects
             want_meta.pop("numerics")
+        if ("stream_mode" not in meta
+                and want_meta.get("stream_mode") == "auto"
+                and want_meta.get("prefetch_batches") == 2):
+            # pre-pipeline checkpoint: all stream modes replay the identical
+            # batch sequence (the epoch plan consumes the rng exactly like
+            # batches()), so resuming under the default knobs is sound;
+            # non-default knobs still reject loudly
+            want_meta.pop("stream_mode")
+            want_meta.pop("prefetch_batches")
         diff = ([k for k in want_meta if meta.get(k) != want_meta[k]]
                 + [k for k in meta if k not in want_meta])
         if diff:
@@ -556,18 +698,29 @@ class RedcliffGridRunner:
         # the guard wraps the whole fit so a signal during compile/data
         # staging is latched too; _fit polls it at epoch boundaries
         guard = PreemptionGuard(enabled=checkpoint_dir is not None)
-        with guard, profiler_trace(self.tc.profile_dir):
+        # the background checkpoint writer is scoped HERE so every exit
+        # path — normal completion, Preempted, or any mid-fit exception —
+        # joins the in-flight write (its __exit__ re-raises background
+        # write failures on clean exits and warns instead of masking an
+        # already-propagating exception). Multi-host saves stay
+        # synchronous: the gathers are collectives
+        writer = None
+        if (checkpoint_dir is not None and self.tc.async_checkpointing
+                and jax.process_count() == 1):
+            writer = durable_ckpt.AsyncCheckpointWriter()
+        wctx = writer if writer is not None else contextlib.nullcontext()
+        with guard, profiler_trace(self.tc.profile_dir), wctx:
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
                              log_dir=log_dir, init_params=init_params,
                              copy_init=copy_init,
                              checkpoint_dir=checkpoint_dir,
                              checkpoint_every=checkpoint_every,
-                             guard=guard)
+                             guard=guard, writer=writer)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
              log_dir=None, init_params=None, copy_init=True,
              checkpoint_dir=None, checkpoint_every=None,
-             guard=None) -> GridResult:
+             guard=None, writer=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
@@ -647,9 +800,99 @@ class RedcliffGridRunner:
             val_history = []
             aligned = False
             start_it = 0
+
+        # ---- batch-stream plan (epoch engine, data/pipeline.py) ----------
+        # resolved ONCE per fit: "epoch" scans the whole epoch's batch
+        # indices in one dispatch against the HBM-resident dataset, "kscan"
+        # scans k stacked batches per dispatch, "per_batch" dispatches every
+        # batch (host streams ride the double-buffered prefetcher).
+        # Multi-phase epochs degrade to per_batch per-epoch below (phases
+        # interleave within each batch).
+        sharding = replicated(self.mesh) if self.mesh is not None else None
+        base_stream = pipeline.choose_stream_mode(
+            tc.stream_mode, train_ds, scan_batches=tc.scan_batches,
+            batch_size=tc.batch_size, single_phase=True,
+            freeze_by_batch=self._freeze_by_batch)
+        Xd = Yd = None
+        if base_stream == "epoch":
+            Xd, Yd = train_ds.device_arrays(sharding)
+        # validation rides the epoch engine too: one scanned dispatch over a
+        # fixed index plan (val order is rng-free), computed once per fit.
+        # The HBM-residency cap applies to the val set independently — the
+        # scan pins it device-resident (plus a transient permuted copy)
+        val_bytes = pipeline.dataset_device_bytes(val_ds)
+        val_scan_ok = (base_stream == "epoch"
+                       and getattr(val_ds, "supports_device_batches", False)
+                       and getattr(val_ds, "Y", None) is not None
+                       and len(val_ds) >= tc.batch_size
+                       and val_bytes is not None
+                       and val_bytes
+                       <= pipeline.DEFAULT_MAX_DEVICE_DATASET_BYTES)
+        vXd = vYd = vidx = None
+        v_rem = np.zeros((0,), np.int32)
+        if val_scan_ok:
+            vXd, vYd = val_ds.device_arrays(sharding)
+            v_full, v_rem = pipeline.epoch_batch_plan(len(val_ds),
+                                                     tc.batch_size)
+            vidx = jnp.asarray(v_full)
+            if sharding is not None:
+                vidx = jax.device_put(vidx, sharding)
+        # device-resident batches for the non-epoch paths (HBM copy +
+        # per-batch device gather), replicated over the mesh; ArrayDataset
+        # itself falls back to host numpy in multi-process runs
+        if getattr(train_ds, "supports_device_batches", False):
+            dev_kw = {"device": True, "sharding": sharding}
+        else:
+            dev_kw = {}
+
+        def train_batch_iter():
+            """One epoch's batch source for the per_batch/kscan paths; host
+            streams ride the prefetcher so batch assembly + device_put of
+            batch t+1 overlap compute of batch t."""
+            src = train_ds.batches(tc.batch_size, rng=rng, **dev_kw)
+            if not dev_kw and tc.prefetch_batches > 0:
+                if jax.process_count() == 1:
+                    put = ((lambda a: jax.device_put(a, sharding))
+                           if sharding is not None else jax.device_put)
+                else:
+                    put = None  # multi-host inputs stay uncommitted numpy
+                src = pipeline.prefetch_batches(
+                    src, depth=tc.prefetch_batches, put=put)
+            return src
+
+        # hoisted cos-tracking window: the first val batch's slice becomes a
+        # once-per-fit device constant instead of a per-epoch
+        # np.asarray(first_val_X) device->host sync
+        cos_Xw = None
+        if self._cos is not None:
+            first = next(iter(val_ds.batches(tc.batch_size)), None)
+            if first is not None:
+                cos_Xw = jnp.asarray(np.asarray(first[0])[
+                    : tc.max_samples_for_gc_tracking,
+                    : self.model.config.max_lag, :])
+                if sharding is not None:
+                    cos_Xw = jax.device_put(cos_Xw, sharding)
+        # per-fit dispatch/stall accounting (bench.py's schema and the
+        # tier-1 dispatch-budget tripwire both read this)
+        self.dispatch_stats = stats = {
+            "mode": base_stream, "epochs": 0, "train_dispatches": 0,
+            "val_dispatches": 0, "ckpt_stall_ms": 0.0}
+        # background checkpoint writer (created and scoped by fit(), which
+        # joins it on EVERY exit path): pre-compile the fused donated-state
+        # snapshot here so the FIRST save's main-thread stall is the
+        # hand-off, not a jit compile (the save-time structure is exactly
+        # these keys)
+        if writer is not None:
+            warm = {k: v for k, v in (
+                ("params", params), ("optA_state", optA_state),
+                ("optB_state", optB_state), ("nstate", nstate),
+                ("accepted", accepted)) if v is not None}
+            jax.block_until_ready(self._ensure_snapshot_fn()(warm))
+
         logger = MetricLogger(log_dir)
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
                    training_mode=self.model.config.training_mode,
+                   stream_mode=base_stream,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
                    resumed_from=ck_src,
                    points=list(self.spec.points))
@@ -666,25 +909,38 @@ class RedcliffGridRunner:
             # (jnp.copy: the train steps donate nstate's buffers, so the
             # original reference would be invalidated by the first dispatch)
             epoch_skip_base = jnp.copy(nstate["skipped"])
-            # device-resident batches (HBM copy + per-batch device gather),
-            # replicated over the mesh; ArrayDataset itself falls back to
-            # host numpy in multi-process runs
-            if getattr(train_ds, "supports_device_batches", False):
-                dev_kw = {"device": True,
-                          "sharding": (replicated(self.mesh)
-                                       if self.mesh is not None else None)}
-            else:
-                dev_kw = {}
-            # scanning batches k-at-a-time preserves update order only when
-            # the epoch runs a single phase (multi-phase epochs interleave
-            # phases within each batch) and no per-batch freeze runs between
-            k = (tc.scan_batches
-                 if not self._freeze_by_batch and len(phases) == 1 else 0)
-            if k > 1:
+            # scanned modes preserve update order only when the epoch runs a
+            # single phase (multi-phase epochs interleave phases within each
+            # batch); such epochs degrade to per_batch
+            mode_e = base_stream if len(phases) == 1 else "per_batch"
+            if mode_e == "epoch":
+                # ONE dispatch for the whole epoch: the shuffled batch order
+                # becomes a device index array and lax.scan gathers each
+                # batch in-graph from the HBM-resident dataset; only the
+                # short epoch remainder takes the per-batch step
+                phase = phases[0]
+                full_idx, rem_idx = pipeline.epoch_batch_plan(
+                    len(train_ds), tc.batch_size, rng=rng)
+                idx = jnp.asarray(full_idx)
+                if sharding is not None:
+                    idx = jax.device_put(idx, sharding)
+                params, optA_state, optB_state, nstate = \
+                    self._epoch_steps[phase](params, optA_state, optB_state,
+                                             nstate, coeffs, active,
+                                             Xd, Yd, idx)[:4]
+                stats["train_dispatches"] += 1
+                if len(rem_idx):
+                    params, optA_state, optB_state, nstate = \
+                        self._steps[phase](params, optA_state, optB_state,
+                                           nstate, coeffs, active,
+                                           Xd[rem_idx], Yd[rem_idx])[:4]
+                    stats["train_dispatches"] += 1
+            elif mode_e == "kscan":
                 # group FULL-SIZE labeled batches and drive each group with
                 # one scanned dispatch; short batches (the epoch remainder,
                 # which would break jnp.stack's uniform shapes) and
                 # label-less batches take the per-batch step in order
+                k = tc.scan_batches
                 phase = phases[0]
                 state = (params, optA_state, optB_state, nstate)
                 group = []
@@ -696,17 +952,20 @@ class RedcliffGridRunner:
                     if len(group) == k:
                         Xs = jnp.stack([jnp.asarray(x) for x, _ in group])
                         Ys = jnp.stack([jnp.asarray(y) for _, y in group])
+                        stats["train_dispatches"] += 1
                         return self._scan_steps[phase](*state, coeffs, active,
                                                        Xs, Ys)[:4]
                     for X, Y in group:
+                        stats["train_dispatches"] += 1
                         state = self._steps[phase](*state, coeffs, active,
                                                    X, Y)[:4]
                     return state
 
-                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                for X, Y in train_batch_iter():
                     if Y is None or X.shape[0] != tc.batch_size:
                         state = run_group(state, group)
                         group = []
+                        stats["train_dispatches"] += 1
                         state = self._steps[phase](*state, coeffs, active,
                                                    X, Y)[:4]
                         continue
@@ -717,26 +976,42 @@ class RedcliffGridRunner:
                 state = run_group(state, group)
                 params, optA_state, optB_state, nstate = state
             else:
-                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                for X, Y in train_batch_iter():
                     for phase in phases:
+                        stats["train_dispatches"] += 1
                         params, optA_state, optB_state, nstate, _ = \
                             self._steps[phase](params, optA_state, optB_state,
                                                nstate, coeffs, active, X, Y)
                     if self._freeze_by_batch:
                         params, accepted = self._freeze_step(params, accepted)
-            combo_sum = 0.0
-            forecast_sum = 0.0
-            factor_sum = 0.0
-            n = 0
-            first_val_X = None
-            for X, Y in val_ds.batches(tc.batch_size):
-                if first_val_X is None:
-                    first_val_X = X
-                combo, fo, fa = self._val(params, coeffs, X, Y)
-                combo_sum = combo_sum + combo
-                forecast_sum = forecast_sum + fo
-                factor_sum = factor_sum + fa
-                n += 1
+            if val_scan_ok:
+                # whole validation set in one scanned dispatch (sequential
+                # carry adds — bit-identical to the per-batch loop's sums);
+                # the short remainder batch adds one per-batch dispatch
+                combo_sum, forecast_sum, factor_sum = self._val_scan(
+                    params, coeffs, vXd, vYd, vidx)
+                stats["val_dispatches"] += 1
+                n = int(vidx.shape[0])
+                if len(v_rem):
+                    combo, fo, fa = self._val(params, coeffs,
+                                              vXd[v_rem], vYd[v_rem])
+                    stats["val_dispatches"] += 1
+                    combo_sum = combo_sum + combo
+                    forecast_sum = forecast_sum + fo
+                    factor_sum = factor_sum + fa
+                    n += 1
+            else:
+                combo_sum = 0.0
+                forecast_sum = 0.0
+                factor_sum = 0.0
+                n = 0
+                for X, Y in val_ds.batches(tc.batch_size):
+                    combo, fo, fa = self._val(params, coeffs, X, Y)
+                    stats["val_dispatches"] += 1
+                    combo_sum = combo_sum + combo
+                    forecast_sum = forecast_sum + fo
+                    factor_sum = factor_sum + fa
+                    n += 1
             if n == 0:
                 raise ValueError(
                     "validation dataset yielded no batches — increase "
@@ -785,11 +1060,10 @@ class RedcliffGridRunner:
                     crit = crit + (coeffs["stopping_criteria_factor_coeff"]
                                    * (factor_sum / n))
                 if self._cos is not None:
-                    Xw = jnp.asarray(np.asarray(
-                        first_val_X)[: tc.max_samples_for_gc_tracking,
-                                     : cfg.max_lag, :])
+                    # cos_Xw is the once-per-fit hoisted device constant —
+                    # no per-epoch host slice/transfer in the hot loop
                     crit = crit + (coeffs["stopping_criteria_cosSim_coeff"]
-                                   * self._cos(params, Xw))
+                                   * self._cos(params, cos_Xw))
                 if self._freeze:
                     # end-of-epoch accept/revert; the accepted tree IS the
                     # best-params analog (trainer fit loop, freeze branch)
@@ -862,8 +1136,16 @@ class RedcliffGridRunner:
                 }
                 saved = False
                 if checkpoint_every and (it + 1) % checkpoint_every == 0:
-                    self._save_checkpoint(checkpoint_dir, snap, ck_meta)
+                    t_save = time.perf_counter()
+                    self._save_checkpoint(checkpoint_dir, snap, ck_meta,
+                                          writer=writer)
+                    stats["ckpt_stall_ms"] += (time.perf_counter()
+                                               - t_save) * 1e3
                     saved = True
+                    if writer is not None and faultinject.armed():
+                        # fault-test determinism: "checkpoint_saved" must
+                        # mean durably on disk before the crash point fires
+                        writer.wait()
                     faultinject.crash_point("checkpoint_saved", epoch=it)
                 # preemption: the guard latched SIGTERM/SIGINT; write one
                 # final checkpoint at this epoch boundary and stop. Multi-host
@@ -887,13 +1169,24 @@ class RedcliffGridRunner:
                         preempted = False
                 if preempted:
                     if not saved:
-                        self._save_checkpoint(checkpoint_dir, snap, ck_meta)
+                        self._save_checkpoint(checkpoint_dir, snap, ck_meta,
+                                              writer=writer)
+                    if writer is not None:
+                        # the final checkpoint must be durable before the
+                        # process acts on Preempted (typically: exits)
+                        writer.wait()
                     logger.log("preempted_final_checkpoint", epoch=it,
                                signum=guard.signum if guard else None)
                     logger.close()
                     raise Preempted(guard.signum if guard else None,
                                     epoch=it)
+            stats["epochs"] += 1
             faultinject.crash_point("epoch_end", epoch=it)
+
+        if writer is not None:
+            # completion barrier: surface any background write failure and
+            # guarantee the last generation is durable before results return
+            writer.wait()
 
         # one gather each; shared by the fit_end record and the result
         final_crit = gather_to_host(best_crit)

@@ -16,7 +16,12 @@ comma-separated list of ``name:arg``:
   checkpoint for epoch N is written (the preemption-without-grace case);
 - ``marker_after_epoch:N`` — write the file named by
   ``REDCLIFF_FAULT_MARKER`` at the end of epoch N (lets a parent process
-  synchronize a SIGTERM with a known fit phase).
+  synchronize a SIGTERM with a known fit phase);
+- ``hang_between_ckpt_replaces:S`` — inside the durable writer's crash
+  window (head already renamed to ``.prev``, new generation not yet
+  promoted) write the marker file once and sleep S seconds, so a parent can
+  SIGKILL the process mid-(background)-checkpoint-write and prove the
+  ``.prev`` fallback resumes.
 
 Numerical fault points (consumed through :func:`poison_batch` /
 :func:`skip_update`, called by the trainers with a global step index; step
@@ -42,8 +47,8 @@ import pickle
 import signal
 import sys
 
-__all__ = ["crash_point", "poison_batch", "skip_update", "corrupt_checkpoint",
-           "flaky", "tiny_grid_fit"]
+__all__ = ["armed", "crash_point", "ckpt_write_point", "poison_batch",
+           "skip_update", "corrupt_checkpoint", "flaky", "tiny_grid_fit"]
 
 ENV_SPEC = "REDCLIFF_FAULT_INJECT"
 ENV_MARKER = "REDCLIFF_FAULT_MARKER"
@@ -60,6 +65,34 @@ def _active_faults():
         if name:
             out.append((name, arg))
     return tuple(out)
+
+
+def armed():
+    """True when ANY fault is armed. The engines use this to serialize
+    otherwise-asynchronous work (e.g. wait for the background checkpoint
+    writer before a crash point) so fault tests stay deterministic."""
+    return bool(os.environ.get(ENV_SPEC))
+
+
+def ckpt_write_point(stage, path=None):
+    """Hook inside ``runtime.checkpoint.write_checkpoint``'s crash window
+    (head renamed to ``.prev``, new generation not yet promoted).
+
+    ``hang_between_ckpt_replaces:SECONDS`` writes the ``REDCLIFF_FAULT_MARKER``
+    file (once) and then sleeps, holding the window open so a parent process
+    can SIGKILL this one mid-write — the on-disk state is then exactly
+    "head missing, .prev intact", which resume must recover from.
+    """
+    for name, arg in _active_faults():
+        if (name == "hang_between_ckpt_replaces"
+                and stage == "between_replaces"):
+            marker = os.environ.get(ENV_MARKER)
+            if marker and not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write(path or "")
+                import time
+
+                time.sleep(float(arg) if arg else 30.0)
 
 
 def crash_point(stage, epoch=None):

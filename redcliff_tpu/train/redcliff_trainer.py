@@ -19,7 +19,9 @@ functional trainer:
 """
 from __future__ import annotations
 
+import copy
 import os
+import sys
 from dataclasses import dataclass, field
 
 import jax
@@ -27,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import faultinject, numerics
@@ -71,6 +74,22 @@ class RedcliffTrainConfig:
     # overhead at large G); <= 1 keeps the one-dispatch-per-batch path.
     # Ignored in FreezeByBatch modes (accept/revert runs between batches)
     scan_batches: int = 0
+    # batch-stream execution mode (data/pipeline.py): "auto" resolves to the
+    # EPOCH engine — one jit'd dispatch scans the whole epoch's batch
+    # indices against the HBM-resident dataset — when eligible, degrading to
+    # the k-batch scan (scan_batches) and then per-batch dispatch. All modes
+    # are bit-identical; "per_batch"/"kscan"/"epoch" force a mode (still
+    # degrading when ineligible, e.g. multi-process or freeze-by-batch)
+    stream_mode: str = "auto"
+    # double-buffered host prefetch depth for streams that stay host
+    # resident (shard streams, multi-process runs): batch assembly +
+    # device_put of batch t+1 overlap compute of batch t. <= 0 disables
+    prefetch_batches: int = 2
+    # hand periodic checkpoint saves to a background writer thread (the
+    # device->host gather + CRC+.prev write stop stalling the train loop;
+    # completion barrier at the next save / fit end). Single-process only —
+    # multi-host saves run collective gathers and stay synchronous
+    async_checkpointing: bool = True
     # numerical fault policy (in-graph non-finite skip guard; divergence
     # rollback + lr backoff in the per-point trainer, per-lane quarantine
     # causes in the grid engine); None disables the sentinel
@@ -343,6 +362,12 @@ class RedcliffTrainer:
         prev_skipped = 0
         step_counter = 0
         aborted = None
+        # background checkpoint writer: periodic saves hand their
+        # device->host materialization + durable write to a thread
+        # (completion barrier at the next save / fit end)
+        writer = (durable_ckpt.AsyncCheckpointWriter()
+                  if save_dir and tc.async_checkpointing
+                  and jax.process_count() == 1 else None)
         logger = MetricLogger(save_dir)
         # try/finally: an exception mid-fit must still close the jsonl
         # handle (otherwise buffered context is lost and the fd leaks)
@@ -361,12 +386,20 @@ class RedcliffTrainer:
                 conf_mat = (np.zeros((cfg.num_supervised_factors,) * 2)
                             if cfg.num_supervised_factors > 0 else None)
 
-                # device-resident batches when the dataset supports them; plain
-                # call otherwise so duck-typed batches() implementations work
+                # device-resident batches when the dataset supports them;
+                # host-resident streams (shard streams, duck-typed batches()
+                # sources) ride the double-buffered prefetcher so batch
+                # assembly + device_put of batch t+1 overlap compute of t
                 dev_kw = ({"device": True}
                           if getattr(train_ds, "supports_device_batches", False)
                           else {})
-                for X, Y in train_ds.batches(tc.batch_size, rng=rng, **dev_kw):
+                batch_src = train_ds.batches(tc.batch_size, rng=rng, **dev_kw)
+                if not dev_kw and tc.prefetch_batches > 0:
+                    put = (jax.device_put if jax.process_count() == 1
+                           else None)
+                    batch_src = pipeline.prefetch_batches(
+                        batch_src, depth=tc.prefetch_batches, put=put)
+                for X, Y in batch_src:
                     X = faultinject.poison_batch(X, step_counter)
                     skip = faultinject.skip_update(step_counter)
                     step_counter += 1
@@ -502,7 +535,8 @@ class RedcliffTrainer:
                 if it % tc.check_every == 0 and save_dir:
                     self._save_checkpoint(save_dir, it, best_params, accepted, params,
                                           optA_state, optB_state, histories, best_it,
-                                          best_loss, tracker, aligned)
+                                          best_loss, tracker, aligned,
+                                          writer=writer)
                 if tc.verbose and it % max(1, tc.check_every) == 0:
                     print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
 
@@ -513,10 +547,19 @@ class RedcliffTrainer:
                        aborted=aborted)
         finally:
             logger.close()
+            if writer is not None:
+                # join the in-flight write on EVERY exit path: a background
+                # write failure re-raises on clean exits and is warned (not
+                # masked) while another exception is already propagating
+                writer.__exit__(*sys.exc_info())
         if save_dir:
+            # periodic background writes were already joined — and their
+            # failures raised — by the finally block's writer.__exit__
             self._save_checkpoint(save_dir, last_it, best_params, accepted, params,
                                   optA_state, optB_state, histories, best_it,
-                                  best_loss, tracker, aligned)
+                                  best_loss, tracker, aligned, writer=writer)
+            if writer is not None:
+                writer.wait()  # the final state must be durable on return
         return RedcliffFitResult(
             params=best_params, best_it=best_it if best_it is not None else 0,
             best_loss=float(best_loss), histories=histories, tracker=tracker,
@@ -613,7 +656,42 @@ class RedcliffTrainer:
 
     def _save_checkpoint(self, save_dir, it, best_params, accepted, params,
                          optA_state, optB_state, histories, best_it, best_loss,
-                         tracker, aligned):
+                         tracker, aligned, writer=None):
+        """``writer`` (AsyncCheckpointWriter) moves the device->host
+        materialization + durable writes onto a background thread: the main
+        thread only deep-copies the host-mutable state (histories/tracker —
+        the train loop keeps appending to the live objects) and kicks off
+        the async device->host copies. The device trees are safe to share
+        with the thread as-is: this trainer's steps do not donate buffers."""
+        if writer is not None and jax.process_count() == 1:
+            # deep copies only on the async path, where the background
+            # thread would otherwise read objects the loop keeps appending
+            hist_snap = copy.deepcopy(histories)
+            tracker_meta = (copy.deepcopy(tracker.as_dict())
+                            if tracker is not None else None)
+            tracker_state = (None if tracker is None
+                             else copy.deepcopy(dict(tracker.__dict__)))
+            for tree in (best_params, accepted, params, optA_state,
+                         optB_state):
+                for leaf in jax.tree.leaves(tree):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+            writer.submit(lambda: self._write_checkpoint_files(
+                save_dir, it, best_params, accepted, params, optA_state,
+                optB_state, hist_snap, best_it, best_loss, tracker_meta,
+                tracker_state, aligned))
+        else:
+            self._write_checkpoint_files(
+                save_dir, it, best_params, accepted, params, optA_state,
+                optB_state, histories, best_it, best_loss,
+                tracker.as_dict() if tracker is not None else None,
+                None if tracker is None else dict(tracker.__dict__),
+                aligned)
+
+    def _write_checkpoint_files(self, save_dir, it, best_params, accepted,
+                                params, optA_state, optB_state, histories,
+                                best_it, best_loss, tracker_meta,
+                                tracker_state, aligned):
         # all three artifacts ride the durable checkpoint writer (atomic
         # tmp+replace, CRC header, .prev generation): a preemption mid-write
         # can no longer tear the resume state
@@ -627,8 +705,8 @@ class RedcliffTrainer:
             })
         meta = {"epoch": it, "best_loss": float(best_loss), "best_it": best_it,
                 **histories}
-        if tracker is not None:
-            meta.update(tracker.as_dict())
+        if tracker_meta is not None:
+            meta.update(tracker_meta)
         durable_ckpt.write_checkpoint(
             os.path.join(save_dir,
                          "training_meta_data_and_hyper_parameters.pkl"), meta)
@@ -647,5 +725,5 @@ class RedcliffTrainer:
                 "best_it": best_it,
                 "best_loss": float(best_loss),
                 "aligned": aligned,
-                "tracker_state": None if tracker is None else dict(tracker.__dict__),
+                "tracker_state": tracker_state,
             })

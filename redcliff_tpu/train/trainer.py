@@ -23,7 +23,9 @@ the learning rate / aborts per the configured :class:`NumericsPolicy`.
 """
 from __future__ import annotations
 
+import copy
 import os
+import sys
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -32,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu.data import pipeline
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import faultinject, numerics
 from redcliff_tpu.runtime.numerics import NumericsPolicy
@@ -53,6 +56,14 @@ class TrainConfig:
     prox_lam: float = 0.0
     verbose: int = 0
     profile_dir: str | None = None  # opt-in jax.profiler trace output dir
+    # double-buffered host prefetch depth for datasets without device-batch
+    # support (shard streams): batch assembly + device_put of batch t+1
+    # overlap compute of batch t (data/pipeline.py). <= 0 disables
+    prefetch_batches: int = 2
+    # hand periodic checkpoint saves to a background writer thread — the
+    # device->host gather + durable CRC+.prev write stop stalling the epoch
+    # loop (completion barrier at the next save / fit end)
+    async_checkpointing: bool = True
     # numerical fault policy (in-graph skip guard + divergence rollback);
     # None disables the sentinel entirely
     numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
@@ -258,17 +269,30 @@ class Trainer:
         last_it = iter_start - 1
         # batches as device-side gathers from an HBM-resident copy: epochs
         # re-ship only index arrays, not batch data. Datasets without the
-        # capability keep the plain call (no kwarg), so duck-typed batches()
-        # implementations still work
+        # capability (shard streams, duck-typed batches() sources) keep the
+        # plain call and ride the double-buffered prefetcher instead
         dev_kw = ({"device": True}
                   if getattr(train_ds, "supports_device_batches", False)
                   else {})
+
+        def train_batch_iter():
+            src = train_ds.batches(cfg.batch_size, rng=rng, **dev_kw)
+            if not dev_kw and cfg.prefetch_batches > 0:
+                put = jax.device_put if jax.process_count() == 1 else None
+                src = pipeline.prefetch_batches(
+                    src, depth=cfg.prefetch_batches, put=put)
+            return src
         policy = cfg.numerics if self._guard else None
         monitor = (numerics.DivergenceMonitor(policy)
                    if policy is not None else None)
         nstate = numerics.init_numerics_state()
         prev_skipped = 0
         aborted = None
+        # background checkpoint writer (completion barrier at the next save
+        # and at fit end); multi-process saves stay synchronous
+        writer = (durable_ckpt.AsyncCheckpointWriter()
+                  if save_dir and cfg.async_checkpointing
+                  and jax.process_count() == 1 else None)
         logger = MetricLogger(save_dir)
         # try/finally: an exception mid-fit must still close the jsonl handle
         # (otherwise buffered context is lost and the fd leaks)
@@ -278,8 +302,7 @@ class Trainer:
             with profiler_trace(cfg.profile_dir):
                 for it in range(iter_start, cfg.max_iter):
                     last_it = it
-                    for X, Y in train_ds.batches(cfg.batch_size, rng=rng,
-                                                 **dev_kw):
+                    for X, Y in train_batch_iter():
                         step_rng = (jax.random.fold_in(step_key, step_counter)
                                     if self._wants_rng else None)
                         X = faultinject.poison_batch(X, step_counter)
@@ -349,7 +372,8 @@ class Trainer:
 
                     if it % cfg.check_every == 0 and save_dir:
                         self._save_checkpoint(save_dir, it, best_params, opt_state, params,
-                                              histories, best_it, best_loss, tracker)
+                                              histories, best_it, best_loss, tracker,
+                                              writer=writer)
                     if cfg.verbose and it % max(1, cfg.check_every) == 0:
                         print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
 
@@ -360,13 +384,23 @@ class Trainer:
                        aborted=aborted)
         finally:
             logger.close()
+            if writer is not None:
+                # join the in-flight write on EVERY exit path: a background
+                # write failure re-raises on clean exits and is warned (not
+                # masked) while another exception is already propagating
+                writer.__exit__(*sys.exc_info())
         if save_dir:
             # stamp the actual last trained epoch so a later resume with a larger
             # max_iter continues from where training really stopped; the resumable
             # state keeps the LAST iterate (params + its opt_state), while
-            # final_best_model.bin holds best_params
+            # final_best_model.bin holds best_params. (Periodic background
+            # writes were already joined — and their failures raised — by
+            # the finally block's writer.__exit__ above.)
             self._save_checkpoint(save_dir, last_it, best_params, opt_state,
-                                  params, histories, best_it, best_loss, tracker)
+                                  params, histories, best_it, best_loss,
+                                  tracker, writer=writer)
+            if writer is not None:
+                writer.wait()  # the final state must be durable on return
         params = best_params
         return FitResult(
             params=params, best_it=best_it if best_it is not None else 0,
@@ -375,10 +409,42 @@ class Trainer:
         )
 
     def _save_checkpoint(self, save_dir, it, best_params, opt_state, params,
-                         histories, best_it, best_loss, tracker):
+                         histories, best_it, best_loss, tracker, writer=None):
         """All three artifacts go through the durable checkpoint writer
         (atomic tmp+replace, CRC header, trailing .prev generation) — a
-        preemption mid-write can no longer tear the resume state."""
+        preemption mid-write can no longer tear the resume state.
+
+        ``writer`` (AsyncCheckpointWriter) moves the device->host
+        materialization + writes onto a background thread; the main thread
+        only deep-copies the host-mutable state (histories/tracker — the
+        loop keeps appending to the live objects) and kicks off the async
+        device->host copies. Sharing the device trees with the thread is
+        safe: this trainer's steps do not donate buffers."""
+        if writer is not None and jax.process_count() == 1:
+            # deep copies only on the async path, where the background
+            # thread would otherwise read objects the loop keeps appending
+            hist_snap = copy.deepcopy(histories)
+            tracker_meta = (copy.deepcopy(tracker.as_dict())
+                            if tracker is not None else None)
+            tracker_state = (None if tracker is None
+                             else copy.deepcopy(dict(tracker.__dict__)))
+            for tree in (best_params, params, opt_state):
+                for leaf in jax.tree.leaves(tree):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+            writer.submit(lambda: self._write_checkpoint_files(
+                save_dir, it, best_params, opt_state, params, hist_snap,
+                best_it, best_loss, tracker_meta, tracker_state))
+        else:
+            self._write_checkpoint_files(
+                save_dir, it, best_params, opt_state, params, histories,
+                best_it, best_loss,
+                tracker.as_dict() if tracker is not None else None,
+                None if tracker is None else dict(tracker.__dict__))
+
+    def _write_checkpoint_files(self, save_dir, it, best_params, opt_state,
+                                params, histories, best_it, best_loss,
+                                tracker_meta, tracker_state):
         os.makedirs(save_dir, exist_ok=True)
         save_model(save_dir, self.model, best_params)
         meta = {
@@ -387,8 +453,8 @@ class Trainer:
             "best_it": best_it,
             **histories,
         }
-        if tracker is not None:
-            meta.update(tracker.as_dict())
+        if tracker_meta is not None:
+            meta.update(tracker_meta)
         durable_ckpt.write_checkpoint(
             os.path.join(save_dir,
                          "training_meta_data_and_hyper_parameters.pkl"), meta)
@@ -405,6 +471,6 @@ class Trainer:
                 "histories": histories,
                 "best_it": best_it,
                 "best_loss": float(best_loss),
-                "tracker_state": None if tracker is None else dict(tracker.__dict__),
+                "tracker_state": tracker_state,
             },
         )

@@ -129,6 +129,56 @@ def test_sigkill_mid_fit_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_sigkill_during_async_ckpt_write_prev_fallback_resumes(tmp_path):
+    """Async-checkpointing crash safety: the fit is SIGKILLed while the
+    BACKGROUND writer sits inside the durable writer's crash window (head
+    already rotated to .prev, new generation not yet promoted — the
+    fault hook holds the window open and writes a marker). Resume must fall
+    back to the .prev generation and still finish bit-identical to an
+    uninterrupted run."""
+    ck = tmp_path / "ck"
+    marker = str(tmp_path / "in_window.marker")
+    env = dict(os.environ,
+               REDCLIFF_FAULT_INJECT="hang_between_ckpt_replaces:60",
+               REDCLIFF_FAULT_MARKER=marker)
+    proc = subprocess.Popen(
+        CHILD + ["--checkpoint-dir", str(ck), "--max-iter", "4"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = time.monotonic() + 180
+        while not os.path.exists(marker):
+            assert proc.poll() is None, proc.communicate()[1][-2000:]
+            assert time.monotonic() < deadline, \
+                "child never reached the checkpoint crash window"
+            time.sleep(0.05)
+        proc.kill()
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    head = str(ck / CKPT_NAME)
+    # killed inside the window: the head generation is gone, .prev intact
+    assert not os.path.exists(head)
+    obj, src = rck.load_checkpoint(head)
+    assert obj is not None and src == head + ".prev"
+
+    res_path = tmp_path / "resumed.pkl"
+    resumed = _run_child(ck, "--max-iter", "4", "--result", str(res_path))
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    full_path = tmp_path / "full.pkl"
+    uninterrupted = _run_child(tmp_path / "ck_full", "--max-iter", "4",
+                               "--result", str(full_path))
+    assert uninterrupted.returncode == 0, uninterrupted.stderr[-2000:]
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    with open(full_path, "rb") as f:
+        want = pickle.load(f)
+    np.testing.assert_array_equal(got["val_history"], want["val_history"])
+    for a, b in zip(got["best_params_leaves"], want["best_params_leaves"]):
+        np.testing.assert_array_equal(a, b)
+
+
 # ---------------------------------------------------------------------------
 # (b) corrupt checkpoint -> quarantine, clean restart
 # ---------------------------------------------------------------------------
