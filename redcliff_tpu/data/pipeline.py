@@ -41,6 +41,9 @@ import threading
 
 import numpy as np
 
+from redcliff_tpu.runtime import faultinject as _faultinject
+from redcliff_tpu.runtime import watchdog as _watchdog
+
 __all__ = [
     "epoch_batch_plan",
     "choose_stream_mode",
@@ -180,6 +183,12 @@ def prefetch_batches(iterator, depth=2, put=None):
     source (or ``put``) re-raises at the consumer's ``next()``. Abandoning
     the generator (consumer exception / early ``close``) cancels the thread
     promptly instead of leaking it blocked on a full queue.
+
+    Liveness: the worker stamps the ``"prefetch"`` heartbeat per produced
+    item AND while waiting on a full queue (a blocked-on-slow-consumer
+    worker is healthy; a worker wedged in the source or in ``put`` stops
+    stamping and the watchdog escalates). The heartbeat retires when the
+    stream ends, so inter-epoch idle never reads as a hang.
     """
     if depth < 1:
         yield from iterator
@@ -194,6 +203,9 @@ def prefetch_batches(iterator, depth=2, put=None):
         END/ERR sentinel when the queue happens to be full would leave the
         consumer blocked on q.get() forever with the real error lost."""
         while not cancel.is_set():
+            # a full queue means the CONSUMER is slow (e.g. compiling), not
+            # that this thread is hung — keep the heartbeat alive
+            _watchdog.stamp("prefetch")
             try:
                 q.put(item, timeout=0.1)
                 return True
@@ -204,6 +216,10 @@ def prefetch_batches(iterator, depth=2, put=None):
     def worker():
         try:
             for item in iterator:
+                if cancel.is_set():
+                    return
+                _watchdog.stamp("prefetch")
+                _faultinject.hang_point("prefetch")
                 if put is not None:
                     item = tuple(None if x is None else put(x) for x in item)
                 if not put_blocking(item):
@@ -211,6 +227,13 @@ def prefetch_batches(iterator, depth=2, put=None):
             put_blocking(END)
         except BaseException as e:  # noqa: BLE001 — re-raised at consumer
             put_blocking((ERR, e))
+        finally:
+            # a cancelled worker retires its own heartbeat: its stamps
+            # happen-before this (same thread), so an abandoning consumer
+            # can never be overtaken by a late stamp re-registering the
+            # beat after the consumer retired it (false-hang orphan)
+            if cancel.is_set():
+                _watchdog.retire("prefetch")
 
     t = threading.Thread(target=worker, name="batch-prefetch", daemon=True)
     t.start()
@@ -230,3 +253,8 @@ def prefetch_batches(iterator, depth=2, put=None):
                 q.get_nowait()
         except queue.Empty:
             pass
+        # bounded join, then retire: covers the normal end-of-stream case
+        # (worker already gone, never saw the cancel) while the worker's
+        # own cancelled-path retire above closes the abandonment race
+        t.join(timeout=5.0)
+        _watchdog.retire("prefetch")

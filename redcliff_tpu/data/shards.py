@@ -21,6 +21,8 @@ import warnings
 
 import numpy as np
 
+from ..runtime import faultinject as _faultinject
+from ..runtime import watchdog as _watchdog
 from ..utils.misc import flatten_directed_spectrum_features
 from ..utils.time_series import make_high_level_signal_features
 from .datasets import ArrayDataset
@@ -99,6 +101,16 @@ class ShardedBatchDataset:
     ``ArrayDataset``'s exact permutation); unshuffled iteration matches the
     concatenated-shard order bit-for-bit, which tests pin against
     ``ArrayDataset``.
+
+    Torn files: a shard that fails to read back — truncated mid-write,
+    bit-rotted pickle, vanished file — is quarantined PER FILE
+    (``quarantined_files[name] = reason``, with a RuntimeWarning) and the
+    stream continues with the remaining shards, the same degrade-don't-crash
+    contract the per-sample non-finite quarantine established. This covers
+    both construction and mid-stream reads (the file may tear between the
+    stats pass and epoch N). Each shard read stamps the ``"shard_loader"``
+    heartbeat so a read wedged on dead storage is a watchdog-visible hang,
+    not a silent stall.
     """
 
     supports_device_batches = False
@@ -112,6 +124,7 @@ class ShardedBatchDataset:
             raise FileNotFoundError(f"no subset_*.pkl shards under {split_dir}")
         self.normalize = normalize
         self.quarantined_samples = 0
+        self.quarantined_files = {}
         self._shape_tc = None
         n = 0
         s = ss = None
@@ -132,10 +145,13 @@ class ShardedBatchDataset:
             ss = ((part ** 2).sum(axis=(0, 1)) if ss is None
                   else ss + (part ** 2).sum(axis=(0, 1)))
         self._n = n
+        _watchdog.retire("shard_loader")  # stats pass done; batches() re-arms
         if self._shape_tc is None:
             raise ValueError(
-                f"every sample under {split_dir} was quarantined as "
-                f"non-finite — nothing to train on")
+                f"every sample under {split_dir} was quarantined "
+                f"(non-finite data or torn shard files: "
+                f"{sorted(self.quarantined_files) or 'none torn'}) — "
+                f"nothing to train on")
         shape_tc = self._shape_tc
         if normalize:
             cnt = max(n * shape_tc[0], 1)
@@ -152,9 +168,33 @@ class ShardedBatchDataset:
                 f"non-finite samples under {split_dir}", RuntimeWarning,
                 stacklevel=2)
 
+    def _empty(self):
+        return (np.zeros((0,) + (self._shape_tc or (0, 0)), np.float32),
+                np.zeros((0, 1), np.float32))
+
     def _load_shard(self, name, count_quarantine=False):
-        with open(os.path.join(self.split_dir, name), "rb") as f:
-            pairs = pickle.load(f)
+        # liveness + chaos hooks: stamped while a read is in flight (the
+        # budget measures one shard load, not inter-load idle — batches()
+        # retires the heartbeat when the stream ends)
+        _watchdog.stamp("shard_loader")
+        _faultinject.hang_point("shard_loader")
+        _faultinject.io_point("shard_read")
+        try:
+            with open(os.path.join(self.split_dir, name), "rb") as f:
+                pairs = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError, ValueError,
+                AttributeError, ImportError, IndexError) as e:
+            # torn/truncated/vanished shard: quarantine the FILE and keep
+            # streaming — the same degrade-don't-crash contract as the
+            # per-sample non-finite quarantine
+            if name not in self.quarantined_files:
+                self.quarantined_files[name] = repr(e)
+                warnings.warn(
+                    f"ShardedBatchDataset: quarantined torn shard file "
+                    f"{name} under {self.split_dir} ({e!r}); continuing "
+                    f"with the remaining shards", RuntimeWarning,
+                    stacklevel=3)
+            return self._empty()
         keep = []
         for pair in pairs:
             x = np.asarray(pair[0], dtype=np.float32)
@@ -167,9 +207,7 @@ class ShardedBatchDataset:
                     self.quarantined_samples += 1
                 continue
             keep.append([x, pair[1]])
-        return samples_to_arrays(keep) if keep else (
-            np.zeros((0,) + (self._shape_tc or (0, 0)), np.float32),
-            np.zeros((0, 1), np.float32))
+        return samples_to_arrays(keep) if keep else self._empty()
 
     def __len__(self):
         return self._n
@@ -189,30 +227,37 @@ class ShardedBatchDataset:
 
         One concatenation per shard (the short carry-over head is prepended
         once), then batches are yielded as views via a cursor — no
-        per-batch recopying of the remaining buffer."""
-        files = list(self.files)
-        if rng is not None:
-            rng.shuffle(files)
-        carry_X = carry_Y = None
-        for name in files:
-            X, Y = self._load_shard(name)
-            if not len(X):
-                continue  # fully-quarantined shard: nothing to buffer
+        per-batch recopying of the remaining buffer.
+
+        A shard that tears between epochs is quarantined per file (see the
+        class docstring) and the stream continues over the survivors."""
+        try:
+            files = list(self.files)
             if rng is not None:
-                order = rng.permutation(len(X))
-                X, Y = X[order], Y[order]
-            if self.normalize:
-                X = (X - self.stats[0]) / self.stats[1]
-            if carry_X is not None and len(carry_X):
-                X = np.concatenate([carry_X, X])
-                Y = np.concatenate([carry_Y, Y])
-            stop = (len(X) // batch_size) * batch_size
-            for start in range(0, stop, batch_size):
-                yield X[start : start + batch_size], \
-                    Y[start : start + batch_size]
-            carry_X, carry_Y = X[stop:], Y[stop:]
-        if carry_X is not None and len(carry_X) and not drop_remainder:
-            yield carry_X, carry_Y
+                rng.shuffle(files)
+            carry_X = carry_Y = None
+            for name in files:
+                X, Y = self._load_shard(name)
+                if not len(X):
+                    continue  # fully-quarantined shard: nothing to buffer
+                if rng is not None:
+                    order = rng.permutation(len(X))
+                    X, Y = X[order], Y[order]
+                if self.normalize:
+                    X = (X - self.stats[0]) / self.stats[1]
+                if carry_X is not None and len(carry_X):
+                    X = np.concatenate([carry_X, X])
+                    Y = np.concatenate([carry_Y, Y])
+                stop = (len(X) // batch_size) * batch_size
+                for start in range(0, stop, batch_size):
+                    yield X[start : start + batch_size], \
+                        Y[start : start + batch_size]
+                carry_X, carry_Y = X[stop:], Y[stop:]
+            if carry_X is not None and len(carry_X) and not drop_remainder:
+                yield carry_X, carry_Y
+        finally:
+            # op-scoped liveness: idle between epochs is not a hang
+            _watchdog.retire("shard_loader")
 
     def num_batches(self, batch_size, drop_remainder=False):
         n = self._n

@@ -41,7 +41,9 @@ from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import faultinject, numerics
-from redcliff_tpu.runtime.preempt import Preempted, PreemptionGuard
+from redcliff_tpu.runtime import watchdog as rt_watchdog
+from redcliff_tpu.runtime.preempt import (DeadlineExceeded, Preempted,
+                                          PreemptionGuard)
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 from redcliff_tpu.utils.precision import matmul_precision_ctx
@@ -67,9 +69,25 @@ class GridSpec:
     """G hyperparameter points sharing one model shape. Each entry of ``points``
     maps coefficient/optimizer/stopping axis names (COEFF_AXES + OPT_AXES +
     STOP_AXES) to floats; unspecified axes fall back to the base config /
-    train config values."""
+    train config values.
+
+    Wall-clock deadlines (docs/ARCHITECTURE.md "Liveness & supervision"):
+    ``fit_deadline_s`` budgets each LANE — a scalar applies to every point, a
+    sequence gives per-point budgets; a lane still active when its budget
+    expires is checkpointed and evicted into ``GridResult.failures`` with
+    cause ``"deadline"`` (the non-finite quarantine machinery; sibling-lane
+    math is untouched, so their results are bit-identical to a no-deadline
+    run). ``grid_deadline_s`` budgets the WHOLE fit: at the first epoch
+    boundary past it, in-flight work is drained, a final checkpoint written,
+    and :class:`~redcliff_tpu.runtime.preempt.DeadlineExceeded` raised —
+    the run exits resumable, like a self-inflicted preemption. Budgets are
+    per-process wall clock (a resumed attempt gets a fresh budget) and are
+    deliberately NOT part of the resume fingerprint: changing them changes
+    how long you search, never what a lane computes."""
 
     points: Sequence[dict]
+    fit_deadline_s: Any = None   # scalar | per-point sequence | None
+    grid_deadline_s: float | None = None
 
     def __post_init__(self):
         valid = set(COEFF_AXES) | set(OPT_AXES) | set(STOP_AXES)
@@ -79,6 +97,25 @@ class GridSpec:
                 raise ValueError(
                     f"grid point {i} has unknown hyperparameter axes "
                     f"{sorted(unknown)}; valid axes: {sorted(valid)}")
+        if self.grid_deadline_s is not None and self.grid_deadline_s <= 0:
+            raise ValueError("grid_deadline_s must be positive")
+        if self.fit_deadline_s is not None:
+            lanes = self.lane_deadlines()
+            if len(lanes) != len(self.points):
+                raise ValueError(
+                    f"fit_deadline_s has {len(lanes)} entries for "
+                    f"{len(self.points)} grid points")
+            if (lanes <= 0).any():
+                raise ValueError("fit_deadline_s entries must be positive")
+
+    def lane_deadlines(self):
+        """Per-lane wall-clock budgets as a float array ((G,), inf = no
+        budget), or None when no per-fit deadline is configured."""
+        if self.fit_deadline_s is None:
+            return None
+        if np.ndim(self.fit_deadline_s) == 0:
+            return np.full((len(self.points),), float(self.fit_deadline_s))
+        return np.asarray([float(d) for d in self.fit_deadline_s])
 
     def stacked(self, base_cfg, train_cfg):
         G = len(self.points)
@@ -525,6 +562,11 @@ class RedcliffGridRunner:
             "stream_mode": tc.stream_mode,
             "prefetch_batches": tc.prefetch_batches,
             "max_iter": tc.max_iter,
+            # matmul precision changes every step's update math (MXU bf16 vs
+            # f32 passes), so resuming under a different precision would
+            # break the bit-identity promise mid-stream (ADVICE r5 audit:
+            # the one update-math knob the PR-3 fingerprint missed)
+            "matmul_precision": tc.matmul_precision,
             # the numerics guard gates every update and decides lane
             # quarantine, so a changed/disabled policy is a different fit
             "numerics": (None if tc.numerics is None
@@ -659,6 +701,13 @@ class RedcliffGridRunner:
             # non-default knobs still reject loudly
             want_meta.pop("stream_mode")
             want_meta.pop("prefetch_batches")
+        if ("matmul_precision" not in meta
+                and want_meta.get("matmul_precision") is None):
+            # pre-watchdog checkpoint: written before the precision knob
+            # joined the fingerprint; the backend-default precision (None)
+            # is what every such checkpoint trained under, so resuming under
+            # the default is sound — a non-default precision still rejects
+            want_meta.pop("matmul_precision")
         diff = ([k for k in want_meta if meta.get(k) != want_meta[k]]
                 + [k for k in meta if k not in want_meta])
         if diff:
@@ -694,7 +743,18 @@ class RedcliffGridRunner:
         with a cause in ``GridResult.failures``) while the rest of the grid
         keeps training. Because checkpoints store gathered host
         state, a fit may resume on a different (e.g. smaller) device mesh
-        than the one that wrote the checkpoint."""
+        than the one that wrote the checkpoint.
+
+        Liveness (ARCHITECTURE.md "Liveness & supervision"): when
+        ``REDCLIFF_WATCHDOG`` is set, a daemon watchdog monitors the
+        heartbeats stamped by this loop, the prefetcher, the shard loader,
+        and the async checkpoint writer, and escalates a stale one:
+        log -> final checkpoint via the preemption latch -> hard exit with
+        the ``hang`` taxonomy code for the supervisor to restart.
+        ``GridSpec.fit_deadline_s`` evicts over-budget lanes into
+        ``failures`` (cause ``"deadline"``, state checkpointed);
+        ``GridSpec.grid_deadline_s`` ends the whole fit resumably with
+        :class:`~redcliff_tpu.runtime.preempt.DeadlineExceeded`."""
         # the guard wraps the whole fit so a signal during compile/data
         # staging is latched too; _fit polls it at epoch boundaries
         guard = PreemptionGuard(enabled=checkpoint_dir is not None)
@@ -709,22 +769,37 @@ class RedcliffGridRunner:
                 and jax.process_count() == 1):
             writer = durable_ckpt.AsyncCheckpointWriter()
         wctx = writer if writer is not None else contextlib.nullcontext()
-        with guard, profiler_trace(self.tc.profile_dir), wctx:
+        # liveness watchdog (env-armed, REDCLIFF_WATCHDOG): monitors the
+        # heartbeats this fit and its data/checkpoint threads stamp, and
+        # escalates a stale one log -> preempt-latch (one final checkpoint
+        # via `guard`) -> hard exit EXIT_HANG for the supervisor to restart.
+        # Daemonized + stopped on every exit path, so no teardown can hang
+        wd = rt_watchdog.maybe_start(guard=guard if guard.enabled else None)
+        with guard, profiler_trace(self.tc.profile_dir), wctx, wd as live_wd:
             return self._fit(key, train_ds, val_ds, max_iter=max_iter,
                              log_dir=log_dir, init_params=init_params,
                              copy_init=copy_init,
                              checkpoint_dir=checkpoint_dir,
                              checkpoint_every=checkpoint_every,
-                             guard=guard, writer=writer)
+                             guard=guard, writer=writer, wd=live_wd)
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
              log_dir=None, init_params=None, copy_init=True,
              checkpoint_dir=None, checkpoint_every=None,
-             guard=None, writer=None) -> GridResult:
+             guard=None, writer=None, wd=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
         G = len(self.spec.points)
+        # wall-clock deadline bookkeeping: budgets are per-process (a
+        # resumed attempt gets a fresh budget — the deadline bounds THIS
+        # allocation's spend, not the fit's total history)
+        fit_t0 = time.monotonic()
+        lane_deadline = self.spec.lane_deadlines()
+        # host-side memo of lanes already deadline-evicted, so the per-epoch
+        # check degenerates to a numpy compare (no device sync) once every
+        # over-budget lane is handled
+        dl_done = np.zeros((G,), dtype=bool)
         stop_after = tc.lookback * tc.check_every
         coeffs = self._shard(self.coeffs)
         ckpt = ck_src = ck_meta = None
@@ -890,13 +965,22 @@ class RedcliffGridRunner:
             jax.block_until_ready(self._ensure_snapshot_fn()(warm))
 
         logger = MetricLogger(log_dir)
+        if wd is not None:
+            # hang incidents land in THIS fit's metrics.jsonl
+            wd.bind(logger=logger)
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
                    training_mode=self.model.config.training_mode,
                    stream_mode=base_stream,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
                    resumed_from=ck_src,
                    points=list(self.spec.points))
+        # fault-injection step index for the host-stream paths (nan_batch /
+        # grad_blowup / skip specs); per-process, like the trainers'
+        fi_step = 0
         for it in range(start_it, max_iter):
+            # the epoch engine's own heartbeat: one stamp per epoch boundary
+            # (budget must cover compile + the longest legit epoch)
+            rt_watchdog.stamp("epoch_engine")
             cfg0 = self.model.config
             if (not aligned and "pretrain_factor" in cfg0.training_mode
                     and it == cfg0.num_pretrain_epochs
@@ -962,6 +1046,7 @@ class RedcliffGridRunner:
                     return state
 
                 for X, Y in train_batch_iter():
+                    rt_watchdog.stamp("batch_loop")
                     if Y is None or X.shape[0] != tc.batch_size:
                         state = run_group(state, group)
                         group = []
@@ -974,9 +1059,16 @@ class RedcliffGridRunner:
                         state = run_group(state, group)
                         group = []
                 state = run_group(state, group)
+                rt_watchdog.retire("batch_loop")
                 params, optA_state, optB_state, nstate = state
             else:
                 for X, Y in train_batch_iter():
+                    rt_watchdog.stamp("batch_loop")
+                    # numerical fault injection rides the host per-batch
+                    # path only (the scanned modes consume device-resident
+                    # data); one env lookup when unarmed
+                    X = faultinject.poison_batch(X, fi_step)
+                    fi_step += 1
                     for phase in phases:
                         stats["train_dispatches"] += 1
                         params, optA_state, optB_state, nstate, _ = \
@@ -984,6 +1076,7 @@ class RedcliffGridRunner:
                                                nstate, coeffs, active, X, Y)
                     if self._freeze_by_batch:
                         params, accepted = self._freeze_step(params, accepted)
+                rt_watchdog.retire("batch_loop")
             if val_scan_ok:
                 # whole validation set in one scanned dispatch (sequential
                 # carry adds — bit-identical to the per-batch loop's sums);
@@ -1093,6 +1186,61 @@ class RedcliffGridRunner:
                     best_params, params)
                 best_epoch = jnp.where(active, jnp.int32(it), best_epoch)
 
+            # ---- wall-clock deadlines (ARCHITECTURE.md "Liveness &
+            # supervision"). Lane eviction runs AFTER this epoch's best/
+            # early-stop bookkeeping: the evicted lane keeps everything it
+            # earned through this epoch, and from the next epoch its lane
+            # freezes via the same active-mask machinery as a non-finite
+            # quarantine — sibling-lane math is untouched, so their results
+            # stay bit-identical to a no-deadline run
+            force_ckpt = False
+            grid_dl_hit = False
+            elapsed = None
+            if lane_deadline is not None or self.spec.grid_deadline_s:
+                elapsed = time.monotonic() - fit_t0
+                if jax.process_count() > 1:
+                    # deadline decisions feed collectives (the eviction
+                    # gather, the final save), so every process must take
+                    # them on the same epoch: process 0's clock decides, on
+                    # the check_every cadence so the broadcast rides an
+                    # existing sync point instead of adding a per-epoch one
+                    if (it + 1) % tc.check_every == 0:
+                        from jax.experimental import multihost_utils
+
+                        elapsed = float(multihost_utils.broadcast_one_to_all(
+                            np.asarray(elapsed)))
+                    else:
+                        elapsed = None
+            if lane_deadline is not None and elapsed is not None:
+                over = np.logical_and(lane_deadline < elapsed,
+                                      np.logical_not(dl_done))
+                if over.any():
+                    dl_done |= over
+                    dl_bad = self._shard(jnp.asarray(over))
+                    newly_dl = jnp.logical_and(active, dl_bad)
+                    # host sync only on the (rare) eviction epoch itself
+                    n_evict = int(np.asarray(gather_to_host(
+                        jnp.sum(newly_dl))))
+                    if n_evict:
+                        failed_epoch = jnp.where(newly_dl, jnp.int32(it),
+                                                 failed_epoch)
+                        failed_cause = jnp.where(
+                            newly_dl, jnp.int32(numerics.CAUSE_DEADLINE),
+                            failed_cause)
+                        active = jnp.logical_and(active,
+                                                 jnp.logical_not(dl_bad))
+                        # the evicted lane's state must land durably: force
+                        # a checkpoint at this epoch regardless of cadence
+                        force_ckpt = True
+                        logger.log("deadline_evicted", epoch=it,
+                                   elapsed_s=round(elapsed, 3),
+                                   lanes=[int(g)
+                                          for g in np.flatnonzero(over)],
+                                   num_evicted=n_evict)
+            if (self.spec.grid_deadline_s and elapsed is not None
+                    and elapsed > self.spec.grid_deadline_s):
+                grid_dl_hit = True
+
             # structured per-epoch record; syncing the grid losses to host
             # costs one transfer, so only do it on the check_every cadence.
             # gather_to_host is a collective on multi-host meshes, so the
@@ -1135,7 +1283,8 @@ class RedcliffGridRunner:
                     "rng_state": rng.bit_generator.state, "epoch": it,
                 }
                 saved = False
-                if checkpoint_every and (it + 1) % checkpoint_every == 0:
+                if (checkpoint_every and (it + 1) % checkpoint_every == 0) \
+                        or force_ckpt or grid_dl_hit:
                     t_save = time.perf_counter()
                     self._save_checkpoint(checkpoint_dir, snap, ck_meta,
                                           writer=writer)
@@ -1180,9 +1329,26 @@ class RedcliffGridRunner:
                     logger.close()
                     raise Preempted(guard.signum if guard else None,
                                     epoch=it)
+            if grid_dl_hit:
+                # whole-grid deadline: in-flight work is already drained
+                # (the epoch completed; the forced save above is the final
+                # checkpoint when checkpointing is on) — flush and exit
+                # resumable, a self-inflicted preemption with its own
+                # taxonomy code
+                if writer is not None:
+                    writer.wait()
+                logger.log("grid_deadline_final_checkpoint", epoch=it,
+                           elapsed_s=round(elapsed, 3),
+                           deadline_s=float(self.spec.grid_deadline_s),
+                           checkpointed=checkpoint_dir is not None)
+                logger.close()
+                raise DeadlineExceeded(
+                    "grid", epoch=it, elapsed_s=elapsed,
+                    deadline_s=float(self.spec.grid_deadline_s))
             stats["epochs"] += 1
             faultinject.crash_point("epoch_end", epoch=it)
 
+        rt_watchdog.retire("epoch_engine")
         if writer is not None:
             # completion barrier: surface any background write failure and
             # guarantee the last generation is durable before results return

@@ -33,6 +33,7 @@ can import the retry primitives safely.
 """
 from redcliff_tpu.runtime.checkpoint import (  # noqa: F401
     CheckpointCorruptError,
+    CheckpointWriteError,
     dataset_fingerprint,
     load_checkpoint,
     quarantine,

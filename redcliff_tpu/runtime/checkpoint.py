@@ -36,7 +36,10 @@ import zlib
 
 import numpy as np
 
-__all__ = ["CheckpointCorruptError", "write_checkpoint", "read_checkpoint",
+from redcliff_tpu.runtime import watchdog as _watchdog
+
+__all__ = ["CheckpointCorruptError", "CheckpointWriteError",
+           "write_checkpoint", "read_checkpoint",
            "load_checkpoint", "quarantine", "dataset_fingerprint",
            "AsyncCheckpointWriter", "FORMAT_VERSION"]
 
@@ -49,13 +52,36 @@ class CheckpointCorruptError(RuntimeError):
     """A checkpoint file exists but fails header/CRC/unpickle validation."""
 
 
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint could not be written durably (ENOSPC/EIO/permission...).
+
+    Carries ``path`` and ``errno`` so callers can distinguish disk-full from
+    anything else; the tmp file has already been cleaned up and any existing
+    on-disk generations are intact (the atomic-promotion protocol never
+    damages them)."""
+
+    def __init__(self, path, cause):
+        self.path = path
+        self.errno = getattr(cause, "errno", None)
+        import errno as _errno
+
+        hint = (" — disk full" if self.errno == _errno.ENOSPC else
+                " — I/O error" if self.errno == _errno.EIO else "")
+        super().__init__(
+            f"could not write checkpoint {path}{hint}: {cause}")
+
+
 def write_checkpoint(path, obj):
     """Atomically write ``obj`` to ``path`` with header+CRC, keeping the
     previous file as ``path + '.prev'``.
 
     The tmp file is fsynced before promotion, so after ``os.replace`` returns
     the new generation is on disk; a crash between the two replaces leaves
-    only ``.prev``, which :func:`load_checkpoint` restores from.
+    only ``.prev``, which :func:`load_checkpoint` restores from. OS-level
+    failures (disk full, EIO, permissions) are mapped to
+    :class:`CheckpointWriteError` with the tmp file removed — the write
+    failed CLEANLY: prior generations are untouched and no orphan tmp is
+    left to fill the disk further.
     """
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     header = _HEADER.pack(MAGIC, FORMAT_VERSION,
@@ -64,22 +90,35 @@ def write_checkpoint(path, obj):
     # AsyncCheckpointWriter racing a synchronous fallback save in the same
     # process) must never share a tmp file
     tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "wb") as f:
-        f.write(header)
-        f.write(payload)
-        f.flush()
-        os.fsync(f.fileno())
-    if os.path.exists(path):
-        os.replace(path, path + ".prev")
-        # crash window: the head is gone and the new generation not yet
-        # promoted — readers fall back to .prev. Fault injection widens this
-        # window on purpose (SIGKILL-during-async-write test); one env
-        # lookup when unarmed
-        if os.environ.get("REDCLIFF_FAULT_INJECT"):
-            from redcliff_tpu.runtime import faultinject
+    armed = os.environ.get("REDCLIFF_FAULT_INJECT")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.write(payload)
+            if armed:
+                from redcliff_tpu.runtime import faultinject
 
-            faultinject.ckpt_write_point("between_replaces", path=path)
-    os.replace(tmp, path)
+                faultinject.io_point("ckpt_write")
+                faultinject.io_error_point("ckpt_write")
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+            # crash window: the head is gone and the new generation not yet
+            # promoted — readers fall back to .prev. Fault injection widens
+            # this window on purpose (SIGKILL-during-async-write test); one
+            # env lookup when unarmed
+            if armed:
+                from redcliff_tpu.runtime import faultinject
+
+                faultinject.ckpt_write_point("between_replaces", path=path)
+        os.replace(tmp, path)
+    except OSError as e:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise CheckpointWriteError(path, e) from e
 
 
 def read_checkpoint(path):
@@ -166,9 +205,10 @@ class AsyncCheckpointWriter:
     loop keeps dispatching while the gather + pickle + fsync happen off the
     main thread.
 
-    ``wait()`` joins the in-flight write and re-raises anything it threw,
-    so a failed background write surfaces at the next save or at fit end
-    instead of vanishing. Crash safety is unchanged from the synchronous
+    ``wait()`` joins the in-flight write and re-raises anything it threw —
+    :class:`CheckpointWriteError` (disk full / EIO) comes back TYPED, so the
+    failure surfaces at the next submit barrier or at fit end instead of the
+    writer thread dying silently. Crash safety is unchanged from the synchronous
     path: :func:`write_checkpoint` is atomic with a ``.prev`` generation,
     so a SIGKILL mid-background-write leaves the previous generation
     loadable (pinned by tests/test_fault_injection.py).
@@ -190,10 +230,21 @@ class AsyncCheckpointWriter:
         self.wait()
 
         def run():
+            # liveness: the writer heartbeats while a write is in flight and
+            # retires after, so idle gaps between saves can never read as a
+            # hang — but a wedged gather/fsync goes stale and the watchdog
+            # escalates (hang_in:ckpt_writer injects exactly that)
+            _watchdog.stamp("ckpt_writer")
             try:
+                if os.environ.get("REDCLIFF_FAULT_INJECT"):
+                    from redcliff_tpu.runtime import faultinject
+
+                    faultinject.hang_point("ckpt_writer")
                 fn()
             except BaseException as e:  # noqa: BLE001 — re-raised in wait()
                 self._err = e
+            finally:
+                _watchdog.retire("ckpt_writer")
 
         self._thread = threading.Thread(target=run, name="ckpt-writer",
                                         daemon=True)
@@ -205,6 +256,8 @@ class AsyncCheckpointWriter:
             t.join()
         err, self._err = self._err, None
         if err is not None:
+            if isinstance(err, CheckpointWriteError):
+                raise err  # typed: callers can tell disk-full from bugs
             raise RuntimeError(
                 "background checkpoint write failed") from err
 

@@ -23,6 +23,26 @@ comma-separated list of ``name:arg``:
   SIGKILL the process mid-(background)-checkpoint-write and prove the
   ``.prev`` fallback resumes.
 
+Liveness fault points (the chaos-soak half of the watchdog story,
+docs/ARCHITECTURE.md "Liveness & supervision"):
+
+- ``hang_in:COMPONENT:S`` — wedge the named heartbeat-stamped component
+  (``prefetch`` / ``shard_loader`` / ``ckpt_writer``) by sleeping S seconds
+  at its fault point. Fires ONCE per marker file when
+  ``REDCLIFF_FAULT_MARKER`` is set (a once-guard file named
+  ``<marker>.hang_<component>`` is written), so a supervisor-restarted
+  attempt runs clean and the hang->detect->restart->finish loop closes;
+- ``slow_io:MS`` — sleep MS milliseconds at every IO fault point
+  (checkpoint writes, shard reads): degraded-NFS latency, not a hang;
+- ``io_error:KIND[:ERRNO]`` — raise an injected ``OSError`` (default
+  ``ENOSPC``) at the named IO site (``ckpt_write``). Once-per-marker gated
+  like ``hang_in`` so a restarted attempt can succeed.
+
+:func:`random_fault_schedule` composes seeded schedules from this full
+grammar (kill / nan / hang / torn write / slow IO / disk error) for the
+chaos soak harness (tests/test_supervisor.py): a supervised run under ANY
+schedule must terminate with correct final artifacts.
+
 Numerical fault points (consumed through :func:`poison_batch` /
 :func:`skip_update`, called by the trainers with a global step index; step
 specs are either one step ``"5"`` or an inclusive range ``"5-8"``):
@@ -42,17 +62,25 @@ jax is imported lazily: the module is importable by backend-free processes.
 from __future__ import annotations
 
 import argparse
+import errno as _errno
 import os
 import pickle
+import random
 import signal
 import sys
 
+from redcliff_tpu.runtime.watchdog import EXIT_DEADLINE, EXIT_PREEMPTED
+
 __all__ = ["armed", "crash_point", "ckpt_write_point", "poison_batch",
-           "skip_update", "corrupt_checkpoint", "flaky", "tiny_grid_fit"]
+           "skip_update", "hang_point", "io_point", "io_error_point",
+           "corrupt_checkpoint", "flaky", "random_fault_schedule",
+           "tiny_grid_fit", "tiny_sharded_fit"]
 
 ENV_SPEC = "REDCLIFF_FAULT_INJECT"
 ENV_MARKER = "REDCLIFF_FAULT_MARKER"
-PREEMPTED_EXIT_CODE = 17
+# the preempted exit code predates the watchdog taxonomy; it IS taxonomy
+# code 17 now (runtime/watchdog.py), re-exported for the older tests
+PREEMPTED_EXIT_CODE = EXIT_PREEMPTED
 
 
 def _active_faults():
@@ -148,6 +176,93 @@ def skip_update(step):
     return False
 
 
+def _once_guard(suffix):
+    """True when this fault may fire: with ``REDCLIFF_FAULT_MARKER`` set the
+    fault fires once per marker (a ``<marker><suffix>`` guard file is
+    written), so a supervisor-restarted attempt runs clean; without a marker
+    the fault fires every time (unit-test mode)."""
+    marker = os.environ.get(ENV_MARKER)
+    if not marker:
+        return True
+    guard = marker + suffix
+    if os.path.exists(guard):
+        return False
+    with open(guard, "w") as f:
+        f.write(suffix)
+    return True
+
+
+def hang_point(component):
+    """Liveness fault point: wedge ``component`` (sleep) when a matching
+    ``hang_in:component:S`` fault is armed. Placed next to the component's
+    heartbeat stamp, so the stamp stops and the watchdog must notice."""
+    for name, arg in _active_faults():
+        if name != "hang_in":
+            continue
+        comp, _, secs = arg.partition(":")
+        if comp != component or not _once_guard(f".hang_{component}"):
+            continue
+        import time
+
+        time.sleep(float(secs) if secs else 3600.0)
+
+
+def io_point(kind):
+    """Latency fault point: ``slow_io:MS`` sleeps MS milliseconds at every
+    IO site (``kind`` is informational — degraded storage is global)."""
+    for name, arg in _active_faults():
+        if name == "slow_io":
+            import time
+
+            time.sleep((float(arg) if arg else 10.0) / 1e3)
+
+
+def io_error_point(kind):
+    """Disk-failure fault point: ``io_error:KIND[:ERRNO]`` raises an
+    injected ``OSError`` (default ENOSPC — disk full) at the named IO site.
+    Once-per-marker gated like :func:`hang_point`."""
+    for name, arg in _active_faults():
+        if name != "io_error":
+            continue
+        k, _, en = arg.partition(":")
+        if k != kind or not _once_guard(f".ioerr_{kind}"):
+            continue
+        code = getattr(_errno, en, _errno.ENOSPC) if en else _errno.ENOSPC
+        raise OSError(code, f"{os.strerror(code)} (injected at {kind})")
+
+
+# the full chaos grammar the schedule fuzzer draws from; every entry must
+# leave a supervised run able to TERMINATE (hangs are once-per-marker and
+# watchdog-evictable, kills land after a durable checkpoint generation)
+FAULT_KINDS = ("kill", "nan", "hang", "torn_write", "slow_io", "io_error")
+
+
+def random_fault_schedule(seed, max_epoch=2, components=("prefetch",
+                                                         "shard_loader",
+                                                         "ckpt_writer")):
+    """One seeded random fault schedule (an ``REDCLIFF_FAULT_INJECT`` spec)
+    composed from the full grammar: kill / nan / hang / torn write / slow IO
+    / disk error. Deterministic in ``seed``; 1-2 faults per schedule so
+    compositions (e.g. slow IO + a mid-write kill) occur across the soak."""
+    r = random.Random(seed)
+    faults = []
+    for kind in r.sample(FAULT_KINDS, r.randint(1, 2)):
+        if kind == "kill":
+            faults.append(
+                f"sigkill_after_checkpoint:{r.randint(0, max_epoch)}")
+        elif kind == "nan":
+            faults.append(f"nan_batch:{r.randint(0, 5)}")
+        elif kind == "hang":
+            faults.append(f"hang_in:{r.choice(components)}:600")
+        elif kind == "torn_write":
+            faults.append("hang_between_ckpt_replaces:600")
+        elif kind == "slow_io":
+            faults.append(f"slow_io:{r.randint(1, 25)}")
+        elif kind == "io_error":
+            faults.append("io_error:ckpt_write:ENOSPC")
+    return ",".join(faults)
+
+
 def corrupt_checkpoint(path, mode="truncate"):
     """Damage a checkpoint file in a controlled way.
 
@@ -194,21 +309,15 @@ def flaky(n_failures, value=True, exc=None):
 # in-process or as a subprocess, so killed/resumed/uninterrupted legs are
 # directly comparable
 # ---------------------------------------------------------------------------
-def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
-                  bad_point=False):
-    """Run the harness's canonical small grid fit and return its GridResult.
-
-    ``bad_point`` swaps point 1's learning rate for an absurd value that
-    drives its loss non-finite within an epoch (exercises the non-finite
-    quarantine path). Everything is seeded; two invocations with the same
-    arguments produce bit-identical results on the same backend.
-    """
+def _tiny_runner(max_iter, bad_point=False, fit_deadline_s=None,
+                 grid_deadline_s=None):
+    """The harness's canonical small grid runner plus its deterministic data
+    arrays (shared by the in-memory and sharded child fits)."""
     import jax
     import numpy as np
 
     jax.config.update("jax_platforms", "cpu")
 
-    from redcliff_tpu.data.datasets import ArrayDataset
     from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
     from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
@@ -229,16 +338,73 @@ def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
                else {"gen_lr": 3e-3})]
     tc = RedcliffTrainConfig(max_iter=max_iter, batch_size=16, check_every=1,
                              seed=0)
-    runner = RedcliffGridRunner(model, tc, GridSpec(points=points))
+    spec = GridSpec(points=points, fit_deadline_s=fit_deadline_s,
+                    grid_deadline_s=grid_deadline_s)
+    runner = RedcliffGridRunner(model, tc, spec)
     cfg = model.config
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
     X = rng.normal(size=(48, T, cfg.num_chans)).astype(np.float32)
     Y = rng.uniform(size=(48, 3, 1)).astype(np.float32)
+    return runner, X, Y
+
+
+def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
+                  bad_point=False, fit_deadline_s=None, grid_deadline_s=None):
+    """Run the harness's canonical small grid fit and return its GridResult.
+
+    ``bad_point`` swaps point 1's learning rate for an absurd value that
+    drives its loss non-finite within an epoch (exercises the non-finite
+    quarantine path). Everything is seeded; two invocations with the same
+    arguments produce bit-identical results on the same backend.
+    """
+    import jax
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+
+    runner, X, Y = _tiny_runner(max_iter, bad_point=bad_point,
+                                fit_deadline_s=fit_deadline_s,
+                                grid_deadline_s=grid_deadline_s)
     ds = ArrayDataset(X, Y)
     return runner.fit(jax.random.PRNGKey(2), ds, ds,
                       checkpoint_dir=checkpoint_dir,
-                      checkpoint_every=checkpoint_every)
+                      checkpoint_every=checkpoint_every,
+                      log_dir=checkpoint_dir)
+
+
+def tiny_sharded_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
+                     fit_deadline_s=None, grid_deadline_s=None):
+    """The supervised-run child: the same tiny grid fit, but streamed from
+    on-disk shards so the host path exercises EVERY watchdog-stamped
+    component — per-batch loop, double-buffered prefetcher, shard loader,
+    async checkpoint writer. The shards are written deterministically under
+    ``<checkpoint_dir>/shards`` (idempotent, so a supervisor-restarted
+    attempt reuses them) and the fit is bit-identical across restarts."""
+    import jax
+
+    from redcliff_tpu.data.shards import ShardedBatchDataset
+
+    runner, X, Y = _tiny_runner(max_iter, fit_deadline_s=fit_deadline_s,
+                                grid_deadline_s=grid_deadline_s)
+    split = os.path.join(checkpoint_dir, "shards", "train")
+    if not os.path.isdir(split):
+        os.makedirs(split)
+        half = len(X) // 2
+        for i, sl in enumerate((slice(0, half), slice(half, None))):
+            with open(os.path.join(split, f"subset_{i}.pkl"), "wb") as f:
+                pickle.dump([[x, y] for x, y in zip(X[sl], Y[sl])], f)
+    # data STAGING is supervised too: the construction-time stats pass reads
+    # every shard, and a read wedged there (hang_in:shard_loader fires on the
+    # first load) would otherwise hang before the fit's own watchdog exists
+    from redcliff_tpu.runtime import watchdog as rt_watchdog
+
+    with rt_watchdog.maybe_start():
+        train = ShardedBatchDataset(split)
+        val = ShardedBatchDataset(split)
+    return runner.fit(jax.random.PRNGKey(2), train, val,
+                      checkpoint_dir=checkpoint_dir,
+                      checkpoint_every=checkpoint_every,
+                      log_dir=checkpoint_dir)
 
 
 def _result_blob(result):
@@ -256,29 +422,58 @@ def _result_blob(result):
     }
 
 
+def _parse_deadlines(spec):
+    """``"inf,0.05"`` -> per-lane deadline list; ``"30"`` -> scalar."""
+    if spec is None:
+        return None
+    parts = [float(p) for p in spec.split(",")]
+    return parts[0] if len(parts) == 1 else parts
+
+
 def _child_main(argv):
     ap = argparse.ArgumentParser(prog="faultinject-child")
     ap.add_argument("--checkpoint-dir", required=True)
     ap.add_argument("--max-iter", type=int, default=4)
     ap.add_argument("--checkpoint-every", type=int, default=1)
     ap.add_argument("--bad-point", action="store_true")
+    ap.add_argument("--sharded", action="store_true",
+                    help="stream the data from on-disk shards (exercises the "
+                         "prefetch/shard-loader heartbeats — the supervised "
+                         "chaos child)")
+    ap.add_argument("--fit-deadline-s", default=None,
+                    help="per-lane wall-clock budget(s), comma separated")
+    ap.add_argument("--grid-deadline-s", type=float, default=None)
     ap.add_argument("--result", default=None,
                     help="write the finished fit's result blob here")
     args = ap.parse_args(argv)
 
-    from redcliff_tpu.runtime.preempt import Preempted
+    from redcliff_tpu.runtime.preempt import DeadlineExceeded, Preempted
 
+    kw = dict(max_iter=args.max_iter,
+              checkpoint_every=args.checkpoint_every,
+              fit_deadline_s=_parse_deadlines(args.fit_deadline_s),
+              grid_deadline_s=args.grid_deadline_s)
     try:
-        result = tiny_grid_fit(args.checkpoint_dir,
-                               max_iter=args.max_iter,
-                               checkpoint_every=args.checkpoint_every,
-                               bad_point=args.bad_point)
+        if args.sharded:
+            result = tiny_sharded_fit(args.checkpoint_dir, **kw)
+        else:
+            result = tiny_grid_fit(args.checkpoint_dir,
+                                   bad_point=args.bad_point, **kw)
     except Preempted as e:
         print(f"faultinject child: {e}", file=sys.stderr)
+        # json.dump, not an f-string: signum is None on the watchdog-latched
+        # preemption path, and Python's None is not JSON's null
+        import json
+
         with open(os.path.join(args.checkpoint_dir, "preempted.json"),
                   "w") as f:
-            f.write(f'{{"signum": {e.signum}, "epoch": {e.epoch}}}')
+            json.dump({"signum": e.signum, "epoch": e.epoch}, f)
         raise SystemExit(PREEMPTED_EXIT_CODE)
+    except DeadlineExceeded as e:
+        # taxonomy code 20: checkpointed + resumable, but the budget is
+        # spent — the supervisor must NOT burn it again on a restart
+        print(f"faultinject child: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_DEADLINE)
     if args.result:
         with open(args.result, "wb") as f:
             pickle.dump(_result_blob(result), f)

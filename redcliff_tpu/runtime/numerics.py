@@ -41,15 +41,19 @@ __all__ = [
     "init_numerics_state", "update_numerics_state", "guarded_update",
     "global_norm", "numerics_summary", "reset_consecutive",
     "scale_learning_rate", "CAUSE_NONFINITE_GRAD", "CAUSE_NONFINITE_VAL",
-    "QUARANTINE_CAUSES",
+    "CAUSE_DEADLINE", "QUARANTINE_CAUSES",
 ]
 
 # grid-lane quarantine cause codes (device-side int32; decoded into
-# GridResult.failures / failures.json records)
+# GridResult.failures / failures.json records). CAUSE_DEADLINE is not a
+# numerical fault — it is the wall-clock eviction (parallel/grid.py
+# fit_deadline_s) riding the same per-lane quarantine machinery
 CAUSE_NONFINITE_GRAD = 1
 CAUSE_NONFINITE_VAL = 2
+CAUSE_DEADLINE = 3
 QUARANTINE_CAUSES = {CAUSE_NONFINITE_GRAD: "nonfinite_grad",
-                     CAUSE_NONFINITE_VAL: "nonfinite_val"}
+                     CAUSE_NONFINITE_VAL: "nonfinite_val",
+                     CAUSE_DEADLINE: "deadline"}
 
 
 @dataclass(frozen=True)

@@ -15,7 +15,26 @@ from __future__ import annotations
 
 import signal
 
-__all__ = ["Preempted", "PreemptionGuard"]
+__all__ = ["Preempted", "DeadlineExceeded", "PreemptionGuard"]
+
+
+class DeadlineExceeded(Exception):
+    """A fit stopped because its wall-clock deadline expired at an epoch
+    boundary, AFTER draining in-flight work and writing a final checkpoint —
+    so a rerun against the same checkpoint_dir resumes losslessly (taxonomy
+    exit code 20: the supervisor treats the budget as spent and does not
+    burn it again; an outer scheduler re-queues with a fresh budget)."""
+
+    def __init__(self, scope, epoch=None, elapsed_s=None, deadline_s=None):
+        self.scope = scope
+        self.epoch = epoch
+        self.elapsed_s = elapsed_s
+        self.deadline_s = deadline_s
+        super().__init__(
+            f"{scope} deadline of {deadline_s}s exceeded at epoch {epoch} "
+            f"(elapsed {None if elapsed_s is None else round(elapsed_s, 1)}s);"
+            f" final checkpoint written — rerun with the same checkpoint_dir "
+            f"to resume")
 
 
 class Preempted(Exception):

@@ -1,0 +1,206 @@
+"""Crash-loop supervisor: restart a driver process until it finishes or the
+failure is one a restart cannot fix.
+
+``python -m redcliff_tpu.supervise -- <driver cmd ...>`` runs the driver as a
+child, classifies every exit through the watchdog taxonomy
+(:func:`~redcliff_tpu.runtime.watchdog.classify_exit`), and restarts on the
+transient classes — preemption, watchdog hang, plain crashes/signals — with
+the shared :mod:`~redcliff_tpu.runtime.retry` backoff between attempts.
+Deterministic failures (``numerics_abort``: a restart replays the same
+divergence) and spent budgets (``deadline``) stop immediately; a crash loop
+gives up after ``max_restarts``. Resume correctness is the checkpoint
+layer's guarantee (durable CRC+``.prev`` generations plus the grid
+fingerprint), so a supervised run's final artifacts are bit-identical to an
+uninterrupted one — pinned by tests/test_supervisor.py.
+
+Every attempt is a line in ``run_ledger.jsonl`` (strict JSON): command, rc,
+classification, action, backoff, wall times — the audit trail an operator
+reads after a 12-hour grid search died at 3am.
+
+stdlib only (the supervisor parent must never initialize a jax backend).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+from redcliff_tpu.runtime.retry import RetryPolicy
+from redcliff_tpu.runtime.watchdog import classify_exit
+
+__all__ = ["SupervisorPolicy", "SuperviseOutcome", "supervise", "main",
+           "LEDGER_NAME"]
+
+LEDGER_NAME = "run_ledger.jsonl"
+
+# restart vs stop per classification; "signal:*" prefixes match "signal"
+RESTART_CLASSES = ("preempted", "hang", "crash", "signal")
+TERMINAL_CLASSES = ("clean", "numerics_abort", "deadline")
+
+DEFAULT_BACKOFF = RetryPolicy(max_attempts=1_000_000, base_delay_s=1.0,
+                              multiplier=2.0, max_delay_s=60.0)
+
+
+@dataclass
+class SupervisorPolicy:
+    """``max_restarts`` bounds the crash loop (restarts, not attempts: 3
+    means up to 4 child runs); ``backoff`` spaces them."""
+
+    max_restarts: int = 5
+    backoff: RetryPolicy = field(default_factory=lambda: DEFAULT_BACKOFF)
+
+
+@dataclass
+class SuperviseOutcome:
+    classification: str   # final classification ("giving_up" on a crash loop)
+    returncode: int       # last child's rc (the supervisor's own exit code)
+    attempts: list        # one record per child run (the ledger lines)
+
+
+def _restartable(classification):
+    return any(classification == c or classification.startswith(c + ":")
+               for c in RESTART_CLASSES)
+
+
+class _Ledger:
+    def __init__(self, path):
+        self.path = path
+        if path:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+
+    def append(self, rec):
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+def supervise(cmd, ledger_path=None, policy=None, env=None,
+              sleep=time.sleep, popen=subprocess.Popen, on_spawn=None,
+              should_stop=None):
+    """Run ``cmd`` under crash-loop supervision; returns
+    :class:`SuperviseOutcome` (its ``returncode`` is what the supervisor
+    process should exit with).
+
+    ``sleep``/``popen``/``on_spawn``/``should_stop`` are injectable for
+    tests and for the CLI's SIGTERM relay: ``on_spawn(proc)`` exposes the
+    live child, ``should_stop()`` (checked after each attempt) turns an
+    externally-preempted supervisor into a stop instead of a restart.
+    """
+    policy = policy or SupervisorPolicy()
+    ledger = _Ledger(ledger_path)
+    attempts = []
+    attempt = 0
+    while True:
+        started = time.time()
+        t0 = time.monotonic()
+        proc = popen(list(cmd), env=env)
+        if on_spawn is not None:
+            on_spawn(proc)
+        rc = proc.wait()
+        classification = classify_exit(rc)
+        stopping = bool(should_stop()) if should_stop is not None else False
+        if classification in TERMINAL_CLASSES or stopping:
+            action = "stop"
+        elif not _restartable(classification):
+            action = "stop"
+        elif attempt >= policy.max_restarts:
+            action = "give_up"
+        else:
+            action = "restart"
+        backoff = (policy.backoff.backoff_s(attempt + 1)
+                   if action == "restart" else 0.0)
+        rec = ledger.append({
+            "event": "attempt", "attempt": attempt, "cmd": list(cmd),
+            "rc": rc, "classification": classification, "action": action,
+            "backoff_s": round(backoff, 3), "started_at": started,
+            "duration_s": round(time.monotonic() - t0, 3),
+        })
+        attempts.append(rec)
+        if action != "restart":
+            final = ("giving_up" if action == "give_up" else classification)
+            ledger.append({"event": "final", "classification": final,
+                           "rc": rc, "attempts": len(attempts)})
+            return SuperviseOutcome(classification=final, returncode=rc,
+                                    attempts=attempts)
+        # backoff in short slices, re-checking the stop flag before the
+        # respawn: a SIGTERM landing BETWEEN attempts (no live child to
+        # relay it to) must stop the loop, not spawn a fresh child that
+        # never saw the preemption notice
+        remaining = backoff
+        while remaining > 0 and not (should_stop is not None
+                                     and should_stop()):
+            step = min(remaining, 0.5)
+            sleep(step)
+            remaining -= step
+        if should_stop is not None and should_stop():
+            ledger.append({"event": "final", "classification": "stopped",
+                           "rc": rc, "attempts": len(attempts)})
+            return SuperviseOutcome(classification="stopped", returncode=rc,
+                                    attempts=attempts)
+        attempt += 1
+
+
+def main(argv=None):
+    """CLI: ``python -m redcliff_tpu.supervise [opts] -- <driver cmd ...>``.
+
+    SIGTERM/SIGINT to the supervisor are relayed to the child (so preempting
+    the supervisor preempts the run: the child latches, checkpoints, exits
+    ``EXIT_PREEMPTED``) and the loop stops instead of restarting. The
+    supervisor exits with the last child's returncode (0 on clean)."""
+    ap = argparse.ArgumentParser(
+        prog="redcliff_tpu.supervise",
+        description="Crash-loop supervisor with exit-code taxonomy and a "
+                    "run_ledger.jsonl audit trail.")
+    ap.add_argument("--ledger", default=LEDGER_NAME,
+                    help=f"ledger path (default ./{LEDGER_NAME})")
+    ap.add_argument("--max-restarts", type=int, default=5)
+    ap.add_argument("--base-delay-s", type=float, default=1.0)
+    ap.add_argument("--max-delay-s", type=float, default=60.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- followed by the driver command")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no driver command given (use: supervise -- <cmd ...>)")
+
+    state = {"child": None, "stop": False}
+
+    def relay(signum, frame):
+        state["stop"] = True
+        child = state["child"]
+        if child is not None and child.poll() is None:
+            try:
+                child.send_signal(signum)
+            except OSError:
+                pass
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, relay)
+
+    policy = SupervisorPolicy(
+        max_restarts=args.max_restarts,
+        backoff=RetryPolicy(max_attempts=1_000_000,
+                            base_delay_s=args.base_delay_s, multiplier=2.0,
+                            max_delay_s=args.max_delay_s))
+    outcome = supervise(
+        cmd, ledger_path=args.ledger, policy=policy,
+        on_spawn=lambda p: state.__setitem__("child", p),
+        should_stop=lambda: state["stop"])
+    print(f"supervise: {outcome.classification} after "
+          f"{len(outcome.attempts)} attempt(s), rc={outcome.returncode}",
+          file=sys.stderr)
+    return outcome.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

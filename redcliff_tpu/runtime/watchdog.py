@@ -1,0 +1,394 @@
+"""Liveness watchdog: heartbeat registry, hang detection, exit-code taxonomy.
+
+PRs 1-3 made the runtime survive *crashes* (durable CRC+``.prev`` checkpoints,
+SIGTERM latch) and *numerical faults* (in-graph guards, rollback). A fit that
+silently **hangs** — a wedged shard read, a prefetch thread deadlocked against
+the async checkpoint writer, a stuck dispatch — still burned the whole
+allocation with no signal. Production ML systems treat liveness as a runtime
+concern (TensorFlow couples checkpointing with supervisor-driven restart so
+long runs survive worker failure, arXiv:1605.08695); this module is that
+layer:
+
+- :class:`HeartbeatRegistry` — named monotonic-clock heartbeats. The epoch
+  engine, per-batch loop, prefetcher, shard loader, and async checkpoint
+  writer each ``stamp()`` theirs (a dict write + one ``time.monotonic`` call;
+  components that finish a scope ``retire()`` so idle phases cannot read as
+  hangs). Every stamp also counts into a persistent tally the tier-1
+  tripwire test checks against — a registered-but-never-stamped component is
+  a dead heartbeat, caught in CI, not production.
+- :class:`Watchdog` — a daemon thread that polls the registry; a stamp older
+  than its declared budget raises a ``hang`` incident: one structured event
+  (per-component ages + all-thread stack dumps via ``sys._current_frames``)
+  to metrics.jsonl/stderr, then escalation up the ladder: **log ->
+  checkpoint -> exit**. The checkpoint rung latches the existing preemption
+  guard, so a merely-slow loop writes a final checkpoint and exits
+  ``EXIT_PREEMPTED``; a truly wedged process is hard-exited with
+  ``EXIT_HANG`` after ``grace_s`` so the supervisor restarts it from the
+  durable checkpoint.
+- the **exit-code taxonomy** shared with :mod:`.supervisor`: a supervised
+  child says *why* it died through its exit code, and the supervisor decides
+  restart-vs-give-up without parsing logs.
+
+stdlib only — no jax, no numpy: bench.py's backend-free parent and the
+supervisor must both import this safely.
+"""
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+
+__all__ = [
+    "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_NUMERICS_ABORT", "EXIT_HANG",
+    "EXIT_DEADLINE", "classify_exit", "CORE_COMPONENTS",
+    "HeartbeatRegistry", "REGISTRY", "stamp", "retire",
+    "WatchdogPolicy", "Watchdog", "maybe_start", "dump_stacks",
+]
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy: how a supervised child says WHY it died. 0 and the
+# 17-20 band are the contract with runtime/supervisor.py (and with outer
+# schedulers); negative returncodes are signals (subprocess convention).
+# 17 predates this module (faultinject.PREEMPTED_EXIT_CODE re-exports it).
+# ---------------------------------------------------------------------------
+EXIT_CLEAN = 0            # fit finished; artifacts complete
+EXIT_PREEMPTED = 17       # SIGTERM/SIGINT latched; final checkpoint written
+EXIT_NUMERICS_ABORT = 18  # numerics sentinel aborted (deterministic: a
+#                           restart replays the same divergence)
+EXIT_HANG = 19            # watchdog hard-exited a wedged process
+EXIT_DEADLINE = 20        # wall-clock deadline; checkpointed + resumable
+
+_EXIT_NAMES = {
+    EXIT_CLEAN: "clean",
+    EXIT_PREEMPTED: "preempted",
+    EXIT_NUMERICS_ABORT: "numerics_abort",
+    EXIT_HANG: "hang",
+    EXIT_DEADLINE: "deadline",
+}
+
+
+def classify_exit(returncode):
+    """Map a child returncode onto the taxonomy: ``clean`` / ``preempted`` /
+    ``numerics_abort`` / ``hang`` / ``deadline`` / ``signal:NAME`` (killed by
+    an un-latched signal, SIGKILL included) / ``crash`` (anything else)."""
+    if returncode in _EXIT_NAMES:
+        return _EXIT_NAMES[returncode]
+    if returncode is not None and returncode < 0:
+        try:
+            return f"signal:{signal.Signals(-returncode).name}"
+        except ValueError:
+            return f"signal:{-returncode}"
+    return "crash"
+
+
+# the heartbeat map a fully-equipped supervised fit stamps (host-stream data,
+# prefetch on, async checkpointing): the tier-1 tripwire test runs such a fit
+# and asserts every one of these actually beat
+CORE_COMPONENTS = ("epoch_engine", "batch_loop", "prefetch", "shard_loader",
+                   "ckpt_writer")
+
+DEFAULT_BUDGET_S = 600.0
+ENV_WATCHDOG = "REDCLIFF_WATCHDOG"
+
+
+class HeartbeatRegistry:
+    """Named monotonic-clock heartbeats with per-component age budgets.
+
+    ``stamp(name)`` auto-registers unknown names (budget =
+    ``default_budget_s``, overridable per component via ``budgets``) so deep
+    components need no plumbing; ``retire(name)`` removes a component from
+    liveness monitoring when its scope ends (a prefetcher between epochs is
+    idle, not hung) while keeping its cumulative stamp count for the
+    dead-heartbeat tripwire. All methods are thread-safe and O(components).
+    """
+
+    def __init__(self, clock=time.monotonic, default_budget_s=DEFAULT_BUDGET_S):
+        self.clock = clock
+        self.default_budget_s = default_budget_s
+        self.budgets = {}  # per-component overrides, consulted on register
+        self._lock = threading.Lock()
+        self._beats = {}   # name -> [last_stamp, budget_s]
+        self._counts = {}  # name -> cumulative stamps (survives retire)
+
+    def register(self, name, budget_s=None):
+        if budget_s is None:
+            budget_s = self.budgets.get(name, self.default_budget_s)
+        with self._lock:
+            self._beats[name] = [self.clock(), float(budget_s)]
+            self._counts.setdefault(name, 0)
+
+    def stamp(self, name):
+        with self._lock:
+            beat = self._beats.get(name)
+            if beat is None:
+                budget = self.budgets.get(name, self.default_budget_s)
+                self._beats[name] = [self.clock(), float(budget)]
+            else:
+                beat[0] = self.clock()
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def retire(self, name):
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def refresh(self):
+        """Re-stamp every live component (no count bump): a watchdog starting
+        mid-process must grant stale entries a fresh budget, not fire on a
+        previous fit's leftovers."""
+        with self._lock:
+            now = self.clock()
+            for beat in self._beats.values():
+                beat[0] = now
+
+    def ages(self):
+        with self._lock:
+            now = self.clock()
+            return {n: now - b[0] for n, b in self._beats.items()}
+
+    def overdue(self):
+        """[(name, age_s, budget_s)] for every live heartbeat past budget."""
+        with self._lock:
+            now = self.clock()
+            return [(n, now - b[0], b[1]) for n, b in self._beats.items()
+                    if now - b[0] > b[1]]
+
+    def counts(self):
+        with self._lock:
+            return dict(self._counts)
+
+    def clear(self):
+        with self._lock:
+            self._beats.clear()
+            self._counts.clear()
+
+
+# process-global registry: components stamp without plumbing a handle through
+# the data layer. Fits that start a Watchdog refresh() it so stale entries
+# from a previous fit in the same process never read as hangs.
+REGISTRY = HeartbeatRegistry()
+
+
+def stamp(name):
+    """Stamp ``name`` on the global registry (auto-registering)."""
+    REGISTRY.stamp(name)
+
+
+def retire(name):
+    """Retire ``name`` from global liveness monitoring (counts persist)."""
+    REGISTRY.retire(name)
+
+
+def dump_stacks():
+    """Every thread's current stack as one string (named per thread) — the
+    forensic core of a ``hang`` event: *where* each thread is wedged."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} (ident {tid}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+@dataclass
+class WatchdogPolicy:
+    """Escalation knobs. ``grace_s`` is the window between latching the
+    preemption guard (rung 2: a slow-but-alive loop checkpoints and exits
+    ``EXIT_PREEMPTED`` on its own) and the hard exit (rung 3:
+    ``os._exit(EXIT_HANG)`` — a wedged process cannot run cleanup, and the
+    durable ``.prev`` checkpoint generation makes that safe)."""
+
+    poll_s: float = 5.0
+    grace_s: float = 30.0
+    default_budget_s: float = DEFAULT_BUDGET_S
+    budgets: dict = field(default_factory=dict)  # per-component overrides
+    hard_exit: bool = True
+    latch_preempt: bool = True
+
+    @classmethod
+    def from_env(cls, env=ENV_WATCHDOG):
+        """Policy from ``REDCLIFF_WATCHDOG``; None when unset/empty/"0".
+
+        ``"1"`` enables defaults; otherwise a comma-separated ``k=v`` list:
+        ``poll_s``, ``grace_s``, ``budget_s`` (default budget), and
+        ``budget.<component>=S`` per-component overrides — e.g.
+        ``REDCLIFF_WATCHDOG="poll_s=0.5,grace_s=2,budget.prefetch=3"``.
+        """
+        spec = os.environ.get(env, "").strip()
+        if not spec or spec == "0":
+            return None
+        policy = cls()
+        if spec == "1":
+            return policy
+        for part in spec.split(","):
+            k, _, v = part.strip().partition("=")
+            if not v:
+                continue
+            if k == "poll_s":
+                policy.poll_s = float(v)
+            elif k == "grace_s":
+                policy.grace_s = float(v)
+            elif k == "budget_s":
+                policy.default_budget_s = float(v)
+            elif k.startswith("budget."):
+                policy.budgets[k[len("budget."):]] = float(v)
+        return policy
+
+
+class Watchdog:
+    """Daemon thread that turns stale heartbeats into the escalation ladder.
+
+    On the first poll that finds overdue heartbeats it emits ONE structured
+    ``hang`` incident (per-component ages/budgets/stamp counts + all-thread
+    stacks) to the bound MetricLogger and stderr, and latches the preemption
+    guard (when bound) so an alive-but-slow loop can still save and exit
+    cleanly. If any heartbeat is still overdue ``grace_s`` later the process
+    is hard-exited with ``EXIT_HANG`` (``on_hang``-only mode — e.g.
+    tpu_watch — sets ``hard_exit=False`` and just keeps logging). A recovery
+    (nothing overdue) rearms the ladder.
+
+    The thread is a daemon and ``stop()`` joins it, so pytest teardown can
+    never hang on a leftover watchdog.
+    """
+
+    def __init__(self, policy=None, registry=None, guard=None, logger=None,
+                 on_hang=None, exit_fn=os._exit, clock=time.monotonic):
+        self.policy = policy or WatchdogPolicy()
+        self.registry = registry if registry is not None else REGISTRY
+        self.guard = guard
+        self.logger = logger
+        self.on_hang = on_hang
+        self.exit_fn = exit_fn
+        self.clock = clock
+        self.incidents = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def bind(self, guard=None, logger=None):
+        """Late-bind the escalation targets (the guard exists before the fit
+        loop, the MetricLogger only inside it)."""
+        if guard is not None:
+            self.guard = guard
+        if logger is not None:
+            self.logger = logger
+        return self
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self.registry.default_budget_s = self.policy.default_budget_s
+        self.registry.budgets.update(self.policy.budgets)
+        # stale stamps from earlier fits in this process get a fresh budget
+        self.registry.refresh()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="runtime-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        latched_at = None
+        while not self._stop.wait(self.policy.poll_s):
+            overdue = self.registry.overdue()
+            if not overdue:
+                latched_at = None  # recovered: rearm the ladder
+                continue
+            now = self.clock()
+            if latched_at is None:
+                latched_at = now
+                self.incidents += 1
+                self._emit(overdue)
+                if self.guard is not None and self.policy.latch_preempt:
+                    # rung 2: a slow-but-alive loop sees the latch at its
+                    # next epoch boundary, writes the final checkpoint, and
+                    # exits EXIT_PREEMPTED on its own
+                    self.guard.signum = None
+                    self.guard.preempted = True
+                continue
+            if now - latched_at >= self.policy.grace_s:
+                if self.policy.hard_exit:
+                    self._hard_exit(overdue)
+                # on_hang-only mode: keep logging one incident per ladder
+                # cycle instead of spamming every poll
+                latched_at = None
+
+    def _record(self, overdue):
+        counts = self.registry.counts()
+        return {
+            "components": {
+                name: {"age_s": round(age, 3), "budget_s": budget,
+                       "stamps": counts.get(name, 0)}
+                for name, age, budget in overdue},
+            "ages_s": {n: round(a, 3)
+                       for n, a in self.registry.ages().items()},
+            "grace_s": self.policy.grace_s,
+        }
+
+    def _emit(self, overdue):
+        rec = self._record(overdue)
+        stacks = dump_stacks()
+        print(f"[watchdog] HANG detected: {rec['components']}\n{stacks}",
+              file=sys.stderr, flush=True)
+        if self.logger is not None and getattr(self.logger, "active", False):
+            self.logger.log("hang", **rec, stacks=stacks)
+        if self.on_hang is not None:
+            try:
+                self.on_hang(rec)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                pass           # silence the ladder
+
+    def _hard_exit(self, overdue):
+        rec = self._record(overdue)
+        # stderr forensics FIRST — guaranteed even if the jsonl logger is
+        # unusable (e.g. the main thread wedged while holding its lock)
+        print(f"[watchdog] still hung after {self.policy.grace_s:.1f}s grace; "
+              f"hard exit {EXIT_HANG}: {rec['components']}",
+              file=sys.stderr, flush=True)
+        with contextlib.suppress(Exception):
+            faulthandler.dump_traceback(file=sys.stderr)
+        sys.stderr.flush()
+        if self.logger is not None and getattr(self.logger, "active", False):
+            # best-effort, time-bounded: the hang_exit record is nice to
+            # have, but the exit must happen even if logging would block
+            def flush_log():
+                with contextlib.suppress(Exception):
+                    self.logger.log("hang_exit", exit_code=EXIT_HANG, **rec)
+                    self.logger.close()
+
+            t = threading.Thread(target=flush_log, name="watchdog-flush",
+                                 daemon=True)
+            t.start()
+            t.join(timeout=5.0)
+        # os._exit, not sys.exit: the main thread is wedged and cannot unwind;
+        # durability is the checkpoint layer's job (.prev generation)
+        self.exit_fn(EXIT_HANG)
+
+
+def maybe_start(guard=None, logger=None, registry=None):
+    """Watchdog context from the environment: a live :class:`Watchdog` when
+    ``REDCLIFF_WATCHDOG`` is set (the supervised-run switch), else an inert
+    nullcontext — call sites never branch."""
+    policy = WatchdogPolicy.from_env()
+    if policy is None:
+        return contextlib.nullcontext(None)
+    return Watchdog(policy=policy, guard=guard, logger=logger,
+                    registry=registry)

@@ -1,0 +1,11 @@
+"""``python -m redcliff_tpu.supervise -- <driver cmd ...>`` — the crash-loop
+supervisor entry point (implementation: :mod:`redcliff_tpu.runtime.supervisor`).
+
+Restarts the driver on preemption / watchdog-hang / crash with backoff, stops
+on clean exit, numerics abort, or a spent deadline, and writes a
+``run_ledger.jsonl`` audit trail of every attempt.
+"""
+from redcliff_tpu.runtime.supervisor import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

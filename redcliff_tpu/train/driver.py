@@ -148,7 +148,8 @@ def set_up_and_run_experiments(args_dict, files_of_cached_model_args,
 def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
                          key=None, mesh=None, max_iter=None,
                          init_point_params=None, checkpoint_dir=None,
-                         checkpoint_every=None, run_dir=None):
+                         checkpoint_every=None, run_dir=None,
+                         fit_deadline_s=None, grid_deadline_s=None):
     """Train G coefficient/optimizer variations of one REDCLIFF model
     concurrently on the device mesh (see parallel.grid.RedcliffGridRunner).
 
@@ -176,12 +177,22 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     record per quarantined point (cause: ``nonfinite_grad`` vs
     ``nonfinite_val``), plus the run context. No file is written when the
     run has no failures.
+
+    Wall-clock budgets (ARCHITECTURE.md "Liveness & supervision"):
+    ``fit_deadline_s`` (scalar or per-point) evicts over-budget lanes into
+    ``failures`` with cause ``"deadline"`` after forcing a checkpoint;
+    ``grid_deadline_s`` ends the whole fit resumably
+    (:class:`~redcliff_tpu.runtime.preempt.DeadlineExceeded`, supervisor
+    taxonomy code 20). Under ``python -m redcliff_tpu.supervise`` with
+    ``REDCLIFF_WATCHDOG`` set, a hung fit is detected, hard-exited, and
+    restarted from the durable checkpoint bit-identically.
     """
     import jax
 
     from ..parallel.grid import GridSpec, RedcliffGridRunner
 
-    spec = GridSpec(points=list(grid_points))
+    spec = GridSpec(points=list(grid_points), fit_deadline_s=fit_deadline_s,
+                    grid_deadline_s=grid_deadline_s)
     runner = RedcliffGridRunner(model, train_config, spec, mesh=mesh)
     key = key if key is not None else jax.random.PRNGKey(train_config.seed)
     init = (runner.init_grid_from(init_point_params)

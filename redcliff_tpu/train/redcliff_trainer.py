@@ -33,6 +33,7 @@ from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
@@ -253,13 +254,17 @@ class RedcliffTrainer:
         experts (parallel.mesh.shard_factor_axis) — XLA partitions the
         per-factor compute and inserts the psum at the mixture sum. K must
         divide by the mesh size."""
-        with profiler_trace(self.config.profile_dir):
+        # env-armed liveness watchdog (REDCLIFF_WATCHDOG): same heartbeat/
+        # escalation contract as the grid engine — no preemption guard here,
+        # so a confirmed hang goes straight to the hard-exit rung
+        wd = rt_watchdog.maybe_start()
+        with profiler_trace(self.config.profile_dir), wd as live_wd:
             return self._fit(params, train_ds, val_ds, true_GC=true_GC,
                              save_dir=save_dir, resume=resume,
-                             factor_mesh=factor_mesh)
+                             factor_mesh=factor_mesh, wd=live_wd)
 
     def _fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
-             resume=True, factor_mesh=None) -> RedcliffFitResult:
+             resume=True, factor_mesh=None, wd=None) -> RedcliffFitResult:
         model, cfg = self.model, self.model.config
         tc = self.config
         self._true_GC = true_GC
@@ -369,12 +374,15 @@ class RedcliffTrainer:
                   if save_dir and tc.async_checkpointing
                   and jax.process_count() == 1 else None)
         logger = MetricLogger(save_dir)
+        if wd is not None:
+            wd.bind(logger=logger)  # hang incidents land in metrics.jsonl
         # try/finally: an exception mid-fit must still close the jsonl
         # handle (otherwise buffered context is lost and the fd leaks)
         try:
             logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
                        train_config=tc, resume_epoch=iter_start)
             for it in range(iter_start, tc.max_iter):
+                rt_watchdog.stamp("epoch_engine")
                 last_it = it
                 # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
                 if (not aligned and "pretrain_factor" in mode
@@ -400,6 +408,7 @@ class RedcliffTrainer:
                     batch_src = pipeline.prefetch_batches(
                         batch_src, depth=tc.prefetch_batches, put=put)
                 for X, Y in batch_src:
+                    rt_watchdog.stamp("batch_loop")
                     X = faultinject.poison_batch(X, step_counter)
                     skip = faultinject.skip_update(step_counter)
                     step_counter += 1
@@ -546,6 +555,8 @@ class RedcliffTrainer:
                        final_val_loss=final_val["combo_loss"],
                        aborted=aborted)
         finally:
+            rt_watchdog.retire("epoch_engine")
+            rt_watchdog.retire("batch_loop")
             logger.close()
             if writer is not None:
                 # join the in-flight write on EVERY exit path: a background

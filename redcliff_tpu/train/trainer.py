@@ -37,6 +37,7 @@ import optax
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
@@ -294,15 +295,19 @@ class Trainer:
                   if save_dir and cfg.async_checkpointing
                   and jax.process_count() == 1 else None)
         logger = MetricLogger(save_dir)
+        # env-armed liveness watchdog, same contract as the grid engine
+        wd = rt_watchdog.maybe_start(logger=logger)
         # try/finally: an exception mid-fit must still close the jsonl handle
         # (otherwise buffered context is lost and the fd leaks)
         try:
             logger.log("fit_start", model=type(self.model).__name__,
                        train_config=cfg, resume_epoch=iter_start)
-            with profiler_trace(cfg.profile_dir):
+            with profiler_trace(cfg.profile_dir), wd:
                 for it in range(iter_start, cfg.max_iter):
+                    rt_watchdog.stamp("epoch_engine")
                     last_it = it
                     for X, Y in train_batch_iter():
+                        rt_watchdog.stamp("batch_loop")
                         step_rng = (jax.random.fold_in(step_key, step_counter)
                                     if self._wants_rng else None)
                         X = faultinject.poison_batch(X, step_counter)
@@ -383,6 +388,8 @@ class Trainer:
                        final_val_loss=final_val["combo_loss"],
                        aborted=aborted)
         finally:
+            rt_watchdog.retire("epoch_engine")
+            rt_watchdog.retire("batch_loop")
             logger.close()
             if writer is not None:
                 # join the in-flight write on EVERY exit path: a background

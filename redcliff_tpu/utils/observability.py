@@ -39,6 +39,7 @@ import contextlib
 import json
 import math
 import os
+import threading
 import time
 from dataclasses import asdict, is_dataclass
 
@@ -87,6 +88,10 @@ class MetricLogger:
 
     def __init__(self, target, filename="metrics.jsonl", resume=True):
         self._fh = None
+        # the liveness watchdog logs hang incidents from its own thread
+        # while the fit loop logs epochs; serialized writes keep every
+        # jsonl line intact (a torn line would break strict-JSON readers)
+        self._lock = threading.Lock()
         if target is None:
             return
         path = target
@@ -111,13 +116,17 @@ class MetricLogger:
         rec.update({k: jsonable(v) for k, v in fields.items()})
         # allow_nan=False is the strictness backstop: jsonable already maps
         # non-finite floats to null, so a violation here is a bug, not data
-        self._fh.write(json.dumps(rec, allow_nan=False) + "\n")
-        self._fh.flush()
+        line = json.dumps(rec, allow_nan=False) + "\n"
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line)
+                self._fh.flush()
 
     def close(self):
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

@@ -504,6 +504,53 @@ def test_sharded_batch_dataset_quarantines_nonfinite(tmp_path):
     assert len(sds) == 8
 
 
+def test_sharded_dataset_quarantines_torn_file_midstream(tmp_path):
+    """A shard file truncated AFTER construction (torn write between the
+    stats pass and epoch N) is quarantined per file — report fires, warning
+    raised — and the stream continues over the surviving shards, matching
+    the PR-2 degrade-don't-crash contract."""
+    import warnings
+
+    from redcliff_tpu.data.shards import ShardedBatchDataset
+
+    split, samples = _write_shards(tmp_path, n_per_shard=(16, 16, 16))
+    sds = ShardedBatchDataset(split)
+    assert len(sds) == 48 and sds.quarantined_files == {}
+    torn = os.path.join(split, "subset_1.pkl")
+    with open(torn, "r+b") as f:
+        f.truncate(os.path.getsize(torn) // 2)
+    with pytest.warns(RuntimeWarning, match="torn shard"):
+        batches = list(sds.batches(8))
+    # the stream continued: both healthy shards' samples arrived, in order
+    assert sum(len(b[0]) for b in batches) == 32
+    assert "subset_1.pkl" in sds.quarantined_files
+    assert "truncated" in sds.quarantined_files["subset_1.pkl"]
+    # the warning fires once per file, not once per epoch
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert sum(len(b[0]) for b in sds.batches(8)) == 32
+
+
+def test_sharded_dataset_quarantines_torn_file_at_construction(tmp_path):
+    from redcliff_tpu.data.shards import ShardedBatchDataset
+
+    split, _ = _write_shards(tmp_path, n_per_shard=(16, 16))
+    with open(os.path.join(split, "subset_0.pkl"), "wb") as f:
+        f.write(b"\x80\x04 not a pickle")
+    with pytest.warns(RuntimeWarning, match="torn shard"):
+        sds = ShardedBatchDataset(split)
+    # stats came from the surviving shard only; the stream works
+    assert len(sds) == 16
+    assert "subset_0.pkl" in sds.quarantined_files
+    assert sum(len(b[0]) for b in sds.batches(8)) == 16
+    # every shard torn -> loud failure, not an empty training set
+    with open(os.path.join(split, "subset_1.pkl"), "wb") as f:
+        f.write(b"junk")
+    with pytest.raises(ValueError, match="torn"):
+        with pytest.warns(RuntimeWarning):
+            ShardedBatchDataset(split)
+
+
 def test_grid_fit_on_sharded_stream_uses_prefetched_host_path(tmp_path):
     """A dataset without device-batch support routes through per_batch +
     prefetcher and still trains to finite losses (the too-big-for-HBM

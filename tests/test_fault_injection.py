@@ -270,6 +270,75 @@ def test_resume_rejects_changed_dataset_shape(tmp_path):
                     checkpoint_dir=ck, checkpoint_every=1)
 
 
+def test_resume_rejects_changed_matmul_precision(tmp_path):
+    """Fingerprint audit (ADVICE r5): matmul precision changes every step's
+    update math, so it now rides the resume fingerprint; the same checkpoint
+    still resumes under the unchanged config (backfill covers pre-precision
+    checkpoints separately)."""
+    import jax
+
+    ck = str(tmp_path / "ck")
+    runner, ds = _mismatch_fit(ck)
+    runner.fit(jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck,
+               checkpoint_every=1)
+    runner2, ds2 = _mismatch_fit(ck, matmul_precision="bfloat16")
+    with pytest.raises(ValueError, match="matmul_precision"):
+        runner2.fit(jax.random.PRNGKey(0), ds2, ds2, checkpoint_dir=ck,
+                    checkpoint_every=1)
+    # a pre-precision checkpoint (field absent) resumes under the default
+    ckpt = rck.read_checkpoint(os.path.join(ck, CKPT_NAME))
+    ckpt["meta"].pop("matmul_precision")
+    rck.write_checkpoint(os.path.join(ck, CKPT_NAME), ckpt)
+    runner3, ds3 = _mismatch_fit(ck)
+    runner3.fit(jax.random.PRNGKey(0), ds3, ds3, checkpoint_dir=ck,
+                checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# disk-full / IO-error hardening of the durable writer
+# ---------------------------------------------------------------------------
+def test_ckpt_write_enospc_maps_to_typed_error_and_cleans_tmp(
+        tmp_path, monkeypatch):
+    import errno
+    import glob
+
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "io_error:ckpt_write:ENOSPC")
+    monkeypatch.delenv("REDCLIFF_FAULT_MARKER", raising=False)
+    path = str(tmp_path / "ck.pkl")
+    with pytest.raises(rck.CheckpointWriteError, match="disk full") as ei:
+        rck.write_checkpoint(path, {"x": 1})
+    assert ei.value.errno == errno.ENOSPC
+    # the failed write left NO debris: no head, no orphan tmp file
+    assert not os.path.exists(path)
+    assert glob.glob(path + ".tmp*") == []
+    # existing generations survive a later failed write untouched
+    monkeypatch.delenv("REDCLIFF_FAULT_INJECT")
+    rck.write_checkpoint(path, {"gen": 1})
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "io_error:ckpt_write:EIO")
+    with pytest.raises(rck.CheckpointWriteError):
+        rck.write_checkpoint(path, {"gen": 2})
+    assert rck.read_checkpoint(path) == {"gen": 1}
+
+
+def test_async_writer_surfaces_enospc_at_next_submit_barrier(
+        tmp_path, monkeypatch):
+    """The background writer must not die silently on a full disk: the
+    typed failure re-raises at the next submit (the barrier), and the
+    writer is reusable after the operator frees space."""
+    monkeypatch.setenv("REDCLIFF_FAULT_INJECT", "io_error:ckpt_write:ENOSPC")
+    monkeypatch.delenv("REDCLIFF_FAULT_MARKER", raising=False)
+    path = str(tmp_path / "ck.pkl")
+    w = rck.AsyncCheckpointWriter()
+    w.submit(lambda: rck.write_checkpoint(path, {"x": 1}))
+    with pytest.raises(rck.CheckpointWriteError, match="disk full"):
+        w.submit(lambda: rck.write_checkpoint(path, {"x": 2}))
+    # disk freed: the writer keeps working and wait() is clean
+    monkeypatch.delenv("REDCLIFF_FAULT_INJECT")
+    w.submit(lambda: rck.write_checkpoint(path, {"x": 3}))
+    w.wait()
+    assert rck.read_checkpoint(path) == {"x": 3}
+
+
 # ---------------------------------------------------------------------------
 # SIGTERM -> one final checkpoint (the SLURM/TPU-VM preemption notice)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,264 @@
+"""Liveness watchdog suite: heartbeat registry, escalation ladder, deadline
+eviction, and the dead-heartbeat tripwire.
+
+The process-level half of the story (a wedged child hard-exits EXIT_HANG and
+the supervisor restarts it bit-identically) lives in tests/test_supervisor.py;
+this file pins the in-process mechanics on fake clocks and tiny fits.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.runtime import watchdog as wdg
+from redcliff_tpu.runtime.preempt import DeadlineExceeded
+from redcliff_tpu.runtime.watchdog import (CORE_COMPONENTS, EXIT_DEADLINE,
+                                           EXIT_HANG, EXIT_NUMERICS_ABORT,
+                                           EXIT_PREEMPTED, HeartbeatRegistry,
+                                           Watchdog, WatchdogPolicy,
+                                           classify_exit)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat registry (fake clock: no sleeping)
+# ---------------------------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_registry_overdue_and_retire():
+    clock = _Clock()
+    reg = HeartbeatRegistry(clock=clock, default_budget_s=5.0)
+    reg.stamp("a")          # auto-registers with the default budget
+    reg.register("b", budget_s=1.0)
+    clock.t = 2.0
+    assert [o[0] for o in reg.overdue()] == ["b"]  # a: 2s < 5s budget
+    reg.stamp("b")          # b recovers
+    assert reg.overdue() == []
+    clock.t = 20.0          # both overdue now
+    assert {o[0] for o in reg.overdue()} == {"a", "b"}
+    reg.retire("a")         # retired components are not liveness-monitored
+    assert [o[0] for o in reg.overdue()] == ["b"]
+    # ...but their cumulative stamp counts persist (the tripwire reads these)
+    assert reg.counts()["a"] == 1
+
+
+def test_registry_refresh_grants_fresh_budget():
+    clock = _Clock()
+    reg = HeartbeatRegistry(clock=clock, default_budget_s=1.0)
+    reg.stamp("stale")
+    clock.t = 100.0
+    assert reg.overdue()
+    reg.refresh()           # what Watchdog.start() does
+    assert reg.overdue() == []
+    assert reg.counts()["stale"] == 1  # refresh is not a stamp
+
+
+def test_registry_budget_overrides():
+    reg = HeartbeatRegistry(clock=_Clock(), default_budget_s=100.0)
+    reg.budgets["fast"] = 2.0
+    reg.stamp("fast")
+    reg.stamp("slow")
+    ages = reg.ages()
+    assert set(ages) == {"fast", "slow"}
+    reg.clock.t = 3.0
+    assert [o[0] for o in reg.overdue()] == ["fast"]
+
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy
+# ---------------------------------------------------------------------------
+def test_classify_exit_taxonomy():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(EXIT_PREEMPTED) == "preempted"
+    assert classify_exit(EXIT_NUMERICS_ABORT) == "numerics_abort"
+    assert classify_exit(EXIT_HANG) == "hang"
+    assert classify_exit(EXIT_DEADLINE) == "deadline"
+    assert classify_exit(-9) == "signal:SIGKILL"
+    assert classify_exit(-15) == "signal:SIGTERM"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(77) == "crash"
+
+
+def test_policy_from_env(monkeypatch):
+    monkeypatch.delenv(wdg.ENV_WATCHDOG, raising=False)
+    assert WatchdogPolicy.from_env() is None
+    monkeypatch.setenv(wdg.ENV_WATCHDOG, "0")
+    assert WatchdogPolicy.from_env() is None
+    monkeypatch.setenv(wdg.ENV_WATCHDOG, "1")
+    assert WatchdogPolicy.from_env() is not None
+    monkeypatch.setenv(wdg.ENV_WATCHDOG,
+                       "poll_s=0.5,grace_s=2,budget_s=9,budget.prefetch=3")
+    p = WatchdogPolicy.from_env()
+    assert p.poll_s == 0.5 and p.grace_s == 2.0
+    assert p.default_budget_s == 9.0 and p.budgets == {"prefetch": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# escalation ladder: log -> preempt latch -> hard exit
+# ---------------------------------------------------------------------------
+class _GuardStub:
+    preempted = False
+    signum = None
+
+
+def test_watchdog_escalates_latch_then_exit():
+    reg = HeartbeatRegistry(default_budget_s=0.05)
+    reg.stamp("wedged")
+    guard = _GuardStub()
+    exits = []
+    events = []
+
+    class _Log:
+        active = True
+
+        def log(self, event, **kw):
+            events.append((event, kw))
+
+        def close(self):
+            pass
+
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=0.1),
+                  registry=reg, guard=guard, logger=_Log(),
+                  exit_fn=exits.append)
+    with wd:
+        assert wd._thread.daemon  # pytest teardown can never hang on this
+        deadline = time.monotonic() + 10.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.01)
+    # rung 2 fired before rung 3: the guard was latched so an alive loop
+    # could still have checkpointed and exited EXIT_PREEMPTED on its own
+    assert guard.preempted is True
+    assert exits == [EXIT_HANG]
+    assert wd.incidents == 1  # one incident, not one per poll
+    kinds = [e for e, _ in events]
+    assert "hang" in kinds and "hang_exit" in kinds
+    hang = dict(events)["hang"]
+    assert "wedged" in hang["components"]
+    assert hang["components"]["wedged"]["age_s"] > 0.05
+    # the forensic stack dump names this (main) thread
+    assert "MainThread" in hang["stacks"]
+
+
+def test_watchdog_recovery_rearms_without_exit():
+    reg = HeartbeatRegistry(default_budget_s=0.08)
+    reg.stamp("slow")
+    exits = []
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=5.0),
+                  registry=reg, exit_fn=exits.append)
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while wd.incidents == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        reg.stamp("slow")  # the component recovers inside the grace window
+        time.sleep(0.1)
+    assert wd.incidents >= 1 and exits == []
+
+
+def test_maybe_start_is_inert_without_env(monkeypatch):
+    monkeypatch.delenv(wdg.ENV_WATCHDOG, raising=False)
+    with wdg.maybe_start() as live:
+        assert live is None
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 tripwire: a short supervised-shaped fit stamps EVERY component
+# in the heartbeat map (no silent dead heartbeats), and no liveness/pipeline
+# thread outlives the fit
+# ---------------------------------------------------------------------------
+def test_every_core_component_stamps_in_sharded_fit(tmp_path):
+    from redcliff_tpu.runtime.faultinject import tiny_sharded_fit
+
+    wdg.REGISTRY.clear()
+    res = tiny_sharded_fit(str(tmp_path), max_iter=1)
+    assert np.all(np.isfinite(res.val_history))
+    counts = wdg.REGISTRY.counts()
+    dead = [c for c in CORE_COMPONENTS if counts.get(c, 0) == 0]
+    assert not dead, f"dead heartbeats (registered but never stamped): {dead}"
+    # op-scoped heartbeats retired on the way out: nothing left to monitor
+    # spuriously, and no daemon worker outlives the fit
+    assert wdg.REGISTRY.ages() == {}
+    alive = [t.name for t in threading.enumerate()
+             if t.name in ("runtime-watchdog", "batch-prefetch",
+                           "ckpt-writer") and t.is_alive()]
+    assert not alive, f"liveness/pipeline threads leaked: {alive}"
+
+
+# ---------------------------------------------------------------------------
+# wall-clock deadlines (acceptance: deadline eviction + whole-grid drain)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ref_fit3():
+    """The shared no-deadline reference run both deadline tests compare
+    against (one compile + fit instead of two)."""
+    from redcliff_tpu.runtime.faultinject import tiny_grid_fit
+
+    return tiny_grid_fit(None, max_iter=3)
+
+
+def test_lane_deadline_evicts_slow_lane_siblings_unchanged(tmp_path,
+                                                           ref_fit3):
+    """A grid with one lane over its wall-clock budget finishes; the lane
+    lands in GridResult.failures with cause 'deadline' plus a valid durable
+    checkpoint, and the sibling lane's results are bit-identical to a
+    no-deadline run."""
+    import jax
+
+    from redcliff_tpu.runtime import checkpoint as rck
+    from redcliff_tpu.runtime.faultinject import tiny_grid_fit
+
+    ck = str(tmp_path / "ck")
+    # lane 1's budget is sub-epoch: "artificially slow" relative to it
+    res = tiny_grid_fit(ck, max_iter=3,
+                        fit_deadline_s=[float("inf"), 1e-4])
+    assert [f["point"] for f in res.failures] == [1]
+    assert res.failures[0]["cause"] == "deadline"
+    assert not res.active[1] and res.active[0]
+    # the evicted lane's state was checkpointed durably (forced save)
+    ckpt = rck.read_checkpoint(os.path.join(ck, "grid_checkpoint.pkl"))
+    assert np.asarray(ckpt["failed_epoch"])[1] == res.failures[0]["epoch"]
+
+    ref = ref_fit3
+    np.testing.assert_array_equal(res.val_history[:, 0],
+                                  ref.val_history[:, 0])
+    for a, b in zip(jax.tree.leaves(res.best_params),
+                    jax.tree.leaves(ref.best_params)):
+        np.testing.assert_array_equal(np.asarray(a)[0], np.asarray(b)[0])
+    # frozen after eviction: the evicted lane's val loss stops changing
+    e = res.failures[0]["epoch"]
+    if e + 2 < res.val_history.shape[0]:
+        np.testing.assert_array_equal(res.val_history[e + 1, 1],
+                                      res.val_history[e + 2, 1])
+
+
+def test_grid_deadline_exits_resumable(tmp_path, ref_fit3):
+    """The whole-grid deadline drains the epoch, writes a final checkpoint,
+    and raises DeadlineExceeded; resuming WITHOUT the deadline completes to
+    results bit-identical to an uninterrupted run."""
+    from redcliff_tpu.runtime.faultinject import tiny_grid_fit
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceeded, match="resume"):
+        tiny_grid_fit(ck, max_iter=3, grid_deadline_s=1e-4)
+    assert os.path.exists(os.path.join(ck, "grid_checkpoint.pkl"))
+    resumed = tiny_grid_fit(ck, max_iter=3)
+    np.testing.assert_array_equal(resumed.val_history, ref_fit3.val_history)
+    np.testing.assert_array_equal(resumed.best_epoch, ref_fit3.best_epoch)
+
+
+def test_gridspec_deadline_validation():
+    from redcliff_tpu.parallel.grid import GridSpec
+
+    with pytest.raises(ValueError, match="positive"):
+        GridSpec(points=[{}], grid_deadline_s=0.0)
+    with pytest.raises(ValueError, match="entries"):
+        GridSpec(points=[{}, {}], fit_deadline_s=[1.0])
+    spec = GridSpec(points=[{}, {}], fit_deadline_s=30.0)
+    np.testing.assert_array_equal(spec.lane_deadlines(), [30.0, 30.0])
+    assert GridSpec(points=[{}]).lane_deadlines() is None

@@ -33,6 +33,7 @@ import sys
 import time
 
 import bench  # reuse the killable probe/measure children + cache writer/lock
+from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.retry import RetryPolicy, retry
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -102,9 +103,22 @@ def main():
     _log(f"tpu_watch start: duration={args.duration_s:.0f}s "
          f"interval={args.interval_s:.0f}s cache={CACHE_PATH}")
 
+    # liveness, log-only: the probe/measure children carry their own kill
+    # timeouts, but a tick wedged OUTSIDE them (cache lock, filesystem)
+    # would silently end the watch — the watchdog heartbeat makes that a
+    # logged incident instead of a mystery. hard_exit=False: the watcher is
+    # opportunistic, killing it buys nothing
+    tick_budget = max(3.0 * args.interval_s, 1800.0)
+    rt_watchdog.REGISTRY.register("tpu_watch_tick", budget_s=tick_budget)
+    wd = rt_watchdog.Watchdog(
+        policy=rt_watchdog.WatchdogPolicy(poll_s=60.0, hard_exit=False,
+                                          latch_preempt=False),
+        on_hang=lambda rec: _log(f"WATCHDOG: tick wedged {rec['components']}"))
+
     def watch_tick(attempt):
         """One cadence tick: probe; on a live window, measure+cache.
         Returns a status string for the retry attempt log."""
+        rt_watchdog.stamp("tpu_watch_tick")
         ok, info = bench._probe_accelerator()
         _log(f"probe {attempt + 1}: ok={ok} {info}")
         if not ok:
@@ -158,8 +172,9 @@ def main():
         base_delay_s=args.interval_s, multiplier=1.0,
         max_delay_s=args.interval_s, jitter_frac=0.0,
         deadline_s=args.duration_s)
-    outcome = retry(watch_tick, policy, is_success=lambda r: False,
-                    info_of=lambda r: r)
+    with wd:
+        outcome = retry(watch_tick, policy, is_success=lambda r: False,
+                        info_of=lambda r: r)
     _log(f"tpu_watch done: {len(outcome.attempts)} probes, "
          f"{state['successes']} cached measurements")
     _log("retry outcome: " + json.dumps(outcome.log()))
