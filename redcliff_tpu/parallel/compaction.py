@@ -1,0 +1,166 @@
+"""Elastic grid scheduling: live-lane compaction + G-bucketed program reuse.
+
+REDCLIFF-S model selection is a grid sweep with per-lane early stopping
+(stopping-criteria coefficients, PAPER §4), but a vmapped grid program has a
+FIXED width: every dispatch computes all G lanes whether or not the ``active``
+mask has already retired them. On an early-stopping sweep that means up to
+half the FLOPs are spent updating frozen lanes and immediately discarding the
+result (BENCH_r05: per-chip throughput halves from G=1 to G=16 — the dead
+lanes ride every dispatch). The same amortize-across-a-population lever that
+NAVAR-style ensembles and DYNOTEARS batched solves exploit (PAPERS.md) cuts
+the other way once the population shrinks.
+
+This module owns the pure-host planning half of the fix; the grid engine
+(parallel/grid.py) executes it:
+
+* **Bucket ladder** (:func:`bucket_width`) — execution widths are drawn from
+  a power-of-two ladder (mesh-compatible: multiples of the device count
+  above it, divisors of it below), so the set of compiled programs stays
+  small and reusable instead of one program per exact (shape, G). Real lanes
+  beyond the live count are padded with masked FILLER lanes (``active`` is
+  False from birth; ``orig_id`` -1), which never surface in results.
+* **Compaction plan** (:func:`plan_compaction`) — at a check-window boundary,
+  when the live-lane count drops below the next ladder rung, the surviving
+  lanes' state (params, opt states, numerics counters, coeffs, rng-free lane
+  bookkeeping, best-trees) is gathered into a compacted grid of the new
+  width and point indices are remapped. Each surviving lane's update stream
+  is BIT-IDENTICAL to the uncompacted run: the vmapped step is per-lane
+  independent (lane g's update reads only lane g's state + the broadcast
+  batch), so removing sibling lanes changes which program runs, never what a
+  lane computes — the same argument the deadline-eviction and early-stop
+  masks already rely on, pinned by tests/test_compaction.py.
+* **History expansion** (:func:`expand_history`) — per-epoch loss rows are
+  recorded at execution width; this scatters them back to original point ids
+  and carries retired lanes' last value forward. Carrying forward IS the
+  uncompacted semantics bit-for-bit: an inactive lane's parameters are
+  frozen, so the uncompacted run recomputes the identical loss every epoch.
+
+Results and failures are always reported under ORIGINAL point ids; filler
+lanes never leak into :class:`~redcliff_tpu.parallel.grid.GridResult`.
+
+numpy-only at module scope (the grid engine calls in with host arrays).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "bucket_width",
+    "next_pow2",
+    "plan_compaction",
+    "expand_history",
+    "CompactionPlan",
+]
+
+
+def next_pow2(n):
+    """Smallest power of two >= max(n, 1)."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_width(n_lanes, n_devices=1):
+    """Execution width for ``n_lanes`` live lanes on an ``n_devices`` mesh.
+
+    The ladder is powers of two, adjusted for mesh divisibility so the
+    compacted grid always re-shards cleanly:
+
+    - no mesh (``n_devices <= 1``): the next power of two;
+    - width >= mesh: rounded up to a multiple of ``n_devices`` (a no-op on
+      power-of-two meshes);
+    - width < mesh: kept when it divides ``n_devices`` (the engine runs on a
+      SUB-mesh of that many devices — the G' < n_devices case), otherwise
+      rounded up to ``n_devices``.
+    """
+    b = next_pow2(n_lanes)
+    n_devices = int(n_devices or 1)
+    if n_devices <= 1:
+        return b
+    if b >= n_devices:
+        return -(-b // n_devices) * n_devices
+    return b if n_devices % b == 0 else n_devices
+
+
+class CompactionPlan:
+    """Host-side recipe for one compaction event.
+
+    Attributes:
+      sel: (new_width,) int32 — exec-row gather indices into the CURRENT
+        grid (surviving lanes first, then filler rows replicating the first
+        survivor so every gathered row holds finite, valid state).
+      orig_ids: (new_width,) int32 — original point id per new exec row,
+        -1 for filler.
+      active: (new_width,) bool — True for the surviving (live) rows only.
+      retire_rows: (k,) int32 — CURRENT exec rows holding real, inactive,
+        not-yet-retired lanes whose frozen results must be gathered to host
+        before their rows are dropped.
+      retire_ids: (k,) int32 — those rows' original point ids.
+    """
+
+    def __init__(self, sel, orig_ids, active, retire_rows, retire_ids):
+        self.sel = sel
+        self.orig_ids = orig_ids
+        self.active = active
+        self.retire_rows = retire_rows
+        self.retire_ids = retire_ids
+
+    @property
+    def new_width(self):
+        return int(self.sel.shape[0])
+
+
+def plan_compaction(active, orig_ids, retired_ids, n_devices=1):
+    """Plan a compaction, or return None when the current width is already
+    the right bucket.
+
+    ``active``: (G_exec,) bool host mask; ``orig_ids``: (G_exec,) int32
+    original point id per exec row (-1 = filler); ``retired_ids``: ids whose
+    results were already captured by an earlier compaction (their rows are
+    gone). Lanes are kept in exec-row order, so surviving lanes' relative
+    order is stable across compactions.
+    """
+    active = np.asarray(active, bool)
+    orig_ids = np.asarray(orig_ids, np.int32)
+    live_rows = np.flatnonzero(active & (orig_ids >= 0)).astype(np.int32)
+    n_live = int(live_rows.size)
+    if n_live == 0:
+        return None  # nothing to run; the fit's own exit paths handle this
+    new_w = bucket_width(n_live, n_devices)
+    if new_w >= orig_ids.size:
+        return None
+    pad = new_w - n_live
+    sel = np.concatenate(
+        [live_rows, np.full((pad,), live_rows[0], np.int32)])
+    new_ids = np.concatenate(
+        [orig_ids[live_rows], np.full((pad,), -1, np.int32)])
+    new_active = np.zeros((new_w,), bool)
+    new_active[:n_live] = True
+    already = set(int(i) for i in retired_ids)
+    retire_rows = np.asarray(
+        [r for r in np.flatnonzero(~active & (orig_ids >= 0))
+         if int(orig_ids[r]) not in already], np.int32)
+    return CompactionPlan(sel, new_ids, new_active, retire_rows,
+                          orig_ids[retire_rows].astype(np.int32))
+
+
+def expand_history(rows, row_eras, eras, n_points):
+    """Scatter exec-width per-epoch rows back to (epochs, n_points) under
+    original point ids, carrying retired lanes' last value forward.
+
+    ``rows``: per-epoch host arrays — exec width (era-indexed) or already
+    full width (``row_eras`` entry -1, e.g. restored from a checkpoint that
+    stored expanded history). ``eras``: list of orig_ids arrays, one per
+    compaction era. Filler entries (orig_id -1) are dropped.
+    """
+    carry = np.full((int(n_points),), np.nan, np.float32)
+    out = []
+    for row, era in zip(rows, row_eras):
+        row = np.asarray(row, np.float32)
+        if era < 0:
+            carry = row.copy()
+        else:
+            ids = eras[era]
+            real = ids >= 0
+            carry[ids[real]] = row[real]
+        out.append(carry.copy())
+    return np.stack(out) if out else np.zeros((0, int(n_points)), np.float32)

@@ -11,6 +11,19 @@ Shape-changing hyperparameters (hidden sizes, lags, factor counts) cannot share
 a compiled program; callers group points by shape and run one GridRun per group
 — the grouping helper below does this from a list of config dicts.
 
+Elastic grid scheduling (parallel/compaction.py, docs/ARCHITECTURE.md
+"Elastic grid scheduling & compile caching"): execution widths ride a
+power-of-two bucket ladder (``g_bucket`` pads off-ladder grids with masked
+filler lanes so heterogeneous sweeps reuse a small program set), and at
+check-window boundaries the engine COMPACTS the grid down the ladder once
+enough lanes have early-stopped/quarantined (``compaction``) — retired lanes
+stop riding every dispatch, surviving lanes' update streams stay
+bit-identical, and results/failures always report under original point ids.
+A persistent, versioned XLA compilation cache (``compile_cache_dir``,
+runtime/compileobs.py) makes restarts warm-start their programs; compile
+durations and cache hits/misses land in ``dispatch_stats`` and
+metrics.jsonl.
+
 Execution engine (data/pipeline.py stream modes): with the default
 ``stream_mode="auto"`` an eligible fit runs the EPOCH engine — the dataset
 stays HBM-resident, each epoch's shuffled batch order becomes a device index
@@ -37,10 +50,12 @@ import optax
 
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import phase_schedule
+from redcliff_tpu.parallel import compaction
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
-from redcliff_tpu.parallel.mesh import grid_mesh, replicated, shard_leading_axis
+from redcliff_tpu.parallel.mesh import (Mesh, grid_mesh, replicated,
+                                        shard_leading_axis)
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
-from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.preempt import (DeadlineExceeded, Preempted,
                                           PreemptionGuard)
@@ -147,17 +162,31 @@ class GridResult:
     val_history: np.ndarray    # (epochs, G) validation combo loss
     coeffs: dict
     active: np.ndarray = None  # (G,) bool; False = point early-stopped
-    # quarantined grid points: lanes whose validation loss went non-finite,
-    # or whose in-graph numerics guard skipped max_consecutive_skips steps in
-    # a row, were frozen (skip further updates, rest of the grid keeps
-    # training); one {"point", "epoch", "cause", "hparams"} record each,
-    # cause in {"nonfinite_grad", "nonfinite_val"}
+    # quarantined/evicted grid points, one {"point", "epoch", "cause",
+    # "hparams"} record each, cause in {"nonfinite_grad", "nonfinite_val",
+    # "deadline"}: ``nonfinite_grad`` — the lane's in-graph numerics guard
+    # skipped max_consecutive_skips steps in a row (stuck on poisoned
+    # gradients); ``nonfinite_val`` — validation loss went non-finite with
+    # finite steps; ``deadline`` — the lane outlived its
+    # ``GridSpec.fit_deadline_s`` wall-clock budget and was checkpointed +
+    # evicted (PR 4). All three freeze the lane via the active mask while
+    # the rest of the grid keeps training; every field of this result is
+    # indexed by ORIGINAL point id regardless of lane compaction
     failures: list = field(default_factory=list)
 
 
 def group_configs_by_shape(config_dicts, shape_keys):
     """Partition config dicts into shape-compatible groups (one compiled program
-    each). Returns {shape_tuple: [indices]}."""
+    each). Returns {shape_tuple: [indices]}.
+
+    Ordering is deterministic: groups appear in first-appearance order of
+    their shape, and indices within a group are ascending — so the grid a
+    caller builds from a group is stable across runs (resume fingerprints
+    include the point list). Each group's GridRun then pads its width up to
+    the power-of-two bucket ladder (``RedcliffTrainConfig.g_bucket``,
+    parallel/compaction.py) with masked filler lanes, so heterogeneous
+    sweeps share a small set of compiled programs instead of one program
+    per exact (shape, G)."""
     groups = {}
     for i, cd in enumerate(config_dicts):
         key = tuple(cd.get(k) for k in shape_keys)
@@ -197,15 +226,44 @@ class RedcliffGridRunner:
         self.model = model
         self.tc = train_config
         self.spec = spec
+        # elastic scheduling (parallel/compaction.py): ``mesh`` is the FULL
+        # device capacity; ``self.mesh`` is the active mesh, which may be a
+        # sub-mesh after bucketing/compaction shrinks the execution width
+        # below the device count
+        self._mesh_full = mesh
         self.mesh = mesh
-        if mesh is not None:
-            n_dev = mesh.devices.size
-            if len(spec.points) % n_dev != 0:
+        self._g_real = G_real = len(spec.points)
+        self._g_bucket = bool(getattr(train_config, "g_bucket", True))
+        self._compaction_on = bool(getattr(train_config, "compaction", True))
+        compileobs.enable_cache(
+            getattr(train_config, "compile_cache_dir", None))
+        compileobs.install()
+        n_dev = mesh.devices.size if mesh is not None else 1
+        if self._g_bucket:
+            g_exec = compaction.bucket_width(G_real, n_dev)
+            if mesh is not None:
+                self.mesh = self._mesh_for(g_exec)
+        else:
+            g_exec = G_real
+            if mesh is not None and G_real % n_dev != 0:
                 raise ValueError(
                     f"grid size {len(spec.points)} must be a multiple of the mesh "
                     f"device count {n_dev} (pad the grid with duplicate points or "
-                    f"shrink the mesh)")
-        self.coeffs = spec.stacked(model.config, train_config)
+                    f"shrink the mesh, or enable g_bucket to pad with masked "
+                    f"filler lanes)")
+        self._g_exec0 = g_exec
+        # original point id per execution lane; -1 marks bucket-padding
+        # filler lanes (masked from birth, never surfaced in GridResult)
+        self._orig_ids0 = np.concatenate(
+            [np.arange(G_real, dtype=np.int32),
+             np.full((g_exec - G_real,), -1, np.int32)])
+        # result-facing coefficients stay at the REAL width; the execution
+        # grid's coeffs are derived per era via _coeffs_for (filler lanes
+        # replicate point 0 — finite, valid math whose results are masked)
+        self.result_coeffs = {
+            k: np.asarray(v)
+            for k, v in spec.stacked(model.config, train_config).items()}
+        self.coeffs = self._coeffs_for(self._orig_ids0)
         self._need_gc = spec.needs_gc(model.config)
         self._need_gc_lagged = spec.needs_gc_lagged(model.config)
         # numerics sentinel: per-lane in-graph non-finite guard + skip
@@ -468,6 +526,63 @@ class RedcliffGridRunner:
         # addressable shards) meshes
         return jax.tree.map(lambda x: put_along_mesh(x, self.mesh), tree)
 
+    def _mesh_for(self, width):
+        """The mesh an execution grid of ``width`` lanes shards over: the
+        full mesh when the width is a multiple of its device count, a
+        SUB-mesh over the first ``width`` devices when the width divides it
+        (the G' < n_devices case after compaction). Bucket-ladder widths
+        (parallel/compaction.py) always satisfy one of the two."""
+        mesh = self._mesh_full
+        if mesh is None:
+            return None
+        n_dev = mesh.devices.size
+        if width % n_dev == 0:
+            return mesh
+        if n_dev % width == 0:
+            return Mesh(mesh.devices.ravel()[:width], mesh.axis_names)
+        raise ValueError(
+            f"grid width {width} cannot shard over the {n_dev}-device mesh "
+            f"(neither a multiple nor a divisor of the device count)")
+
+    def _coeffs_for(self, orig_ids):
+        """Execution-width stacked coefficients for one compaction era:
+        real lanes take their point's values, filler lanes replicate the
+        first real lane (their math must stay finite; their results are
+        discarded via the active mask)."""
+        ids = np.asarray(orig_ids)
+        real = ids >= 0
+        fill = int(ids[real][0]) if real.any() else 0
+        idx = np.where(real, ids, fill)
+        return {k: jnp.asarray(v[idx]) for k, v in self.result_coeffs.items()}
+
+    def _exec_deadlines(self, orig_ids):
+        """Per-execution-lane wall-clock budgets for the current era
+        (filler lanes: +inf), or None when no per-fit deadline is set."""
+        lane_deadline = self.spec.lane_deadlines()
+        if lane_deadline is None:
+            return None
+        ids = np.asarray(orig_ids)
+        out = np.full(ids.shape, np.inf)
+        m = ids >= 0
+        out[m] = lane_deadline[ids[m]]
+        return out
+
+    # programs already dispatched at least once, keyed by (kind, phase,
+    # width, batch shape...): the first dispatch of a new program may pay a
+    # cold XLA compile, so it runs under the op-scoped ``compile`` heartbeat
+    # — the watchdog excuses stalled siblings while it is live instead of
+    # misclassifying a long first-compile window as a hang
+    _seen_programs = None
+
+    def _call_cold(self, key, fn, *args):
+        if self._seen_programs is None:
+            self._seen_programs = set()
+        if key in self._seen_programs:
+            return fn(*args)
+        self._seen_programs.add(key)
+        with rt_watchdog.op_scope(rt_watchdog.COMPILE_COMPONENT):
+            return fn(*args)
+
     def phase_for_epoch(self, epoch):
         return phase_schedule(self.model.config, epoch)
 
@@ -479,7 +594,6 @@ class RedcliffGridRunner:
         as a per-point gather along the factor axis."""
         cfg = self.model.config
         tc = self.tc
-        G = len(self.spec.points)
         preds, labels = [], []
         fw_fn = jax.jit(jax.vmap(
             lambda p, X: self.model.forward(p, X)[2][0], in_axes=(0, None)))
@@ -493,11 +607,12 @@ class RedcliffGridRunner:
                 labels.append(np.asarray(Y[:, :, col]))
             else:
                 labels.append(np.asarray(Y))
-        preds = np.concatenate(preds, axis=1)  # (G, N, K)
+        preds = np.concatenate(preds, axis=1)  # (G, N, K), G = EXECUTION width
         lab = np.vstack(labels)  # (N, S)
         from redcliff_tpu.utils.misc import factor_alignment_order
 
         K = cfg.num_factors
+        G = preds.shape[0]  # execution width (bucket filler lanes included)
         orders = np.zeros((G, K), dtype=np.int32)
         for g in range(G):
             orders[g] = np.asarray(
@@ -544,7 +659,13 @@ class RedcliffGridRunner:
         horizon-invariant (no phase schedule or early-stop term reads
         max_iter), so training the first N epochs and resuming toward a
         different horizon is bit-safe; only a changed tc.max_iter is treated
-        as a different configured fit."""
+        as a different configured fit. Also deliberately absent, like the
+        deadlines: the elastic-scheduling knobs (``compaction``,
+        ``g_bucket``, ``compile_cache_dir``) — they change which PROGRAM
+        executes (grid width, warm starts), never what a lane computes, and
+        the checkpoint state itself carries the compaction era
+        (``orig_ids``/``retired``) so resume always lands in the bucket the
+        checkpoint was written at."""
         tc = self.tc
         return {
             "points": list(self.spec.points),
@@ -581,19 +702,33 @@ class RedcliffGridRunner:
     _DONATED_STATE_KEYS = ("params", "optA_state", "optB_state", "nstate",
                            "accepted")
 
+    # snapshot keys that are already host-side bookkeeping (no device
+    # gather): compaction-era state plus the scalar loop bookkeeping
+    _HOST_STATE_KEYS = ("epoch", "aligned", "rng_state", "val_history",
+                        "val_eras", "eras", "orig_ids", "retired")
+
     @staticmethod
     def _hostify(snap, meta, to_host):
         """Snapshot dict -> the checkpoint payload (device->host gathers
-        included). Runs on the background writer thread in async mode."""
+        included). Runs on the background writer thread in async mode.
+
+        The per-epoch loss rows are stored EXPANDED to the original point
+        width (compaction.expand_history) so a resumed fit — which may land
+        in a different compaction era than the one that wrote any given row
+        — always restores a uniform, original-id-indexed history."""
         host = {
             k: (jax.tree.map(to_host, v) if v is not None else None)
             for k, v in snap.items()
-            if k not in ("epoch", "aligned", "rng_state", "val_history")
+            if k not in RedcliffGridRunner._HOST_STATE_KEYS
         }
         host["epoch"] = snap["epoch"]
         host["aligned"] = snap["aligned"]
         host["rng_state"] = snap["rng_state"]
-        host["val_history"] = [to_host(v) for v in snap["val_history"]]
+        host["orig_ids"] = np.asarray(snap["orig_ids"], np.int32)
+        host["retired"] = snap["retired"]
+        rows = [to_host(v) for v in snap["val_history"]]
+        host["val_history"] = list(compaction.expand_history(
+            rows, snap["val_eras"], snap["eras"], len(meta["points"])))
         host["meta"] = meta
         return host
 
@@ -623,8 +758,10 @@ class RedcliffGridRunner:
         donated = self._ensure_snapshot_fn()(donated)
         snap = {}
         for k, v in state.items():
-            if k == "val_history":
-                snap[k] = list(v)  # the live list keeps growing
+            if k in ("val_history", "val_eras", "eras"):
+                snap[k] = list(v)  # the live lists keep growing
+            elif k == "retired":
+                snap[k] = dict(v)  # compaction may retire more lanes later
             else:
                 snap[k] = donated.get(k, v) if k in self._DONATED_STATE_KEYS \
                     else v
@@ -743,7 +880,9 @@ class RedcliffGridRunner:
         with a cause in ``GridResult.failures``) while the rest of the grid
         keeps training. Because checkpoints store gathered host
         state, a fit may resume on a different (e.g. smaller) device mesh
-        than the one that wrote the checkpoint.
+        than the one that wrote the checkpoint; the elastic scheduler's
+        compaction era (execution width, lane->point map, retired results)
+        is checkpointed too, so resume lands in the same bucket.
 
         Liveness (ARCHITECTURE.md "Liveness & supervision"): when
         ``REDCLIFF_WATCHDOG`` is set, a daemon watchdog monitors the
@@ -790,25 +929,30 @@ class RedcliffGridRunner:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
-        G = len(self.spec.points)
+        G_real = self._g_real
         # wall-clock deadline bookkeeping: budgets are per-process (a
         # resumed attempt gets a fresh budget — the deadline bounds THIS
         # allocation's spend, not the fit's total history)
         fit_t0 = time.monotonic()
-        lane_deadline = self.spec.lane_deadlines()
-        # host-side memo of lanes already deadline-evicted, so the per-epoch
-        # check degenerates to a numpy compare (no device sync) once every
-        # over-budget lane is handled
-        dl_done = np.zeros((G,), dtype=bool)
         stop_after = tc.lookback * tc.check_every
-        coeffs = self._shard(self.coeffs)
         ckpt = ck_src = ck_meta = None
         if checkpoint_dir is not None:
             ck_meta = self._checkpoint_meta(train_ds, val_ds)
             ckpt, ck_src = self._load_checkpoint(checkpoint_dir, ck_meta)
         if ckpt is not None:
             # resume: the full fit state comes from the checkpoint; the
-            # (expensive) fresh grid init is skipped entirely
+            # (expensive) fresh grid init is skipped entirely. The
+            # compaction era (execution width, lane->point map, retired
+            # results) is part of that state, so a resumed fit lands in
+            # exactly the bucket the checkpoint was written at
+            ids = ckpt.get("orig_ids")
+            orig_ids = (np.asarray(ids, np.int32) if ids is not None
+                        else np.arange(len(np.asarray(ckpt["active"])),
+                                       dtype=np.int32))
+            retired = dict(ckpt.get("retired") or {})
+            Gx = int(orig_ids.size)
+            if self._mesh_full is not None:
+                self.mesh = self._mesh_for(Gx)
             params = self._shard(jax.tree.map(jnp.asarray, ckpt["params"]))
             optA_state = self._shard(jax.tree.map(jnp.asarray,
                                                   ckpt["optA_state"]))
@@ -822,13 +966,19 @@ class RedcliffGridRunner:
             accepted = (self._shard(jax.tree.map(jnp.asarray,
                                                  ckpt["accepted"]))
                         if ckpt["accepted"] is not None else None)
+            # checkpointed rows are already expanded to the original width
+            # (original-id indexed); rows appended by THIS attempt carry
+            # their era index instead
             val_history = list(ckpt["val_history"])
+            val_eras = [-1] * len(val_history)
+            eras = [orig_ids]
+            era_cur = 0
             aligned = ckpt["aligned"]
             failed_epoch = self._shard(jnp.asarray(ckpt["failed_epoch"]))
             ns = ckpt.get("nstate")
             nstate = (self._shard(jax.tree.map(jnp.asarray, ns))
                       if ns is not None
-                      else self._shard(numerics.init_numerics_state(lanes=G)))
+                      else self._shard(numerics.init_numerics_state(lanes=Gx)))
             fc = ckpt.get("failed_cause")
             if fc is None:
                 # pre-sentinel checkpoint: every already-quarantined lane was
@@ -853,28 +1003,52 @@ class RedcliffGridRunner:
                 params, optA_state, optB_state = init_params
             else:
                 params, optA_state, optB_state = self.init_grid(key)
+            orig_ids = self._orig_ids0.copy()
+            retired = {}
+            eras = [orig_ids]
+            era_cur = 0
+            Gx = self._g_exec0
+            pad = Gx - G_real
+            if pad:
+                # bucket padding: filler lanes replicate lane 0's state —
+                # finite, valid math that compiles into the same program as
+                # the real lanes; the active mask below keeps them frozen
+                # and orig_ids keeps them out of every result
+                padf = lambda t: jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x, jnp.repeat(x[:1], pad, axis=0)], axis=0), t)
+                params, optA_state, optB_state = (
+                    padf(params), padf(optA_state), padf(optB_state))
             params = self._shard(params)
             optA_state = self._shard(optA_state)
             optB_state = self._shard(optB_state)
-            best_crit = jnp.full((G,), jnp.inf)
-            best_epoch = jnp.zeros((G,), dtype=jnp.int32)
+            best_crit = jnp.full((Gx,), jnp.inf)
+            best_epoch = jnp.zeros((Gx,), dtype=jnp.int32)
             # materialize a copy: the train steps donate (consume) the live
             # params buffers, so best_params must never alias them
             best_params = jax.tree.map(jnp.copy, params)
             # Freeze-mode accepted tree (the per-point trainer's "accepted")
             accepted = jax.tree.map(jnp.copy, params) if self._freeze else None
-            # per-point early-stop lane mask: converged points stop updating
-            active = self._shard(jnp.ones((G,), dtype=bool))
+            # per-point early-stop lane mask: converged points stop
+            # updating; bucket-padding filler lanes are born inactive
+            active = self._shard(jnp.asarray(orig_ids >= 0))
             # non-finite quarantine bookkeeping: epoch a lane went bad
             # (-1 = healthy) plus its cause code; quarantined lanes freeze
             # like early-stopped ones but are reported as failures, not
             # results. The numerics sentinel counters ride per-lane
-            failed_epoch = self._shard(jnp.full((G,), -1, jnp.int32))
-            failed_cause = self._shard(jnp.zeros((G,), jnp.int32))
-            nstate = self._shard(numerics.init_numerics_state(lanes=G))
+            failed_epoch = self._shard(jnp.full((Gx,), -1, jnp.int32))
+            failed_cause = self._shard(jnp.zeros((Gx,), jnp.int32))
+            nstate = self._shard(numerics.init_numerics_state(lanes=Gx))
             val_history = []
+            val_eras = []
             aligned = False
             start_it = 0
+        # per-execution-lane deadline bookkeeping (era-remapped on
+        # compaction); dl_done memoizes already-evicted lanes so the
+        # per-epoch check degenerates to a numpy compare (no device sync)
+        lane_deadline = self._exec_deadlines(orig_ids)
+        dl_done = np.zeros((Gx,), dtype=bool)
+        coeffs = self._shard(self._coeffs_for(orig_ids))
 
         # ---- batch-stream plan (epoch engine, data/pipeline.py) ----------
         # resolved ONCE per fit: "epoch" scans the whole epoch's batch
@@ -947,11 +1121,21 @@ class RedcliffGridRunner:
                     : self.model.config.max_lag, :])
                 if sharding is not None:
                     cos_Xw = jax.device_put(cos_Xw, sharding)
-        # per-fit dispatch/stall accounting (bench.py's schema and the
-        # tier-1 dispatch-budget tripwire both read this)
+        # per-fit dispatch/stall/compile/lane accounting (bench.py's schema
+        # and the tier-1 dispatch-budget + recompile tripwires read this).
+        # lane_epochs counts lanes actually computed (width x epochs);
+        # lane_epochs_nominal is what an uncompacted run of this attempt
+        # would have computed — their gap is the dead-lane FLOPs saved
         self.dispatch_stats = stats = {
             "mode": base_stream, "epochs": 0, "train_dispatches": 0,
-            "val_dispatches": 0, "ckpt_stall_ms": 0.0}
+            "val_dispatches": 0, "ckpt_stall_ms": 0.0,
+            "grid_width": Gx, "lanes_real": G_real,
+            "lanes_padded": int((orig_ids < 0).sum()), "lanes_live": None,
+            "compactions": 0, "lane_epochs": 0, "lane_epochs_nominal": 0,
+            "compile_ms": 0.0, "compiles": 0, "cache_hits": 0,
+            "cache_misses": 0}
+        compile_t0 = compileobs.snapshot()
+        width_nominal = Gx
         # background checkpoint writer (created and scoped by fit(), which
         # joins it on EVERY exit path): pre-compile the fused donated-state
         # snapshot here so the FIRST save's main-thread stall is the
@@ -968,9 +1152,11 @@ class RedcliffGridRunner:
         if wd is not None:
             # hang incidents land in THIS fit's metrics.jsonl
             wd.bind(logger=logger)
-        logger.log("fit_start", model="RedcliffGridRunner", grid_size=G,
+        logger.log("fit_start", model="RedcliffGridRunner", grid_size=G_real,
+                   grid_width=Gx, lanes_padded=stats["lanes_padded"],
                    training_mode=self.model.config.training_mode,
                    stream_mode=base_stream,
+                   compile_cache_dir=jax.config.jax_compilation_cache_dir,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
                    resumed_from=ck_src,
                    points=list(self.spec.points))
@@ -979,8 +1165,12 @@ class RedcliffGridRunner:
         fi_step = 0
         for it in range(start_it, max_iter):
             # the epoch engine's own heartbeat: one stamp per epoch boundary
-            # (budget must cover compile + the longest legit epoch)
+            # (a cold compile inside the epoch additionally stamps the
+            # op-scoped ``compile`` beat via _call_cold, which excuses this
+            # one while XLA runs)
             rt_watchdog.stamp("epoch_engine")
+            epoch_width = Gx
+            epoch_compile_t0 = compileobs.snapshot()
             cfg0 = self.model.config
             if (not aligned and "pretrain_factor" in cfg0.training_mode
                     and it == cfg0.num_pretrain_epochs
@@ -1008,16 +1198,17 @@ class RedcliffGridRunner:
                 idx = jnp.asarray(full_idx)
                 if sharding is not None:
                     idx = jax.device_put(idx, sharding)
-                params, optA_state, optB_state, nstate = \
-                    self._epoch_steps[phase](params, optA_state, optB_state,
-                                             nstate, coeffs, active,
-                                             Xd, Yd, idx)[:4]
+                params, optA_state, optB_state, nstate = self._call_cold(
+                    ("epoch", phase, Gx, idx.shape),
+                    self._epoch_steps[phase], params, optA_state, optB_state,
+                    nstate, coeffs, active, Xd, Yd, idx)[:4]
                 stats["train_dispatches"] += 1
                 if len(rem_idx):
-                    params, optA_state, optB_state, nstate = \
-                        self._steps[phase](params, optA_state, optB_state,
-                                           nstate, coeffs, active,
-                                           Xd[rem_idx], Yd[rem_idx])[:4]
+                    params, optA_state, optB_state, nstate = self._call_cold(
+                        ("step", phase, Gx, len(rem_idx)),
+                        self._steps[phase], params, optA_state, optB_state,
+                        nstate, coeffs, active,
+                        Xd[rem_idx], Yd[rem_idx])[:4]
                     stats["train_dispatches"] += 1
             elif mode_e == "kscan":
                 # group FULL-SIZE labeled batches and drive each group with
@@ -1037,12 +1228,16 @@ class RedcliffGridRunner:
                         Xs = jnp.stack([jnp.asarray(x) for x, _ in group])
                         Ys = jnp.stack([jnp.asarray(y) for _, y in group])
                         stats["train_dispatches"] += 1
-                        return self._scan_steps[phase](*state, coeffs, active,
-                                                       Xs, Ys)[:4]
+                        return self._call_cold(
+                            ("kscan", phase, Gx, Xs.shape),
+                            self._scan_steps[phase], *state, coeffs, active,
+                            Xs, Ys)[:4]
                     for X, Y in group:
                         stats["train_dispatches"] += 1
-                        state = self._steps[phase](*state, coeffs, active,
-                                                   X, Y)[:4]
+                        state = self._call_cold(
+                            ("step", phase, Gx, X.shape, Y is None),
+                            self._steps[phase], *state, coeffs, active,
+                            X, Y)[:4]
                     return state
 
                 for X, Y in train_batch_iter():
@@ -1051,8 +1246,10 @@ class RedcliffGridRunner:
                         state = run_group(state, group)
                         group = []
                         stats["train_dispatches"] += 1
-                        state = self._steps[phase](*state, coeffs, active,
-                                                   X, Y)[:4]
+                        state = self._call_cold(
+                            ("step", phase, Gx, X.shape, Y is None),
+                            self._steps[phase], *state, coeffs, active,
+                            X, Y)[:4]
                         continue
                     group.append((X, Y))
                     if len(group) == k:
@@ -1072,8 +1269,10 @@ class RedcliffGridRunner:
                     for phase in phases:
                         stats["train_dispatches"] += 1
                         params, optA_state, optB_state, nstate, _ = \
-                            self._steps[phase](params, optA_state, optB_state,
-                                               nstate, coeffs, active, X, Y)
+                            self._call_cold(
+                                ("step", phase, Gx, X.shape, Y is None),
+                                self._steps[phase], params, optA_state,
+                                optB_state, nstate, coeffs, active, X, Y)
                     if self._freeze_by_batch:
                         params, accepted = self._freeze_step(params, accepted)
                 rt_watchdog.retire("batch_loop")
@@ -1081,13 +1280,15 @@ class RedcliffGridRunner:
                 # whole validation set in one scanned dispatch (sequential
                 # carry adds — bit-identical to the per-batch loop's sums);
                 # the short remainder batch adds one per-batch dispatch
-                combo_sum, forecast_sum, factor_sum = self._val_scan(
+                combo_sum, forecast_sum, factor_sum = self._call_cold(
+                    ("val_scan", Gx), self._val_scan,
                     params, coeffs, vXd, vYd, vidx)
                 stats["val_dispatches"] += 1
                 n = int(vidx.shape[0])
                 if len(v_rem):
-                    combo, fo, fa = self._val(params, coeffs,
-                                              vXd[v_rem], vYd[v_rem])
+                    combo, fo, fa = self._call_cold(
+                        ("val", Gx, len(v_rem)), self._val,
+                        params, coeffs, vXd[v_rem], vYd[v_rem])
                     stats["val_dispatches"] += 1
                     combo_sum = combo_sum + combo
                     forecast_sum = forecast_sum + fo
@@ -1099,7 +1300,8 @@ class RedcliffGridRunner:
                 factor_sum = 0.0
                 n = 0
                 for X, Y in val_ds.batches(tc.batch_size):
-                    combo, fo, fa = self._val(params, coeffs, X, Y)
+                    combo, fo, fa = self._call_cold(
+                        ("val", Gx, X.shape), self._val, params, coeffs, X, Y)
                     stats["val_dispatches"] += 1
                     combo_sum = combo_sum + combo
                     forecast_sum = forecast_sum + fo
@@ -1109,8 +1311,13 @@ class RedcliffGridRunner:
                 raise ValueError(
                     "validation dataset yielded no batches — increase "
                     "val_fraction or dataset size")
-            # keep per-epoch losses device-resident; one host transfer at the end
-            val_history.append(combo_sum / n)
+            # keep per-epoch losses device-resident; one host transfer at
+            # the end (rows are execution-width — the era index records
+            # which lane->point map they were computed under, and
+            # compaction.expand_history scatters them back to original ids)
+            val_now = combo_sum / n
+            val_history.append(val_now)
+            val_eras.append(era_cur)
             # graceful degradation: a point whose val loss went non-finite,
             # OR whose in-graph guard skipped max_consecutive_skips steps in
             # a row (the lane is stuck on poisoned gradients), is quarantined
@@ -1118,7 +1325,7 @@ class RedcliffGridRunner:
             # grid keeps training. Pure device compute (no host sync); the
             # failed epochs + causes surface in GridResult.failures and
             # failures.json
-            bad = jnp.logical_not(jnp.isfinite(val_history[-1]))
+            bad = jnp.logical_not(jnp.isfinite(val_now))
             if self._guard:
                 bad = jnp.logical_or(
                     bad, nstate["consecutive"] >= self._numerics_k)
@@ -1156,7 +1363,8 @@ class RedcliffGridRunner:
                     # cos_Xw is the once-per-fit hoisted device constant —
                     # no per-epoch host slice/transfer in the hot loop
                     crit = crit + (coeffs["stopping_criteria_cosSim_coeff"]
-                                   * self._cos(params, cos_Xw))
+                                   * self._call_cold(("cos", Gx), self._cos,
+                                                     params, cos_Xw))
                 if self._freeze:
                     # end-of-epoch accept/revert; the accepted tree IS the
                     # best-params analog (trainer fit loop, freeze branch)
@@ -1232,9 +1440,11 @@ class RedcliffGridRunner:
                         # the evicted lane's state must land durably: force
                         # a checkpoint at this epoch regardless of cadence
                         force_ckpt = True
+                        # report ORIGINAL point ids, not execution rows —
+                        # after a compaction the two disagree
                         logger.log("deadline_evicted", epoch=it,
                                    elapsed_s=round(elapsed, 3),
-                                   lanes=[int(g)
+                                   lanes=[int(orig_ids[g])
                                           for g in np.flatnonzero(over)],
                                    num_evicted=n_evict)
             if (self.spec.grid_deadline_s and elapsed is not None
@@ -1248,16 +1458,21 @@ class RedcliffGridRunner:
             # typically only process 0 writes) — gather everywhere, write
             # wherever a logger is attached
             if it % tc.check_every == 0:
-                # one gather serves both the epoch log and the exit test
+                # one gather serves the epoch log, the exit test, and the
+                # compaction decision
                 act_host = gather_to_host(active)
+                stats["lanes_live"] = int(act_host.sum())
                 if logger.active or jax.process_count() > 1:
                     failed_host = gather_to_host(failed_epoch)
                     skipped_host = np.asarray(
                         gather_to_host(nstate["skipped"]))
                     logger.log("epoch", epoch=it, phases=list(phases),
-                               val_combo_loss=gather_to_host(val_history[-1]),
+                               val_combo_loss=gather_to_host(val_now),
                                best_criteria=gather_to_host(best_crit),
                                num_active=int(act_host.sum()),
+                               lanes_live=stats["lanes_live"],
+                               grid_width=Gx,
+                               lanes_padded=int((orig_ids < 0).sum()),
                                num_quarantined=int((failed_host >= 0).sum()),
                                guarded_steps_skipped=int(skipped_host.sum()))
                 # global early exit: once EVERY lane has hit its per-point
@@ -1271,6 +1486,98 @@ class RedcliffGridRunner:
                     logger.log("early_exit_all_inactive", epoch=it)
                     break
 
+                # ---- elastic lane compaction (parallel/compaction.py) ----
+                # when the live-lane count has dropped below the next bucket
+                # on the power-of-two ladder, gather the survivors into a
+                # compacted grid and stop paying FLOPs for retired lanes.
+                # Runs at check-window boundaries only (the act_host gather
+                # above is the decision input — no extra sync) and BEFORE
+                # the checkpoint block, so the epoch-it checkpoint stores
+                # the compacted state and a resume lands in the same bucket.
+                # Per-lane updates are bit-identical across widths: the
+                # vmapped step is lane-independent, the same property the
+                # active-mask freeze already relies on. Single-process only
+                # (a multi-host grid would have to re-span hosts mid-fit)
+                plan = None
+                if self._compaction_on and jax.process_count() == 1:
+                    plan = compaction.plan_compaction(
+                        act_host, orig_ids, retired.keys(),
+                        self._mesh_full.devices.size
+                        if self._mesh_full is not None else 1)
+                if plan is not None:
+                    # retire frozen lanes' results to host before their
+                    # rows are dropped (their state never changes again)
+                    if plan.retire_rows.size:
+                        rows = jnp.asarray(plan.retire_rows)
+                        frozen = gather_to_host({
+                            "best_params": jax.tree.map(
+                                lambda l: l[rows], best_params),
+                            "best_crit": best_crit[rows],
+                            "best_epoch": best_epoch[rows],
+                            "failed_epoch": failed_epoch[rows],
+                            "failed_cause": failed_cause[rows],
+                        })
+                        for i, pid in enumerate(plan.retire_ids):
+                            retired[int(pid)] = {
+                                "best_params": jax.tree.map(
+                                    lambda l, _i=i: np.asarray(l[_i]),
+                                    frozen["best_params"]),
+                                "best_crit": float(frozen["best_crit"][i]),
+                                "best_epoch": int(frozen["best_epoch"][i]),
+                                "failed_epoch": int(
+                                    frozen["failed_epoch"][i]),
+                                "failed_cause": int(
+                                    frozen["failed_cause"][i]),
+                            }
+                    old_width = Gx
+                    self.mesh = self._mesh_for(plan.new_width)
+                    sel = jnp.asarray(plan.sel)
+                    take = lambda t: self._shard(
+                        jax.tree.map(lambda l: l[sel], t))
+                    params = take(params)
+                    optA_state = take(optA_state)
+                    optB_state = take(optB_state)
+                    nstate = take(nstate)
+                    best_params = take(best_params)
+                    if accepted is not None:
+                        accepted = take(accepted)
+                    best_crit = take(best_crit)
+                    best_epoch = take(best_epoch)
+                    failed_epoch = take(failed_epoch)
+                    failed_cause = take(failed_cause)
+                    active = self._shard(jnp.asarray(plan.active))
+                    orig_ids = plan.orig_ids
+                    Gx = plan.new_width
+                    coeffs = self._shard(self._coeffs_for(orig_ids))
+                    lane_deadline = self._exec_deadlines(orig_ids)
+                    dl_done = dl_done[plan.sel]
+                    # replicated device data must follow the (possibly
+                    # shrunken) active mesh; device_arrays keeps one copy
+                    # per placement, so this is a cache hit when the mesh
+                    # is unchanged
+                    sharding = (replicated(self.mesh)
+                                if self.mesh is not None else None)
+                    if Xd is not None:
+                        Xd, Yd = train_ds.device_arrays(sharding)
+                    if val_scan_ok:
+                        vXd, vYd = val_ds.device_arrays(sharding)
+                        vidx = jnp.asarray(v_full)
+                        if sharding is not None:
+                            vidx = jax.device_put(vidx, sharding)
+                    if cos_Xw is not None and sharding is not None:
+                        cos_Xw = jax.device_put(cos_Xw, sharding)
+                    eras.append(orig_ids)
+                    era_cur += 1
+                    stats["compactions"] += 1
+                    stats["grid_width"] = Gx
+                    stats["lanes_padded"] = int((orig_ids < 0).sum())
+                    logger.log(
+                        "compaction", epoch=it, from_width=old_width,
+                        to_width=Gx, lanes_live=stats["lanes_live"],
+                        retired=[int(p) for p in plan.retire_ids],
+                        mesh_devices=(self.mesh.devices.size
+                                      if self.mesh is not None else None))
+
             if checkpoint_dir is not None:
                 snap = {
                     "params": params, "optA_state": optA_state,
@@ -1279,7 +1586,9 @@ class RedcliffGridRunner:
                     "active": active, "accepted": accepted,
                     "failed_epoch": failed_epoch,
                     "failed_cause": failed_cause, "nstate": nstate,
-                    "val_history": val_history, "aligned": aligned,
+                    "val_history": val_history, "val_eras": val_eras,
+                    "eras": eras, "orig_ids": orig_ids, "retired": retired,
+                    "aligned": aligned,
                     "rng_state": rng.bit_generator.state, "epoch": it,
                 }
                 saved = False
@@ -1346,6 +1655,24 @@ class RedcliffGridRunner:
                     "grid", epoch=it, elapsed_s=elapsed,
                     deadline_s=float(self.spec.grid_deadline_s))
             stats["epochs"] += 1
+            # dead-lane accounting: lanes this epoch actually computed vs
+            # what an uncompacted run would have (their gap, summed over
+            # epochs, is the FLOPs compaction saved — bench.py reports it
+            # as dead_lane_flops_saved_pct)
+            stats["lane_epochs"] += epoch_width
+            stats["lane_epochs_nominal"] += width_nominal
+            # per-epoch compile observability: any epoch that compiled a
+            # program logs what it cost and whether the persistent cache
+            # served it (runtime/compileobs.py)
+            if logger.active:
+                dc = compileobs.delta(epoch_compile_t0)
+                if dc["compiles"]:
+                    logger.log("compile", epoch=it,
+                               programs=dc["compiles"],
+                               compile_ms=dc["compile_ms"],
+                               cache_hits=dc["cache_hits"],
+                               cache_misses=dc["cache_misses"],
+                               grid_width=Gx)
             faultinject.crash_point("epoch_end", epoch=it)
 
         rt_watchdog.retire("epoch_engine")
@@ -1353,13 +1680,49 @@ class RedcliffGridRunner:
             # completion barrier: surface any background write failure and
             # guarantee the last generation is durable before results return
             writer.wait()
+        stats.update(compileobs.delta(compile_t0))
 
-        # one gather each; shared by the fit_end record and the result
-        final_crit = gather_to_host(best_crit)
-        final_epoch = gather_to_host(best_epoch)
-        final_active = gather_to_host(active)
-        final_failed = np.asarray(gather_to_host(failed_epoch))
-        final_cause = np.asarray(gather_to_host(failed_cause))
+        # ---- result assembly under ORIGINAL point ids -------------------
+        # one gather each; live execution lanes scatter through orig_ids,
+        # lanes retired by earlier compactions come from the host-side
+        # retired store, filler lanes are dropped
+        exec_crit = gather_to_host(best_crit)
+        exec_epoch = gather_to_host(best_epoch)
+        exec_active = gather_to_host(active)
+        exec_failed = np.asarray(gather_to_host(failed_epoch))
+        exec_cause = np.asarray(gather_to_host(failed_cause))
+        exec_best = gather_to_host(best_params)
+        real = orig_ids >= 0
+        ids = orig_ids[real]
+        G_real = self._g_real
+
+        def full_of(exec_arr, fill, dtype=None):
+            out = np.full((G_real,) + np.shape(exec_arr)[1:], fill,
+                          dtype or np.asarray(exec_arr).dtype)
+            out[ids] = np.asarray(exec_arr)[real]
+            return out
+
+        final_crit = full_of(exec_crit, np.inf)
+        final_epoch = full_of(exec_epoch, 0)
+        final_active = full_of(exec_active, False)
+        final_failed = full_of(exec_failed, -1)
+        final_cause = full_of(exec_cause, 0)
+        leaves, treedef = jax.tree.flatten(exec_best)
+        retired_leaves = {pid: jax.tree.leaves(rec["best_params"])
+                          for pid, rec in retired.items()}
+        full_leaves = []
+        for li, leaf in enumerate(leaves):
+            full = np.zeros((G_real,) + leaf.shape[1:], leaf.dtype)
+            full[ids] = np.asarray(leaf)[real]
+            for pid, rls in retired_leaves.items():
+                full[pid] = rls[li]
+            full_leaves.append(full)
+        best_params_full = jax.tree.unflatten(treedef, full_leaves)
+        for pid, rec in retired.items():
+            final_crit[pid] = rec["best_crit"]
+            final_epoch[pid] = rec["best_epoch"]
+            final_failed[pid] = rec["failed_epoch"]
+            final_cause[pid] = rec["failed_cause"]
         failures = [{"point": int(g), "epoch": int(e),
                      "cause": numerics.QUARANTINE_CAUSES.get(
                          int(c), "nonfinite_val"),
@@ -1369,14 +1732,18 @@ class RedcliffGridRunner:
         logger.log("fit_end", best_epoch=final_epoch,
                    best_criteria=final_crit,
                    num_active=int(final_active.sum()),
+                   compactions=stats["compactions"],
+                   compile_ms=stats["compile_ms"],
                    failures=failures)
         logger.close()
         return GridResult(
-            best_params=gather_to_host(best_params),
+            best_params=best_params_full,
             best_criteria=final_crit,
             best_epoch=final_epoch,
-            val_history=np.stack([self._to_host(v) for v in val_history]),
-            coeffs={k: np.asarray(v) for k, v in self.coeffs.items()},
+            val_history=compaction.expand_history(
+                [self._to_host(v) for v in val_history], val_eras, eras,
+                G_real),
+            coeffs=dict(self.result_coeffs),
             active=final_active,
             failures=failures,
         )

@@ -25,7 +25,12 @@ this package does the same:
   off;
 - :mod:`~redcliff_tpu.runtime.faultinject` — fault-injection hooks + child
   fit used by tests/test_fault_injection.py to SIGKILL fits mid-run, corrupt
-  checkpoints, and inject probe failures.
+  checkpoints, and inject probe failures;
+- :mod:`~redcliff_tpu.runtime.compileobs` — compile observability (per-program
+  compile durations, persistent-cache hit/miss counters via
+  ``jax.monitoring``) and the versioned persistent XLA compilation cache
+  (``jax_compilation_cache_dir``) that makes restarts and supervisor
+  re-attempts warm-start their programs instead of recompiling the world.
 
 None of these modules import jax at module scope: bench.py's parent process
 must stay backend-free (a hung TPU tunnel would wedge it in a C call), so it

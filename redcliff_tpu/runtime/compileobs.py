@@ -1,0 +1,149 @@
+"""Compile observability + the persistent XLA compilation cache.
+
+After PR 3 removed dispatch overhead from the hot path, the two largest
+untouched costs in a grid sweep are dead-lane FLOPs (parallel/compaction.py)
+and COMPILATION: every distinct (shape, G) grid program pays a full XLA
+compile, again on every restart, supervisor re-attempt, and resumed
+preemption. XLA ships a content-addressed persistent compilation cache
+exactly for this; this module wires it in and makes compilation *visible* —
+per-program compile durations, persistent-cache hits/misses — so bench
+artifacts and metrics.jsonl can report warm-start wins and tier-1 tripwires
+can catch silently reintroduced steady-state recompiles.
+
+Two halves:
+
+* :func:`install` registers ``jax.monitoring`` listeners (idempotent,
+  process-global) that tally every ``backend_compile`` duration and every
+  persistent-cache hit/miss. :func:`snapshot` / :func:`delta` give callers
+  cheap before/after accounting; the grid engine folds the per-fit delta
+  into ``dispatch_stats`` and logs a ``compile`` event per epoch that
+  compiled anything.
+* :func:`enable_cache` points ``jax_compilation_cache_dir`` at a VERSIONED
+  subdirectory (jax/jaxlib version + backend platform + a cache schema tag),
+  so upgrading the toolchain can never replay stale executables, and drops
+  the min-compile-time/min-entry-size thresholds so the small grid programs
+  this repo compiles actually land in the cache.
+
+jax is imported lazily (bench.py's backend-free parent imports the runtime
+package); until a caller with a live backend installs the listeners, every
+function here is inert.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "install", "enable_cache", "snapshot", "delta", "cache_version_tag",
+    "ENV_CACHE_DIR", "CACHE_SCHEMA",
+]
+
+# repo-side cache schema tag: bump to orphan all prior persistent-cache
+# entries (e.g. if a custom-call/lowering change makes old executables
+# unsafe to replay without jax itself revving)
+CACHE_SCHEMA = 1
+ENV_CACHE_DIR = "REDCLIFF_COMPILE_CACHE"
+
+_lock = threading.Lock()
+_installed = False
+_enabled_dir = None
+_counters = {
+    "compiles": 0,        # backend_compile invocations (cache hits included:
+    #                       a hit still runs the fast deserialize path)
+    "compile_ms": 0.0,    # total wall time inside backend_compile
+    "cache_hits": 0,      # persistent-cache executable reuses
+    "cache_misses": 0,    # full compiles that went to (or bypassed) the cache
+}
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(name, **kw):
+    if name == _CACHE_HIT_EVENT:
+        with _lock:
+            _counters["cache_hits"] += 1
+    elif name == _CACHE_MISS_EVENT:
+        with _lock:
+            _counters["cache_misses"] += 1
+
+
+def _on_duration(name, secs, **kw):
+    if name == _BACKEND_COMPILE_EVENT:
+        with _lock:
+            _counters["compiles"] += 1
+            _counters["compile_ms"] += secs * 1e3
+
+
+def install():
+    """Register the monitoring listeners once per process. Safe to call from
+    every engine constructor; returns True when the listeners are live."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+        _installed = True
+    import jax
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    return True
+
+
+def snapshot():
+    """Current cumulative counters (a copy)."""
+    with _lock:
+        return dict(_counters)
+
+
+def delta(before, after=None):
+    """Counter difference ``after - before`` (``after`` defaults to now)."""
+    after = snapshot() if after is None else after
+    return {k: (round(after[k] - before[k], 3)
+                if isinstance(after[k], float) else after[k] - before[k])
+            for k in _counters}
+
+
+def cache_version_tag():
+    """The versioned subdirectory name: cache entries are only ever replayed
+    by the exact toolchain (+ backend platform + repo schema) that wrote
+    them."""
+    import jax
+    import jaxlib
+
+    return (f"jax{jax.__version__}-jaxlib{jaxlib.__version__}-"
+            f"{jax.default_backend()}-cc{CACHE_SCHEMA}")
+
+
+def enable_cache(base_dir=None):
+    """Enable the persistent XLA compilation cache under
+    ``<base_dir>/<version-tag>/`` and install the observability listeners.
+
+    ``base_dir`` falls back to the ``REDCLIFF_COMPILE_CACHE`` env var; with
+    neither set this is a no-op returning None. Idempotent for a given
+    directory; returns the resolved versioned cache dir. Thresholds are
+    dropped to cache-everything (the grid's programs are many, small, and
+    recompiled on every restart — exactly the workload the default
+    min-compile-time heuristic skips)."""
+    global _enabled_dir
+    base_dir = base_dir if base_dir is not None else os.environ.get(
+        ENV_CACHE_DIR) or None
+    if not base_dir:
+        return None
+    import jax
+
+    cache_dir = os.path.join(base_dir, cache_version_tag())
+    with _lock:
+        already = _enabled_dir
+    if already == cache_dir:
+        install()
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    with _lock:
+        _enabled_dir = cache_dir
+    install()
+    return cache_dir

@@ -47,7 +47,8 @@ from dataclasses import dataclass, field
 __all__ = [
     "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_NUMERICS_ABORT", "EXIT_HANG",
     "EXIT_DEADLINE", "classify_exit", "CORE_COMPONENTS",
-    "HeartbeatRegistry", "REGISTRY", "stamp", "retire",
+    "HeartbeatRegistry", "REGISTRY", "stamp", "retire", "op_scope",
+    "COMPILE_COMPONENT", "COMPILE_BUDGET_S",
     "WatchdogPolicy", "Watchdog", "maybe_start", "dump_stacks",
 ]
 
@@ -173,6 +174,18 @@ class HeartbeatRegistry:
 # from a previous fit in the same process never read as hangs.
 REGISTRY = HeartbeatRegistry()
 
+# the op-scoped cold-compile heartbeat: the engines stamp it around dispatches
+# that may trigger a fresh XLA compile (first call of a program at a new
+# (shape, G) — parallel/grid.py). While it is live and within budget, the
+# watchdog EXCUSES other overdue components: a long first-compile window
+# blocks the main thread legitimately, and before this beat existed it was
+# misclassified as an epoch_engine/batch_loop hang. A compile older than its
+# own (generous) budget still escalates — a truly wedged XLA compile is a
+# hang. Overridable like any budget via REDCLIFF_WATCHDOG=budget.compile=S.
+COMPILE_COMPONENT = "compile"
+COMPILE_BUDGET_S = 1800.0
+REGISTRY.budgets.setdefault(COMPILE_COMPONENT, COMPILE_BUDGET_S)
+
 
 def stamp(name):
     """Stamp ``name`` on the global registry (auto-registering)."""
@@ -182,6 +195,27 @@ def stamp(name):
 def retire(name):
     """Retire ``name`` from global liveness monitoring (counts persist)."""
     REGISTRY.retire(name)
+
+
+@contextlib.contextmanager
+def op_scope(name):
+    """Stamp ``name`` for the duration of one operation, retiring on exit —
+    the op-scoped heartbeat shape (stamp at entry, retire when the scope
+    ends) used for cold compiles: ``with op_scope(COMPILE_COMPONENT): ...``.
+
+    A closing COMPILE scope additionally ``refresh()``es the registry:
+    every live component's age includes the whole compile window it was
+    legitimately blocked behind, so without a fresh budget the first poll
+    after a long (but in-budget) compile would fire a false hang incident
+    on the still-stale siblings the excuse just stopped covering.
+    """
+    stamp(name)
+    try:
+        yield
+    finally:
+        retire(name)
+        if name == COMPILE_COMPONENT:
+            REGISTRY.refresh()
 
 
 def dump_stacks():
@@ -309,6 +343,14 @@ class Watchdog:
         latched_at = None
         while not self._stop.wait(self.policy.poll_s):
             overdue = self.registry.overdue()
+            if overdue and not any(n == COMPILE_COMPONENT
+                                   for n, _, _ in overdue) \
+                    and COMPILE_COMPONENT in self.registry.ages():
+                # a live, in-budget cold-compile scope legitimately blocks
+                # the main thread (epoch_engine/batch_loop cannot stamp
+                # while XLA compiles) — excuse everything until the compile
+                # finishes or itself exceeds its own budget
+                overdue = []
             if not overdue:
                 latched_at = None  # recovered: rearm the ladder
                 continue

@@ -186,6 +186,15 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     taxonomy code 20). Under ``python -m redcliff_tpu.supervise`` with
     ``REDCLIFF_WATCHDOG`` set, a hung fit is detected, hard-exited, and
     restarted from the durable checkpoint bit-identically.
+
+    Elastic scheduling (ARCHITECTURE.md "Elastic grid scheduling & compile
+    caching"): with the default ``train_config.compaction``/``g_bucket``
+    the grid's execution width rides a power-of-two bucket ladder and
+    COMPACTS as lanes early-stop or quarantine — results and
+    ``failures.json`` records stay indexed by original point id — and
+    ``train_config.compile_cache_dir`` (or ``REDCLIFF_COMPILE_CACHE``)
+    enables the persistent, versioned XLA compilation cache so restarted
+    attempts warm-start instead of recompiling every grid program.
     """
     import jax
 

@@ -32,7 +32,7 @@ import optax
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
-from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.freeze import apply_freeze
@@ -91,6 +91,26 @@ class RedcliffTrainConfig:
     # completion barrier at the next save / fit end). Single-process only —
     # multi-host saves run collective gathers and stay synchronous
     async_checkpointing: bool = True
+    # elastic grid scheduling (grid engine only; parallel/compaction.py):
+    # at check-window boundaries, when the live-lane count drops below the
+    # next power-of-two bucket, gather the surviving lanes into a compacted
+    # grid and stop paying FLOPs for retired lanes. Per-lane update streams
+    # are bit-identical to the uncompacted run; results/failures report
+    # under original point ids. Single-process only (multi-host grids skip
+    # compaction rather than re-spanning hosts mid-fit)
+    compaction: bool = True
+    # pad the grid's execution width up to the power-of-two bucket ladder
+    # with masked filler lanes (never surfaced in results), so heterogeneous
+    # sweeps and post-compaction grids reuse a small set of compiled
+    # programs instead of one program per exact (shape, G). Also lifts the
+    # grid-size-divides-mesh requirement (filler lanes absorb the remainder)
+    g_bucket: bool = True
+    # persistent XLA compilation cache directory (runtime/compileobs.py):
+    # compiled grid programs are cached under a versioned subdir
+    # (jax/jaxlib/backend/schema) so restarts, supervisor re-attempts, and
+    # resumed preemptions warm-start instead of recompiling. None = follow
+    # the REDCLIFF_COMPILE_CACHE env var (unset -> disabled)
+    compile_cache_dir: str | None = None
     # numerical fault policy (in-graph non-finite skip guard; divergence
     # rollback + lr backoff in the per-point trainer, per-lane quarantine
     # causes in the grid engine); None disables the sentinel
@@ -130,6 +150,10 @@ class RedcliffTrainer:
     def __init__(self, model: RedcliffSCMLP, config: RedcliffTrainConfig):
         self.model = model
         self.config = config
+        # persistent compile cache + compile counters (no-op when neither
+        # the config knob nor REDCLIFF_COMPILE_CACHE is set)
+        compileobs.enable_cache(config.compile_cache_dir)
+        compileobs.install()
         self.optA = _torch_style_adam(config.embed_lr, config.embed_eps,
                                       config.embed_weight_decay)
         self.optB = _torch_style_adam(config.gen_lr, config.gen_eps,

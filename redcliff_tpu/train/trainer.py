@@ -36,7 +36,7 @@ import optax
 
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
-from redcliff_tpu.runtime import faultinject, numerics
+from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.tracking import GCProgressTracker
@@ -65,6 +65,9 @@ class TrainConfig:
     # device->host gather + durable CRC+.prev write stop stalling the epoch
     # loop (completion barrier at the next save / fit end)
     async_checkpointing: bool = True
+    # persistent XLA compilation cache base dir (runtime/compileobs.py);
+    # None = follow the REDCLIFF_COMPILE_CACHE env var (unset -> disabled)
+    compile_cache_dir: str | None = None
     # numerical fault policy (in-graph skip guard + divergence rollback);
     # None disables the sentinel entirely
     numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
@@ -115,6 +118,8 @@ class Trainer:
         self.model = model
         self.config = config
         self.has_labels = has_labels
+        compileobs.enable_cache(config.compile_cache_dir)
+        compileobs.install()
         # inject_hyperparams makes the learning rate part of the optimizer
         # STATE, so the DivergenceMonitor can back it off on rollback without
         # recompiling the step

@@ -10,6 +10,18 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import tempfile  # noqa: E402
+
+# persistent XLA compile cache for the whole suite (runtime/compileobs.py):
+# the fault-injection / supervisor / multihost tests spawn subprocess
+# children that would each cold-compile the identical tiny-grid programs;
+# with the cache they warm-start from disk, keeping tier-1 inside its wall
+# budget. The env var propagates to every child (their engines enable it in
+# their constructors); content-addressed keys make it correctness-neutral.
+os.environ.setdefault(
+    "REDCLIFF_COMPILE_CACHE",
+    os.path.join(tempfile.gettempdir(), "redcliff_t1_xla_cache"))
+
 import jax  # noqa: E402
 
 # hard override via config (not env): the session sitecustomize registers the
@@ -17,6 +29,10 @@ import jax  # noqa: E402
 # virtual CPU mesh for determinism and f32 matmul exactness
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+from redcliff_tpu.runtime import compileobs  # noqa: E402
+
+compileobs.enable_cache()
 
 
 def pytest_configure(config):

@@ -1,0 +1,380 @@
+"""Elastic grid scheduler acceptance battery (parallel/compaction.py +
+runtime/compileobs.py): live-lane compaction must be BIT-identical to the
+fixed-width run — per-lane params, metrics, and failures under original
+point ids — including across a mid-run SIGKILL resume that crosses a
+compaction boundary; bucket-padding filler lanes must never leak into
+GridResult; the persistent compile cache must serve warm programs; and a
+steady-state recompile tripwire pins "two epochs after warmup compile
+nothing" for future PRs.
+"""
+import os
+import pickle
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu.parallel import compaction
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.runtime import checkpoint as rck
+from redcliff_tpu.runtime import compileobs
+from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+from redcliff_tpu.utils.observability import read_jsonl
+from test_parallel_grid import _data, _model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = [sys.executable, "-m", "redcliff_tpu.runtime.faultinject"]
+
+
+# ---------------------------------------------------------------------------
+# pure planning units
+# ---------------------------------------------------------------------------
+def test_bucket_width_ladder():
+    assert compaction.next_pow2(0) == 1
+    assert compaction.next_pow2(1) == 1
+    assert compaction.next_pow2(5) == 8
+    assert compaction.next_pow2(16) == 16
+    # no mesh: plain powers of two
+    assert compaction.bucket_width(3) == 4
+    assert compaction.bucket_width(9) == 16
+    # width >= mesh: multiple of the device count (no-op on pow2 meshes)
+    assert compaction.bucket_width(9, n_devices=8) == 16
+    assert compaction.bucket_width(16, n_devices=8) == 16
+    assert compaction.bucket_width(9, n_devices=6) == 18
+    # width < mesh: a divisor runs on a sub-mesh, otherwise pad to the mesh
+    assert compaction.bucket_width(2, n_devices=8) == 2
+    assert compaction.bucket_width(3, n_devices=8) == 4
+    assert compaction.bucket_width(3, n_devices=6) == 6
+
+
+def test_plan_compaction_orders_and_retires():
+    active = np.array([False, True, False, True, False, False, True, False])
+    orig = np.arange(8, dtype=np.int32)
+    plan = compaction.plan_compaction(active, orig, retired_ids=[0])
+    assert plan.new_width == 4  # 3 live -> bucket 4
+    # survivors keep exec-row order; filler replicates the first survivor
+    np.testing.assert_array_equal(plan.sel, [1, 3, 6, 1])
+    np.testing.assert_array_equal(plan.orig_ids, [1, 3, 6, -1])
+    np.testing.assert_array_equal(plan.active, [True, True, True, False])
+    # inactive real lanes retire once (0 was already retired earlier)
+    np.testing.assert_array_equal(sorted(plan.retire_ids), [2, 4, 5, 7])
+    # a half-filler grid still trims down the ladder (4 -> 2)
+    trim = compaction.plan_compaction(
+        np.array([True, True, False, False]),
+        np.array([0, 1, -1, -1], np.int32), retired_ids=[])
+    assert trim.new_width == 2 and trim.retire_rows.size == 0
+    np.testing.assert_array_equal(trim.orig_ids, [0, 1])
+    # already at the right bucket -> no plan
+    assert compaction.plan_compaction(
+        np.array([True, True]), np.array([0, 1], np.int32),
+        retired_ids=[]) is None
+    # nothing live -> no plan (the fit's own exit paths own this case)
+    assert compaction.plan_compaction(
+        np.zeros(4, bool), orig[:4], retired_ids=[]) is None
+
+
+def test_expand_history_carries_retired_lanes_forward():
+    eras = [np.array([0, 1, 2, 3], np.int32), np.array([1, 3], np.int32)]
+    rows = [np.array([1., 2., 3., 4.]), np.array([1.5, 2.5, 3.5, 4.5]),
+            np.array([20., 40.]), np.array([21., 41.])]
+    out = compaction.expand_history(rows, [0, 0, 1, 1], eras, 4)
+    np.testing.assert_array_equal(out[1], [1.5, 2.5, 3.5, 4.5])
+    # lanes 0/2 were dropped after epoch 1: their value carries forward,
+    # which IS the uncompacted semantics (frozen params -> identical loss)
+    np.testing.assert_array_equal(out[2], [1.5, 20., 3.5, 40.])
+    np.testing.assert_array_equal(out[3], [1.5, 21., 3.5, 41.])
+    # full-width rows (restored from a checkpoint) pass through as-is
+    out2 = compaction.expand_history(
+        [np.arange(4.), np.array([9., 9.])],
+        [-1, 1], eras, 4)
+    np.testing.assert_array_equal(out2[1], [0., 9., 2., 9.])
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance property: compaction ON == compaction OFF, bit for bit
+# ---------------------------------------------------------------------------
+def test_compaction_bit_identity_g16_early_stop_and_quarantine(tmp_path):
+    """Seeded G=16 fit where 8 lanes early-stop (zero lr, patience 1) and 2
+    quarantine (poison lr -> non-finite): per-lane final params, metrics
+    (val_history/criteria/epochs), active masks, and failure records with
+    compaction ON equal the fixed-width compaction-OFF run exactly. Also
+    asserts the scheduler actually compacted and logged it (this is not a
+    vacuous pass), and that metrics.jsonl carries the new lanes_live /
+    grid_width / compaction observability."""
+    import dataclasses
+
+    model = _model()
+    # 6 live + 8 zero-lr early-stoppers + 2 poison-lr quarantines = 16
+    points = ([{"gen_lr": 1e-3 * (1 + i)} for i in range(6)]
+              + [{"gen_lr": 0.0, "embed_lr": 0.0}] * 8
+              + [{"gen_lr": 1e20, "embed_lr": 1e20}] * 2)
+    spec = GridSpec(points=points)
+    ds = _data(model)
+    key = jax.random.PRNGKey(7)
+    tc = RedcliffTrainConfig(max_iter=5, batch_size=32, lookback=1,
+                             check_every=1)
+    log_on = str(tmp_path / "on")
+    r_on = RedcliffGridRunner(model, tc, spec)
+    res_on = r_on.fit(key, ds, ds, log_dir=log_on)
+    r_off = RedcliffGridRunner(
+        model, dataclasses.replace(tc, compaction=False), spec)
+    res_off = r_off.fit(key, ds, ds)
+
+    assert r_on.dispatch_stats["compactions"] >= 1
+    assert r_on.dispatch_stats["grid_width"] < 16
+    assert r_off.dispatch_stats["compactions"] == 0
+    assert r_on.dispatch_stats["lane_epochs"] \
+        < r_on.dispatch_stats["lane_epochs_nominal"]
+    # >= 6 lanes actually retired mid-run, as the property demands
+    assert int((~res_on.active).sum()) >= 6
+
+    np.testing.assert_array_equal(res_on.val_history, res_off.val_history)
+    np.testing.assert_array_equal(res_on.best_criteria,
+                                  res_off.best_criteria)
+    np.testing.assert_array_equal(res_on.best_epoch, res_off.best_epoch)
+    np.testing.assert_array_equal(res_on.active, res_off.active)
+    assert res_on.failures == res_off.failures
+    assert {f["point"] for f in res_on.failures} == {14, 15}
+    # params: xla's NEW cpu thunk runtime (the jax default this suite runs
+    # under) emits scan bodies whose codegen depends on the program width,
+    # rounding a handful of weights ~1 ulp differently across widths — the
+    # legacy runtime and the per-batch program are width-EXACT (see
+    # test_compaction_bit_identity_exact_on_width_stable_runtime, which
+    # pins full bitwise equality on that runtime). Here: tight float
+    # equality, plus bitwise on everything decision-shaped above
+    for a, b in zip(jax.tree.leaves(res_on.best_params),
+                    jax.tree.leaves(res_off.best_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # observability: epoch records carry lane occupancy, and the compaction
+    # event + per-program compile costs landed in metrics.jsonl
+    events = read_jsonl(log_on)
+    epochs = [e for e in events if e.get("event") == "epoch"]
+    assert epochs and all("lanes_live" in e and "grid_width" in e
+                          for e in epochs)
+    comps = [e for e in events if e.get("event") == "compaction"]
+    assert comps and comps[0]["to_width"] < comps[0]["from_width"]
+    assert comps[0]["retired"] == sorted(comps[0]["retired"])
+    compiles = [e for e in events if e.get("event") == "compile"]
+    assert compiles and all(e["compile_ms"] > 0 for e in compiles)
+
+
+def test_filler_lanes_never_leak_into_grid_result():
+    """A non-power-of-two grid (G=3 -> width-4 bucket) reports results at
+    the REAL width everywhere, including when a real lane quarantines: no
+    phantom point ids, no filler rows in any result field."""
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 2e-3},
+                            {"gen_lr": 1e20, "embed_lr": 1e20}])
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=32, check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(1), ds, ds)
+    assert runner.dispatch_stats["grid_width"] in (1, 2, 4)
+    assert runner.dispatch_stats["lanes_real"] == 3
+    assert res.val_history.shape[1] == 3
+    assert res.best_criteria.shape == (3,)
+    assert res.active.shape == (3,)
+    assert jax.tree.leaves(res.best_params)[0].shape[0] == 3
+    assert {f["point"] for f in res.failures} <= {0, 1, 2}
+    assert [f["point"] for f in res.failures] == [2]
+    assert {k: v.shape for k, v in res.coeffs.items()} \
+        == {k: (3,) for k in res.coeffs}
+
+
+_STRICT_CHILD = r"""
+import os, sys
+sys.path.insert(0, os.path.join({repo!r}, "tests"))
+import numpy as np, jax, dataclasses
+jax.config.update("jax_platforms", "cpu")
+from test_parallel_grid import _model, _data
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+model = _model()
+# 2 live + 3 early-stop + 1 quarantine = 6 points -> width-8 bucket, then
+# compaction to width 2 once the retirements land
+points = ([{{"gen_lr": 1e-3}}, {{"gen_lr": 3e-3}}]
+          + [{{"gen_lr": 0.0, "embed_lr": 0.0}}] * 3
+          + [{{"gen_lr": 1e20, "embed_lr": 1e20}}])
+spec = GridSpec(points=points)
+ds = _data(model, n=48)
+tc = RedcliffTrainConfig(max_iter=4, batch_size=16, lookback=1,
+                         check_every=1)
+key = jax.random.PRNGKey(7)
+r_on = RedcliffGridRunner(model, tc, spec)
+res_on = r_on.fit(key, ds, ds)
+assert r_on.dispatch_stats["compactions"] >= 1, r_on.dispatch_stats
+assert r_on.dispatch_stats["grid_width"] == 2, r_on.dispatch_stats
+r_off = RedcliffGridRunner(
+    model, dataclasses.replace(tc, compaction=False), spec)
+res_off = r_off.fit(key, ds, ds)
+np.testing.assert_array_equal(res_on.val_history, res_off.val_history)
+np.testing.assert_array_equal(res_on.best_criteria, res_off.best_criteria)
+np.testing.assert_array_equal(res_on.best_epoch, res_off.best_epoch)
+np.testing.assert_array_equal(res_on.active, res_off.active)
+assert res_on.failures == res_off.failures
+for a, b in zip(jax.tree.leaves(res_on.best_params),
+                jax.tree.leaves(res_off.best_params)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+print("STRICT-BIT-IDENTITY-OK")
+"""
+
+
+def test_compaction_bit_identity_exact_on_width_stable_runtime(tmp_path):
+    """FULL bitwise identity — per-lane params included — of compaction ON
+    vs OFF, on a backend whose codegen is width-stable (XLA's legacy CPU
+    runtime; the new thunk runtime rounds scan bodies ~1 ulp differently
+    per program width, see the in-process test above). This is the
+    tentpole's bit-identity claim pinned end to end: early stop +
+    quarantine + bucket padding + compaction 8 -> 2."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_cpu_use_thunk_runtime=false").strip()
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _STRICT_CHILD.format(repo=REPO)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "STRICT-BIT-IDENTITY-OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL resume across a compaction boundary
+# ---------------------------------------------------------------------------
+def test_sigkill_resume_across_compaction_boundary(tmp_path):
+    """The canonical tiny fit with a poison point quarantines lane 1 and
+    compacts 2 -> 1 at the first check window. SIGKILLing right after the
+    epoch-2 checkpoint (inside the compacted era) and resuming must land in
+    the same bucket and finish bit-identical to an uninterrupted run —
+    the 'compaction events checkpointed' contract, end to end."""
+
+    def run_child(ck, *extra, fault=None, timeout=240):
+        env = dict(os.environ)
+        env.pop("REDCLIFF_FAULT_INJECT", None)
+        if fault:
+            env["REDCLIFF_FAULT_INJECT"] = fault
+        return subprocess.run(
+            CHILD + ["--checkpoint-dir", str(ck), "--bad-point"]
+            + list(extra),
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout)
+
+    ck = tmp_path / "ck"
+    killed = run_child(ck, "--max-iter", "4",
+                       fault="sigkill_after_checkpoint:2")
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    ckpt = rck.read_checkpoint(str(ck / "grid_checkpoint.pkl"))
+    assert ckpt["epoch"] == 2
+    # the checkpoint was written INSIDE the compacted era: one-lane width,
+    # lane->point map and the retired lane's frozen results on board
+    assert len(ckpt["orig_ids"]) == 1
+    assert 1 in ckpt["retired"]
+
+    res_path = tmp_path / "resumed.pkl"
+    resumed = run_child(ck, "--max-iter", "4", "--result", str(res_path))
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    full_path = tmp_path / "full.pkl"
+    uninterrupted = run_child(tmp_path / "ck_full", "--max-iter", "4",
+                              "--result", str(full_path))
+    assert uninterrupted.returncode == 0, uninterrupted.stderr[-2000:]
+
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    with open(full_path, "rb") as f:
+        want = pickle.load(f)
+    np.testing.assert_array_equal(got["val_history"], want["val_history"])
+    np.testing.assert_array_equal(got["best_criteria"],
+                                  want["best_criteria"])
+    np.testing.assert_array_equal(got["best_epoch"], want["best_epoch"])
+    np.testing.assert_array_equal(got["active"], want["active"])
+    assert got["failures"] == want["failures"]
+    assert [f["point"] for f in got["failures"]] == [1]
+    for a, b in zip(got["best_params_leaves"], want["best_params_leaves"]):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# steady-state recompile tripwire + persistent compile cache
+# ---------------------------------------------------------------------------
+def test_steady_state_zero_recompiles_after_warmup():
+    """CI tripwire: once a fit has warmed every program, further epochs (a
+    whole second fit here — strictly stronger than 'two epochs after
+    warmup') must trigger ZERO new XLA compilations. A future PR that
+    silently reintroduces per-epoch or per-fit recompiles fails here."""
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 2e-3}])
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=32)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    runner.fit(jax.random.PRNGKey(0), ds, ds)  # warmup: compiles everything
+    before = compileobs.snapshot()
+    runner.fit(jax.random.PRNGKey(0), ds, ds)  # steady state
+    d = compileobs.delta(before)
+    assert d["compiles"] == 0, (
+        f"steady-state epochs recompiled {d['compiles']} program(s) "
+        f"({d['compile_ms']} ms) — a dispatch in the hot loop is "
+        f"jit-specializing on something that changes per epoch/fit")
+    assert runner.dispatch_stats["compiles"] == 0
+
+
+def test_persistent_compile_cache_warm_start(tmp_path):
+    """enable_cache points jax at a VERSIONED cache dir; clearing the
+    in-memory executable caches and re-compiling an identical program is
+    served from disk (cache_hits) rather than recompiled from scratch."""
+    import jax.numpy as jnp
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    try:
+        cache_dir = compileobs.enable_cache(str(tmp_path / "cc"))
+        assert compileobs.cache_version_tag() in cache_dir
+        assert jax.__version__ in os.path.basename(cache_dir)
+
+        @jax.jit
+        def f(x):
+            return jnp.sin(x) @ jnp.cos(x.T) + 3.0
+
+        x = jnp.ones((32, 32))
+        before = compileobs.snapshot()
+        f(x).block_until_ready()
+        cold = compileobs.delta(before)
+        assert cold["compiles"] >= 1 and cold["cache_misses"] >= 1
+        jax.clear_caches()
+        before = compileobs.snapshot()
+        f(x).block_until_ready()
+        warm = compileobs.delta(before)
+        assert warm["cache_hits"] >= 1
+        assert warm["cache_misses"] == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        # conftest's suite-wide cache dir may have been displaced; restore
+        compileobs.enable_cache()
+
+
+# ---------------------------------------------------------------------------
+# compaction vs the wall-clock deadline machinery
+# ---------------------------------------------------------------------------
+def test_deadline_eviction_after_compaction_reports_original_ids(tmp_path):
+    """A lane deadline firing AFTER a compaction must evict the right lane
+    and report it under its ORIGINAL point id (the deadline arrays are
+    era-remapped on compaction)."""
+    model = _model()
+    # lane 1 early-stops (compaction 4 -> smaller); lane 3's deadline then
+    # fires on the compacted grid
+    spec = GridSpec(
+        points=[{"gen_lr": 1e-3}, {"gen_lr": 0.0, "embed_lr": 0.0},
+                {"gen_lr": 2e-3}, {"gen_lr": 3e-3}],
+        fit_deadline_s=[np.inf, np.inf, np.inf, 1e-6])
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=32, lookback=1,
+                             check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(3), ds, ds,
+                     checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4)
+    dl = [f for f in res.failures if f["cause"] == "deadline"]
+    assert [f["point"] for f in dl] == [3]
+    assert not res.active[1] and not res.active[3]

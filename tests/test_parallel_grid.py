@@ -98,6 +98,56 @@ def test_group_configs_by_shape():
     assert groups[((16,),)] == [2]
 
 
+def test_group_configs_by_shape_heterogeneous_and_stable():
+    """Multi-key heterogeneous partitioning with the documented ordering
+    contract: groups in first-appearance order, ascending indices within
+    each group, missing keys grouped under None."""
+    cfgs = [
+        {"gen_hidden": (16,), "embed_lag": 4},
+        {"gen_hidden": (8,), "embed_lag": 4},
+        {"gen_hidden": (16,), "embed_lag": 8},
+        {"gen_hidden": (8,), "embed_lag": 4, "lr": 9.0},  # lr is not a shape
+        {"gen_hidden": (16,), "embed_lag": 4},
+        {"embed_lag": 4},  # missing shape key -> None slot
+    ]
+    groups = group_configs_by_shape(cfgs, ["gen_hidden", "embed_lag"])
+    assert list(groups) == [((16,), 4), ((8,), 4), ((16,), 8), (None, 4)]
+    assert groups[((16,), 4)] == [0, 4]
+    assert groups[((8,), 4)] == [1, 3]
+    assert groups[((16,), 8)] == [2]
+    assert groups[(None, 4)] == [5]
+    # identical input -> identical grouping (the resume fingerprint pins
+    # the per-group point list)
+    assert groups == group_configs_by_shape(cfgs, ["gen_hidden", "embed_lag"])
+
+
+def test_shape_group_bucket_padding_never_leaks_filler():
+    """The heterogeneous-sweep flow end to end: a 3-point shape group runs
+    at a width-4 bucket (g_bucket padding, parallel/compaction.py) and its
+    GridResult stays 3-wide everywhere — filler lanes never surface."""
+    cfgs = [{"gen_lr": 1e-3}, {"gen_lr": 2e-3}, {"gen_lr": 5e-3},
+            {"gen_lr": 1e-3}, {"gen_lr": 4e-3}]
+    # simulate a heterogeneous sweep: indices partition into shape groups
+    groups = group_configs_by_shape(
+        [{"h": (8,)}, {"h": (8,)}, {"h": (8,)}, {"h": (16,)}, {"h": (16,)}],
+        ["h"])
+    idxs = groups[((8,),)]
+    assert idxs == [0, 1, 2]
+    model = _model()
+    spec = GridSpec(points=[cfgs[i] for i in idxs])
+    runner = RedcliffGridRunner(model, RedcliffTrainConfig(
+        max_iter=2, batch_size=32), spec)
+    assert runner._g_exec0 == 4  # padded up the pow2 ladder
+    ds = _data(model)
+    res = runner.fit(jax.random.PRNGKey(3), ds, ds)
+    assert res.val_history.shape == (2, 3)
+    assert res.best_criteria.shape == (3,)
+    assert res.active.shape == (3,)
+    assert all(v.shape == (3,) for v in res.coeffs.values())
+    assert jax.tree.leaves(res.best_params)[0].shape[0] == 3
+    assert res.failures == []
+
+
 def test_pallas_gl_prox_matches_jnp():
     rng = np.random.default_rng(0)
     W = jnp.asarray(rng.normal(size=(3, 5, 6, 5, 2)).astype(np.float32))
@@ -404,10 +454,20 @@ def test_grid_step_lane_mask_freezes_point():
 
 
 def test_grid_mesh_divisibility_validated():
+    """g_bucket (default) absorbs a non-divisible grid by padding the
+    execution width up the power-of-two ladder (masked filler lanes, sub-mesh
+    when the bucket is smaller than the device count); with g_bucket=False
+    the historical loud rejection is preserved."""
     model = _model()
     spec = GridSpec(points=[{} for _ in range(3)])
     with pytest.raises(ValueError, match="multiple of the mesh"):
-        RedcliffGridRunner(model, RedcliffTrainConfig(), spec, mesh=grid_mesh(8))
+        RedcliffGridRunner(model, RedcliffTrainConfig(g_bucket=False), spec,
+                           mesh=grid_mesh(8))
+    # default: G=3 pads to a width-4 bucket on a 4-device sub-mesh
+    runner = RedcliffGridRunner(model, RedcliffTrainConfig(), spec,
+                                mesh=grid_mesh(8))
+    assert runner._g_exec0 == 4
+    assert runner.mesh.devices.size == 4
 
 
 def test_factor_axis_sharding_matches_unsharded():
