@@ -687,6 +687,32 @@ def _bench_dead_lanes(jax):
     }
 
 
+def _bench_remesh():
+    """Degraded-mesh re-shard planning latency (parallel/remesh.py): the
+    host-only cost a host-loss resume adds BEFORE the first dispatch —
+    planning which lanes of a checkpointed sweep ride the bucket ladder
+    onto the survivors. Measured at sweep-service scale (G=4096, half the
+    lanes already retired) onto a non-power-of-two 6-device survivor set
+    (the worst case: every lane migrates and the width re-buckets)."""
+    import numpy as np
+
+    from redcliff_tpu.parallel import remesh
+
+    G = 4096
+    rng = np.random.default_rng(0)
+    active = rng.random(G) < 0.5
+    ids = np.arange(G, dtype=np.int32)
+    t0 = time.perf_counter()
+    plan = remesh.plan_resharding(active, ids, [], n_devices=6)
+    plan_ms = (time.perf_counter() - t0) * 1e3
+    return {"grid_points": G, "lanes_live": int(active.sum()),
+            "to_devices": 6,
+            "new_width": plan.new_width if plan is not None else None,
+            "lanes_retired": (int(plan.retire_rows.size)
+                              if plan is not None else 0),
+            "plan_ms": round(plan_ms, 3)}
+
+
 def _bench_compile_cache(jax, runner, compile_args):
     """Warm-vs-cold compile cost of the headline scanned program with the
     persistent XLA compilation cache (runtime/compileobs.py). The cold number
@@ -864,6 +890,13 @@ def _measure(platform):
     except Exception as e:
         compaction_probe = {"error": f"{type(e).__name__}: {e}"}
 
+    # elastic re-meshing: host-side re-shard plan latency at sweep-service
+    # scale (what a degraded-mesh resume pays before its first dispatch)
+    try:
+        remesh_probe = _bench_remesh()
+    except Exception as e:
+        remesh_probe = {"error": f"{type(e).__name__}: {e}"}
+
     # persistent-cache win: cold (captured at the headline scan compile,
     # cache miss) vs warm (in-memory caches cleared, identical program
     # re-lowered -> persistent-cache retrieval)
@@ -907,6 +940,7 @@ def _measure(platform):
         "dead_lane_flops_saved_pct": compaction_probe.get(
             "dead_lane_flops_saved_pct"),
         "compaction": compaction_probe,
+        "remesh": remesh_probe,
         "compile_cache": compile_cache,
         "error": None,
     })

@@ -111,17 +111,53 @@ class ShardedBatchDataset:
     stats pass and epoch N). Each shard read stamps the ``"shard_loader"``
     heartbeat so a read wedged on dead storage is a watchdog-visible hang,
     not a silent stall.
+
+    Host-local streaming (multi-host scale-out, ROADMAP item 5 /
+    docs/ARCHITECTURE.md "Elastic re-meshing & host-fault tolerance"):
+    ``host_id``/``n_hosts`` restrict this instance to its host's round-robin
+    slice of the sorted shard list (``files[host_id::n_hosts]`` by sorted
+    index) — every shard is owned by exactly one host, uneven counts
+    included (no shard dropped, none read twice). Quarantine — per-sample
+    tallies AND torn-file records — stays per host: each host reports only
+    the shards it owns, so one host's dead storage never poisons another's
+    stream. The heartbeat is host-scoped too (``host<h>:shard_loader``),
+    giving the watchdog's per-host staleness detector a real producer.
+    Normalization statistics are computed over the host-local slice
+    (documented deviation: the global-stats path is the in-memory loader;
+    callers needing cross-host-identical stats precompute and pass
+    ``normalize=False`` plus their own transform).
     """
 
     supports_device_batches = False
 
-    def __init__(self, split_dir, normalize=True):
+    def __init__(self, split_dir, normalize=True, host_id=None, n_hosts=None):
         self.split_dir = split_dir
-        self.files = sorted(
+        all_files = sorted(
             x for x in os.listdir(split_dir)
             if "subset_" in x and x.endswith(".pkl") and "metadata" not in x)
-        if not self.files:
+        if not all_files:
             raise FileNotFoundError(f"no subset_*.pkl shards under {split_dir}")
+        if (host_id is None) != (n_hosts is None):
+            raise ValueError("host_id and n_hosts must be given together")
+        self.host_id = host_id
+        self.n_hosts = n_hosts
+        self._hb = ("shard_loader" if host_id is None
+                    else _watchdog.host_component(host_id, "shard_loader"))
+        if n_hosts is not None:
+            if not (0 <= int(host_id) < int(n_hosts)):
+                raise ValueError(
+                    f"host_id {host_id} out of range for n_hosts {n_hosts}")
+            # round-robin by sorted index: a partition of the shard list for
+            # ANY (n_files, n_hosts) — no shard dropped, none assigned twice
+            self.files = all_files[int(host_id)::int(n_hosts)]
+            if not self.files:
+                raise FileNotFoundError(
+                    f"host {host_id}/{n_hosts} owns no shards under "
+                    f"{split_dir} ({len(all_files)} shard file(s) < "
+                    f"{n_hosts} hosts) — reduce n_hosts or write more "
+                    f"shards")
+        else:
+            self.files = all_files
         self.normalize = normalize
         self.quarantined_samples = 0
         self.quarantined_files = {}
@@ -145,7 +181,7 @@ class ShardedBatchDataset:
             ss = ((part ** 2).sum(axis=(0, 1)) if ss is None
                   else ss + (part ** 2).sum(axis=(0, 1)))
         self._n = n
-        _watchdog.retire("shard_loader")  # stats pass done; batches() re-arms
+        _watchdog.retire(self._hb)  # stats pass done; batches() re-arms
         if self._shape_tc is None:
             raise ValueError(
                 f"every sample under {split_dir} was quarantined "
@@ -175,8 +211,9 @@ class ShardedBatchDataset:
     def _load_shard(self, name, count_quarantine=False):
         # liveness + chaos hooks: stamped while a read is in flight (the
         # budget measures one shard load, not inter-load idle — batches()
-        # retires the heartbeat when the stream ends)
-        _watchdog.stamp("shard_loader")
+        # retires the heartbeat when the stream ends). Host-local instances
+        # stamp their host-scoped beat (host<h>:shard_loader)
+        _watchdog.stamp(self._hb)
         _faultinject.hang_point("shard_loader")
         _faultinject.io_point("shard_read")
         try:
@@ -257,7 +294,7 @@ class ShardedBatchDataset:
                 yield carry_X, carry_Y
         finally:
             # op-scoped liveness: idle between epochs is not a hang
-            _watchdog.retire("shard_loader")
+            _watchdog.retire(self._hb)
 
     def num_batches(self, batch_size, drop_remainder=False):
         n = self._n

@@ -48,6 +48,8 @@ __all__ = [
     "bucket_width",
     "next_pow2",
     "plan_compaction",
+    "assemble_plan",
+    "unretired_frozen_rows",
     "expand_history",
     "CompactionPlan",
 ]
@@ -109,6 +111,38 @@ class CompactionPlan:
         return int(self.sel.shape[0])
 
 
+def unretired_frozen_rows(active, orig_ids, retired_ids):
+    """Exec rows holding real, inactive, NOT-yet-retired lanes — the lanes
+    whose frozen results a plan must gather to host before their rows drop.
+    Shared by check-window compaction and degraded-mesh re-sharding
+    (parallel/remesh.py)."""
+    already = set(int(i) for i in retired_ids)
+    return np.asarray(
+        [r for r in np.flatnonzero(~active & (orig_ids >= 0))
+         if int(orig_ids[r]) not in already], np.int32)
+
+
+def assemble_plan(orig_ids, keep_rows, keep_active, fill_row, new_w,
+                  retire_rows):
+    """Build a :class:`CompactionPlan` from a keep-row selection: kept rows
+    first (their active flags preserved), then filler rows replicating
+    ``fill_row``. The filler invariant lives HERE, once: callers must point
+    ``fill_row`` at a lane holding finite, valid state (filler lanes run
+    real masked math — non-finite state would poison device-side anomaly
+    accounting even though results are discarded)."""
+    orig_ids = np.asarray(orig_ids, np.int32)
+    keep_rows = np.asarray(keep_rows, np.int32)
+    retire_rows = np.asarray(retire_rows, np.int32)
+    pad = int(new_w) - keep_rows.size
+    sel = np.concatenate([keep_rows, np.full((pad,), fill_row, np.int32)])
+    new_ids = np.concatenate(
+        [orig_ids[keep_rows], np.full((pad,), -1, np.int32)])
+    new_active = np.zeros((int(new_w),), bool)
+    new_active[: keep_rows.size] = keep_active
+    return CompactionPlan(sel, new_ids, new_active, retire_rows,
+                          orig_ids[retire_rows].astype(np.int32))
+
+
 def plan_compaction(active, orig_ids, retired_ids, n_devices=1):
     """Plan a compaction, or return None when the current width is already
     the right bucket.
@@ -128,19 +162,9 @@ def plan_compaction(active, orig_ids, retired_ids, n_devices=1):
     new_w = bucket_width(n_live, n_devices)
     if new_w >= orig_ids.size:
         return None
-    pad = new_w - n_live
-    sel = np.concatenate(
-        [live_rows, np.full((pad,), live_rows[0], np.int32)])
-    new_ids = np.concatenate(
-        [orig_ids[live_rows], np.full((pad,), -1, np.int32)])
-    new_active = np.zeros((new_w,), bool)
-    new_active[:n_live] = True
-    already = set(int(i) for i in retired_ids)
-    retire_rows = np.asarray(
-        [r for r in np.flatnonzero(~active & (orig_ids >= 0))
-         if int(orig_ids[r]) not in already], np.int32)
-    return CompactionPlan(sel, new_ids, new_active, retire_rows,
-                          orig_ids[retire_rows].astype(np.int32))
+    return assemble_plan(
+        orig_ids, live_rows, True, live_rows[0], new_w,
+        unretired_frozen_rows(active, orig_ids, retired_ids))
 
 
 def expand_history(rows, row_eras, eras, n_points):

@@ -50,7 +50,7 @@ import optax
 
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import phase_schedule
-from redcliff_tpu.parallel import compaction
+from redcliff_tpu.parallel import compaction, remesh
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import (Mesh, grid_mesh, replicated,
                                         shard_leading_axis)
@@ -703,9 +703,10 @@ class RedcliffGridRunner:
                            "accepted")
 
     # snapshot keys that are already host-side bookkeeping (no device
-    # gather): compaction-era state plus the scalar loop bookkeeping
+    # gather): compaction-era state plus the scalar loop bookkeeping and the
+    # mesh-shape audit metadata
     _HOST_STATE_KEYS = ("epoch", "aligned", "rng_state", "val_history",
-                        "val_eras", "eras", "orig_ids", "retired")
+                        "val_eras", "eras", "orig_ids", "retired", "mesh")
 
     @staticmethod
     def _hostify(snap, meta, to_host):
@@ -726,6 +727,10 @@ class RedcliffGridRunner:
         host["rng_state"] = snap["rng_state"]
         host["orig_ids"] = np.asarray(snap["orig_ids"], np.int32)
         host["retired"] = snap["retired"]
+        # mesh shape the writing attempt ran at: audit metadata only — it is
+        # NOT in the fingerprint (meta), so a checkpoint from an 8-device
+        # mesh resumes on 4 devices (and vice versa) without rejection
+        host["mesh"] = snap.get("mesh")
         rows = [to_host(v) for v in snap["val_history"]]
         host["val_history"] = list(compaction.expand_history(
             rows, snap["val_eras"], snap["eras"], len(meta["points"])))
@@ -884,6 +889,19 @@ class RedcliffGridRunner:
         compaction era (execution width, lane->point map, retired results)
         is checkpointed too, so resume lands in the same bucket.
 
+        Elastic re-meshing (ARCHITECTURE.md "Elastic re-meshing & host-fault
+        tolerance"): when the device count differs from the checkpoint's —
+        the supervisor degraded ``REDCLIFF_MESH_DEVICES`` after a
+        ``host_lost`` exit, or part of a slice came back — the resume
+        RE-SHARDS automatically: surviving lanes ride the bucket ladder at
+        the new device count, frozen lanes retire to the host store, and a
+        structured ``remesh`` event (old/new width, lanes migrated, plan
+        latency) lands in metrics.jsonl and ``dispatch_stats``. Dispatch
+        errors with device-loss / collective-timeout / coordinator-loss
+        signatures are mapped to the typed
+        :class:`~redcliff_tpu.parallel.remesh.HostLostError` so drivers can
+        exit with the ``host_lost`` taxonomy code (21).
+
         Liveness (ARCHITECTURE.md "Liveness & supervision"): when
         ``REDCLIFF_WATCHDOG`` is set, a daemon watchdog monitors the
         heartbeats stamped by this loop, the prefetcher, the shard loader,
@@ -915,12 +933,26 @@ class RedcliffGridRunner:
         # Daemonized + stopped on every exit path, so no teardown can hang
         wd = rt_watchdog.maybe_start(guard=guard if guard.enabled else None)
         with guard, profiler_trace(self.tc.profile_dir), wctx, wd as live_wd:
-            return self._fit(key, train_ds, val_ds, max_iter=max_iter,
-                             log_dir=log_dir, init_params=init_params,
-                             copy_init=copy_init,
-                             checkpoint_dir=checkpoint_dir,
-                             checkpoint_every=checkpoint_every,
-                             guard=guard, writer=writer, wd=live_wd)
+            try:
+                return self._fit(key, train_ds, val_ds, max_iter=max_iter,
+                                 log_dir=log_dir, init_params=init_params,
+                                 copy_init=copy_init,
+                                 checkpoint_dir=checkpoint_dir,
+                                 checkpoint_every=checkpoint_every,
+                                 guard=guard, writer=writer, wd=live_wd)
+            except (Preempted, DeadlineExceeded, remesh.HostLostError):
+                raise
+            except Exception as e:
+                # elastic re-meshing (parallel/remesh.py): a dispatch dying
+                # with a device-loss / collective-timeout / coordinator-loss
+                # signature means the MESH lost capacity, not that the fit
+                # is wrong — surface it as the typed host-loss failure so
+                # drivers exit EXIT_HOST_LOST and the supervisor re-meshes
+                # instead of restarting at the same shape
+                tag = remesh.classify_device_error(e)
+                if tag is not None:
+                    raise remesh.HostLostError(tag, detail=str(e)) from e
+                raise
 
     def _fit(self, key, train_ds, val_ds, max_iter=None,
              log_dir=None, init_params=None, copy_init=True,
@@ -939,6 +971,7 @@ class RedcliffGridRunner:
         if checkpoint_dir is not None:
             ck_meta = self._checkpoint_meta(train_ds, val_ds)
             ckpt, ck_src = self._load_checkpoint(checkpoint_dir, ck_meta)
+        remesh_info = None
         if ckpt is not None:
             # resume: the full fit state comes from the checkpoint; the
             # (expensive) fresh grid init is skipped entirely. The
@@ -951,6 +984,40 @@ class RedcliffGridRunner:
                                        dtype=np.int32))
             retired = dict(ckpt.get("retired") or {})
             Gx = int(orig_ids.size)
+            # ---- elastic re-meshing (parallel/remesh.py) -----------------
+            # the checkpoint may come from a DIFFERENT mesh (the supervisor
+            # degraded the device budget after a host loss, or capacity came
+            # back). When the device count changed — or the checkpointed
+            # width cannot shard over what is visible now — re-shard the
+            # lanes onto the current mesh: survivors ride the bucket ladder
+            # at the new device count, frozen lanes retire to the host
+            # store, and every result still reports under original point
+            # ids. The resume fingerprint is untouched (mesh-agnostic by
+            # design); same-mesh resumes take the fast path unchanged.
+            n_dev = (self._mesh_full.devices.size
+                     if self._mesh_full is not None else 1)
+            ck_mesh = ckpt.get("mesh") or {}
+            mesh_changed = (ck_mesh.get("n_devices") is not None
+                            and int(ck_mesh["n_devices"]) != n_dev)
+            incompatible = (self._mesh_full is not None
+                            and not remesh.width_fits(Gx, n_dev))
+            if mesh_changed or incompatible:
+                t_plan = time.perf_counter()
+                plan = remesh.plan_resharding(
+                    np.asarray(ckpt["active"], bool), orig_ids,
+                    retired.keys(), n_dev, compact=self._compaction_on)
+                if plan is not None:
+                    migrated = remesh.apply_reshard(ckpt, retired, plan)
+                    remesh_info = {
+                        "from_width": Gx, "to_width": plan.new_width,
+                        "from_devices": ck_mesh.get("n_devices"),
+                        "to_devices": n_dev, "lanes_migrated": migrated,
+                        "lanes_retired": [int(p) for p in plan.retire_ids],
+                        "plan_ms": round(
+                            (time.perf_counter() - t_plan) * 1e3, 3),
+                    }
+                    orig_ids = np.asarray(plan.orig_ids, np.int32)
+                    Gx = plan.new_width
             if self._mesh_full is not None:
                 self.mesh = self._mesh_for(Gx)
             params = self._shard(jax.tree.map(jnp.asarray, ckpt["params"]))
@@ -1133,7 +1200,12 @@ class RedcliffGridRunner:
             "lanes_padded": int((orig_ids < 0).sum()), "lanes_live": None,
             "compactions": 0, "lane_epochs": 0, "lane_epochs_nominal": 0,
             "compile_ms": 0.0, "compiles": 0, "cache_hits": 0,
-            "cache_misses": 0}
+            "cache_misses": 0,
+            # degraded-mesh resume accounting (parallel/remesh.py): count +
+            # the full plan record (old/new width, lanes migrated, plan
+            # latency) when THIS attempt re-sharded a checkpoint onto a
+            # different mesh
+            "remeshes": 1 if remesh_info else 0, "remesh": remesh_info}
         compile_t0 = compileobs.snapshot()
         width_nominal = Gx
         # background checkpoint writer (created and scoped by fit(), which
@@ -1148,6 +1220,10 @@ class RedcliffGridRunner:
                 ("accepted", accepted)) if v is not None}
             jax.block_until_ready(self._ensure_snapshot_fn()(warm))
 
+        # the full-capacity mesh shape, recorded in every checkpoint payload
+        # (audit metadata, NOT part of the resume fingerprint) and in the
+        # run's metrics — the other half of the degraded-resume audit trail
+        mesh_desc = remesh.mesh_shape(self._mesh_full)
         logger = MetricLogger(log_dir)
         if wd is not None:
             # hang incidents land in THIS fit's metrics.jsonl
@@ -1155,11 +1231,15 @@ class RedcliffGridRunner:
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G_real,
                    grid_width=Gx, lanes_padded=stats["lanes_padded"],
                    training_mode=self.model.config.training_mode,
-                   stream_mode=base_stream,
+                   stream_mode=base_stream, mesh=mesh_desc,
                    compile_cache_dir=jax.config.jax_compilation_cache_dir,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
                    resumed_from=ck_src,
                    points=list(self.spec.points))
+        if remesh_info is not None:
+            # structured re-mesh event: which mesh the checkpoint came from,
+            # which it landed on, how many lanes migrated, plan latency
+            logger.log("remesh", epoch=start_it - 1, **remesh_info)
         # fault-injection step index for the host-stream paths (nan_batch /
         # grad_blowup / skip specs); per-process, like the trainers'
         fi_step = 0
@@ -1588,7 +1668,7 @@ class RedcliffGridRunner:
                     "failed_cause": failed_cause, "nstate": nstate,
                     "val_history": val_history, "val_eras": val_eras,
                     "eras": eras, "orig_ids": orig_ids, "retired": retired,
-                    "aligned": aligned,
+                    "aligned": aligned, "mesh": mesh_desc,
                     "rng_state": rng.bit_generator.state, "epoch": it,
                 }
                 saved = False

@@ -1,0 +1,366 @@
+"""Elastic re-meshing: host-fault detection and degraded-mesh resume planning.
+
+The multi-host story so far (parallel/distributed.py) treats the mesh as
+static: lose a host and the whole fit dies, and while the durable checkpoints
+*can* resume on a different mesh (they hold gathered host state), doing so is
+a manual operation — an operator restarts the driver by hand with a smaller
+device set. Production ML runtimes treat worker loss as an expected event the
+system absorbs (TensorFlow couples checkpoint durability with supervised
+restart exactly so long runs survive worker failure, arXiv:1605.08695); this
+module is the planning half of that story for REDCLIFF grid sweeps:
+
+- :class:`HostLostError` — the TYPED "part of the mesh is gone" failure. The
+  grid engine raises it when a dispatch dies with a device-loss /
+  collective-timeout / coordinator-loss signature
+  (:func:`classify_device_error`), the watchdog's host-scoped staleness
+  detector exits with its taxonomy code (``EXIT_HOST_LOST``), and fault
+  injection raises it directly (``host_drop:h``).
+- :func:`plan_resharding` — given the lanes a checkpoint holds and the device
+  count actually visible *now*, the lane re-sharding plan that lands the
+  survivors on the largest viable execution mesh: live lanes ride the PR-5
+  bucket ladder at the new device count, frozen-but-unretired lanes retire to
+  the host store, filler lanes pad the remainder. Reuses
+  :class:`~redcliff_tpu.parallel.compaction.CompactionPlan` — a re-mesh IS a
+  compaction whose trigger is the mesh shrinking rather than lanes retiring
+  (and, unlike a compaction, it may *grow* the width when the new device
+  count divides nothing smaller).
+- :func:`apply_reshard` — applies that plan to a loaded checkpoint payload on
+  the host (pure numpy gathers), before any device array exists. Results keep
+  reporting under ORIGINAL point ids; nothing about the resume fingerprint
+  changes (the fingerprint is deliberately mesh-agnostic).
+- :func:`visible_devices` / :func:`visible_mesh` — the device set this
+  attempt may use, capped by ``REDCLIFF_MESH_DEVICES`` (the knob the
+  supervisor decrements on a ``host_lost`` exit: re-mesh-then-restart).
+- :func:`mesh_shape` — {n_hosts, n_devices, device_kind} metadata recorded
+  per attempt in ``run_ledger.jsonl`` and in every grid checkpoint payload,
+  so degraded-mesh resumes are auditable end to end.
+
+Single-process simulation caveat (pinned in project memory + ROADMAP item 5):
+this container's CPU backend cannot run 2-process collectives, so tier-1
+coverage simulates hosts as partitions of the virtual 8-device CPU mesh
+(``REDCLIFF_SIM_HOSTS`` declares the partition count) and a "host drop" is a
+typed-error exit + a smaller ``REDCLIFF_MESH_DEVICES`` on the next attempt.
+The real 2-process DCN leg stays in the dry-run/slow tier.
+
+numpy-only at module scope; jax is imported lazily so backend-free processes
+(the supervisor parent, bench.py's parent) can import this safely.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from redcliff_tpu.parallel import compaction
+
+__all__ = [
+    "HostLostError",
+    "classify_device_error",
+    "mesh_shape",
+    "visible_devices",
+    "visible_mesh",
+    "choose_mesh_devices",
+    "plan_resharding",
+    "apply_reshard",
+    "width_fits",
+    "ENV_MESH_DEVICES",
+    "ENV_SIM_HOSTS",
+]
+
+# the degraded-mesh knob: the supervisor sets/decrements this on a host_lost
+# exit; visible_devices() caps the device list to it on the next attempt
+ENV_MESH_DEVICES = "REDCLIFF_MESH_DEVICES"
+# single-process simulation: how many "hosts" partition the local device
+# list (tier-1 runs cannot spawn real 2-process collectives on this CPU
+# backend); real multi-process runs ignore it (process_index is the truth)
+ENV_SIM_HOSTS = "REDCLIFF_SIM_HOSTS"
+
+
+class HostLostError(RuntimeError):
+    """Part of the execution mesh is gone: a host stopped heartbeating, a
+    collective timed out, or the backend reported a device/coordinator loss.
+
+    This is a RESTARTABLE-after-re-mesh failure, not a crash: the durable
+    checkpoint holds gathered host state, so the supervisor's answer is
+    "shrink the mesh and resume" (taxonomy exit code
+    :data:`~redcliff_tpu.runtime.watchdog.EXIT_HOST_LOST`), never a page.
+
+    ``reason`` is the detection route (``host_drop`` / ``device_lost`` /
+    ``collective_timeout`` / ``coordinator_loss`` / ``host_stale``);
+    ``host`` is the lost host's index when the detector knows it."""
+
+    def __init__(self, reason, host=None, detail=None):
+        self.reason = reason
+        self.host = host
+        at = f" (host {host})" if host is not None else ""
+        msg = f"mesh degraded: {reason}{at}"
+        if detail:
+            msg += f" — {detail}"
+        msg += ("; resume from the durable checkpoint on the surviving "
+                "devices (supervisor: re-mesh-then-restart)")
+        super().__init__(msg)
+
+
+# detection signatures for backend errors that mean "the mesh lost capacity",
+# not "the math is wrong". Matched against lowercased str(exc); deliberately
+# substring-based — XLA/PJRT error text varies by backend and version, and a
+# false negative merely degrades to the old behavior (crash -> same-shape
+# restart). A false POSITIVE is costlier (the supervisor irreversibly drops
+# a host's worth of healthy devices), so the conjunctive branches require an
+# explicit timeout word next to the collective/coordinator evidence — the
+# looser "unavailable" (any gRPC UNAVAILABLE status) counts only for the
+# coordinator, whose loss genuinely presents that way.
+_DEVICE_LOST_SIGS = (
+    "device lost", "device is lost", "lost device", "device disconnected",
+    "device failure", "device removed", "device_lost",
+)
+_COORDINATOR_SIGS = (
+    "coordinator", "distributed runtime service", "preemption notice",
+)
+_TIMEOUT_SIGS = ("timed out", "timeout", "deadline exceeded")
+_COORD_TIMEOUT_SIGS = _TIMEOUT_SIGS + ("unavailable",)
+_COLLECTIVE_SIGS = ("collective", "all-reduce", "allreduce", "all-gather",
+                    "allgather", "psum", "nccl", "cross-host")
+
+
+def classify_device_error(exc):
+    """Map a backend exception onto a host-loss detection route, or None.
+
+    Returns ``"device_lost"`` (explicit device-loss signal),
+    ``"collective_timeout"`` (a cross-device/host collective timed out — the
+    signature of a peer that stopped participating), or
+    ``"coordinator_loss"`` (the distributed coordinator went away). None
+    means "not mesh-shaped": the caller re-raises and the failure stays in
+    its original class."""
+    if exc is None:
+        return None
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(s in text for s in _DEVICE_LOST_SIGS):
+        return "device_lost"
+    if any(s in text for s in _COORDINATOR_SIGS) \
+            and any(s in text for s in _COORD_TIMEOUT_SIGS):
+        return "coordinator_loss"
+    if any(s in text for s in _COLLECTIVE_SIGS) \
+            and any(s in text for s in _TIMEOUT_SIGS):
+        return "collective_timeout"
+    return None
+
+
+def visible_devices(devices=None, env=ENV_MESH_DEVICES):
+    """The device list this attempt may mesh over: ``jax.devices()`` capped
+    by the ``REDCLIFF_MESH_DEVICES`` env knob (unset/invalid = no cap).
+
+    The cap takes the FIRST n devices — device ids are stable across
+    restarts, so every attempt at the same cap meshes over the same devices
+    (in the single-process simulation, "losing host h" = capping below h's
+    partition; on a real multi-host mesh the dead host's devices are simply
+    absent from ``jax.devices()`` and the cap is belt-and-braces)."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    spec = os.environ.get(env, "").strip()
+    if spec:
+        try:
+            n = int(spec)
+        except ValueError:
+            return devices
+        if n >= 1:
+            devices = devices[:n]
+    return devices
+
+
+def visible_mesh(axis_name="grid", devices=None, n_lanes=None):
+    """1-D grid mesh over :func:`visible_devices` — what drivers build when
+    they want the supervisor's re-mesh decisions honored. With ``n_lanes``
+    the mesh is additionally trimmed to :func:`choose_mesh_devices`'s
+    largest VIABLE device count for that many lanes (the one auto-mesh
+    recipe, shared by `run_coefficient_grid(mesh="auto")` and the
+    fault-injection child)."""
+    from redcliff_tpu.parallel.mesh import grid_mesh
+
+    devs = visible_devices(devices)
+    if n_lanes is not None:
+        devs = devs[: choose_mesh_devices(len(devs), n_lanes)]
+    return grid_mesh(devices=devs, axis_name=axis_name)
+
+
+def mesh_shape(mesh=None, devices=None, sim_hosts=None):
+    """{n_hosts, n_devices, device_kind} for a mesh / device list — the
+    audit metadata stamped into ``run_ledger.jsonl`` attempts and grid
+    checkpoint payloads (NOT the resume fingerprint: checkpoints stay
+    mesh-agnostic by design).
+
+    ``n_hosts`` counts distinct ``process_index`` values; in the
+    single-process simulation ``REDCLIFF_SIM_HOSTS`` (or ``sim_hosts``)
+    overrides it with the declared partition count."""
+    if devices is None:
+        if mesh is not None:
+            devices = list(np.asarray(mesh.devices).ravel())
+        else:
+            import jax
+
+            devices = jax.local_devices()[:1]
+    devices = list(devices)
+    if sim_hosts is None:
+        spec = os.environ.get(ENV_SIM_HOSTS, "").strip()
+        if spec:
+            try:
+                sim_hosts = int(spec)
+            except ValueError:
+                sim_hosts = None
+    n_hosts = len({getattr(d, "process_index", 0) for d in devices}) or 1
+    if n_hosts == 1 and sim_hosts is not None and sim_hosts >= 1:
+        # the simulated partition count applies ONLY when the devices are
+        # genuinely single-process; on a real multi-controller mesh the
+        # process_index spread is the truth and a stale/declared sim value
+        # (the supervisor exports it alongside n_hosts) must not distort
+        # the audit trail
+        n_hosts = min(int(sim_hosts), len(devices))
+    kind = getattr(devices[0], "device_kind", None) if devices else None
+    return {"n_hosts": int(n_hosts), "n_devices": len(devices),
+            "device_kind": kind}
+
+
+def width_fits(width, n_devices):
+    """True when a ``width``-lane grid can shard over ``n_devices`` (the
+    grid engine's sub-mesh rule: multiple OR divisor of the device count).
+    The ONE place this invariant lives — the grid resume path and the
+    planner both consult it, so they can never drift apart."""
+    n_devices = int(n_devices or 1)
+    if n_devices <= 1 or width <= 0:
+        return True
+    return width % n_devices == 0 or n_devices % width == 0
+
+
+def choose_mesh_devices(n_visible, n_lanes):
+    """The largest viable execution mesh for ``n_lanes`` lanes on
+    ``n_visible`` surviving devices.
+
+    Any device count is *runnable* (the bucket ladder pads the width to a
+    multiple), so "viable" is decided by wall-clock: a dispatch takes as
+    long as the lanes each device computes (width / devices). The planner
+    compares the full survivor set against the largest power-of-two subset
+    — e.g. 9 live lanes on 6 survivors bucket to width 18 (3 lanes/device),
+    beating the 4-device pow2 sub-mesh's width 16 (4 lanes/device) — and
+    picks the smaller per-device load, preferring MORE devices on a tie
+    (the filler lanes a wider bucket adds burn joules, not seconds, and the
+    compaction ladder reclaims them at the next check window)."""
+    n_visible = max(int(n_visible), 1)
+    n_lanes = max(int(n_lanes), 1)
+    pow2 = 1 << (n_visible.bit_length() - 1)  # largest pow2 <= n_visible
+    candidates = sorted({n_visible, pow2}, reverse=True)
+
+    def load(n_dev):
+        w = compaction.bucket_width(n_lanes, n_dev)
+        # width < device count runs on a sub-mesh of `w` devices
+        return w / (n_dev if w % n_dev == 0 else w)
+
+    best = candidates[0]
+    best_load = load(best)
+    for cand in candidates[1:]:
+        if load(cand) < best_load:
+            best, best_load = cand, load(cand)
+    return best
+
+
+def plan_resharding(active, orig_ids, retired_ids, n_devices, compact=True):
+    """Lane re-sharding plan for resuming a checkpoint onto an
+    ``n_devices`` mesh, or None when the checkpointed width already fits.
+
+    ``active``/``orig_ids`` are the checkpoint's host arrays (execution
+    width; ``orig_ids`` -1 marks bucket filler), ``retired_ids`` the point
+    ids whose results already live in the host-side retired store.
+
+    With ``compact=True`` (the elastic-scheduler default) only LIVE lanes
+    ride to the new mesh — frozen-but-unretired lanes (early-stopped,
+    quarantined, deadline-evicted) retire their frozen results to the host
+    store exactly like a check-window compaction would. With
+    ``compact=False`` every real lane keeps its row (fixed-width
+    semantics), re-bucketed only as far as mesh viability requires.
+
+    Unlike :func:`~redcliff_tpu.parallel.compaction.plan_compaction`, the
+    plan may GROW the width: a surviving device count that divides nothing
+    smaller (say width 8 onto 6 devices) pads up the ladder with filler
+    lanes rather than failing the resume."""
+    active = np.asarray(active, bool)
+    orig_ids = np.asarray(orig_ids, np.int32)
+    real = orig_ids >= 0
+    live_rows = np.flatnonzero(active & real).astype(np.int32)
+    retire_rows = np.zeros((0,), np.int32)
+    if compact and live_rows.size:
+        keep_rows = live_rows
+        retire_rows = compaction.unretired_frozen_rows(active, orig_ids,
+                                                       retired_ids)
+    else:
+        # no live lanes (resume-to-finish) or compaction off: every real
+        # lane keeps its row so the fixed-width semantics are preserved
+        keep_rows = np.flatnonzero(real).astype(np.int32)
+    if keep_rows.size == 0:
+        return None  # nothing real on board; the fit's exit paths own this
+    new_w = compaction.bucket_width(keep_rows.size, n_devices)
+    if new_w == int(orig_ids.size) and width_fits(orig_ids.size, n_devices):
+        return None
+    # filler invariant (compaction.assemble_plan): prefer a live fill lane —
+    # in the keep-all branches keep_rows[0] may be a quarantined lane
+    # holding non-finite params
+    fill_row = live_rows[0] if live_rows.size else keep_rows[0]
+    return compaction.assemble_plan(orig_ids, keep_rows, active[keep_rows],
+                                    fill_row, new_w, retire_rows)
+
+
+# checkpoint payload keys holding per-lane state (leading axis = execution
+# width) that a re-shard must gather through the plan's row selection.
+# "active" is NOT here: the plan computes the new mask directly (a
+# sel-gather would mark filler rows with the fill lane's liveness)
+_LANE_STATE_KEYS = ("params", "optA_state", "optB_state", "best_params",
+                    "accepted", "nstate", "best_crit", "best_epoch",
+                    "failed_epoch", "failed_cause")
+
+
+def apply_reshard(ckpt, retired, plan):
+    """Apply a re-shard plan to a loaded checkpoint payload IN PLACE (host
+    numpy gathers — no device array exists yet) and absorb the plan's
+    retirements into ``retired``. Returns the number of live lanes migrated.
+
+    ``ckpt`` is the grid checkpoint dict (host trees at the old execution
+    width); ``retired`` the engine's {point_id: frozen results} store. The
+    checkpoint's ``val_history`` rows are already expanded to the original
+    point width, so they pass through untouched."""
+    import jax  # tree mapping only; no device arrays are created here
+
+    # retire frozen lanes' results BEFORE remapping: retire_rows index the
+    # OLD width. Pre-sentinel checkpoints carry no failed_cause — backfill
+    # exactly like the grid resume path does (every already-quarantined
+    # lane was a validation quarantine by construction)
+    failed_epoch = np.asarray(ckpt["failed_epoch"])
+    fc = ckpt.get("failed_cause")
+    if fc is None:
+        from redcliff_tpu.runtime import numerics
+
+        fc = np.where(failed_epoch >= 0, numerics.CAUSE_NONFINITE_VAL,
+                      0).astype(np.int32)
+    failed_cause = np.asarray(fc)
+    for i, row in enumerate(np.asarray(plan.retire_rows)):
+        pid = int(plan.retire_ids[i])
+        retired[pid] = {
+            "best_params": jax.tree.map(
+                lambda l, _r=int(row): np.asarray(l[_r]),
+                ckpt["best_params"]),
+            "best_crit": float(np.asarray(ckpt["best_crit"])[row]),
+            "best_epoch": int(np.asarray(ckpt["best_epoch"])[row]),
+            "failed_epoch": int(failed_epoch[row]),
+            "failed_cause": int(failed_cause[row]),
+        }
+    sel = np.asarray(plan.sel)
+    for key in _LANE_STATE_KEYS:
+        val = ckpt.get(key)
+        if val is None:
+            continue  # accepted/nstate may be absent (non-freeze fits,
+        #             pre-sentinel checkpoints)
+        ckpt[key] = jax.tree.map(lambda l: np.asarray(l)[sel], val)
+    ckpt["active"] = np.asarray(plan.active)
+    ckpt["orig_ids"] = np.asarray(plan.orig_ids, np.int32)
+    ckpt["retired"] = retired
+    return int(np.asarray(plan.active).sum())

@@ -25,7 +25,9 @@ this package does the same:
   off;
 - :mod:`~redcliff_tpu.runtime.faultinject` — fault-injection hooks + child
   fit used by tests/test_fault_injection.py to SIGKILL fits mid-run, corrupt
-  checkpoints, and inject probe failures;
+  checkpoints, inject probe failures, and simulate host drops / device loss
+  / coordinator loss (the elastic re-meshing story,
+  :mod:`~redcliff_tpu.parallel.remesh`);
 - :mod:`~redcliff_tpu.runtime.compileobs` — compile observability (per-program
   compile durations, persistent-cache hit/miss counters via
   ``jax.monitoring``) and the versioned persistent XLA compilation cache

@@ -38,6 +38,29 @@ docs/ARCHITECTURE.md "Liveness & supervision"):
   ``ENOSPC``) at the named IO site (``ckpt_write``). Once-per-marker gated
   like ``hang_in`` so a restarted attempt can succeed.
 
+Host-fault points (the elastic re-meshing story, docs/ARCHITECTURE.md
+"Elastic re-meshing & host-fault tolerance"; all once-per-marker gated so a
+re-meshed restart runs clean, all firing at the end of the named epoch —
+AFTER that epoch's checkpoint, like a real mid-grid loss with durable state
+on disk):
+
+- ``host_drop:H[:EPOCH]`` — host H's partition of the mesh "dies": raises
+  the typed :class:`~redcliff_tpu.parallel.remesh.HostLostError` directly
+  (the watchdog's stale-host detection route, pre-classified). Default
+  epoch 1;
+- ``device_lost[:EPOCH]`` — raises a RuntimeError with an XLA-shaped
+  device-loss message, exercising the
+  :func:`~redcliff_tpu.parallel.remesh.classify_device_error` mapping in
+  the grid engine (explicit device-loss-signal route);
+- ``coordinator_loss[:EPOCH]`` — raises a RuntimeError with a coordinator
+  heartbeat-timeout message (the coordinator-loss route through the same
+  classifier).
+
+All three surface as exit code ``EXIT_HOST_LOST`` (21) from the child, so
+the supervisor re-meshes and restarts instead of restarting at the same
+shape. :func:`random_host_fault_schedule` composes seeded host-fault
+schedules for the host-drop chaos soak (tests/test_remesh.py).
+
 :func:`random_fault_schedule` composes seeded schedules from this full
 grammar (kill / nan / hang / torn write / slow IO / disk error) for the
 chaos soak harness (tests/test_supervisor.py): a supervised run under ANY
@@ -69,12 +92,13 @@ import random
 import signal
 import sys
 
-from redcliff_tpu.runtime.watchdog import EXIT_DEADLINE, EXIT_PREEMPTED
+from redcliff_tpu.runtime.watchdog import (EXIT_DEADLINE, EXIT_HOST_LOST,
+                                           EXIT_PREEMPTED)
 
 __all__ = ["armed", "crash_point", "ckpt_write_point", "poison_batch",
            "skip_update", "hang_point", "io_point", "io_error_point",
            "corrupt_checkpoint", "flaky", "random_fault_schedule",
-           "tiny_grid_fit", "tiny_sharded_fit"]
+           "random_host_fault_schedule", "tiny_grid_fit", "tiny_sharded_fit"]
 
 ENV_SPEC = "REDCLIFF_FAULT_INJECT"
 ENV_MARKER = "REDCLIFF_FAULT_MARKER"
@@ -136,6 +160,36 @@ def crash_point(stage, epoch=None):
             if marker and not os.path.exists(marker):
                 with open(marker, "w") as f:
                     f.write(str(epoch))
+        if name in HOST_FAULT_KINDS and stage == "epoch_end":
+            _host_fault(name, arg, epoch)
+
+
+def _host_fault(name, arg, epoch):
+    """Raise the armed host fault when its epoch matches (default: end of
+    epoch 1 — after that epoch's checkpoint, so durable state exists like a
+    real mid-grid host loss). Once-per-marker gated: the re-meshed restart
+    runs clean and the loss->re-mesh->resume loop closes."""
+    if name == "host_drop":
+        host_s, _, ep_s = arg.partition(":")
+        host = int(host_s) if host_s else 0
+    else:
+        host, ep_s = None, arg
+    if epoch != (int(ep_s) if ep_s else 1) or not _once_guard(f".{name}"):
+        return
+    if name == "host_drop":
+        from redcliff_tpu.parallel.remesh import HostLostError
+
+        raise HostLostError("host_drop", host=host,
+                            detail=f"injected at epoch {epoch}")
+    if name == "device_lost":
+        # XLA-shaped device-loss text: must trip
+        # remesh.classify_device_error -> "device_lost" in the grid engine
+        raise RuntimeError(
+            f"INTERNAL: device lost: local device vanished mid-dispatch "
+            f"(injected host fault, epoch {epoch})")
+    raise RuntimeError(
+        f"DEADLINE_EXCEEDED: coordinator heartbeat timed out; distributed "
+        f"runtime service unavailable (injected host fault, epoch {epoch})")
 
 
 def _step_match(spec, step):
@@ -236,6 +290,28 @@ def io_error_point(kind):
 # watchdog-evictable, kills land after a durable checkpoint generation)
 FAULT_KINDS = ("kill", "nan", "hang", "torn_write", "slow_io", "io_error")
 
+# the host-fault grammar (all once-per-marker; all raise out of epoch_end)
+HOST_FAULT_KINDS = ("host_drop", "device_lost", "coordinator_loss")
+
+
+def random_host_fault_schedule(seed, max_epoch=1, n_hosts=4):
+    """One seeded host-fault schedule for the host-drop chaos soak: a host
+    drop / device loss / coordinator loss at a random epoch, optionally
+    composed with degraded-storage latency. Deterministic in ``seed``; every
+    schedule must leave a supervised-with-mesh run able to terminate (the
+    fault is once-per-marker and fires after a durable checkpoint)."""
+    r = random.Random(seed)
+    kind = r.choice(HOST_FAULT_KINDS)
+    ep = r.randint(0, max_epoch)
+    if kind == "host_drop":
+        fault = f"host_drop:{r.randrange(max(n_hosts, 1))}:{ep}"
+    else:
+        fault = f"{kind}:{ep}"
+    faults = [fault]
+    if r.random() < 0.5:
+        faults.append(f"slow_io:{r.randint(1, 20)}")
+    return ",".join(faults)
+
 
 def random_fault_schedule(seed, max_epoch=2, components=("prefetch",
                                                          "shard_loader",
@@ -310,9 +386,16 @@ def flaky(n_failures, value=True, exc=None):
 # directly comparable
 # ---------------------------------------------------------------------------
 def _tiny_runner(max_iter, bad_point=False, fit_deadline_s=None,
-                 grid_deadline_s=None):
+                 grid_deadline_s=None, grid_size=2, use_mesh=False):
     """The harness's canonical small grid runner plus its deterministic data
-    arrays (shared by the in-memory and sharded child fits)."""
+    arrays (shared by the in-memory and sharded child fits).
+
+    ``grid_size`` widens the sweep for mesh-shaped tests (the default 2
+    keeps the historical point list byte-for-byte, so older fault tests'
+    bit-identity baselines are untouched); ``use_mesh`` shards the grid over
+    the largest viable mesh of the VISIBLE devices — capped by
+    ``REDCLIFF_MESH_DEVICES``, i.e. the supervisor's re-mesh decisions are
+    honored (parallel/remesh.py)."""
     import jax
     import numpy as np
 
@@ -333,14 +416,24 @@ def _tiny_runner(max_iter, bad_point=False, fit_deadline_s=None,
     # 1e20 (not merely "large"): Adam-normalized updates bound the step to
     # ~lr, so the poison lr must push params past sqrt(f32 max) for the
     # squared forecast error to overflow to inf within an epoch
-    points = [{"gen_lr": 1e-3},
-              ({"gen_lr": 1e20, "embed_lr": 1e20} if bad_point
-               else {"gen_lr": 3e-3})]
+    if grid_size == 2:
+        points = [{"gen_lr": 1e-3},
+                  ({"gen_lr": 1e20, "embed_lr": 1e20} if bad_point
+                   else {"gen_lr": 3e-3})]
+    else:
+        points = [{"gen_lr": 1e-3 * (1 + 0.5 * i)} for i in range(grid_size)]
+        if bad_point:
+            points[-1] = {"gen_lr": 1e20, "embed_lr": 1e20}
     tc = RedcliffTrainConfig(max_iter=max_iter, batch_size=16, check_every=1,
                              seed=0)
     spec = GridSpec(points=points, fit_deadline_s=fit_deadline_s,
                     grid_deadline_s=grid_deadline_s)
-    runner = RedcliffGridRunner(model, tc, spec)
+    mesh = None
+    if use_mesh:
+        from redcliff_tpu.parallel import remesh as _remesh
+
+        mesh = _remesh.visible_mesh(n_lanes=len(points))
+    runner = RedcliffGridRunner(model, tc, spec, mesh=mesh)
     cfg = model.config
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
@@ -350,13 +443,16 @@ def _tiny_runner(max_iter, bad_point=False, fit_deadline_s=None,
 
 
 def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
-                  bad_point=False, fit_deadline_s=None, grid_deadline_s=None):
+                  bad_point=False, fit_deadline_s=None, grid_deadline_s=None,
+                  grid_size=2, use_mesh=False):
     """Run the harness's canonical small grid fit and return its GridResult.
 
-    ``bad_point`` swaps point 1's learning rate for an absurd value that
-    drives its loss non-finite within an epoch (exercises the non-finite
-    quarantine path). Everything is seeded; two invocations with the same
-    arguments produce bit-identical results on the same backend.
+    ``bad_point`` swaps the last point's learning rate for an absurd value
+    that drives its loss non-finite within an epoch (exercises the
+    non-finite quarantine path). Everything is seeded; two invocations with
+    the same arguments produce bit-identical results on the same backend.
+    ``grid_size``/``use_mesh``: see :func:`_tiny_runner` — the mesh-sharded
+    child for the host-fault acceptance tests.
     """
     import jax
 
@@ -364,7 +460,8 @@ def tiny_grid_fit(checkpoint_dir, max_iter=4, checkpoint_every=1,
 
     runner, X, Y = _tiny_runner(max_iter, bad_point=bad_point,
                                 fit_deadline_s=fit_deadline_s,
-                                grid_deadline_s=grid_deadline_s)
+                                grid_deadline_s=grid_deadline_s,
+                                grid_size=grid_size, use_mesh=use_mesh)
     ds = ArrayDataset(X, Y)
     return runner.fit(jax.random.PRNGKey(2), ds, ds,
                       checkpoint_dir=checkpoint_dir,
@@ -440,6 +537,13 @@ def _child_main(argv):
                     help="stream the data from on-disk shards (exercises the "
                          "prefetch/shard-loader heartbeats — the supervised "
                          "chaos child)")
+    ap.add_argument("--grid-size", type=int, default=2,
+                    help="number of grid points (2 = the historical tiny "
+                         "fit; larger = the mesh-shaped host-fault child)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard the grid over the largest viable mesh of "
+                         "the visible devices (REDCLIFF_MESH_DEVICES-capped "
+                         "— the supervisor's re-mesh decisions apply)")
     ap.add_argument("--fit-deadline-s", default=None,
                     help="per-lane wall-clock budget(s), comma separated")
     ap.add_argument("--grid-deadline-s", type=float, default=None)
@@ -447,6 +551,7 @@ def _child_main(argv):
                     help="write the finished fit's result blob here")
     args = ap.parse_args(argv)
 
+    from redcliff_tpu.parallel.remesh import HostLostError
     from redcliff_tpu.runtime.preempt import DeadlineExceeded, Preempted
 
     kw = dict(max_iter=args.max_iter,
@@ -458,7 +563,15 @@ def _child_main(argv):
             result = tiny_sharded_fit(args.checkpoint_dir, **kw)
         else:
             result = tiny_grid_fit(args.checkpoint_dir,
-                                   bad_point=args.bad_point, **kw)
+                                   bad_point=args.bad_point,
+                                   grid_size=args.grid_size,
+                                   use_mesh=args.mesh, **kw)
+    except HostLostError as e:
+        # taxonomy code 21: part of the mesh is gone; the durable checkpoint
+        # holds gathered host state — the supervisor's answer is a smaller
+        # REDCLIFF_MESH_DEVICES and a restart, never a same-shape retry
+        print(f"faultinject child: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_HOST_LOST)
     except Preempted as e:
         print(f"faultinject child: {e}", file=sys.stderr)
         # json.dump, not an f-string: signum is None on the watchdog-latched

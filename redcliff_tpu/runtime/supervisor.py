@@ -13,9 +13,25 @@ layer's guarantee (durable CRC+``.prev`` generations plus the grid
 fingerprint), so a supervised run's final artifacts are bit-identical to an
 uninterrupted one — pinned by tests/test_supervisor.py.
 
+Host-fault tolerance (elastic re-meshing, docs/ARCHITECTURE.md "Elastic
+re-meshing & host-fault tolerance"): a child exiting ``host_lost`` (taxonomy
+code 21 — stale per-host heartbeats, a collective timeout mapped to
+:class:`~redcliff_tpu.parallel.remesh.HostLostError`, or an explicit
+device-loss signal) is NOT restarted at the same shape. When the policy
+declares the mesh (``mesh_devices``/``n_hosts``), the supervisor degrades
+the device budget by one host's worth, exports it to the next attempt via
+``REDCLIFF_MESH_DEVICES`` (which
+:func:`~redcliff_tpu.parallel.remesh.visible_mesh` honors), and restarts —
+the grid engine re-shards the checkpointed lanes onto the smaller mesh and
+the sweep continues with results still reported under original point ids.
+A mesh degraded below ``min_devices`` stops with ``mesh_exhausted``. Without
+a declared mesh, ``host_lost`` degrades to a plain same-shape restart.
+
 Every attempt is a line in ``run_ledger.jsonl`` (strict JSON): command, rc,
-classification, action, backoff, wall times — the audit trail an operator
-reads after a 12-hour grid search died at 3am.
+classification, action, backoff, wall times, and the commanded mesh shape
+({n_hosts, n_devices, device_kind}) — the audit trail an operator reads
+after a 12-hour grid search died at 3am, including which attempts ran
+degraded.
 
 stdlib only (the supervisor parent must never initialize a jax backend).
 """
@@ -38,21 +54,54 @@ __all__ = ["SupervisorPolicy", "SuperviseOutcome", "supervise", "main",
 
 LEDGER_NAME = "run_ledger.jsonl"
 
-# restart vs stop per classification; "signal:*" prefixes match "signal"
-RESTART_CLASSES = ("preempted", "hang", "crash", "signal")
+# restart vs stop per classification; "signal:*" prefixes match "signal".
+# host_lost restarts too — via the re-mesh path when the policy declares a
+# mesh, degrading to a same-shape restart when it does not
+RESTART_CLASSES = ("preempted", "hang", "crash", "signal", "host_lost")
 TERMINAL_CLASSES = ("clean", "numerics_abort", "deadline")
 
 DEFAULT_BACKOFF = RetryPolicy(max_attempts=1_000_000, base_delay_s=1.0,
                               multiplier=2.0, max_delay_s=60.0)
 
+# the env knob the next attempt's visible_mesh() honors; kept as a literal
+# (not imported from parallel.remesh) so this module stays stdlib-only
+MESH_DEVICES_ENV = "REDCLIFF_MESH_DEVICES"
+SIM_HOSTS_ENV = "REDCLIFF_SIM_HOSTS"
+
 
 @dataclass
 class SupervisorPolicy:
     """``max_restarts`` bounds the crash loop (restarts, not attempts: 3
-    means up to 4 child runs); ``backoff`` spaces them."""
+    means up to 4 child runs); ``backoff`` spaces them.
+
+    Mesh declaration (enables re-mesh-then-restart on ``host_lost`` exits):
+    ``mesh_devices`` is the full-strength device count, ``n_hosts`` how many
+    hosts it spans; ``devices_per_host`` defaults to the even split. On each
+    ``host_lost`` the budget drops by one host's devices and the new budget
+    is exported to the child via ``REDCLIFF_MESH_DEVICES``; once it would
+    fall below ``min_devices`` (or the last host is gone) the run stops with
+    ``mesh_exhausted``. With ``mesh_devices`` alone (host width unknown) the
+    budget degrades conservatively by ONE device per loss — under-shooting
+    just costs extra restart rounds until the budget fits the survivors,
+    while over-shooting would discard healthy devices for the rest of the
+    sweep. ``device_kind`` is audit metadata for the ledger."""
 
     max_restarts: int = 5
     backoff: RetryPolicy = field(default_factory=lambda: DEFAULT_BACKOFF)
+    mesh_devices: int | None = None
+    n_hosts: int | None = None
+    devices_per_host: int | None = None
+    min_devices: int = 1
+    device_kind: str | None = None
+
+    def host_width(self):
+        """Devices one lost host takes with it (1 when unknown — degrade
+        conservatively rather than throw away healthy capacity)."""
+        if self.devices_per_host:
+            return int(self.devices_per_host)
+        if self.mesh_devices and self.n_hosts:
+            return max(int(self.mesh_devices) // int(self.n_hosts), 1)
+        return 1
 
 
 @dataclass
@@ -98,34 +147,80 @@ def supervise(cmd, ledger_path=None, policy=None, env=None,
     ledger = _Ledger(ledger_path)
     attempts = []
     attempt = 0
+    # commanded mesh shape: what the NEXT child may use. Degrades by one
+    # host's devices on every host_lost exit; exported via
+    # REDCLIFF_MESH_DEVICES so the child's visible_mesh() honors it
+    cur_devices = policy.mesh_devices
+    cur_hosts = policy.n_hosts
+
+    def child_env():
+        if cur_devices is None:
+            return env  # no mesh tracking: pass the caller's env untouched
+        e = dict(env if env is not None else os.environ)
+        e[MESH_DEVICES_ENV] = str(cur_devices)
+        if cur_hosts is not None:
+            e[SIM_HOSTS_ENV] = str(cur_hosts)
+        return e
+
     while True:
         started = time.time()
         t0 = time.monotonic()
-        proc = popen(list(cmd), env=env)
+        proc = popen(list(cmd), env=child_env())
         if on_spawn is not None:
             on_spawn(proc)
         rc = proc.wait()
         classification = classify_exit(rc)
         stopping = bool(should_stop()) if should_stop is not None else False
+        mesh_exhausted = False
+        remesh_to = None
         if classification in TERMINAL_CLASSES or stopping:
             action = "stop"
         elif not _restartable(classification):
             action = "stop"
         elif attempt >= policy.max_restarts:
             action = "give_up"
+        elif classification == "host_lost" and cur_devices is not None:
+            # re-mesh-then-restart: shrink the commanded mesh by one host's
+            # devices; the resumed child re-shards its checkpointed lanes
+            # onto the survivors. Exhausting the mesh is terminal — there
+            # is nothing left to run on
+            remesh_to = cur_devices - policy.host_width()
+            if remesh_to < max(policy.min_devices, 1) \
+                    or (cur_hosts is not None and cur_hosts <= 1):
+                action = "stop"
+                mesh_exhausted = True
+            else:
+                action = "remesh_restart"
         else:
             action = "restart"
+        restarting = action in ("restart", "remesh_restart")
         backoff = (policy.backoff.backoff_s(attempt + 1)
-                   if action == "restart" else 0.0)
-        rec = ledger.append({
+                   if restarting else 0.0)
+        rec = {
             "event": "attempt", "attempt": attempt, "cmd": list(cmd),
             "rc": rc, "classification": classification, "action": action,
             "backoff_s": round(backoff, 3), "started_at": started,
             "duration_s": round(time.monotonic() - t0, 3),
-        })
+        }
+        if cur_devices is not None:
+            # the mesh shape THIS attempt ran under — the degraded-resume
+            # audit trail (which attempts ran at which width)
+            rec["mesh"] = {"n_hosts": cur_hosts, "n_devices": cur_devices,
+                           "device_kind": policy.device_kind}
+        ledger.append(rec)
         attempts.append(rec)
-        if action != "restart":
-            final = ("giving_up" if action == "give_up" else classification)
+        if action == "remesh_restart":
+            ledger.append({
+                "event": "remesh", "from_devices": cur_devices,
+                "to_devices": remesh_to, "from_hosts": cur_hosts,
+                "to_hosts": (cur_hosts - 1 if cur_hosts else None)})
+            cur_devices = remesh_to
+            if cur_hosts:
+                cur_hosts -= 1
+        if not restarting:
+            final = ("giving_up" if action == "give_up"
+                     else "mesh_exhausted" if mesh_exhausted
+                     else classification)
             ledger.append({"event": "final", "classification": final,
                            "rc": rc, "attempts": len(attempts)})
             return SuperviseOutcome(classification=final, returncode=rc,
@@ -164,6 +259,19 @@ def main(argv=None):
     ap.add_argument("--max-restarts", type=int, default=5)
     ap.add_argument("--base-delay-s", type=float, default=1.0)
     ap.add_argument("--max-delay-s", type=float, default=60.0)
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="full-strength device count: enables re-mesh-then-"
+                         "restart on host_lost exits (exported to the child "
+                         f"via {MESH_DEVICES_ENV})")
+    ap.add_argument("--n-hosts", type=int, default=None,
+                    help="hosts the mesh spans (devices-per-host defaults "
+                         "to the even split)")
+    ap.add_argument("--devices-per-host", type=int, default=None,
+                    help="devices one lost host takes with it")
+    ap.add_argument("--min-devices", type=int, default=1,
+                    help="stop with mesh_exhausted below this budget")
+    ap.add_argument("--device-kind", default=None,
+                    help="audit metadata for the ledger's mesh records")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- followed by the driver command")
     args = ap.parse_args(argv)
@@ -191,7 +299,10 @@ def main(argv=None):
         max_restarts=args.max_restarts,
         backoff=RetryPolicy(max_attempts=1_000_000,
                             base_delay_s=args.base_delay_s, multiplier=2.0,
-                            max_delay_s=args.max_delay_s))
+                            max_delay_s=args.max_delay_s),
+        mesh_devices=args.mesh_devices, n_hosts=args.n_hosts,
+        devices_per_host=args.devices_per_host,
+        min_devices=args.min_devices, device_kind=args.device_kind)
     outcome = supervise(
         cmd, ledger_path=args.ledger, policy=policy,
         on_spawn=lambda p: state.__setitem__("child", p),

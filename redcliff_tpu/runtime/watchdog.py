@@ -46,8 +46,9 @@ from dataclasses import dataclass, field
 
 __all__ = [
     "EXIT_CLEAN", "EXIT_PREEMPTED", "EXIT_NUMERICS_ABORT", "EXIT_HANG",
-    "EXIT_DEADLINE", "classify_exit", "CORE_COMPONENTS",
+    "EXIT_DEADLINE", "EXIT_HOST_LOST", "classify_exit", "CORE_COMPONENTS",
     "HeartbeatRegistry", "REGISTRY", "stamp", "retire", "op_scope",
+    "host_component", "host_of",
     "COMPILE_COMPONENT", "COMPILE_BUDGET_S",
     "WatchdogPolicy", "Watchdog", "maybe_start", "dump_stacks",
 ]
@@ -64,6 +65,10 @@ EXIT_NUMERICS_ABORT = 18  # numerics sentinel aborted (deterministic: a
 #                           restart replays the same divergence)
 EXIT_HANG = 19            # watchdog hard-exited a wedged process
 EXIT_DEADLINE = 20        # wall-clock deadline; checkpointed + resumable
+EXIT_HOST_LOST = 21       # part of the mesh is gone (host heartbeats stale /
+#                           collective timeout / device-loss signal): the
+#                           supervisor re-meshes (smaller device budget) and
+#                           restarts from the durable checkpoint
 
 _EXIT_NAMES = {
     EXIT_CLEAN: "clean",
@@ -71,13 +76,15 @@ _EXIT_NAMES = {
     EXIT_NUMERICS_ABORT: "numerics_abort",
     EXIT_HANG: "hang",
     EXIT_DEADLINE: "deadline",
+    EXIT_HOST_LOST: "host_lost",
 }
 
 
 def classify_exit(returncode):
     """Map a child returncode onto the taxonomy: ``clean`` / ``preempted`` /
-    ``numerics_abort`` / ``hang`` / ``deadline`` / ``signal:NAME`` (killed by
-    an un-latched signal, SIGKILL included) / ``crash`` (anything else)."""
+    ``numerics_abort`` / ``hang`` / ``deadline`` / ``host_lost`` /
+    ``signal:NAME`` (killed by an un-latched signal, SIGKILL included) /
+    ``crash`` (anything else)."""
     if returncode in _EXIT_NAMES:
         return _EXIT_NAMES[returncode]
     if returncode is not None and returncode < 0:
@@ -117,9 +124,22 @@ class HeartbeatRegistry:
         self._beats = {}   # name -> [last_stamp, budget_s]
         self._counts = {}  # name -> cumulative stamps (survives retire)
 
+    def _budget_for(self, name):
+        """Configured budget for ``name``; host-scoped beats
+        (``host<h>:component``) fall back to the base component's override
+        (an operator tuning ``budget.shard_loader`` expects it to govern
+        every host's shard loader) before the default."""
+        if name in self.budgets:
+            return self.budgets[name]
+        if host_of(name) is not None:
+            base = name.partition(":")[2]
+            if base in self.budgets:
+                return self.budgets[base]
+        return self.default_budget_s
+
     def register(self, name, budget_s=None):
         if budget_s is None:
-            budget_s = self.budgets.get(name, self.default_budget_s)
+            budget_s = self._budget_for(name)
         with self._lock:
             self._beats[name] = [self.clock(), float(budget_s)]
             self._counts.setdefault(name, 0)
@@ -128,8 +148,8 @@ class HeartbeatRegistry:
         with self._lock:
             beat = self._beats.get(name)
             if beat is None:
-                budget = self.budgets.get(name, self.default_budget_s)
-                self._beats[name] = [self.clock(), float(budget)]
+                self._beats[name] = [self.clock(),
+                                     float(self._budget_for(name))]
             else:
                 beat[0] = self.clock()
             self._counts[name] = self._counts.get(name, 0) + 1
@@ -218,6 +238,27 @@ def op_scope(name):
             REGISTRY.refresh()
 
 
+def host_component(host_id, component):
+    """The host-scoped heartbeat name for ``component`` on host ``host_id``
+    (``"host2:shard_loader"``). Host-scoped beats let one process observe
+    per-host liveness — a real multi-controller run's cross-host heartbeat
+    relay, or the single-process simulation's host partitions — and give the
+    watchdog the signal for :data:`EXIT_HOST_LOST` classification: ONE
+    host's components going stale while the rest of the process stays live
+    is a lost host, not a wedged process."""
+    return f"host{int(host_id)}:{component}"
+
+
+def host_of(name):
+    """The host index a heartbeat name is scoped to, or None for ordinary
+    process-wide components."""
+    if name.startswith("host"):
+        head, sep, _ = name.partition(":")
+        if sep and head[4:].isdigit():
+            return int(head[4:])
+    return None
+
+
 def dump_stacks():
     """Every thread's current stack as one string (named per thread) — the
     forensic core of a ``hang`` event: *where* each thread is wedged."""
@@ -243,6 +284,10 @@ class WatchdogPolicy:
     budgets: dict = field(default_factory=dict)  # per-component overrides
     hard_exit: bool = True
     latch_preempt: bool = True
+    # classify "exactly one host's heartbeats stale, everything else live"
+    # as a lost host (exit EXIT_HOST_LOST: the supervisor re-meshes) instead
+    # of a process hang. Disable with REDCLIFF_WATCHDOG=...,host_loss=0
+    host_loss: bool = True
 
     @classmethod
     def from_env(cls, env=ENV_WATCHDOG):
@@ -269,6 +314,8 @@ class WatchdogPolicy:
                 policy.grace_s = float(v)
             elif k == "budget_s":
                 policy.default_budget_s = float(v)
+            elif k == "host_loss":
+                policy.host_loss = v not in ("0", "false", "off")
             elif k.startswith("budget."):
                 policy.budgets[k[len("budget."):]] = float(v)
         return policy
@@ -339,8 +386,31 @@ class Watchdog:
         return False
 
     # ------------------------------------------------------------------
+    def _lost_host(self, overdue):
+        """The host index when EVERY overdue heartbeat is scoped to one host
+        AND at least one other component (another host's, or any plain
+        process-wide beat) is still being monitored — the signature of a
+        peer that stopped participating while this process stays healthy.
+        None otherwise (a process-wide stall is a hang, not a host loss)."""
+        hosts = {host_of(n) for n, _, _ in overdue}
+        if len(hosts) != 1 or None in hosts:
+            return None
+        lost = next(iter(hosts))
+        others = [n for n in self.registry.ages() if host_of(n) != lost]
+        return lost if others else None
+
+    def _other_counts(self, lost):
+        """Stamp counts of every component NOT scoped to the lost host —
+        the proof-of-life baseline for the host-loss grace window."""
+        return {n: c for n, c in self.registry.counts().items()
+                if host_of(n) != lost}
+
     def _run(self):
         latched_at = None
+        host_latched_at = None
+        host_alive0 = None
+        host_demoted = False  # a host-loss incident failed proof-of-life:
+        #                       stay on the hang ladder until recovery
         while not self._stop.wait(self.policy.poll_s):
             overdue = self.registry.overdue()
             if overdue and not any(n == COMPILE_COMPONENT
@@ -352,9 +422,56 @@ class Watchdog:
                 # finishes or itself exceeds its own budget
                 overdue = []
             if not overdue:
-                latched_at = None  # recovered: rearm the ladder
+                latched_at = host_latched_at = None  # recovered: rearm
+                host_demoted = False
                 continue
             now = self.clock()
+            lost = (self._lost_host(overdue)
+                    if self.policy.host_loss and not host_demoted else None)
+            if lost is not None:
+                # host-loss ladder: one structured incident, then exit with
+                # the re-mesh taxonomy code after grace. Deliberately NO
+                # preempt latch — the in-process loop is healthy (nothing
+                # here needs saving beyond the last periodic checkpoint),
+                # and on a real multi-host mesh a final save would wedge on
+                # collectives the dead host can no longer join; exiting
+                # fast hands the supervisor the re-mesh decision
+                latched_at = None
+                if host_latched_at is None:
+                    host_latched_at = now
+                    host_alive0 = self._other_counts(lost)
+                    self.incidents += 1
+                    self._emit(overdue, event="host_lost", host=lost)
+                    continue
+                if now - host_latched_at >= self.policy.grace_s:
+                    # proof of life: "others are merely in-budget" is not
+                    # evidence this process is healthy (a whole-process
+                    # wedge freezes short-budget host beats first); only a
+                    # component that actually STAMPED during the grace
+                    # window proves liveness. Without one, demote to the
+                    # ordinary hang ladder — exit 19 and a same-shape
+                    # restart, never a misclassified mesh shrink. (A main
+                    # thread blocked on a dead collective takes the typed
+                    # collective-timeout route in the grid engine, not
+                    # this heartbeat route.)
+                    counts = self._other_counts(lost)
+                    alive = any(counts.get(n, 0) > c0
+                                for n, c0 in host_alive0.items()) \
+                        or any(n not in host_alive0 for n in counts)
+                    if alive:
+                        if self.policy.hard_exit:
+                            self._hard_exit(
+                                overdue, exit_code=EXIT_HOST_LOST,
+                                event="host_lost", host=lost)
+                        host_latched_at = None
+                        continue
+                    host_latched_at = None
+                    host_demoted = True  # until recovery rearms
+                    lost = None  # fall through to the hang ladder below
+                else:
+                    continue
+            if lost is None and host_latched_at is not None:
+                host_latched_at = None
             if latched_at is None:
                 latched_at = now
                 self.incidents += 1
@@ -385,35 +502,38 @@ class Watchdog:
             "grace_s": self.policy.grace_s,
         }
 
-    def _emit(self, overdue):
+    def _emit(self, overdue, event="hang", **extra):
         rec = self._record(overdue)
+        rec.update(extra)
         stacks = dump_stacks()
-        print(f"[watchdog] HANG detected: {rec['components']}\n{stacks}",
-              file=sys.stderr, flush=True)
+        print(f"[watchdog] {event.upper()} detected: {rec['components']}"
+              f"\n{stacks}", file=sys.stderr, flush=True)
         if self.logger is not None and getattr(self.logger, "active", False):
-            self.logger.log("hang", **rec, stacks=stacks)
+            self.logger.log(event, **rec, stacks=stacks)
         if self.on_hang is not None:
             try:
                 self.on_hang(rec)
             except Exception:  # noqa: BLE001 — a bad callback must not
                 pass           # silence the ladder
 
-    def _hard_exit(self, overdue):
+    def _hard_exit(self, overdue, exit_code=EXIT_HANG, event="hang", **extra):
         rec = self._record(overdue)
+        rec.update(extra)
         # stderr forensics FIRST — guaranteed even if the jsonl logger is
         # unusable (e.g. the main thread wedged while holding its lock)
-        print(f"[watchdog] still hung after {self.policy.grace_s:.1f}s grace; "
-              f"hard exit {EXIT_HANG}: {rec['components']}",
+        print(f"[watchdog] {event} persists after {self.policy.grace_s:.1f}s "
+              f"grace; hard exit {exit_code}: {rec['components']}",
               file=sys.stderr, flush=True)
         with contextlib.suppress(Exception):
             faulthandler.dump_traceback(file=sys.stderr)
         sys.stderr.flush()
         if self.logger is not None and getattr(self.logger, "active", False):
-            # best-effort, time-bounded: the hang_exit record is nice to
+            # best-effort, time-bounded: the *_exit record is nice to
             # have, but the exit must happen even if logging would block
             def flush_log():
                 with contextlib.suppress(Exception):
-                    self.logger.log("hang_exit", exit_code=EXIT_HANG, **rec)
+                    self.logger.log(f"{event}_exit", exit_code=exit_code,
+                                    **rec)
                     self.logger.close()
 
             t = threading.Thread(target=flush_log, name="watchdog-flush",
@@ -422,7 +542,7 @@ class Watchdog:
             t.join(timeout=5.0)
         # os._exit, not sys.exit: the main thread is wedged and cannot unwind;
         # durability is the checkpoint layer's job (.prev generation)
-        self.exit_fn(EXIT_HANG)
+        self.exit_fn(exit_code)
 
 
 def maybe_start(guard=None, logger=None, registry=None):

@@ -195,12 +195,29 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     ``train_config.compile_cache_dir`` (or ``REDCLIFF_COMPILE_CACHE``)
     enables the persistent, versioned XLA compilation cache so restarted
     attempts warm-start instead of recompiling every grid program.
+
+    Host-fault tolerance (ARCHITECTURE.md "Elastic re-meshing & host-fault
+    tolerance"): ``mesh="auto"`` builds the largest viable mesh over the
+    VISIBLE devices — ``jax.devices()`` capped by ``REDCLIFF_MESH_DEVICES``,
+    the knob the supervisor degrades after a ``host_lost`` exit — so a
+    supervised driver resumes a dropped-host sweep on the surviving devices
+    automatically: the grid engine re-shards the checkpointed lanes onto
+    the smaller mesh (structured ``remesh`` event in metrics.jsonl) and
+    results keep reporting under original point ids.
     """
     import jax
 
     from ..parallel.grid import GridSpec, RedcliffGridRunner
 
-    spec = GridSpec(points=list(grid_points), fit_deadline_s=fit_deadline_s,
+    grid_points = list(grid_points)
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(f"mesh must be a Mesh, None, or 'auto'; "
+                             f"got {mesh!r}")
+        from ..parallel import remesh as _remesh
+
+        mesh = _remesh.visible_mesh(n_lanes=len(grid_points))
+    spec = GridSpec(points=grid_points, fit_deadline_s=fit_deadline_s,
                     grid_deadline_s=grid_deadline_s)
     runner = RedcliffGridRunner(model, train_config, spec, mesh=mesh)
     key = key if key is not None else jax.random.PRNGKey(train_config.seed)

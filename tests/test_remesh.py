@@ -1,0 +1,779 @@
+"""Elastic re-meshing & host-fault tolerance acceptance battery
+(parallel/remesh.py + the host_lost supervisor/watchdog taxonomy).
+
+The headline property: a "host" dying mid-grid (single-process sub-mesh
+simulation — this container's CPU backend cannot run 2-process collectives,
+see ROADMAP item 5) surfaces as a typed ``host_lost`` exit, the supervisor
+degrades the mesh budget and restarts, and the resumed fit re-shards the
+checkpointed lanes onto the survivors with per-lane decision streams
+bit-identical to an uninterrupted run at the degraded width — results under
+original point ids throughout. Plus: the resume fingerprint stays
+mesh-agnostic (checkpoints cross device counts in both directions), the
+``remesh`` event lands in metrics.jsonl / dispatch_stats / run_ledger.jsonl,
+and ShardedBatchDataset's host-local shard assignment partitions uneven
+shard counts exactly.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.parallel import remesh
+from redcliff_tpu.runtime import watchdog as wdg
+from redcliff_tpu.runtime.faultinject import (_result_blob,
+                                              random_host_fault_schedule,
+                                              tiny_grid_fit)
+from redcliff_tpu.runtime.retry import RetryPolicy
+from redcliff_tpu.runtime.supervisor import (MESH_DEVICES_ENV,
+                                             SupervisorPolicy, supervise)
+from redcliff_tpu.runtime.watchdog import (EXIT_HANG, EXIT_HOST_LOST,
+                                           HeartbeatRegistry, Watchdog,
+                                           WatchdogPolicy, classify_exit)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = [sys.executable, "-m", "redcliff_tpu.runtime.faultinject"]
+
+
+# ---------------------------------------------------------------------------
+# planner units
+# ---------------------------------------------------------------------------
+def test_classify_device_error_routes():
+    cde = remesh.classify_device_error
+    assert cde(RuntimeError(
+        "INTERNAL: device lost: local device vanished")) == "device_lost"
+    assert cde(RuntimeError("PJRT error: device disconnected")) \
+        == "device_lost"
+    assert cde(RuntimeError(
+        "DEADLINE_EXCEEDED: coordinator heartbeat timed out")) \
+        == "coordinator_loss"
+    assert cde(RuntimeError(
+        "distributed runtime service unavailable")) == "coordinator_loss"
+    assert cde(RuntimeError(
+        "collective all-reduce timed out after 60s")) == "collective_timeout"
+    assert cde(RuntimeError("NCCL operation timeout")) == "collective_timeout"
+    # not mesh-shaped: model/shape errors stay in their original class
+    assert cde(ValueError("shapes (3, 4) and (4, 5) do not match")) is None
+    assert cde(RuntimeError("loss went non-finite at step 7")) is None
+    assert cde(None) is None
+
+
+def test_choose_mesh_devices_prefers_wall_clock_then_width():
+    # 9 lanes: all 6 survivors (width 18, 3 lanes/device) beat the pow2
+    # 4-subset (width 16, 4 lanes/device)
+    assert remesh.choose_mesh_devices(6, 9) == 6
+    # ties go to MORE devices (filler burns joules, not seconds)
+    assert remesh.choose_mesh_devices(6, 8) == 6
+    assert remesh.choose_mesh_devices(8, 8) == 8
+    assert remesh.choose_mesh_devices(1, 5) == 1
+
+
+def test_plan_resharding_shrink_grow_and_compact_off():
+    ids = np.arange(8, dtype=np.int32)
+    live = np.ones(8, bool)
+    # width 8 onto 6 devices: grows up the ladder with filler padding
+    p = remesh.plan_resharding(live, ids, [], 6)
+    assert p.new_width == 12
+    np.testing.assert_array_equal(p.orig_ids[:8], ids)
+    assert (p.orig_ids[8:] == -1).all()
+    assert p.active[:8].all() and not p.active[8:].any()
+    assert p.retire_rows.size == 0
+    # compatible meshes need no plan (same-mesh resumes stay on the fast
+    # path; pow2 shrink rides the sub-mesh rule)
+    assert remesh.plan_resharding(live, ids, [], 8) is None
+    assert remesh.plan_resharding(live, ids, [], 4) is None
+    assert remesh.plan_resharding(live, ids, [], 1) is None
+    # 3 live of 8 onto 4 devices, compacting: width 4, frozen lanes retire
+    # (except those already in the retired store)
+    some = np.array([1, 0, 1, 0, 0, 1, 0, 0], bool)
+    p2 = remesh.plan_resharding(some, ids, [7], 4)
+    assert p2.new_width == 4
+    np.testing.assert_array_equal(p2.orig_ids, [0, 2, 5, -1])
+    assert sorted(int(i) for i in p2.retire_ids) == [1, 3, 4, 6]
+    # compact=False keeps every real lane at fixed-width semantics
+    p3 = remesh.plan_resharding(some, ids, [], 6, compact=False)
+    assert p3.new_width == 12 and p3.retire_rows.size == 0
+    assert list(p3.active[:8]) == list(some)
+    # no live lanes (resume-to-finish): keep all real rows, retire nothing
+    p4 = remesh.plan_resharding(np.zeros(8, bool), ids, [], 6)
+    assert p4.new_width == 12 and p4.retire_rows.size == 0
+    assert not p4.active.any()
+    # filler rows replicate a LIVE lane even in the keep-all branch — row 0
+    # may be a quarantined lane holding non-finite params
+    p5 = remesh.plan_resharding(
+        np.array([0, 1, 0, 0, 0, 0, 0, 0], bool), ids, [], 6, compact=False)
+    assert (p5.sel[8:] == 1).all()
+    # filler-only input: nothing to plan
+    assert remesh.plan_resharding(
+        np.zeros(2, bool), np.full(2, -1, np.int32), [], 4) is None
+
+
+def test_visible_devices_and_mesh_shape(monkeypatch):
+    import jax
+
+    monkeypatch.delenv(remesh.ENV_MESH_DEVICES, raising=False)
+    monkeypatch.delenv(remesh.ENV_SIM_HOSTS, raising=False)
+    assert len(remesh.visible_devices()) == jax.device_count()
+    monkeypatch.setenv(remesh.ENV_MESH_DEVICES, "6")
+    assert len(remesh.visible_devices()) == 6
+    monkeypatch.setenv(remesh.ENV_MESH_DEVICES, "not-a-number")
+    assert len(remesh.visible_devices()) == jax.device_count()
+    monkeypatch.setenv(remesh.ENV_MESH_DEVICES, "6")
+    monkeypatch.setenv(remesh.ENV_SIM_HOSTS, "3")
+    shape = remesh.mesh_shape(remesh.visible_mesh())
+    assert shape == {"n_hosts": 3, "n_devices": 6, "device_kind": "cpu"}
+    # mesh=None describes the single-device default placement
+    monkeypatch.delenv(remesh.ENV_SIM_HOSTS, raising=False)
+    assert remesh.mesh_shape(None)["n_devices"] == 1
+
+
+def test_mesh_shape_ignores_sim_hosts_on_real_multiprocess(monkeypatch):
+    """REDCLIFF_SIM_HOSTS applies ONLY to genuinely single-process device
+    sets: on a real multi-controller mesh the process_index spread is the
+    truth, and the supervisor-exported sim value must not distort the
+    audit trail."""
+    class _Dev:
+        def __init__(self, pi):
+            self.process_index = pi
+            self.device_kind = "tpu"
+
+    monkeypatch.setenv(remesh.ENV_SIM_HOSTS, "4")
+    real = [_Dev(0), _Dev(0), _Dev(1), _Dev(1)]
+    assert remesh.mesh_shape(devices=real)["n_hosts"] == 2
+    sim = [_Dev(0)] * 4
+    assert remesh.mesh_shape(devices=sim)["n_hosts"] == 4
+
+
+# ---------------------------------------------------------------------------
+# watchdog: host-scoped staleness -> EXIT_HOST_LOST
+# ---------------------------------------------------------------------------
+def test_host_component_naming_and_taxonomy():
+    assert wdg.host_component(3, "shard_loader") == "host3:shard_loader"
+    assert wdg.host_of("host3:shard_loader") == 3
+    assert wdg.host_of("shard_loader") is None
+    assert wdg.host_of("hostile:thing") is None
+    assert classify_exit(EXIT_HOST_LOST) == "host_lost"
+
+
+class _GuardStub:
+    preempted = False
+    signum = None
+
+
+class _Log:
+    active = True
+
+    def __init__(self, events):
+        self._events = events
+
+    def log(self, event, **kw):
+        self._events.append((event, kw))
+
+    def close(self):
+        pass
+
+
+def test_watchdog_host_scoped_staleness_exits_host_lost():
+    """One host's heartbeats going stale while the process stays healthy is
+    a HOST loss (exit 21, no preempt latch — nothing in-process needs
+    saving and a final save could wedge on dead collectives), not a hang."""
+    reg = HeartbeatRegistry(default_budget_s=0.05)
+    reg.stamp(wdg.host_component(2, "stream"))
+    reg.stamp("epoch_engine")
+    guard = _GuardStub()
+    exits, events = [], []
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=0.1),
+                  registry=reg, guard=guard, logger=_Log(events),
+                  exit_fn=exits.append)
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not exits and time.monotonic() < deadline:
+            reg.stamp("epoch_engine")  # this process keeps beating
+            time.sleep(0.01)
+    assert exits == [EXIT_HOST_LOST]
+    assert guard.preempted is False
+    kinds = [e for e, _ in events]
+    assert "host_lost" in kinds and "host_lost_exit" in kinds
+    lost = dict(events)["host_lost"]
+    assert lost["host"] == 2
+    assert "host2:stream" in lost["components"]
+
+
+def test_watchdog_host_loss_demotes_to_hang_without_proof_of_life():
+    """A short-budget host beat going overdue while every other component
+    is merely IN-BUDGET (but frozen) must not shrink the mesh: without a
+    fresh stamp from some other component during the grace window, the
+    incident demotes to the ordinary hang ladder (exit 19) — a wedged
+    process gets a same-shape restart, never a misclassified re-mesh."""
+    reg = HeartbeatRegistry(default_budget_s=10.0)  # epoch_engine in budget
+    reg.budgets["host2:stream"] = 0.05
+    reg.stamp("host2:stream")
+    reg.stamp("epoch_engine")
+    exits, events = [], []
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=0.1),
+                  registry=reg, logger=_Log(events), exit_fn=exits.append)
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.01)  # NOBODY stamps: the whole process is frozen
+    assert exits == [EXIT_HANG]
+    kinds = [e for e, _ in events]
+    # the host-loss incident fired, failed its proof-of-life check, and
+    # the hang ladder took over
+    assert "host_lost" in kinds and "hang" in kinds
+    assert "host_lost_exit" not in kinds
+
+
+def test_watchdog_whole_process_stall_is_still_a_hang():
+    """Host-scoped AND process-wide beats both stale = this process is
+    wedged: the ordinary hang ladder (exit 19), not host_lost."""
+    reg = HeartbeatRegistry(default_budget_s=0.05)
+    reg.stamp(wdg.host_component(1, "stream"))
+    reg.stamp("epoch_engine")
+    exits = []
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=0.05),
+                  registry=reg, exit_fn=exits.append)
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert exits == [EXIT_HANG]
+    # and a lone stale host beat with NOTHING else monitored is a hang too
+    # (no evidence the rest of the process is alive)
+    reg2 = HeartbeatRegistry(default_budget_s=0.05)
+    reg2.stamp(wdg.host_component(1, "stream"))
+    exits2 = []
+    wd2 = Watchdog(policy=WatchdogPolicy(poll_s=0.02, grace_s=0.05),
+                   registry=reg2, exit_fn=exits2.append)
+    with wd2:
+        deadline = time.monotonic() + 10.0
+        while not exits2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert exits2 == [EXIT_HANG]
+
+
+def test_host_scoped_heartbeat_inherits_base_budget_override():
+    """budget.shard_loader must govern EVERY host's shard loader: the
+    host-scoped beat falls back to the base component's override instead
+    of silently reverting to the 600s default."""
+    reg = HeartbeatRegistry(clock=lambda: reg_clock[0],
+                            default_budget_s=100.0)
+    reg_clock = [0.0]
+    reg.budgets["shard_loader"] = 2.0
+    reg.stamp("host1:shard_loader")
+    reg.stamp("other")
+    reg_clock[0] = 3.0
+    assert [o[0] for o in reg.overdue()] == ["host1:shard_loader"]
+    # an exact host-scoped override still wins over the base fallback
+    reg.budgets["host1:shard_loader"] = 50.0
+    reg.retire("host1:shard_loader")
+    reg.stamp("host1:shard_loader")
+    reg_clock[0] = 6.0
+    assert reg.overdue() == []
+
+
+def test_apply_reshard_backfills_presentinel_failed_cause():
+    """A pre-sentinel checkpoint (no failed_cause) with frozen lanes must
+    re-shard, not crash: the retire loop backfills causes from
+    failed_epoch exactly like the grid resume path."""
+    from redcliff_tpu.runtime import numerics
+
+    ids = np.arange(4, dtype=np.int32)
+    active = np.array([True, False, True, True])
+    ckpt = {
+        "params": np.arange(4.0).reshape(4, 1),
+        "optA_state": np.arange(4.0), "optB_state": np.arange(4.0),
+        "best_params": {"w": np.arange(8.0).reshape(4, 2)},
+        "best_crit": np.array([1.0, 2.0, 3.0, 4.0]),
+        "best_epoch": np.array([0, 1, 2, 3]),
+        "active": active, "accepted": None,
+        "failed_epoch": np.array([-1, 1, -1, -1]),  # lane 1 quarantined
+        "orig_ids": ids,
+    }
+    retired = {}
+    plan = remesh.plan_resharding(active, ids, retired.keys(), 6)
+    assert plan is not None and list(plan.retire_ids) == [1]
+    migrated = remesh.apply_reshard(ckpt, retired, plan)
+    assert migrated == 3
+    assert retired[1]["failed_cause"] == numerics.CAUSE_NONFINITE_VAL
+    assert retired[1]["failed_epoch"] == 1
+    np.testing.assert_array_equal(retired[1]["best_params"]["w"], [2.0, 3.0])
+    assert ckpt["params"].shape[0] == plan.new_width
+
+
+def test_watchdog_policy_host_loss_knob(monkeypatch):
+    monkeypatch.setenv(wdg.ENV_WATCHDOG, "poll_s=0.5,host_loss=0")
+    p = WatchdogPolicy.from_env()
+    assert p.host_loss is False
+    monkeypatch.setenv(wdg.ENV_WATCHDOG, "1")
+    assert WatchdogPolicy.from_env().host_loss is True
+
+
+# ---------------------------------------------------------------------------
+# supervisor: host_lost -> re-mesh-then-restart, mesh audit in the ledger
+# ---------------------------------------------------------------------------
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+
+    def wait(self):
+        return self._rc
+
+
+def _fake_popen(rcs, envs):
+    def popen(cmd, env=None):
+        envs.append(dict(env) if env is not None else None)
+        return _FakeProc(rcs[len(envs) - 1])
+
+    return popen
+
+
+def _fast_policy(**kw):
+    return SupervisorPolicy(
+        backoff=RetryPolicy(max_attempts=10 ** 6, base_delay_s=0.0,
+                            multiplier=2.0, max_delay_s=0.0), **kw)
+
+
+def test_supervisor_remesh_restart_degrades_mesh(tmp_path):
+    """Two host losses on a 4-host x 2-device mesh: each attempt's ledger
+    line records the mesh it ran under, each host_lost triggers a
+    remesh_restart that shrinks REDCLIFF_MESH_DEVICES by one host's worth,
+    and the run finishes clean on the twice-degraded mesh."""
+    envs = []
+    ledger = str(tmp_path / "run_ledger.jsonl")
+    out = supervise(
+        ["driver"], ledger_path=ledger,
+        policy=_fast_policy(mesh_devices=8, n_hosts=4, device_kind="cpu"),
+        popen=_fake_popen([EXIT_HOST_LOST, EXIT_HOST_LOST, 0], envs),
+        sleep=lambda s: None)
+    assert out.classification == "clean"
+    assert [e[MESH_DEVICES_ENV] for e in envs] == ["8", "6", "4"]
+    assert [e["REDCLIFF_SIM_HOSTS"] for e in envs] == ["4", "3", "2"]
+    recs = [json.loads(l) for l in open(ledger)]
+    attempts = [r for r in recs if r["event"] == "attempt"]
+    assert [a["classification"] for a in attempts] == \
+        ["host_lost", "host_lost", "clean"]
+    assert [a["action"] for a in attempts] == \
+        ["remesh_restart", "remesh_restart", "stop"]
+    assert [a["mesh"] for a in attempts] == [
+        {"n_hosts": 4, "n_devices": 8, "device_kind": "cpu"},
+        {"n_hosts": 3, "n_devices": 6, "device_kind": "cpu"},
+        {"n_hosts": 2, "n_devices": 4, "device_kind": "cpu"}]
+    remeshes = [r for r in recs if r["event"] == "remesh"]
+    assert [(r["from_devices"], r["to_devices"]) for r in remeshes] == \
+        [(8, 6), (6, 4)]
+
+
+def test_supervisor_mesh_exhausted_stops(tmp_path):
+    """A mesh that cannot degrade further (min_devices floor, or the last
+    host) is terminal: there is nothing left to run on."""
+    envs = []
+    out = supervise(
+        ["driver"],
+        policy=_fast_policy(mesh_devices=8, n_hosts=4, min_devices=7),
+        popen=_fake_popen([EXIT_HOST_LOST], envs), sleep=lambda s: None)
+    assert out.classification == "mesh_exhausted"
+    assert out.attempts[0]["action"] == "stop"
+    # last-host case
+    envs2 = []
+    out2 = supervise(
+        ["driver"], policy=_fast_policy(mesh_devices=2, n_hosts=1),
+        popen=_fake_popen([EXIT_HOST_LOST], envs2), sleep=lambda s: None)
+    assert out2.classification == "mesh_exhausted"
+
+
+def test_supervisor_unknown_host_width_degrades_one_device(tmp_path):
+    """--mesh-devices without n_hosts/devices-per-host: the host width is
+    unknown, so each loss degrades by ONE device (conservative — extra
+    restart rounds beat discarding healthy capacity for the whole sweep)."""
+    envs = []
+    out = supervise(
+        ["driver"], policy=_fast_policy(mesh_devices=8),
+        popen=_fake_popen([EXIT_HOST_LOST, EXIT_HOST_LOST, 0], envs),
+        sleep=lambda s: None)
+    assert out.classification == "clean"
+    assert [e[MESH_DEVICES_ENV] for e in envs] == ["8", "7", "6"]
+
+
+def test_supervisor_host_lost_without_mesh_is_plain_restart(tmp_path):
+    """No declared mesh = no re-mesh knowledge: host_lost degrades to the
+    ordinary restart class (same shape, and no mesh env is injected)."""
+    envs = []
+    out = supervise(
+        ["driver"], policy=_fast_policy(),
+        popen=_fake_popen([EXIT_HOST_LOST, 0], envs), sleep=lambda s: None)
+    assert out.classification == "clean"
+    assert out.attempts[0]["action"] == "restart"
+    assert "mesh" not in out.attempts[0]
+    assert envs == [None, None]  # caller env passed through untouched
+
+
+# ---------------------------------------------------------------------------
+# ShardedBatchDataset: host-local shard assignment
+# ---------------------------------------------------------------------------
+def _write_shards(split_dir, n_files, per_file=3, channels=2, T=4, seed=0):
+    os.makedirs(split_dir)
+    rng = np.random.default_rng(seed)
+    for i in range(n_files):
+        pairs = [[rng.normal(size=(T, channels)).astype(np.float32),
+                  np.float32([i * per_file + j])]
+                 for j in range(per_file)]
+        with open(os.path.join(split_dir, f"subset_{i}.pkl"), "wb") as f:
+            pickle.dump(pairs, f)
+
+
+@pytest.mark.parametrize("n_files,n_hosts", [(5, 2), (7, 3), (4, 4)])
+def test_host_local_assignment_partitions_unevenly(tmp_path, n_files,
+                                                   n_hosts):
+    """Host-local shard assignment is a PARTITION for any (files, hosts):
+    no shard dropped, none owned twice — uneven counts included — and every
+    sample streams from exactly one host."""
+    from redcliff_tpu.data.shards import ShardedBatchDataset
+
+    split = str(tmp_path / "train")
+    _write_shards(split, n_files)
+    parts = [ShardedBatchDataset(split, normalize=False, host_id=h,
+                                 n_hosts=n_hosts) for h in range(n_hosts)]
+    owned = [f for p in parts for f in p.files]
+    assert sorted(owned) == sorted(
+        f"subset_{i}.pkl" for i in range(n_files))  # complete
+    assert len(owned) == len(set(owned))            # disjoint
+    # sample-level: the union of host streams is exactly the dataset (the
+    # label encodes (file, sample), so multiset equality pins no-dup/no-drop)
+    labels = []
+    for p in parts:
+        for _, Y in p.batches(batch_size=2):
+            labels.extend(float(y) for y in Y.ravel())
+    assert sorted(labels) == list(range(n_files * 3))
+    assert sum(len(p) for p in parts) == n_files * 3
+
+
+def test_host_local_assignment_errors_and_heartbeat(tmp_path):
+    from redcliff_tpu.data.shards import ShardedBatchDataset
+
+    split = str(tmp_path / "train")
+    _write_shards(split, 2)
+    with pytest.raises(ValueError, match="together"):
+        ShardedBatchDataset(split, host_id=0)
+    with pytest.raises(ValueError, match="out of range"):
+        ShardedBatchDataset(split, host_id=2, n_hosts=2)
+    # more hosts than shards: the empty host fails loudly at construction
+    with pytest.raises(FileNotFoundError, match="owns no shards"):
+        ShardedBatchDataset(split, host_id=2, n_hosts=3)
+    # host-scoped heartbeat: the per-host staleness detector's producer
+    before = wdg.REGISTRY.counts().get("host1:shard_loader", 0)
+    ds = ShardedBatchDataset(split, host_id=1, n_hosts=2)
+    assert wdg.REGISTRY.counts()["host1:shard_loader"] > before
+    assert "host1:shard_loader" not in wdg.REGISTRY.ages()  # retired when idle
+    assert ds.files == ["subset_1.pkl"]
+
+
+def test_run_coefficient_grid_rejects_unknown_mesh_string():
+    """Only 'auto' is a valid mesh string (and it resolves before any model
+    work); typos fail loudly instead of silently training unsharded."""
+    from redcliff_tpu.train.driver import run_coefficient_grid
+
+    with pytest.raises(ValueError, match="'auto'"):
+        run_coefficient_grid(None, None, [{"gen_lr": 1e-3}], None, None,
+                             mesh="bogus")
+
+
+# ---------------------------------------------------------------------------
+# tripwire: the resume fingerprint is mesh-agnostic (satellite 2)
+# ---------------------------------------------------------------------------
+def test_resume_fingerprint_is_mesh_agnostic(tmp_path, monkeypatch):
+    """A checkpoint written on an 8-device mesh must be ACCEPTED on a
+    4-device mesh (and vice versa) — the mesh is audit metadata in the
+    payload, never part of the compatibility fingerprint. A rejection here
+    means someone added a mesh-shaped field to _checkpoint_meta."""
+    monkeypatch.delenv("REDCLIFF_FAULT_INJECT", raising=False)
+    monkeypatch.delenv(remesh.ENV_MESH_DEVICES, raising=False)
+    ck = str(tmp_path / "ck_8to4")
+    blob_8 = _result_blob(tiny_grid_fit(ck, max_iter=2, use_mesh=True))
+    monkeypatch.setenv(remesh.ENV_MESH_DEVICES, "4")
+    # resume on 4 devices: must load (not reject as "different fit") and
+    # reproduce the finished fit's results exactly from the checkpoint
+    blob_4 = _result_blob(tiny_grid_fit(ck, max_iter=2, use_mesh=True))
+    for k in ("val_history", "best_criteria", "best_epoch", "active"):
+        np.testing.assert_array_equal(blob_4[k], blob_8[k])
+    # and vice versa: written at 4, resumed at 8
+    ck2 = str(tmp_path / "ck_4to8")
+    blob_w4 = _result_blob(tiny_grid_fit(ck2, max_iter=2, use_mesh=True))
+    monkeypatch.delenv(remesh.ENV_MESH_DEVICES, raising=False)
+    blob_r8 = _result_blob(tiny_grid_fit(ck2, max_iter=2, use_mesh=True))
+    np.testing.assert_array_equal(blob_r8["val_history"],
+                                  blob_w4["val_history"])
+
+
+# ---------------------------------------------------------------------------
+# typed-error mapping: injected device/coordinator loss -> HostLostError
+# ---------------------------------------------------------------------------
+def test_injected_device_and_coordinator_loss_map_to_typed_error(
+        tmp_path, monkeypatch):
+    monkeypatch.delenv("REDCLIFF_FAULT_MARKER", raising=False)
+    cases = [("device_lost:0", "device_lost", None),
+             ("coordinator_loss:0", "coordinator_loss", None),
+             ("host_drop:2:0", "host_drop", 2)]
+    for spec, reason, host in cases:
+        monkeypatch.setenv("REDCLIFF_FAULT_INJECT", spec)
+        with pytest.raises(remesh.HostLostError) as ei:
+            tiny_grid_fit(str(tmp_path / reason), max_iter=1)
+        assert ei.value.reason == reason
+        assert ei.value.host == host
+
+
+# ---------------------------------------------------------------------------
+# in-process degraded-mesh resume: remesh plan + event + stats + audit
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def degraded_reference(tmp_path_factory):
+    """The uninterrupted run at the DEGRADED width: G=8 on the 6-device
+    survivor mesh (execution width 12) for all 3 epochs — what every
+    resumed leg must match bit-for-bit at the decision level. Computed once
+    per module (three tests compare against it)."""
+    prev = os.environ.get(remesh.ENV_MESH_DEVICES)
+    prev_fi = os.environ.pop("REDCLIFF_FAULT_INJECT", None)
+    os.environ[remesh.ENV_MESH_DEVICES] = "6"
+    try:
+        res = tiny_grid_fit(
+            str(tmp_path_factory.mktemp("degraded_ref")), max_iter=3,
+            grid_size=8, use_mesh=True)
+        return _result_blob(res)
+    finally:
+        if prev is None:
+            os.environ.pop(remesh.ENV_MESH_DEVICES, None)
+        else:
+            os.environ[remesh.ENV_MESH_DEVICES] = prev
+        if prev_fi is not None:
+            os.environ["REDCLIFF_FAULT_INJECT"] = prev_fi
+
+
+def _assert_decisions_match(got, want):
+    """Per-lane decision streams + GridResult under original point ids,
+    BITWISE; params float-tight (a re-mesh changes the per-device shard
+    width mid-history, which XLA codegen may round ~1 ulp — measured on
+    the legacy runtime, exact on the thunk runtime for this shape; see the
+    strict slow leg and ARCHITECTURE's caveat)."""
+    np.testing.assert_array_equal(got["val_history"], want["val_history"])
+    np.testing.assert_array_equal(got["best_criteria"],
+                                  want["best_criteria"])
+    np.testing.assert_array_equal(got["best_epoch"], want["best_epoch"])
+    np.testing.assert_array_equal(got["active"], want["active"])
+    assert got["failures"] == want["failures"]
+    for a, b in zip(got["best_params_leaves"], want["best_params_leaves"]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_degraded_mesh_resume_reshards_and_matches(tmp_path, monkeypatch,
+                                                   degraded_reference):
+    """Checkpoint at width 8 on the full 8-device mesh, resume with only 6
+    devices visible: the engine re-shards to the width-12 bucket (all 8
+    lanes migrate, 4 filler pads), logs the structured ``remesh`` event,
+    surfaces it in dispatch_stats, stamps the new mesh into the checkpoint
+    payload — and the finished fit matches the uninterrupted degraded-width
+    run at the decision level, under original point ids."""
+    import jax
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+    from redcliff_tpu.runtime import checkpoint as rck
+    from redcliff_tpu.runtime.faultinject import _tiny_runner
+    from redcliff_tpu.utils.observability import read_jsonl
+
+    monkeypatch.delenv("REDCLIFF_FAULT_INJECT", raising=False)
+    monkeypatch.delenv(remesh.ENV_MESH_DEVICES, raising=False)
+    ck = str(tmp_path / "ck")
+    runner, X, Y = _tiny_runner(3, grid_size=8, use_mesh=True)
+    assert runner.mesh.devices.size == 8
+    ds = ArrayDataset(X, Y)
+    runner.fit(jax.random.PRNGKey(2), ds, ds, max_iter=2,
+               checkpoint_dir=ck, checkpoint_every=1)
+    ckpt = rck.read_checkpoint(os.path.join(ck, "grid_checkpoint.pkl"))
+    assert ckpt["mesh"] == {"n_hosts": 1, "n_devices": 8,
+                            "device_kind": "cpu"}
+
+    monkeypatch.setenv(remesh.ENV_MESH_DEVICES, "6")
+    runner2, _, _ = _tiny_runner(3, grid_size=8, use_mesh=True)
+    assert runner2.mesh.devices.size == 6
+    res = runner2.fit(jax.random.PRNGKey(2), ds, ds,
+                      checkpoint_dir=ck, checkpoint_every=1, log_dir=ck)
+    stats = runner2.dispatch_stats
+    assert stats["remeshes"] == 1 and stats["grid_width"] == 12
+    assert stats["remesh"]["from_width"] == 8
+    assert stats["remesh"]["to_width"] == 12
+    assert stats["remesh"]["lanes_migrated"] == 8
+    assert stats["remesh"]["plan_ms"] >= 0
+    rem = [e for e in read_jsonl(ck) if e.get("event") == "remesh"]
+    assert len(rem) == 1
+    assert rem[0]["from_devices"] == 8 and rem[0]["to_devices"] == 6
+    assert rem[0]["lanes_migrated"] == 8 and rem[0]["lanes_retired"] == []
+    # the post-remesh checkpoint carries the NEW mesh (audit end to end)
+    ckpt2 = rck.read_checkpoint(os.path.join(ck, "grid_checkpoint.pkl"))
+    assert ckpt2["mesh"]["n_devices"] == 6
+    assert len(ckpt2["orig_ids"]) == 12
+    _assert_decisions_match(_result_blob(res), degraded_reference)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: SIGKILL-grade host loss mid-grid, supervised end to end
+# ---------------------------------------------------------------------------
+def _run_supervised_mesh(tmp_path, ck, fault, result=None, max_iter=3,
+                         timeout=420, extra_env=None):
+    env = dict(os.environ,
+               REDCLIFF_FAULT_MARKER=str(tmp_path / "fault.marker"))
+    env.pop(remesh.ENV_MESH_DEVICES, None)
+    env.pop("REDCLIFF_WATCHDOG", None)
+    if fault:
+        env["REDCLIFF_FAULT_INJECT"] = fault
+    else:
+        env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.update(extra_env or {})
+    ledger = str(tmp_path / "run_ledger.jsonl")
+    child = CHILD + ["--checkpoint-dir", str(ck), "--mesh",
+                     "--grid-size", "8", "--max-iter", str(max_iter)]
+    if result:
+        child += ["--result", str(result)]
+    cmd = [sys.executable, "-m", "redcliff_tpu.supervise",
+           "--ledger", ledger, "--max-restarts", "3",
+           "--base-delay-s", "0.05",
+           "--mesh-devices", "8", "--n-hosts", "4", "--device-kind", "cpu",
+           "--"] + child
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    recs = [json.loads(l) for l in open(ledger)]
+    return proc, recs
+
+
+def test_host_drop_supervised_remesh_acceptance(tmp_path,
+                                                degraded_reference):
+    """THE host-fault acceptance: a simulated host partition (host 3 of a
+    4-host x 2-device mesh) dies at the end of epoch 1, mid-grid. The child
+    exits with the host_lost taxonomy code, the supervisor classifies it,
+    degrades the commanded mesh 8 -> 6 devices (ledger ``remesh`` event,
+    per-attempt mesh shapes), and the restarted child re-shards the
+    checkpointed lanes onto the survivors (metrics ``remesh`` event) and
+    finishes — with per-lane decision streams and the final GridResult,
+    under original point ids, bit-identical to an uninterrupted run at the
+    degraded width."""
+    ck = tmp_path / "ck"
+    res_path = tmp_path / "res.pkl"
+    proc, recs = _run_supervised_mesh(tmp_path, ck, "host_drop:3:1",
+                                      result=res_path)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    attempts = [r for r in recs if r["event"] == "attempt"]
+    assert attempts[0]["rc"] == EXIT_HOST_LOST
+    assert attempts[0]["classification"] == "host_lost"
+    assert attempts[0]["action"] == "remesh_restart"
+    assert attempts[0]["mesh"] == {"n_hosts": 4, "n_devices": 8,
+                                   "device_kind": "cpu"}
+    assert attempts[-1]["classification"] == "clean"
+    assert attempts[-1]["mesh"] == {"n_hosts": 3, "n_devices": 6,
+                                    "device_kind": "cpu"}
+    remeshes = [r for r in recs if r["event"] == "remesh"]
+    assert [(r["from_devices"], r["to_devices"]) for r in remeshes] \
+        == [(8, 6)]
+    # the resumed child re-sharded 8 -> 12 and said so in metrics.jsonl
+    events = [json.loads(l) for l in open(ck / "metrics.jsonl")]
+    rem = [e for e in events if e.get("event") == "remesh"]
+    assert rem and rem[0]["from_width"] == 8 and rem[0]["to_width"] == 12
+    assert rem[0]["lanes_migrated"] == 8
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    _assert_decisions_match(got, degraded_reference)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault", ["device_lost:1", "coordinator_loss:1"])
+def test_device_and_coordinator_loss_supervised(tmp_path, fault,
+                                                degraded_reference):
+    """The other two detection routes end-to-end: an XLA-shaped device-loss
+    / coordinator-timeout error is mapped to the typed HostLostError by the
+    grid engine, exits 21, and the supervised re-mesh resume completes
+    identically. (Tier-1 covers the mapping in-process and the host_drop
+    route through the supervisor; these ride the slow tier.)"""
+    ck = tmp_path / "ck"
+    res_path = tmp_path / "res.pkl"
+    proc, recs = _run_supervised_mesh(tmp_path, ck, fault, result=res_path)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    attempts = [r for r in recs if r["event"] == "attempt"]
+    assert attempts[0]["classification"] == "host_lost"
+    assert attempts[0]["action"] == "remesh_restart"
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    _assert_decisions_match(got, degraded_reference)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: strict bitwise leg + host-fault chaos soak
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_host_drop_acceptance_strict_legacy_runtime(tmp_path):
+    """The acceptance property on the OTHER CPU runtime (legacy, the
+    width-stable one the PR-5 strict compaction leg uses): decision streams
+    and the final GridResult stay BITWISE across the re-mesh. Params are
+    float-tight, not bitwise: unlike a same-mesh compaction (where epochs
+    before the width change ran on the identical device layout), a re-mesh
+    changes the PER-DEVICE shard width mid-history (1 lane/device on the
+    8-mesh epochs vs 2 on the 6-mesh), and measured on this container the
+    legacy runtime rounds ~1 ulp across shard layouts (23/768 elements,
+    <=1.5e-8 on the probe shape) — while the thunk runtime is exact on the
+    same shape (tier-1 test above). Decision-level bitwise holds on BOTH."""
+    env_extra = {"XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_cpu_use_thunk_runtime=false").strip()}
+    ck = tmp_path / "ck"
+    res_path = tmp_path / "res.pkl"
+    proc, recs = _run_supervised_mesh(tmp_path, ck, "host_drop:3:1",
+                                      result=res_path, extra_env=env_extra)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    # uninterrupted degraded-width reference under the SAME runtime
+    ref_path = tmp_path / "ref.pkl"
+    env = dict(os.environ, **env_extra)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env[remesh.ENV_MESH_DEVICES] = "6"
+    ref = subprocess.run(
+        CHILD + ["--checkpoint-dir", str(tmp_path / "ck_ref"), "--mesh",
+                 "--grid-size", "8", "--max-iter", "3",
+                 "--result", str(ref_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    with open(res_path, "rb") as f:
+        got = pickle.load(f)
+    with open(ref_path, "rb") as f:
+        want = pickle.load(f)
+    np.testing.assert_array_equal(got["val_history"], want["val_history"])
+    np.testing.assert_array_equal(got["best_criteria"],
+                                  want["best_criteria"])
+    np.testing.assert_array_equal(got["best_epoch"], want["best_epoch"])
+    np.testing.assert_array_equal(got["active"], want["active"])
+    assert got["failures"] == want["failures"]
+    for a, b in zip(got["best_params_leaves"], want["best_params_leaves"]):
+        # ~1 ulp across per-device shard layouts (see docstring)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(10)))
+def test_host_fault_chaos_soak(tmp_path, seed):
+    """The host-drop chaos soak: seeded schedules across the host-fault
+    grammar (host_drop / device_lost / coordinator_loss, optionally over
+    degraded storage) must all terminate clean under supervision with a
+    complete ledger — every host_lost classified, every restart re-meshed,
+    and the final durable checkpoint intact."""
+    from redcliff_tpu.runtime import checkpoint as rck
+
+    schedule = random_host_fault_schedule(seed)
+    ck = tmp_path / "ck"
+    proc, recs = _run_supervised_mesh(tmp_path, ck, schedule)
+    assert proc.returncode == 0, (schedule, proc.stderr[-3000:])
+    attempts = [r for r in recs if r["event"] == "attempt"]
+    finals = [r for r in recs if r["event"] == "final"]
+    assert len(finals) == 1 and finals[0]["classification"] == "clean"
+    for a in attempts[:-1]:
+        assert a["classification"] == "host_lost", (schedule, attempts)
+        assert a["action"] == "remesh_restart"
+    assert attempts[-1]["classification"] == "clean"
+    ckpt, _ = rck.load_checkpoint(str(ck / "grid_checkpoint.pkl"))
+    assert ckpt is not None and ckpt["epoch"] == 2
