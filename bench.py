@@ -46,6 +46,10 @@ Extra context fields (so "fast" is judgeable against hardware capability):
                     round whose "cold" sample itself warm-started from a
                     previous run's cache — the cross-run win, reported
                     rather than hidden
+  obs_overhead_pct — telemetry-spine cost (redcliff_tpu/obs): tracing-on vs
+                    tracing-off throughput of the compiled grid step through
+                    the engine's dispatch chokepoint (per-dispatch span +
+                    flight ring). Contract: <= 2% on, ~0 off
   probe_log       — every accelerator probe attempt (the axon TPU tunnel hangs
                     intermittently for minutes; attempts spread with backoff)
   probe_retry     — fixed-schema outcome of the shared probe retry policy
@@ -736,6 +740,75 @@ def _bench_compile_cache(jax, runner, compile_args):
     }
 
 
+def _bench_obs_overhead(jax, runner, grid_state, steps=30, calls=4000):
+    """obs_overhead_pct: the telemetry spine's cost on the hot path.
+
+    Two measurements through the engine's dispatch chokepoint
+    (``_call_cold`` -> per-dispatch span -> flight ring):
+
+    1. the spine's PER-DISPATCH cost in isolation — ``_call_cold`` around a
+       no-op callable, tracing on vs off (``redcliff_tpu.obs.set_enabled``),
+       averaged over ``calls`` iterations. Differencing two full-dispatch
+       throughput legs instead would report this container's run-to-run
+       step noise (measured at +-25%), orders of magnitude above the
+       spine's µs-level cost;
+    2. the real compiled grid step's time (one short run, tracing off).
+
+    ``pct`` = span cost / step time. The spine's contract is <= 2% with
+    tracing on and ~0 off (ISSUE 7 acceptance; docs/ARCHITECTURE.md
+    "Telemetry spine") — this probe pins it in every BENCH_r* round."""
+    import jax.numpy as jnp
+
+    from redcliff_tpu import obs
+    from redcliff_tpu.runtime.numerics import init_numerics_state
+
+    noop = lambda: None
+    key_noop = ("obs_probe_noop",)
+
+    def per_call_us(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            runner._call_cold(key_noop, noop)
+        return (time.perf_counter() - t0) / n * 1e6
+
+    p0, a0, b0, coeffs, X, Y = grid_state
+    G = int(jax.tree.leaves(coeffs)[0].shape[0])
+    # the donated-buffer step consumes its inputs; probe on private copies
+    p = jax.tree.map(jnp.copy, p0)
+    a = jax.tree.map(jnp.copy, a0)
+    b = jax.tree.map(jnp.copy, b0)
+    ns = init_numerics_state(lanes=G)
+    active = jnp.ones((G,), dtype=bool)
+    step = runner._steps["combined"]
+    key = ("obs_probe", "combined", G)
+
+    was = obs.enabled()
+    try:
+        obs.set_enabled(True)
+        per_call_us(100)  # warm the cold path + span machinery
+        on_us = per_call_us(calls)
+        obs.set_enabled(False)
+        per_call_us(100)
+        off_us = per_call_us(calls)
+        # real step time, tracing off (the denominator)
+        p, a, b, ns = runner._call_cold(key, step, p, a, b, ns, coeffs,
+                                        active, X, Y)[:4]  # warm compile
+        jax.block_until_ready(p)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, a, b, ns = runner._call_cold(key, step, p, a, b, ns,
+                                            coeffs, active, X, Y)[:4]
+        jax.block_until_ready(p)
+        step_us = (time.perf_counter() - t0) / steps * 1e6
+    finally:
+        obs.set_enabled(was)
+    span_us = max(on_us - off_us, 0.0)
+    return {"pct": round(100.0 * span_us / step_us, 4),
+            "span_cost_us": round(span_us, 3),
+            "disabled_cost_us": round(off_us, 3),
+            "step_us": round(step_us, 1), "steps": steps, "calls": calls}
+
+
 def _bench_ckpt_stall(jax, grid_state):
     """Main-thread checkpoint cost, async hand-off vs synchronous write, on
     the headline grid state: async_ms is what the train loop actually stalls
@@ -916,6 +989,14 @@ def _measure(platform):
         compile_cache = {"error": f"{type(e).__name__}: {e}",
                          "dir": compile_cache_dir}
 
+    # telemetry-spine overhead (redcliff_tpu/obs): tracing-on vs tracing-off
+    # throughput through the engine's dispatch chokepoint, every round
+    try:
+        obs_overhead = _bench_obs_overhead(jax, headline["runner"],
+                                           headline["state"])
+    except Exception as e:  # never fail the bench over the obs probe
+        obs_overhead = {"error": f"{type(e).__name__}: {e}"}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -942,6 +1023,8 @@ def _measure(platform):
         "compaction": compaction_probe,
         "remesh": remesh_probe,
         "compile_cache": compile_cache,
+        "obs_overhead_pct": obs_overhead.get("pct"),
+        "obs_overhead": obs_overhead,
         "error": None,
     })
 

@@ -38,9 +38,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
+from redcliff_tpu import obs as _obs
 from redcliff_tpu.runtime import faultinject as _faultinject
 from redcliff_tpu.runtime import watchdog as _watchdog
 
@@ -220,8 +222,16 @@ def prefetch_batches(iterator, depth=2, put=None):
                     return
                 _watchdog.stamp("prefetch")
                 _faultinject.hang_point("prefetch")
-                if put is not None:
-                    item = tuple(None if x is None else put(x) for x in item)
+                # traced fill span (ring-only): the transform/device_put
+                # half of producing one batch — a post-mortem flight record
+                # shows what the prefetcher was filling when it wedged.
+                # Enqueue-waiting on a full queue is deliberately outside
+                # the span (a blocked-on-slow-consumer worker is healthy)
+                with _obs.span("prefetch.fill", component="prefetch"):
+                    if put is not None:
+                        item = tuple(None if x is None else put(x)
+                                     for x in item)
+                _obs.counters.add("prefetch_items", 1)
                 if not put_blocking(item):
                     return
             put_blocking(END)
@@ -239,7 +249,18 @@ def prefetch_batches(iterator, depth=2, put=None):
     t.start()
     try:
         while True:
+            # consumer-side stall accounting: time blocked on an empty
+            # queue IS the pipeline's un-overlapped fill cost. Counted into
+            # obs.counters (the grid folds it into dispatch_stats.
+            # prefetch_stall_ms); stalls > 1 ms also land in the prefetch
+            # flight ring
+            t_get0 = time.perf_counter()
             item = q.get()
+            wait_ms = (time.perf_counter() - t_get0) * 1e3
+            _obs.counters.add("prefetch_stall_ms", wait_ms)
+            if wait_ms > 1.0:
+                _obs.record_span("prefetch.stall", wait_ms,
+                                 component="prefetch")
             if item is END:
                 return
             if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:

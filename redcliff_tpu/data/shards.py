@@ -21,6 +21,7 @@ import warnings
 
 import numpy as np
 
+from .. import obs as _obs
 from ..runtime import faultinject as _faultinject
 from ..runtime import watchdog as _watchdog
 from ..utils.misc import flatten_directed_spectrum_features
@@ -217,8 +218,12 @@ class ShardedBatchDataset:
         _faultinject.hang_point("shard_loader")
         _faultinject.io_point("shard_read")
         try:
-            with open(os.path.join(self.split_dir, name), "rb") as f:
-                pairs = pickle.load(f)
+            # traced load span (ring-only, under the heartbeat's component
+            # name): a flight record after a wedged/slow storage incident
+            # shows which shard files were read last and how long each took
+            with _obs.span("shard.load", component=self._hb, file=name):
+                with open(os.path.join(self.split_dir, name), "rb") as f:
+                    pairs = pickle.load(f)
         except (OSError, pickle.UnpicklingError, EOFError, ValueError,
                 AttributeError, ImportError, IndexError) as e:
             # torn/truncated/vanished shard: quarantine the FILE and keep

@@ -1,0 +1,58 @@
+"""redcliff_tpu.obs — the telemetry spine (docs/ARCHITECTURE.md "Telemetry
+spine").
+
+One instrumentation layer every subsystem reports through (the
+production-monitoring shape of large-scale ML systems, arXiv:1605.08695):
+
+* :mod:`.spans` — lifecycle trace spans (monotonic + wall clocks, pid/host,
+  parent propagation) and cross-thread counters; zero-cost when disabled
+  (``REDCLIFF_TRACE=0``), never a host sync;
+* :mod:`.flight` — the crash flight recorder: bounded in-memory rings of
+  each component's last spans/events, dumped as ``flight_record.json`` on
+  hang / host-loss / numerics-abort escalation;
+* :mod:`.logging` — strict-JSON ``metrics.jsonl`` writing (seq/pid/host
+  identity on every record, size-capped rotation) and crash-tolerant
+  reading (torn lines skipped and counted);
+* :mod:`.schema` — the versioned event-schema registry + validator (the
+  tier-1 tripwire validates every emitted event against it);
+* :mod:`.report` — the run-analytics CLI: ``python -m redcliff_tpu.obs
+  report <run_dir>``.
+
+Import discipline: this ``__init__`` (and ``spans``/``flight``/``schema``)
+is stdlib-only — the watchdog, the supervisor, and bench.py's backend-free
+parent import it safely; numpy-using pieces (``logging``, ``report``) load
+lazily on first attribute access.
+"""
+from __future__ import annotations
+
+from redcliff_tpu.obs import flight, schema, spans  # noqa: F401 (stdlib-only)
+from redcliff_tpu.obs.spans import COUNTERS as counters  # noqa: F401
+from redcliff_tpu.obs.spans import (NOOP, Span, enabled, record_span,  # noqa: F401
+                                    set_enabled, span)
+
+__all__ = [
+    "span", "record_span", "Span", "NOOP", "enabled", "set_enabled",
+    "counters",
+    "flight", "schema", "spans",
+    "MetricLogger", "jsonable", "read_jsonl", "jsonl_files",
+    "profiler_trace", "build_report", "render_text",
+]
+
+_LAZY = {
+    "MetricLogger": "redcliff_tpu.obs.logging",
+    "jsonable": "redcliff_tpu.obs.logging",
+    "read_jsonl": "redcliff_tpu.obs.logging",
+    "jsonl_files": "redcliff_tpu.obs.logging",
+    "profiler_trace": "redcliff_tpu.obs.logging",
+    "build_report": "redcliff_tpu.obs.report",
+    "render_text": "redcliff_tpu.obs.report",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,7 @@
+"""``python -m redcliff_tpu.obs report <run_dir>`` — run-analytics CLI."""
+import sys
+
+from redcliff_tpu.obs.report import main
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,263 @@
+"""Structured jsonl metric logging + the opt-in jax.profiler trace hook.
+
+The write half of the telemetry spine (docs/ARCHITECTURE.md "Telemetry
+spine"): every engine appends strict-JSON event records to
+``<save_dir>/metrics.jsonl`` through :class:`MetricLogger`, and every record
+carries the schema-v1 identity triple — a process-wide monotonic ``seq``,
+``pid``, and ``host`` — so interleaved multi-attempt / multi-host logs have
+a total order per process (order across processes by ``(host, pid, seq)``
+plus ``wall_time``). Files rotate at a byte cap
+(``REDCLIFF_METRICS_MAX_BYTES`` / the ``max_bytes`` knob) to
+``metrics.jsonl.1`` … so chaos soaks and week-long sweeps cannot grow one
+file unbounded.
+
+The read half is crash-tolerant: :func:`read_jsonl` walks the rotation
+chain oldest-first and SKIPS unparseable lines instead of raising — a
+SIGKILL mid-append leaves a torn final line, which used to poison the whole
+file for every reader; now it is skipped and counted (``stats`` out-param),
+so post-mortem tooling reads everything the run managed to flush. Event
+schemas are registered in :mod:`redcliff_tpu.obs.schema`.
+
+numpy at module scope (for :func:`jsonable`) but never jax — bench.py's
+backend-free parent imports this path.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import math
+import os
+import re
+import threading
+import time
+from dataclasses import asdict, is_dataclass
+
+import numpy as np
+
+from redcliff_tpu.obs import spans as _spans
+
+__all__ = ["MetricLogger", "profiler_trace", "jsonable", "read_jsonl",
+           "jsonl_files", "ENV_MAX_BYTES", "DEFAULT_MAX_BACKUPS"]
+
+ENV_MAX_BYTES = "REDCLIFF_METRICS_MAX_BYTES"
+DEFAULT_MAX_BACKUPS = 8
+
+# process-wide event sequence: one counter shared by every logger in the
+# process, so (pid, seq) totally orders a process's records even when two
+# loggers (e.g. a fit's and the watchdog's) interleave on different files
+_seq = itertools.count(1)
+
+
+def jsonable(v):
+    """Recursively coerce numpy/jax scalars and arrays into STRICT
+    JSON-encodable Python values. Arrays become (nested) lists; non-finite
+    floats (NaN/inf, scalar or array element) become ``None`` — the emitted
+    lines never contain the JSON-standard-breaking ``NaN``/``Infinity``
+    tokens."""
+    if isinstance(v, bool) or v is None or isinstance(v, (int, str)):
+        return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if is_dataclass(v) and not isinstance(v, type):
+        return {k: jsonable(x) for k, x in asdict(v).items()}
+    if isinstance(v, dict):
+        return {str(k): jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [jsonable(x) for x in v]
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        f = float(v)
+        return f if math.isfinite(f) else None
+    if hasattr(v, "ndim"):  # numpy / jax arrays without importing jax here
+        arr = np.asarray(v)
+        if arr.ndim == 0:
+            return jsonable(arr.item())
+        return [jsonable(x) for x in arr.tolist()]
+    return str(v)
+
+
+class MetricLogger:
+    """Append-only jsonl metric writer.
+
+    ``MetricLogger(save_dir)`` writes to ``<save_dir>/metrics.jsonl``;
+    ``MetricLogger(None)`` is a no-op sink so call sites never branch.
+    Resumed runs keep appending to the same file — the ``epoch`` field makes
+    replays self-describing, and the ``seq``/``pid``/``host`` identity
+    triple stamped on every record totally orders interleaved attempts.
+
+    Rotation: when ``max_bytes`` (default: the ``REDCLIFF_METRICS_MAX_BYTES``
+    env var; 0/unset = never rotate) is exceeded after a write, the file
+    rotates — ``metrics.jsonl`` -> ``metrics.jsonl.1``, shifting existing
+    backups up and dropping the oldest past ``max_backups``. Records are
+    never split across the rotation boundary (whole lines only), and
+    :func:`read_jsonl` reads the chain back oldest-first.
+    """
+
+    def __init__(self, target, filename="metrics.jsonl", resume=True,
+                 max_bytes=None, max_backups=DEFAULT_MAX_BACKUPS):
+        self._fh = None
+        # the liveness watchdog logs hang incidents from its own thread
+        # while the fit loop logs epochs; serialized writes keep every
+        # jsonl line intact (a torn line would break strict-JSON readers)
+        self._lock = threading.Lock()
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(ENV_MAX_BYTES, "0")) or None
+            except ValueError:
+                max_bytes = None
+        self.max_bytes = max_bytes
+        self.max_backups = max(int(max_backups), 1)
+        self._pid = os.getpid()
+        self._host = _spans.HOST
+        if target is None:
+            return
+        path = target
+        if not str(target).endswith(".jsonl"):
+            os.makedirs(target, exist_ok=True)
+            path = os.path.join(target, filename)
+        else:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self._fh = open(path, "a" if resume else "w")
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+
+    @property
+    def active(self):
+        return self._fh is not None
+
+    def log(self, event, **fields):
+        if self._fh is None:
+            return
+        rec = {"event": event, "wall_time": time.time(),
+               "seq": next(_seq), "pid": self._pid, "host": self._host}
+        rec.update({k: jsonable(v) for k, v in fields.items()})
+        # allow_nan=False is the strictness backstop: jsonable already maps
+        # non-finite floats to null, so a violation here is a bug, not data
+        line = json.dumps(rec, allow_nan=False) + "\n"
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line)
+                self._fh.flush()
+                self._bytes += len(line)
+                if self.max_bytes and self._bytes > self.max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self):
+        """Rotate under the held lock: close, shift the backup chain up
+        (dropping the oldest), reopen fresh. Rotation is best-effort: if the
+        head rename fails (e.g. the directory lost write permission — rename
+        needs it, appending to the existing file does not), the file is
+        reopened for APPEND, never truncated — a failed rotation may grow
+        the file past the cap but can never destroy recorded telemetry."""
+        self._fh.close()
+        rotated = False
+        try:
+            oldest = f"{self.path}.{self.max_backups}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.max_backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            rotated = True
+        except OSError:
+            pass  # appending must keep working
+        self._fh = open(self.path, "w" if rotated else "a")
+        if rotated:
+            self._bytes = 0
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def jsonl_files(path):
+    """The rotation chain for a jsonl path (or a run dir), oldest first:
+    ``[path.N, ..., path.1, path]`` — only files that exist. The base path
+    is always last so readers see records in write order."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    rotated = []
+    parent = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    try:
+        for name in os.listdir(parent):
+            m = pat.match(name)
+            if m:
+                rotated.append((int(m.group(1)), os.path.join(parent, name)))
+    except OSError:
+        pass
+    out = [p for _, p in sorted(rotated, reverse=True)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_jsonl(path, event=None, stats=None, strict=False):
+    """Load a metrics.jsonl file (optionally filtered by event type),
+    following the rotation chain oldest-first.
+
+    Crash-tolerant by default: a line that fails to parse — the torn final
+    line a SIGKILL mid-append leaves behind, or a line truncated by disk
+    full — is SKIPPED and counted instead of poisoning the whole file.
+    Pass a dict as ``stats`` to receive ``{"files", "records",
+    "torn_lines"}``; ``strict=True`` restores raise-on-bad-line.
+    """
+    files = jsonl_files(path)
+    if not files:
+        # preserve the pre-rotation contract: a missing file raises
+        raise FileNotFoundError(
+            path if str(path).endswith(".jsonl")
+            else os.path.join(path, "metrics.jsonl"))
+    out = []
+    torn = 0
+    for fpath in files:
+        with open(fpath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    if strict:
+                        raise
+                    torn += 1
+                    continue
+                if event is None or rec.get("event") == event:
+                    out.append(rec)
+    if stats is not None:
+        stats.update(files=files, records=len(out), torn_lines=torn)
+    return out
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir):
+    """Opt-in ``jax.profiler.trace`` context. ``log_dir=None`` is a no-op, so
+    trainers wrap their epoch loops unconditionally and profiling turns on by
+    setting ``profile_dir`` in the train config."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(str(log_dir)):
+        yield

@@ -1,0 +1,394 @@
+"""Run-analytics CLI: join a run directory's telemetry into one summary.
+
+``python -m redcliff_tpu.obs report <run_dir>`` reads everything the spine
+wrote — ``metrics.jsonl`` (rotation chain, torn lines tolerated),
+``run_ledger.jsonl`` (supervisor attempts), the checkpointed
+``dispatch_stats`` inside ``grid_checkpoint.pkl``, and any
+``flight_record.json`` — and produces:
+
+* a per-run summary: wall time in compile / train dispatch / val dispatch /
+  checkpoint stall / prefetch stall, lane-epochs by G-bucket, the
+  compaction/remesh history, quarantine + numerics skip/rollback counts,
+  supervisor attempt classifications;
+* a machine-readable per-(shape, G-bucket) **cost table** — observed epoch
+  step cost and compile cost per compiled program family. This table is the
+  training input for ROADMAP item 4's learned cost model (choose bucket
+  ladders/compaction points by predicted wall-clock) and item 1's admission
+  planner (pack requests into G-buckets the mesh can absorb);
+* a schema audit: every record validated against the versioned registry
+  (:mod:`redcliff_tpu.obs.schema`), torn-line counts per file.
+
+``--json`` prints the full report as one JSON object; ``-o PATH`` writes it.
+The builder is importable (:func:`build_report`) for tests and services.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from redcliff_tpu.obs import schema as _schema
+from redcliff_tpu.obs.logging import read_jsonl
+
+__all__ = ["build_report", "render_text", "main", "LEDGER_NAME"]
+
+LEDGER_NAME = "run_ledger.jsonl"
+
+# dispatch_stats keys summed across attempts for the time breakdown
+_SUM_STATS = ("train_dispatches", "val_dispatches", "epochs", "compactions",
+              "remeshes", "lane_epochs", "lane_epochs_nominal",
+              "compile_ms", "compiles", "cache_hits", "cache_misses",
+              "ckpt_stall_ms", "ckpt_barrier_stall_ms", "prefetch_stall_ms",
+              "prefetch_items", "train_time_ms", "val_time_ms")
+
+
+def _shape_key(shape):
+    if not isinstance(shape, dict) or not shape:
+        return "unknown"
+    return ",".join(f"{k}={shape[k]}" for k in sorted(shape))
+
+
+def _read_ledger(run_dir, stats):
+    path = os.path.join(run_dir, LEDGER_NAME)
+    if not os.path.exists(path):
+        return []
+    return read_jsonl(path, stats=stats)
+
+
+def _checkpoint_stats(run_dir):
+    """dispatch_stats snapshot stored in the newest grid checkpoint
+    generation, or None (older checkpoints / no checkpoint / no numpy)."""
+    path = os.path.join(run_dir, "grid_checkpoint.pkl")
+    if not os.path.exists(path):
+        return None
+    try:
+        from redcliff_tpu.runtime import checkpoint as durable_ckpt
+
+        ckpt, _src = durable_ckpt.load_checkpoint(path,
+                                                  allow_quarantine=False)
+        if isinstance(ckpt, dict):
+            return ckpt.get("dispatch_stats")
+    except Exception:  # noqa: BLE001 — a torn checkpoint must not kill
+        return None    # the report; the metrics chain still has the story
+    return None
+
+
+def build_report(run_dir):
+    """Aggregate one run directory's telemetry into a plain-dict report
+    (strict-JSON-able; see module docstring for the sections)."""
+    mstats, lstats = {}, {}
+    try:
+        records = read_jsonl(run_dir, stats=mstats)
+    except FileNotFoundError:
+        records = []
+        mstats = {"files": [], "records": 0, "torn_lines": 0}
+    ledger = _read_ledger(run_dir, lstats)
+
+    fits = []
+    cur = None            # current fit context: {"shape_key", "shape", ...}
+    cost = {}             # (shape_key, g_bucket) -> accumulators
+    compactions, remeshes, failures, hangs = [], [], [], []
+    anomalies = rollbacks = aborts = skipped_steps = 0
+    quarantined = 0
+    stats_sum = {k: 0 for k in _SUM_STATS}
+    t_first = t_last = None
+
+    # two epoch-count sources per (shape, width): EXACT per-width
+    # accumulators from fit_end's dispatch_stats (the grid counts every
+    # epoch), and SAMPLED counts from `epoch` events (the grid only emits
+    # those on the check_every cadence — up to check_every x fewer than ran;
+    # the trainers emit every epoch, so sampling is exact there). Exact wins
+    # whenever present
+    def _cost(shape_key, width):
+        key = (shape_key, int(width))
+        if key not in cost:
+            cost[key] = {"epochs_sampled": 0, "epoch_ms_sampled": 0.0,
+                         "epochs_exact": 0, "epoch_ms_exact": 0.0,
+                         "compiles": 0, "compile_ms": 0.0, "cache_hits": 0,
+                         "cache_misses": 0}
+        return cost[key]
+
+    for rec in records:
+        ev = rec.get("event")
+        wt = rec.get("wall_time")
+        if isinstance(wt, (int, float)):
+            t_first = wt if t_first is None else min(t_first, wt)
+            t_last = wt if t_last is None else max(t_last, wt)
+        if ev == "fit_start":
+            cur = {"model": rec.get("model"),
+                   "shape": rec.get("shape"),
+                   "shape_key": _shape_key(rec.get("shape")),
+                   "grid_size": rec.get("grid_size"),
+                   "grid_width": rec.get("grid_width"),
+                   "stream_mode": rec.get("stream_mode"),
+                   "resumed_from_epoch": rec.get("resumed_from_epoch"),
+                   "mesh": rec.get("mesh")}
+            fits.append(cur)
+        elif ev == "epoch":
+            width = rec.get("grid_width") or 1
+            if isinstance(rec.get("epoch_ms"), (int, float)):
+                c = _cost(cur["shape_key"] if cur else "unknown", width)
+                c["epochs_sampled"] += 1
+                c["epoch_ms_sampled"] += rec["epoch_ms"]
+            skipped_steps = max(skipped_steps,
+                                rec.get("guarded_steps_skipped") or 0)
+        elif ev == "compile":
+            width = rec.get("grid_width") or (cur or {}).get("grid_width") \
+                or 1
+            c = _cost(cur["shape_key"] if cur else "unknown", width)
+            c["compiles"] += rec.get("programs") or 0
+            c["compile_ms"] += rec.get("compile_ms") or 0.0
+            c["cache_hits"] += rec.get("cache_hits") or 0
+            c["cache_misses"] += rec.get("cache_misses") or 0
+        elif ev == "compaction":
+            compactions.append({k: rec.get(k) for k in
+                                ("epoch", "from_width", "to_width",
+                                 "lanes_live", "retired")})
+            if cur is not None:
+                cur["grid_width"] = rec.get("to_width")
+        elif ev == "remesh":
+            remeshes.append({k: rec.get(k) for k in
+                             ("epoch", "from_width", "to_width",
+                              "from_devices", "to_devices",
+                              "lanes_migrated", "plan_ms")})
+        elif ev == "anomaly":
+            anomalies += 1
+        elif ev == "numerics":
+            if rec.get("kind") == "rollback":
+                rollbacks += 1
+            elif rec.get("kind") == "abort":
+                aborts += 1
+        elif ev == "fit_end":
+            ds = rec.get("dispatch_stats")
+            if isinstance(ds, dict):
+                for k in _SUM_STATS:
+                    v = ds.get(k)
+                    if isinstance(v, (int, float)):
+                        stats_sum[k] += v
+                # exact per-width epoch/step-cost accumulators (every epoch
+                # counted, not just the check-window-sampled ones)
+                em = ds.get("epoch_ms_by_width") or {}
+                sk = cur["shape_key"] if cur else "unknown"
+                for w, n in (ds.get("epochs_by_width") or {}).items():
+                    c = _cost(sk, int(w))
+                    c["epochs_exact"] += int(n)
+                    c["epoch_ms_exact"] += float(em.get(w, 0.0))
+            for f in rec.get("failures") or []:
+                failures.append(f)
+            quarantined += len(rec.get("failures") or [])
+        elif ev in ("hang", "host_lost", "hang_exit", "host_lost_exit"):
+            hangs.append({"event": ev,
+                          "components": sorted(rec.get("components") or {}),
+                          "exit_code": rec.get("exit_code")})
+
+    ck_stats = _checkpoint_stats(run_dir)
+
+    attempts = [r for r in ledger if r.get("event") == "attempt"]
+    classes = {}
+    for a in attempts:
+        c = a.get("classification") or "?"
+        classes[c] = classes.get(c, 0) + 1
+    final = next((r for r in reversed(ledger)
+                  if r.get("event") == "final"), None)
+
+    cost_table = []
+    by_bucket = {}
+    for (sk, width), acc in sorted(cost.items()):
+        exact = acc["epochs_exact"] > 0
+        n = acc["epochs_exact"] if exact else acc["epochs_sampled"]
+        ms = acc["epoch_ms_exact"] if exact else acc["epoch_ms_sampled"]
+        cost_table.append(
+            {"shape": sk, "g_bucket": width, "epochs": n,
+             "mean_epoch_ms": round(ms / n, 3) if n else None,
+             "total_epoch_ms": round(ms, 3),
+             # sampled=True: epoch counts/times come from check-window
+             # `epoch` events only (the emitting fit never wrote its
+             # dispatch_stats — e.g. it crashed before fit_end), so they
+             # undercount by up to check_every
+             "sampled": not exact,
+             "compiles": acc["compiles"],
+             "compile_ms": round(acc["compile_ms"], 3),
+             "cache_hits": acc["cache_hits"],
+             "cache_misses": acc["cache_misses"]})
+        if n:
+            by_bucket[str(width)] = by_bucket.get(str(width), 0) + n
+
+    schema_errors = _schema.validate_records(records)
+    ledger_errors = _schema.validate_records(ledger, kind="ledger")
+
+    saved = None
+    if stats_sum["lane_epochs_nominal"]:
+        saved = round(100.0 * (1 - stats_sum["lane_epochs"]
+                               / stats_sum["lane_epochs_nominal"]), 1)
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "schema_version": _schema.SCHEMA_VERSION,
+        "wall_span_s": (round(t_last - t_first, 3)
+                        if t_first is not None else None),
+        "fits": fits,
+        "attempts": {"n": len(attempts), "classifications": classes,
+                     "final": (final or {}).get("classification"),
+                     "meshes": [a.get("mesh") for a in attempts
+                                if a.get("mesh")]},
+        "time_breakdown_ms": {
+            "compile": round(stats_sum["compile_ms"], 3),
+            "train_dispatch": round(stats_sum["train_time_ms"], 3),
+            "val_dispatch": round(stats_sum["val_time_ms"], 3),
+            "ckpt_stall": round(stats_sum["ckpt_stall_ms"], 3),
+            "ckpt_barrier_stall": round(stats_sum["ckpt_barrier_stall_ms"],
+                                        3),
+            "prefetch_stall": round(stats_sum["prefetch_stall_ms"], 3),
+            # the rows are NESTED measurements, not a partition:
+            # ckpt_barrier_stall is contained in ckpt_stall (the async
+            # submit barrier runs inside the save hand-off), and cold
+            # compiles + prefetch stalls happen inside the train_dispatch
+            # wall time — summing the rows double-counts
+            "overlap_note": "nested, not disjoint: ckpt_barrier_stall "
+                            "within ckpt_stall; compile and prefetch_stall "
+                            "within train_dispatch",
+        },
+        "dispatches": {"train": int(stats_sum["train_dispatches"]),
+                       "val": int(stats_sum["val_dispatches"]),
+                       "epochs": int(stats_sum["epochs"])},
+        "lane_epochs": {"total": int(stats_sum["lane_epochs"]),
+                        "nominal": int(stats_sum["lane_epochs_nominal"]),
+                        "saved_pct": saved,
+                        "by_bucket": by_bucket},
+        "compactions": compactions,
+        "remeshes": remeshes,
+        "numerics": {"anomaly_events": anomalies,
+                     "guarded_steps_skipped": int(skipped_steps),
+                     "rollbacks": rollbacks, "aborts": aborts,
+                     "quarantined_lanes": quarantined,
+                     "failures": failures},
+        "hang_incidents": hangs,
+        "flight_records": sorted(
+            os.path.basename(p) for p in
+            glob.glob(os.path.join(run_dir, "flight_record*.json"))),
+        "checkpoint_dispatch_stats": ck_stats,
+        "cost_table": cost_table,
+        "read_audit": {
+            "metrics": mstats, "ledger": lstats,
+            "schema_errors": [
+                {"index": i, "errors": errs} for i, errs in schema_errors],
+            "ledger_schema_errors": [
+                {"index": i, "errors": errs} for i, errs in ledger_errors],
+        },
+    }
+
+
+def _fmt_ms(ms):
+    if ms is None:
+        return "-"
+    if ms >= 60_000:
+        return f"{ms / 60_000:.1f}min"
+    if ms >= 1_000:
+        return f"{ms / 1_000:.2f}s"
+    return f"{ms:.1f}ms"
+
+
+def render_text(report):
+    """Human-readable rendering of :func:`build_report` output."""
+    r = report
+    out = [f"run report: {r['run_dir']}",
+           f"  schema v{r['schema_version']}; wall span "
+           f"{_fmt_ms((r['wall_span_s'] or 0) * 1e3)}; "
+           f"{len(r['fits'])} fit attempt(s)"]
+    at = r["attempts"]
+    if at["n"]:
+        cls = ", ".join(f"{k}x{v}" for k, v in
+                        sorted(at["classifications"].items()))
+        out.append(f"  supervisor: {at['n']} attempt(s) [{cls}] -> "
+                   f"{at['final'] or '?'}")
+    tb = r["time_breakdown_ms"]
+    out.append("time breakdown (nested measurements — do not sum: barrier "
+               "within ckpt_stall; compile/prefetch within train_dispatch):")
+    for k in ("compile", "train_dispatch", "val_dispatch", "ckpt_stall",
+              "ckpt_barrier_stall", "prefetch_stall"):
+        out.append(f"  {k:<20} {_fmt_ms(tb[k])}")
+    d = r["dispatches"]
+    le = r["lane_epochs"]
+    out.append(f"dispatches: {d['train']} train / {d['val']} val over "
+               f"{d['epochs']} epoch(s)")
+    out.append(f"lane-epochs: {le['total']} of {le['nominal']} nominal"
+               + (f" ({le['saved_pct']}% saved by compaction)"
+                  if le["saved_pct"] is not None else "")
+               + f"; by bucket {le['by_bucket']}")
+    if r["compactions"]:
+        out.append(f"compactions: " + "; ".join(
+            f"epoch {c['epoch']}: {c['from_width']}->{c['to_width']}"
+            for c in r["compactions"]))
+    if r["remeshes"]:
+        out.append(f"remeshes: " + "; ".join(
+            f"epoch {c['epoch']}: {c['from_devices']}->{c['to_devices']} "
+            f"devices" for c in r["remeshes"]))
+    n = r["numerics"]
+    out.append(f"numerics: {n['anomaly_events']} anomaly event(s), "
+               f"{n['guarded_steps_skipped']} guarded step(s) skipped, "
+               f"{n['rollbacks']} rollback(s), {n['aborts']} abort(s), "
+               f"{n['quarantined_lanes']} quarantined lane(s)")
+    if r["hang_incidents"]:
+        out.append(f"hang/host-loss incidents: {len(r['hang_incidents'])} "
+                   f"(flight records: {r['flight_records'] or 'none'})")
+    out.append("cost table (per shape x G-bucket):")
+    out.append(f"  {'g_bucket':>8} {'epochs':>7} {'mean_epoch':>11} "
+               f"{'compile':>9} {'hits/miss':>10}  shape")
+    for row in r["cost_table"]:
+        # "~" marks sampled rows (check-window epoch events only — the fit
+        # never wrote its dispatch_stats, so counts undercount)
+        n = f"{row['epochs']}~" if row.get("sampled") else f"{row['epochs']}"
+        out.append(
+            f"  {row['g_bucket']:>8} {n:>7} "
+            f"{_fmt_ms(row['mean_epoch_ms']):>11} "
+            f"{_fmt_ms(row['compile_ms']):>9} "
+            f"{row['cache_hits']:>4}/{row['cache_misses']:<5}  "
+            f"{row['shape']}")
+    if not r["cost_table"]:
+        out.append("  (no timed epochs recorded)")
+    audit = r["read_audit"]
+    torn = (audit["metrics"].get("torn_lines", 0)
+            + audit["ledger"].get("torn_lines", 0))
+    nerr = len(audit["schema_errors"]) + len(audit["ledger_schema_errors"])
+    out.append(f"read audit: {audit['metrics'].get('records', 0)} metric "
+               f"record(s), {torn} torn line(s) skipped, "
+               f"{nerr} schema violation(s)")
+    for e in (audit["schema_errors"] + audit["ledger_schema_errors"])[:5]:
+        out.append(f"  record {e['index']}: {'; '.join(e['errors'])}")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m redcliff_tpu.obs",
+        description="Telemetry-spine tooling (docs/ARCHITECTURE.md "
+                    "'Telemetry spine').")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser(
+        "report", help="join metrics.jsonl + run_ledger.jsonl + checkpointed "
+                       "dispatch_stats into a per-run summary and a "
+                       "per-(shape, G-bucket) cost table")
+    rp.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
+    rp.add_argument("--json", action="store_true",
+                    help="print the full report as one JSON object")
+    rp.add_argument("-o", "--output", default=None,
+                    help="also write the JSON report to this path")
+    args = ap.parse_args(argv)
+    if args.cmd == "report":
+        report = build_report(args.run_dir)
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(report, f, indent=2, allow_nan=False)
+                f.write("\n")
+        if args.json:
+            json.dump(report, sys.stdout, indent=2, allow_nan=False)
+            sys.stdout.write("\n")
+        else:
+            print(render_text(report))
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,236 @@
+"""Versioned event-schema registry for the telemetry spine.
+
+Every line this repo writes to ``metrics.jsonl`` (and every record in the
+supervisor's ``run_ledger.jsonl``) is one of the event types registered
+here. The registry is the *contract*: an emitter adding an event type or a
+field must register it — the tier-1 schema tripwire
+(tests/test_obs_report.py) runs a faulted supervised grid fit and validates
+every emitted record, so undocumented drift fails CI, not a 3am post-mortem.
+The full taxonomy table lives in docs/ARCHITECTURE.md "Telemetry spine".
+
+Validation is CLOSED: an unknown event name, a missing required field, or a
+field that is neither registered nor matched by one of the event's
+``patterns`` (dynamic metric families like the GC-tracker's
+``f1_t0.5_factor2``) is an error. Records from older writers may lack the
+``seq``/``pid``/``host`` identity fields (added in schema version 1) —
+readers stay backfill-tolerant, so those are optional everywhere.
+
+stdlib only.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+__all__ = ["SCHEMA_VERSION", "EventSchema", "EVENTS", "LEDGER_EVENTS",
+           "validate_record", "validate_records", "SHAPE_KEYS", "shape_desc"]
+
+SCHEMA_VERSION = 1
+
+# model-config fields that key a compiled program family: with the grid
+# width they form the (shape, G-bucket) axis of the obs report's cost table
+# (the input ROADMAP item 4's learned cost model trains on). Emitters stamp
+# the subset their model config defines into fit_start's "shape" field.
+SHAPE_KEYS = ("num_chans", "gen_lag", "embed_lag", "max_lag", "num_factors",
+              "num_supervised_factors", "gen_hidden", "embed_hidden_sizes",
+              "input_length", "num_sims")
+
+
+def shape_desc(config):
+    """The ``fit_start.shape`` dict for a model config: every
+    :data:`SHAPE_KEYS` field the config defines (non-None)."""
+    return {k: getattr(config, k) for k in SHAPE_KEYS
+            if getattr(config, k, None) is not None}
+
+# identity fields the MetricLogger stamps on every record (schema v1);
+# optional on read: pre-v1 files and third-party writers lack them
+_IDENTITY = ("seq", "pid", "host")
+
+# numerics-sentinel summary fields (runtime/numerics.py numerics_summary),
+# splatted into anomaly/numerics events by the trainers
+_NUMERICS_SUMMARY = ("skipped", "consecutive", "checked", "grad_norm_last",
+                     "grad_norm_mean", "grad_norm_std", "grad_norm_max")
+
+# hang/host-loss incident body (runtime/watchdog.py _record)
+_INCIDENT = ("components", "ages_s", "grace_s", "stacks", "host")
+
+
+@dataclass(frozen=True)
+class EventSchema:
+    """One registered event type. ``required``/``optional`` are field names
+    beyond the registry-wide core fields; ``patterns`` are regexes that
+    admit dynamic field families."""
+
+    emitter: str
+    required: frozenset = frozenset()
+    optional: frozenset = frozenset()
+    patterns: tuple = ()
+    version: int = SCHEMA_VERSION
+    _compiled: tuple = field(default=None, compare=False, repr=False)
+
+    def allows(self, name):
+        if name in self.required or name in self.optional:
+            return True
+        compiled = self._compiled
+        if compiled is None:
+            compiled = tuple(re.compile(p) for p in self.patterns)
+            object.__setattr__(self, "_compiled", compiled)
+        return any(p.match(name) for p in compiled)
+
+
+def _ev(emitter, required=(), optional=(), patterns=()):
+    return EventSchema(emitter=emitter, required=frozenset(required),
+                       optional=frozenset(optional), patterns=tuple(patterns))
+
+
+# ---------------------------------------------------------------------------
+# metrics.jsonl events. Core fields: event + wall_time required (the
+# MetricLogger stamps both), seq/pid/host optional-on-read.
+# ---------------------------------------------------------------------------
+EVENTS = {
+    "fit_start": _ev(
+        "trainers + grid engine",
+        required=("model",),
+        optional=("train_config", "resume_epoch", "training_mode", "shape",
+                  "grid_size", "grid_width", "lanes_padded", "stream_mode",
+                  "mesh", "compile_cache_dir", "resumed_from_epoch",
+                  "resumed_from", "points")),
+    "epoch": _ev(
+        "trainers + grid engine",
+        required=("epoch",),
+        optional=("phases", "criteria", "epoch_ms",
+                  # grid per-check-window fields
+                  "val_combo_loss", "best_criteria", "num_active",
+                  "lanes_live", "grid_width", "lanes_padded",
+                  "num_quarantined", "guarded_steps_skipped"),
+        patterns=(
+            # the trainers splat validate() loss parts and the GC tracker's
+            # per-threshold/per-factor oracle metrics into the record
+            r".*_loss$", r".*_penalty$", r".*_sim$",
+            r"^(f1|roc_auc|accuracy|precision|recall|deltacon0|"
+            r"deltaffinity|gc_l1|cosine_sim|confusion)_[A-Za-z0-9._\-]+$")),
+    "anomaly": _ev(
+        "numerics sentinel (trainers)",
+        required=("epoch", "cause"),
+        optional=("epoch_skipped_steps",) + _NUMERICS_SUMMARY),
+    "numerics": _ev(
+        "DivergenceMonitor (trainers)",
+        required=("epoch", "kind", "cause"),
+        optional=("restored_epoch", "lr_scale", "learning_rates",
+                  "rollbacks", "flight_record") + _NUMERICS_SUMMARY),
+    "fit_end": _ev(
+        "trainers + grid engine",
+        optional=("best_it", "best_loss", "final_val_loss", "aborted",
+                  "best_epoch", "best_criteria", "num_active", "compactions",
+                  "compile_ms", "failures", "dispatch_stats")),
+    "compile": _ev(
+        "grid engine (runtime/compileobs.py counters)",
+        required=("epoch", "programs", "compile_ms"),
+        optional=("cache_hits", "cache_misses", "grid_width")),
+    "compaction": _ev(
+        "grid engine (parallel/compaction.py)",
+        required=("epoch", "from_width", "to_width"),
+        optional=("lanes_live", "retired", "mesh_devices")),
+    "remesh": _ev(
+        "grid engine (parallel/remesh.py)",
+        required=("epoch",),
+        optional=("from_width", "to_width", "from_devices", "to_devices",
+                  "lanes_migrated", "lanes_retired", "plan_ms")),
+    "deadline_evicted": _ev(
+        "grid engine (GridSpec.fit_deadline_s)",
+        required=("epoch", "lanes"),
+        optional=("elapsed_s", "num_evicted")),
+    "early_exit_all_inactive": _ev("grid engine", required=("epoch",)),
+    "preempted_final_checkpoint": _ev(
+        "grid engine (PreemptionGuard)",
+        required=("epoch",), optional=("signum",)),
+    "grid_deadline_final_checkpoint": _ev(
+        "grid engine (GridSpec.grid_deadline_s)",
+        required=("epoch",),
+        optional=("elapsed_s", "deadline_s", "checkpointed")),
+    "hang": _ev("watchdog", required=("components",), optional=_INCIDENT),
+    "hang_exit": _ev(
+        "watchdog", required=("exit_code",), optional=_INCIDENT),
+    "host_lost": _ev(
+        "watchdog", required=("components",), optional=_INCIDENT),
+    "host_lost_exit": _ev(
+        "watchdog", required=("exit_code",), optional=_INCIDENT),
+    "span": _ev(
+        "obs.spans (emit=True call sites)",
+        required=("name", "dur_ms"),
+        optional=("span_id", "parent_id", "t_wall", "t_mono", "component",
+                  "attrs", "error")),
+    "flight_record": _ev(
+        "obs.flight (artifact file, not a jsonl line)",
+        required=("reason", "components"),
+        optional=("schema_version", "extra")),
+}
+
+# ---------------------------------------------------------------------------
+# run_ledger.jsonl events (runtime/supervisor.py): stdlib writer, no
+# wall_time core field (attempts carry started_at instead)
+# ---------------------------------------------------------------------------
+LEDGER_EVENTS = {
+    "attempt": _ev(
+        "supervisor",
+        required=("attempt", "cmd", "rc", "classification", "action"),
+        optional=("backoff_s", "started_at", "duration_s", "mesh")),
+    "remesh": _ev(
+        "supervisor",
+        required=("from_devices", "to_devices"),
+        optional=("from_hosts", "to_hosts")),
+    "final": _ev(
+        "supervisor",
+        required=("classification",), optional=("rc", "attempts")),
+}
+
+
+def _registry_for(kind):
+    if kind == "metrics":
+        return EVENTS, ("event", "wall_time")
+    if kind == "ledger":
+        return LEDGER_EVENTS, ("event",)
+    raise ValueError(f"unknown registry kind {kind!r}")
+
+
+def validate_record(rec, kind="metrics"):
+    """Validate one record against the registry; returns a list of error
+    strings (empty = valid)."""
+    registry, core_required = _registry_for(kind)
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {type(rec).__name__}"]
+    errors = []
+    name = rec.get("event")
+    if name is None:
+        return ["missing 'event' field"]
+    schema = registry.get(name)
+    if schema is None:
+        return [f"unknown event type {name!r} (register it in "
+                f"redcliff_tpu/obs/schema.py and document it in "
+                f"docs/ARCHITECTURE.md)"]
+    for f_ in core_required:
+        if f_ not in rec:
+            errors.append(f"{name}: missing core field {f_!r}")
+    for f_ in sorted(schema.required):
+        if f_ not in rec:
+            errors.append(f"{name}: missing required field {f_!r}")
+    known_core = set(core_required) | set(_IDENTITY)
+    for f_ in rec:
+        if f_ in known_core:
+            continue
+        if not schema.allows(f_):
+            errors.append(
+                f"{name}: unregistered field {f_!r} (add it to the event's "
+                f"schema in redcliff_tpu/obs/schema.py)")
+    return errors
+
+
+def validate_records(records, kind="metrics"):
+    """Validate a sequence of records; returns ``[(index, [errors...])]``
+    for every invalid record (empty list = all valid)."""
+    out = []
+    for i, rec in enumerate(records):
+        errs = validate_record(rec, kind=kind)
+        if errs:
+            out.append((i, errs))
+    return out

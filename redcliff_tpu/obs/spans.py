@@ -1,0 +1,222 @@
+"""Lifecycle trace spans: the timing half of the telemetry spine.
+
+A *span* wraps one operation — a dispatch, a checkpoint write, a shard load,
+a compaction — and records how long it took, against both clocks (monotonic
+for durations, wall for cross-process alignment), with process/host identity
+and a propagated parent id so nested spans reconstruct the call tree in a
+post-mortem. Every finished span lands in the in-memory flight-recorder ring
+(:mod:`redcliff_tpu.obs.flight`); spans opened with ``emit=True`` and a live
+:class:`~redcliff_tpu.obs.logging.MetricLogger` additionally write one
+``span`` event to ``metrics.jsonl`` (schema: :mod:`redcliff_tpu.obs.schema`).
+
+Cost discipline (the spine's contract, pinned by bench.py's
+``obs_overhead_pct`` and the tier-1 identity tripwire):
+
+* **zero-cost when disabled** — :func:`span` returns one shared no-op
+  context after a single module-global flag check (``REDCLIFF_TRACE=0``);
+* **never a host sync** — a span measures host wall time around the
+  operation it wraps. Around an asynchronously-dispatched XLA program that
+  is *enqueue* time, by design: no ``.block_until_ready()``, no transfer,
+  ever happens inside span bookkeeping (device time stays attributable via
+  the engines' dispatch counters);
+* hot-path spans (per-dispatch) are ring-only: a dict build + deque append,
+  no I/O.
+
+Side-band counters (:class:`Counters`) accumulate cross-thread totals that
+have no natural span emission point — prefetch stall milliseconds, async
+checkpoint submit-barrier stalls — which the grid engine folds into its
+per-fit ``dispatch_stats``.
+
+stdlib only — no numpy, no jax: the watchdog and the backend-free bench
+parent import this path.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+from redcliff_tpu.obs import flight as _flight
+
+__all__ = ["span", "record_span", "enabled", "set_enabled", "Span", "NOOP",
+           "Counters", "COUNTERS", "ENV_TRACE", "PID", "HOST"]
+
+ENV_TRACE = "REDCLIFF_TRACE"
+
+# tracing defaults ON: the spine's steady-state cost is ring appends and a
+# handful of jsonl lines per check window (bench pins it <= 2% of wps);
+# REDCLIFF_TRACE=0 drops it to one flag check per span() call
+_enabled = os.environ.get(ENV_TRACE, "1").strip().lower() not in (
+    "0", "off", "false")
+
+PID = os.getpid()
+try:
+    HOST = os.uname().nodename
+except (AttributeError, OSError):  # non-posix
+    import socket
+
+    HOST = socket.gethostname()
+
+# process-wide span ids: unique within a process; (pid, span_id) is unique
+# across the run's processes (both ride every span record)
+_ids = itertools.count(1)
+_tls = threading.local()  # per-thread open-span stack (parent propagation)
+
+
+def enabled():
+    """Whether tracing is live (module-global flag; one attribute read)."""
+    return _enabled
+
+
+def set_enabled(flag):
+    """Flip tracing at runtime (bench.py's on/off overhead probe; tests).
+    Returns the new state."""
+    global _enabled
+    _enabled = bool(flag)
+    return _enabled
+
+
+class _NoopSpan:
+    """The shared disabled-tracing span: enter/exit do nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP = _NoopSpan()
+
+
+class Span:
+    """One traced operation. Use via :func:`span` as a context manager."""
+
+    __slots__ = ("name", "component", "logger", "emit", "attrs",
+                 "span_id", "parent_id", "t_wall", "t_mono", "dur_ms")
+
+    def __init__(self, name, component, logger, emit, attrs):
+        self.name = name
+        self.component = component or name.partition(".")[0]
+        self.logger = logger
+        self.emit = emit
+        self.attrs = attrs
+        self.span_id = None
+        self.parent_id = None
+        self.t_wall = None
+        self.t_mono = None
+        self.dur_ms = None
+
+    def set(self, **attrs):
+        """Attach/overwrite attributes mid-span (recorded at exit)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.parent_id = stack[-1].span_id if stack else None
+        self.span_id = next(_ids)
+        stack.append(self)
+        self.t_wall = time.time()
+        self.t_mono = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_ms = (time.perf_counter() - self.t_mono) * 1e3
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        rec = {
+            "event": "span", "name": self.name,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "t_wall": self.t_wall, "t_mono": self.t_mono,
+            "dur_ms": round(self.dur_ms, 3), "pid": PID, "host": HOST,
+        }
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        _flight.record(self.component, rec)
+        if self.emit and self.logger is not None \
+                and getattr(self.logger, "active", False):
+            emit_rec = {k: v for k, v in rec.items()
+                        if k not in ("event", "pid", "host")}
+            self.logger.log("span", **emit_rec)
+        return False
+
+
+def span(name, *, component=None, logger=None, emit=False, **attrs):
+    """Open a trace span named ``name`` (convention:
+    ``"<component>.<operation>"``, e.g. ``"grid.dispatch"``,
+    ``"ckpt.write"`` — see docs/ARCHITECTURE.md "Telemetry spine").
+
+    ``component`` keys the flight-recorder ring the finished span lands in
+    (defaults to the name's dotted head). ``emit=True`` + a live ``logger``
+    additionally writes a ``span`` event to metrics.jsonl — reserve it for
+    low-frequency lifecycle spans (check windows, compactions, remeshes);
+    hot-path spans stay ring-only. ``**attrs`` must be plain JSON-able
+    scalars/short lists. Returns the shared no-op when tracing is disabled.
+    """
+    if not _enabled:
+        return NOOP
+    return Span(name, component, logger, emit, attrs)
+
+
+def record_span(name, dur_ms, *, component=None, logger=None, emit=False,
+                t_wall=None, **attrs):
+    """Record an already-measured operation as a finished span — for call
+    sites where wrapping the block in a context manager would be awkward
+    (e.g. long engine sections timed with ``perf_counter``). Same record
+    shape and destinations as :class:`Span`; returns the record, or None
+    when tracing is disabled."""
+    if not _enabled:
+        return None
+    stack = getattr(_tls, "stack", None)
+    rec = {
+        "event": "span", "name": name,
+        "span_id": next(_ids),
+        "parent_id": stack[-1].span_id if stack else None,
+        "t_wall": t_wall if t_wall is not None else time.time(),
+        "dur_ms": round(dur_ms, 3), "pid": PID, "host": HOST,
+    }
+    if attrs:
+        rec["attrs"] = dict(attrs)
+    _flight.record(component or name.partition(".")[0], rec)
+    if emit and logger is not None and getattr(logger, "active", False):
+        logger.log("span", **{k: v for k, v in rec.items()
+                              if k not in ("event", "pid", "host")})
+    return rec
+
+
+class Counters:
+    """Thread-safe additive counters for cross-thread time accounting that
+    has no single span emission point (prefetch stall, ckpt barrier stall).
+    Engines snapshot at fit start and fold the delta into their stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c = {}
+
+    def add(self, key, value=1.0):
+        with self._lock:
+            self._c[key] = self._c.get(key, 0.0) + value
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._c)
+
+    def delta(self, before):
+        """``now - before`` for every key present now (missing = 0)."""
+        now = self.snapshot()
+        return {k: round(v - before.get(k, 0.0), 3) for k, v in now.items()}
+
+
+COUNTERS = Counters()

@@ -38,6 +38,7 @@ dispatch_stats`` records what actually ran).
 from __future__ import annotations
 
 import contextlib
+import copy
 import os
 import time
 from dataclasses import asdict, dataclass, field
@@ -59,8 +60,9 @@ from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.preempt import (DeadlineExceeded, Preempted,
                                           PreemptionGuard)
+from redcliff_tpu import obs
+from redcliff_tpu.obs import MetricLogger, profiler_trace
 from redcliff_tpu.train.freeze import apply_freeze
-from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 from redcliff_tpu.utils.precision import matmul_precision_ctx
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
@@ -577,14 +579,30 @@ class RedcliffGridRunner:
     def _call_cold(self, key, fn, *args):
         if self._seen_programs is None:
             self._seen_programs = set()
-        if key in self._seen_programs:
-            return fn(*args)
-        self._seen_programs.add(key)
-        with rt_watchdog.op_scope(rt_watchdog.COMPILE_COMPONENT):
+        cold = key not in self._seen_programs
+        if cold:
+            self._seen_programs.add(key)
+        # per-dispatch trace span: ring-only (obs.flight — the crash flight
+        # recorder's evidence of what the engine was dispatching in its last
+        # seconds), one dict + deque append when tracing is on, one flag
+        # check when off. Measures host enqueue wall time by design — no
+        # block_until_ready, no transfer (device time stays attributable via
+        # dispatch_stats' counters)
+        with obs.span("grid.dispatch", component="dispatch",
+                      kind=str(key[0]), cold=cold):
+            if cold:
+                with rt_watchdog.op_scope(rt_watchdog.COMPILE_COMPONENT):
+                    return fn(*args)
             return fn(*args)
 
     def phase_for_epoch(self, epoch):
         return phase_schedule(self.model.config, epoch)
+
+    def _shape_desc(self):
+        """fit_start's ``shape`` field: the model-config fields that key a
+        compiled program family — with the grid width, the (shape, G-bucket)
+        axis of the obs report's cost table."""
+        return obs.schema.shape_desc(self.model.config)
 
     def _align_all_points(self, params, train_ds):
         """Per-point Hungarian alignment of factors to supervised labels at the
@@ -703,10 +721,13 @@ class RedcliffGridRunner:
                            "accepted")
 
     # snapshot keys that are already host-side bookkeeping (no device
-    # gather): compaction-era state plus the scalar loop bookkeeping and the
-    # mesh-shape audit metadata
+    # gather): compaction-era state plus the scalar loop bookkeeping, the
+    # mesh-shape audit metadata, and the dispatch_stats telemetry snapshot
+    # (audit/analytics payload — like "mesh", NOT part of the resume
+    # fingerprint; the obs report CLI joins it with metrics.jsonl)
     _HOST_STATE_KEYS = ("epoch", "aligned", "rng_state", "val_history",
-                        "val_eras", "eras", "orig_ids", "retired", "mesh")
+                        "val_eras", "eras", "orig_ids", "retired", "mesh",
+                        "dispatch_stats")
 
     @staticmethod
     def _hostify(snap, meta, to_host):
@@ -731,6 +752,7 @@ class RedcliffGridRunner:
         # NOT in the fingerprint (meta), so a checkpoint from an 8-device
         # mesh resumes on 4 devices (and vice versa) without rejection
         host["mesh"] = snap.get("mesh")
+        host["dispatch_stats"] = snap.get("dispatch_stats")
         rows = [to_host(v) for v in snap["val_history"]]
         host["val_history"] = list(compaction.expand_history(
             rows, snap["val_eras"], snap["eras"], len(meta["points"])))
@@ -1003,21 +1025,26 @@ class RedcliffGridRunner:
                             and not remesh.width_fits(Gx, n_dev))
             if mesh_changed or incompatible:
                 t_plan = time.perf_counter()
-                plan = remesh.plan_resharding(
-                    np.asarray(ckpt["active"], bool), orig_ids,
-                    retired.keys(), n_dev, compact=self._compaction_on)
-                if plan is not None:
-                    migrated = remesh.apply_reshard(ckpt, retired, plan)
-                    remesh_info = {
-                        "from_width": Gx, "to_width": plan.new_width,
-                        "from_devices": ck_mesh.get("n_devices"),
-                        "to_devices": n_dev, "lanes_migrated": migrated,
-                        "lanes_retired": [int(p) for p in plan.retire_ids],
-                        "plan_ms": round(
-                            (time.perf_counter() - t_plan) * 1e3, 3),
-                    }
-                    orig_ids = np.asarray(plan.orig_ids, np.int32)
-                    Gx = plan.new_width
+                # traced span (ring-only: the MetricLogger does not exist
+                # yet this early in resume; the structured `remesh` event
+                # below carries the same numbers into metrics.jsonl)
+                with obs.span("grid.remesh", component="remesh",
+                              from_width=Gx, to_devices=n_dev):
+                    plan = remesh.plan_resharding(
+                        np.asarray(ckpt["active"], bool), orig_ids,
+                        retired.keys(), n_dev, compact=self._compaction_on)
+                    if plan is not None:
+                        migrated = remesh.apply_reshard(ckpt, retired, plan)
+                        remesh_info = {
+                            "from_width": Gx, "to_width": plan.new_width,
+                            "from_devices": ck_mesh.get("n_devices"),
+                            "to_devices": n_dev, "lanes_migrated": migrated,
+                            "lanes_retired": [int(p) for p in plan.retire_ids],
+                            "plan_ms": round(
+                                (time.perf_counter() - t_plan) * 1e3, 3),
+                        }
+                        orig_ids = np.asarray(plan.orig_ids, np.int32)
+                        Gx = plan.new_width
             if self._mesh_full is not None:
                 self.mesh = self._mesh_for(Gx)
             params = self._shard(jax.tree.map(jnp.asarray, ckpt["params"]))
@@ -1201,12 +1228,23 @@ class RedcliffGridRunner:
             "compactions": 0, "lane_epochs": 0, "lane_epochs_nominal": 0,
             "compile_ms": 0.0, "compiles": 0, "cache_hits": 0,
             "cache_misses": 0,
+            # telemetry-spine timing (redcliff_tpu/obs): host wall time in
+            # the train/val dispatch sections per epoch (enqueue time — no
+            # host sync is added to measure it), bucketed by execution
+            # width for the obs report's (shape, G-bucket) cost table, plus
+            # the cross-thread stall counters (prefetch consumer waits,
+            # async-checkpoint submit barriers) folded from obs.counters
+            "train_time_ms": 0.0, "val_time_ms": 0.0,
+            "epoch_ms_by_width": {}, "epochs_by_width": {},
+            "prefetch_stall_ms": 0.0, "prefetch_items": 0,
+            "ckpt_barrier_stall_ms": 0.0,
             # degraded-mesh resume accounting (parallel/remesh.py): count +
             # the full plan record (old/new width, lanes migrated, plan
             # latency) when THIS attempt re-sharded a checkpoint onto a
             # different mesh
             "remeshes": 1 if remesh_info else 0, "remesh": remesh_info}
         compile_t0 = compileobs.snapshot()
+        counters_t0 = obs.counters.snapshot()
         width_nominal = Gx
         # background checkpoint writer (created and scoped by fit(), which
         # joins it on EVERY exit path): pre-compile the fused donated-state
@@ -1231,6 +1269,7 @@ class RedcliffGridRunner:
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G_real,
                    grid_width=Gx, lanes_padded=stats["lanes_padded"],
                    training_mode=self.model.config.training_mode,
+                   shape=self._shape_desc(),
                    stream_mode=base_stream, mesh=mesh_desc,
                    compile_cache_dir=jax.config.jax_compilation_cache_dir,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
@@ -1251,6 +1290,10 @@ class RedcliffGridRunner:
             rt_watchdog.stamp("epoch_engine")
             epoch_width = Gx
             epoch_compile_t0 = compileobs.snapshot()
+            # per-epoch host wall clock (enqueue time; no host sync added):
+            # the step-cost sample the obs report's (shape, G-bucket) cost
+            # table aggregates
+            t_epoch0 = time.perf_counter()
             cfg0 = self.model.config
             if (not aligned and "pretrain_factor" in cfg0.training_mode
                     and it == cfg0.num_pretrain_epochs
@@ -1356,6 +1399,8 @@ class RedcliffGridRunner:
                     if self._freeze_by_batch:
                         params, accepted = self._freeze_step(params, accepted)
                 rt_watchdog.retire("batch_loop")
+            t_val0 = time.perf_counter()
+            stats["train_time_ms"] += (t_val0 - t_epoch0) * 1e3
             if val_scan_ok:
                 # whole validation set in one scanned dispatch (sequential
                 # carry adds — bit-identical to the per-batch loop's sums);
@@ -1391,6 +1436,23 @@ class RedcliffGridRunner:
                 raise ValueError(
                     "validation dataset yielded no batches — increase "
                     "val_fraction or dataset size")
+            # fold this epoch's timing into the per-width cost accumulators
+            # and refresh the cross-thread stall counters (absolute deltas
+            # vs the fit-start snapshot, so every checkpoint sees current
+            # totals). One lock acquisition per epoch
+            t_val1 = time.perf_counter()
+            stats["val_time_ms"] += (t_val1 - t_val0) * 1e3
+            epoch_ms = (t_val1 - t_epoch0) * 1e3
+            wkey = str(Gx)
+            stats["epoch_ms_by_width"][wkey] = (
+                stats["epoch_ms_by_width"].get(wkey, 0.0) + epoch_ms)
+            stats["epochs_by_width"][wkey] = (
+                stats["epochs_by_width"].get(wkey, 0) + 1)
+            cdelta = obs.counters.delta(counters_t0)
+            stats["prefetch_stall_ms"] = cdelta.get("prefetch_stall_ms", 0.0)
+            stats["prefetch_items"] = int(cdelta.get("prefetch_items", 0))
+            stats["ckpt_barrier_stall_ms"] = cdelta.get(
+                "ckpt_barrier_stall_ms", 0.0)
             # keep per-epoch losses device-resident; one host transfer at
             # the end (rows are execution-width — the era index records
             # which lane->point map they were computed under, and
@@ -1538,23 +1600,31 @@ class RedcliffGridRunner:
             # typically only process 0 writes) — gather everywhere, write
             # wherever a logger is attached
             if it % tc.check_every == 0:
-                # one gather serves the epoch log, the exit test, and the
-                # compaction decision
-                act_host = gather_to_host(active)
-                stats["lanes_live"] = int(act_host.sum())
-                if logger.active or jax.process_count() > 1:
-                    failed_host = gather_to_host(failed_epoch)
-                    skipped_host = np.asarray(
-                        gather_to_host(nstate["skipped"]))
-                    logger.log("epoch", epoch=it, phases=list(phases),
-                               val_combo_loss=gather_to_host(val_now),
-                               best_criteria=gather_to_host(best_crit),
-                               num_active=int(act_host.sum()),
-                               lanes_live=stats["lanes_live"],
-                               grid_width=Gx,
-                               lanes_padded=int((orig_ids < 0).sum()),
-                               num_quarantined=int((failed_host >= 0).sum()),
-                               guarded_steps_skipped=int(skipped_host.sum()))
+                # traced check window: the per-check-window host sync (the
+                # act_host gather + the epoch record's loss gathers) is the
+                # one place the hot loop touches the host — its span makes
+                # that cost visible per window in metrics.jsonl
+                with obs.span("grid.check_window", component="check_window",
+                              logger=logger, emit=True, epoch=it, width=Gx):
+                    # one gather serves the epoch log, the exit test, and
+                    # the compaction decision
+                    act_host = gather_to_host(active)
+                    stats["lanes_live"] = int(act_host.sum())
+                    if logger.active or jax.process_count() > 1:
+                        failed_host = gather_to_host(failed_epoch)
+                        skipped_host = np.asarray(
+                            gather_to_host(nstate["skipped"]))
+                        logger.log(
+                            "epoch", epoch=it, phases=list(phases),
+                            val_combo_loss=gather_to_host(val_now),
+                            best_criteria=gather_to_host(best_crit),
+                            num_active=int(act_host.sum()),
+                            lanes_live=stats["lanes_live"],
+                            grid_width=Gx,
+                            lanes_padded=int((orig_ids < 0).sum()),
+                            num_quarantined=int((failed_host >= 0).sum()),
+                            guarded_steps_skipped=int(skipped_host.sum()),
+                            epoch_ms=round(epoch_ms, 3))
                 # global early exit: once EVERY lane has hit its per-point
                 # patience, further epochs are pure masked compute (the
                 # per-point trainer would have broken out of each run long
@@ -1585,6 +1655,7 @@ class RedcliffGridRunner:
                         self._mesh_full.devices.size
                         if self._mesh_full is not None else 1)
                 if plan is not None:
+                    t_comp = time.perf_counter()
                     # retire frozen lanes' results to host before their
                     # rows are dropped (their state never changes again)
                     if plan.retire_rows.size:
@@ -1657,6 +1728,13 @@ class RedcliffGridRunner:
                         retired=[int(p) for p in plan.retire_ids],
                         mesh_devices=(self.mesh.devices.size
                                       if self.mesh is not None else None))
+                    # traced span for the whole apply (retire gather +
+                    # survivor re-shard + device-data re-placement)
+                    obs.record_span(
+                        "grid.compaction",
+                        (time.perf_counter() - t_comp) * 1e3,
+                        component="compaction", logger=logger, emit=True,
+                        epoch=it, from_width=old_width, to_width=Gx)
 
             if checkpoint_dir is not None:
                 snap = {
@@ -1669,6 +1747,9 @@ class RedcliffGridRunner:
                     "val_history": val_history, "val_eras": val_eras,
                     "eras": eras, "orig_ids": orig_ids, "retired": retired,
                     "aligned": aligned, "mesh": mesh_desc,
+                    # telemetry snapshot for the obs report CLI (deep copy:
+                    # the live dict keeps mutating under the async writer)
+                    "dispatch_stats": copy.deepcopy(stats),
                     "rng_state": rng.bit_generator.state, "epoch": it,
                 }
                 saved = False
@@ -1677,8 +1758,13 @@ class RedcliffGridRunner:
                     t_save = time.perf_counter()
                     self._save_checkpoint(checkpoint_dir, snap, ck_meta,
                                           writer=writer)
-                    stats["ckpt_stall_ms"] += (time.perf_counter()
-                                               - t_save) * 1e3
+                    stall_ms = (time.perf_counter() - t_save) * 1e3
+                    stats["ckpt_stall_ms"] += stall_ms
+                    # main-thread hand-off stall span (the async writer's
+                    # own write span lands under the "ckpt" component)
+                    obs.record_span("grid.ckpt_save", stall_ms,
+                                    component="ckpt", epoch=it,
+                                    background=writer is not None)
                     saved = True
                     if writer is not None and faultinject.armed():
                         # fault-test determinism: "checkpoint_saved" must
@@ -1761,6 +1847,11 @@ class RedcliffGridRunner:
             # guarantee the last generation is durable before results return
             writer.wait()
         stats.update(compileobs.delta(compile_t0))
+        cdelta = obs.counters.delta(counters_t0)
+        stats["prefetch_stall_ms"] = cdelta.get("prefetch_stall_ms", 0.0)
+        stats["prefetch_items"] = int(cdelta.get("prefetch_items", 0))
+        stats["ckpt_barrier_stall_ms"] = cdelta.get(
+            "ckpt_barrier_stall_ms", 0.0)
 
         # ---- result assembly under ORIGINAL point ids -------------------
         # one gather each; live execution lanes scatter through orig_ids,
@@ -1814,7 +1905,11 @@ class RedcliffGridRunner:
                    num_active=int(final_active.sum()),
                    compactions=stats["compactions"],
                    compile_ms=stats["compile_ms"],
-                   failures=failures)
+                   failures=failures,
+                   # the full per-fit telemetry (dispatch counts, stall and
+                   # per-width timing accumulators): the obs report CLI's
+                   # primary input for the time breakdown + cost table
+                   dispatch_stats=stats)
         logger.close()
         return GridResult(
             best_params=best_params_full,

@@ -31,11 +31,13 @@ import os
 import pickle
 import struct
 import threading
+import time
 import warnings
 import zlib
 
 import numpy as np
 
+from redcliff_tpu import obs as _obs
 from redcliff_tpu.runtime import watchdog as _watchdog
 
 __all__ = ["CheckpointCorruptError", "CheckpointWriteError",
@@ -83,6 +85,12 @@ def write_checkpoint(path, obj):
     failed CLEANLY: prior generations are untouched and no orphan tmp is
     left to fill the disk further.
     """
+    # traced (ring-only) span: durable-write latency is flight-recorder
+    # evidence — a post-mortem of a hang/ENOSPC shows the last writes and
+    # how long they took. The span wraps pickle+fsync+promotion below via
+    # record_span at the end (no context manager around the early-returning
+    # error path)
+    t_span0 = time.perf_counter()
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     header = _HEADER.pack(MAGIC, FORMAT_VERSION,
                           zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
@@ -113,11 +121,17 @@ def write_checkpoint(path, obj):
 
                 faultinject.ckpt_write_point("between_replaces", path=path)
         os.replace(tmp, path)
+        _obs.record_span("ckpt.write", (time.perf_counter() - t_span0) * 1e3,
+                         component="ckpt", file=os.path.basename(path),
+                         bytes=len(payload))
     except OSError as e:
         try:
             os.remove(tmp)
         except OSError:
             pass
+        _obs.record_span("ckpt.write", (time.perf_counter() - t_span0) * 1e3,
+                         component="ckpt", file=os.path.basename(path),
+                         error=type(e).__name__)
         raise CheckpointWriteError(path, e) from e
 
 
@@ -227,7 +241,15 @@ class AsyncCheckpointWriter:
         return self._thread is not None and self._thread.is_alive()
 
     def submit(self, fn):
+        # the submit barrier: how long the MAIN thread stalls waiting for
+        # the previous background write. Counted always (the grid folds it
+        # into dispatch_stats.ckpt_barrier_stall_ms); ring-recorded when
+        # tracing is on so a flight record shows barrier pressure
+        t_bar0 = time.perf_counter()
         self.wait()
+        stall_ms = (time.perf_counter() - t_bar0) * 1e3
+        _obs.counters.add("ckpt_barrier_stall_ms", stall_ms)
+        _obs.record_span("ckpt.submit_barrier", stall_ms, component="ckpt")
 
         def run():
             # liveness: the writer heartbeats while a write is in flight and
@@ -240,7 +262,10 @@ class AsyncCheckpointWriter:
                     from redcliff_tpu.runtime import faultinject
 
                     faultinject.hang_point("ckpt_writer")
-                fn()
+                # the background write's span (gather + pickle + fsync)
+                # nests the ckpt.write span recorded by write_checkpoint
+                with _obs.span("ckpt.async_write", component="ckpt"):
+                    fn()
             except BaseException as e:  # noqa: BLE001 — re-raised in wait()
                 self._err = e
             finally:

@@ -502,12 +502,40 @@ class Watchdog:
             "grace_s": self.policy.grace_s,
         }
 
+    def _dump_flight(self, reason, rec, timeout_s=5.0):
+        """Dump the crash flight recorder (redcliff_tpu/obs/flight.py) next
+        to the bound logger's metrics.jsonl: the stalled component's last
+        spans — per-dispatch, checkpoint writes, prefetch fills, shard
+        loads — are in-memory evidence that was deliberately never flushed
+        to disk; an escalation is exactly when it must be. Best-effort AND
+        time-bounded: the dump writes to the same filesystem whose wedge may
+        be the very hang being escalated, and blocking I/O is uninterruptible
+        by try/except — so it runs in a daemon thread joined for at most
+        ``timeout_s``, like the hard-exit's log flush. Forensics can never
+        block the ladder (or the guaranteed exit)."""
+        result = [None]
+
+        def dump():
+            with contextlib.suppress(Exception):
+                from redcliff_tpu.obs import flight as _flight
+
+                result[0] = _flight.dump_for_logger(self.logger,
+                                                    reason=reason, extra=rec)
+
+        t = threading.Thread(target=dump, name="watchdog-flight",
+                             daemon=True)
+        t.start()
+        t.join(timeout=timeout_s)
+        return result[0]
+
     def _emit(self, overdue, event="hang", **extra):
         rec = self._record(overdue)
         rec.update(extra)
         stacks = dump_stacks()
+        flight_path = self._dump_flight(event, rec)
         print(f"[watchdog] {event.upper()} detected: {rec['components']}"
-              f"\n{stacks}", file=sys.stderr, flush=True)
+              + (f"\nflight record: {flight_path}" if flight_path else "")
+              + f"\n{stacks}", file=sys.stderr, flush=True)
         if self.logger is not None and getattr(self.logger, "active", False):
             self.logger.log(event, **rec, stacks=stacks)
         if self.on_hang is not None:
@@ -524,6 +552,10 @@ class Watchdog:
         print(f"[watchdog] {event} persists after {self.policy.grace_s:.1f}s "
               f"grace; hard exit {exit_code}: {rec['components']}",
               file=sys.stderr, flush=True)
+        # refresh the flight record with the state at exit time (the _emit
+        # dump is grace_s old by now); time-bounded like the log flush below
+        # — a wedged filesystem must not block the exit
+        self._dump_flight(event, dict(rec, exit_code=exit_code))
         with contextlib.suppress(Exception):
             faulthandler.dump_traceback(file=sys.stderr)
         sys.stderr.flush()

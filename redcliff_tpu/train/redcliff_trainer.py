@@ -22,6 +22,7 @@ from __future__ import annotations
 import copy
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -29,8 +30,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu import obs
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
+from redcliff_tpu.obs import MetricLogger, profiler_trace
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
@@ -38,7 +41,6 @@ from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
-from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 from redcliff_tpu.utils.precision import matmul_precision_ctx
 
 __all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
@@ -404,9 +406,11 @@ class RedcliffTrainer:
         # handle (otherwise buffered context is lost and the fd leaks)
         try:
             logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
+                       shape=obs.schema.shape_desc(cfg),
                        train_config=tc, resume_epoch=iter_start)
             for it in range(iter_start, tc.max_iter):
                 rt_watchdog.stamp("epoch_engine")
+                t_epoch0 = time.perf_counter()
                 last_it = it
                 # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
                 if (not aligned and "pretrain_factor" in mode
@@ -517,8 +521,16 @@ class RedcliffTrainer:
                         rolled_back = True
                     elif action.kind == "abort":
                         aborted = action.cause
+                        # numerics-abort escalation dumps the crash flight
+                        # recorder next to metrics.jsonl (last spans per
+                        # component — post-mortems stop depending on what
+                        # happened to be flushed)
+                        fr = obs.flight.dump_for_logger(
+                            logger, reason="numerics_abort",
+                            extra={"epoch": it, "cause": action.cause})
                         logger.log("numerics", kind="abort", epoch=it,
-                                   cause=action.cause, **nhost)
+                                   cause=action.cause, flight_record=fr,
+                                   **nhost)
                     elif criteria is None or np.isfinite(criteria):
                         monitor.note_good(
                             it, {"params": params, "accepted": accepted,
@@ -559,6 +571,8 @@ class RedcliffTrainer:
                 # log before honoring the early stop so the stopping epoch's
                 # record (criteria included) lands in metrics.jsonl
                 logger.log("epoch", epoch=it, phases=list(phases), criteria=criteria,
+                           epoch_ms=round(
+                               (time.perf_counter() - t_epoch0) * 1e3, 3),
                            **val, **(tracker.latest_as_dict() if tracker else {}))
                 if stop_early or aborted is not None:
                     break

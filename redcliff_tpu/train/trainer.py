@@ -26,6 +26,7 @@ from __future__ import annotations
 import copy
 import os
 import sys
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -34,13 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from redcliff_tpu import obs
 from redcliff_tpu.data import pipeline
+from redcliff_tpu.obs import MetricLogger, profiler_trace
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.tracking import GCProgressTracker
-from redcliff_tpu.utils.observability import MetricLogger, profiler_trace
 
 __all__ = ["TrainConfig", "Trainer", "FitResult", "save_model", "load_model"]
 
@@ -306,10 +308,12 @@ class Trainer:
         # (otherwise buffered context is lost and the fd leaks)
         try:
             logger.log("fit_start", model=type(self.model).__name__,
+                       shape=obs.schema.shape_desc(self.model.config),
                        train_config=cfg, resume_epoch=iter_start)
             with profiler_trace(cfg.profile_dir), wd:
                 for it in range(iter_start, cfg.max_iter):
                     rt_watchdog.stamp("epoch_engine")
+                    t_epoch0 = time.perf_counter()
                     last_it = it
                     for X, Y in train_batch_iter():
                         rt_watchdog.stamp("batch_loop")
@@ -336,7 +340,10 @@ class Trainer:
                     else:
                         criteria = val["combo_loss"]
 
-                    logger.log("epoch", epoch=it, criteria=criteria, **val,
+                    logger.log("epoch", epoch=it, criteria=criteria,
+                               epoch_ms=round(
+                                   (time.perf_counter() - t_epoch0) * 1e3, 3),
+                               **val,
                                **(tracker.latest_as_dict() if tracker else {}))
 
                     if monitor is not None:
@@ -365,8 +372,17 @@ class Trainer:
                             continue  # re-run from the snapshot; no best/ckpt update
                         if action.kind == "abort":
                             aborted = action.cause
+                            # numerics-abort escalation dumps the crash
+                            # flight recorder (last spans/events per
+                            # component) next to metrics.jsonl — the
+                            # post-mortem no longer depends on what
+                            # happened to be flushed
+                            fr = obs.flight.dump_for_logger(
+                                logger, reason="numerics_abort",
+                                extra={"epoch": it, "cause": action.cause})
                             logger.log("numerics", kind="abort", epoch=it,
-                                       cause=action.cause, **nhost)
+                                       cause=action.cause,
+                                       flight_record=fr, **nhost)
                             break
                         if np.isfinite(criteria):
                             monitor.note_good(it, (params, opt_state))

@@ -1,166 +1,13 @@
-"""Structured observability: jsonl metric logging + opt-in jax.profiler traces.
+"""Back-compat shim: the observability layer grew into the telemetry spine
+at :mod:`redcliff_tpu.obs` (trace spans, flight recorder, schema registry,
+run-analytics CLI — docs/ARCHITECTURE.md "Telemetry spine").
 
-The reference's only observability is high-density ``print(..., flush=True)``
-inside every fit loop, and parts of the analysis layer *parse the captured
-stdout* (ref README.md:96, models/redcliff_s_cmlp.py:1549-1569). This build
-makes metrics machine-readable, first-class artifacts (SURVEY §5):
-
-* every trainer appends one JSON object per epoch to
-  ``<save_dir>/metrics.jsonl`` (schema below), so analyses read structured
-  records instead of log scrapes;
-* an opt-in ``jax.profiler`` trace context captures XLA/TPU timelines around
-  the train loop for perf work (view with TensorBoard / xprof).
-
-jsonl schema: every line is one JSON object with at least ``{"event": str,
-"wall_time": float}``. Events emitted by the trainers:
-
-* ``fit_start``  — model class, config snapshot, resume epoch
-* ``epoch``      — epoch index, phase list, per-term validation losses,
-                   stopping criteria, latest GC-vs-oracle metrics when a
-                   tracker is active
-* ``anomaly``    — the numerics sentinel skipped step(s) this epoch:
-                   ``cause`` (``nonfinite_grad``), the epoch's skipped-step
-                   count, and the gradient-norm running stats
-                   (``grad_norm_mean/std/max/last``)
-* ``numerics``   — a sentinel intervention: ``kind`` is ``rollback``
-                   (``cause``, ``restored_epoch``, ``lr_scale``, the new
-                   ``learning_rates``, cumulative ``rollbacks``) or
-                   ``abort`` (``cause``, e.g. ``all_nonfinite_validation``)
-* ``fit_end``    — best_it, best_loss, final validation loss, abort cause
-                   (None for a clean fit)
-
-Records are STRICT JSON: non-finite floats are mapped to ``null`` by
-``jsonable`` (any standards-compliant consumer can read the file), so a
-missing value in a plot is a recorded anomaly, not a parser crash.
+This module re-exports the original surface so existing imports keep
+working; new code should import from ``redcliff_tpu.obs`` directly.
 """
 from __future__ import annotations
 
-import contextlib
-import json
-import math
-import os
-import threading
-import time
-from dataclasses import asdict, is_dataclass
-
-import numpy as np
+from redcliff_tpu.obs.logging import (MetricLogger, jsonable, profiler_trace,
+                                      read_jsonl)
 
 __all__ = ["MetricLogger", "profiler_trace", "jsonable", "read_jsonl"]
-
-
-def jsonable(v):
-    """Recursively coerce numpy/jax scalars and arrays into STRICT
-    JSON-encodable Python values. Arrays become (nested) lists; non-finite
-    floats (NaN/inf, scalar or array element) become ``None`` — the emitted
-    lines never contain the JSON-standard-breaking ``NaN``/``Infinity``
-    tokens."""
-    if isinstance(v, bool) or v is None or isinstance(v, (int, str)):
-        return v
-    if isinstance(v, float):
-        return v if math.isfinite(v) else None
-    if is_dataclass(v) and not isinstance(v, type):
-        return {k: jsonable(x) for k, x in asdict(v).items()}
-    if isinstance(v, dict):
-        return {str(k): jsonable(x) for k, x in v.items()}
-    if isinstance(v, (list, tuple)):
-        return [jsonable(x) for x in v]
-    if isinstance(v, (np.integer,)):
-        return int(v)
-    if isinstance(v, (np.floating,)):
-        f = float(v)
-        return f if math.isfinite(f) else None
-    if hasattr(v, "ndim"):  # numpy / jax arrays without importing jax here
-        arr = np.asarray(v)
-        if arr.ndim == 0:
-            return jsonable(arr.item())
-        return [jsonable(x) for x in arr.tolist()]
-    return str(v)
-
-
-class MetricLogger:
-    """Append-only jsonl metric writer.
-
-    ``MetricLogger(save_dir)`` writes to ``<save_dir>/metrics.jsonl``;
-    ``MetricLogger(None)`` is a no-op sink so call sites never branch.
-    Resumed runs keep appending to the same file — the ``epoch`` field makes
-    replays self-describing.
-    """
-
-    def __init__(self, target, filename="metrics.jsonl", resume=True):
-        self._fh = None
-        # the liveness watchdog logs hang incidents from its own thread
-        # while the fit loop logs epochs; serialized writes keep every
-        # jsonl line intact (a torn line would break strict-JSON readers)
-        self._lock = threading.Lock()
-        if target is None:
-            return
-        path = target
-        if not str(target).endswith(".jsonl"):
-            os.makedirs(target, exist_ok=True)
-            path = os.path.join(target, filename)
-        else:
-            parent = os.path.dirname(path)
-            if parent:
-                os.makedirs(parent, exist_ok=True)
-        self.path = path
-        self._fh = open(path, "a" if resume else "w")
-
-    @property
-    def active(self):
-        return self._fh is not None
-
-    def log(self, event, **fields):
-        if self._fh is None:
-            return
-        rec = {"event": event, "wall_time": time.time()}
-        rec.update({k: jsonable(v) for k, v in fields.items()})
-        # allow_nan=False is the strictness backstop: jsonable already maps
-        # non-finite floats to null, so a violation here is a bug, not data
-        line = json.dumps(rec, allow_nan=False) + "\n"
-        with self._lock:
-            if self._fh is not None:
-                self._fh.write(line)
-                self._fh.flush()
-
-    def close(self):
-        with self._lock:
-            if self._fh is not None:
-                self._fh.close()
-                self._fh = None
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-
-def read_jsonl(path, event=None):
-    """Load a metrics.jsonl file (optionally filtered by event type)."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "metrics.jsonl")
-    out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            rec = json.loads(line)
-            if event is None or rec.get("event") == event:
-                out.append(rec)
-    return out
-
-
-@contextlib.contextmanager
-def profiler_trace(log_dir):
-    """Opt-in ``jax.profiler.trace`` context. ``log_dir=None`` is a no-op, so
-    trainers wrap their epoch loops unconditionally and profiling turns on by
-    setting ``profile_dir`` in the train config."""
-    if not log_dir:
-        yield
-        return
-    import jax
-
-    os.makedirs(log_dir, exist_ok=True)
-    with jax.profiler.trace(str(log_dir)):
-        yield

@@ -1,0 +1,277 @@
+"""Telemetry-spine acceptance suite (redcliff_tpu/obs + ISSUE 7):
+
+* the tier-1 SCHEMA TRIPWIRE — a small supervised grid fit with numerical
+  faults injected must emit only registry-valid events (undocumented event/
+  field drift fails here, not in a 3am post-mortem);
+* the run-analytics report: ``obs report <run_dir>`` joins metrics.jsonl +
+  run_ledger.jsonl + the checkpointed dispatch_stats into a time breakdown
+  and a non-empty per-(shape, G-bucket) cost table;
+* flight recorder on escalation: a watchdog hang incident dumps
+  ``flight_record.json`` containing the stalled component's last spans;
+* tracing neutrality: spans on vs off is bit-identical (the spine observes,
+  never participates).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu import obs
+from redcliff_tpu.obs import build_report, flight, read_jsonl, schema
+from redcliff_tpu.obs.logging import MetricLogger
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.runtime import checkpoint as rck
+from redcliff_tpu.runtime.watchdog import (HeartbeatRegistry, Watchdog,
+                                           WatchdogPolicy)
+from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+from test_parallel_grid import _data, _model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def faulted_run(tmp_path_factory):
+    """One supervised grid fit with nan-batch faults injected from step 2 on:
+    every lane quarantines via the in-graph guard (cause nonfinite_grad),
+    exercising fit_start/epoch/span/compile/fit_end + failure machinery.
+    Ledger lines are appended the way the supervisor writes them, so the
+    report join has both spines to read."""
+    run = str(tmp_path_factory.mktemp("obs_run"))
+    model = _model()
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3},
+                            {"gen_lr": 2e-3}])
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=32, check_every=1,
+                             stream_mode="per_batch")
+    runner = RedcliffGridRunner(model, tc, spec)
+    ds = _data(model)
+    old = os.environ.get("REDCLIFF_FAULT_INJECT")
+    os.environ["REDCLIFF_FAULT_INJECT"] = "nan_batch:2-50"
+    try:
+        runner.fit(jax.random.PRNGKey(0), ds, ds, log_dir=run,
+                   checkpoint_dir=run, checkpoint_every=1)
+    finally:
+        if old is None:
+            os.environ.pop("REDCLIFF_FAULT_INJECT", None)
+        else:
+            os.environ["REDCLIFF_FAULT_INJECT"] = old
+    # the supervisor's ledger schema, verbatim (runtime/supervisor.py)
+    with open(os.path.join(run, "run_ledger.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "event": "attempt", "attempt": 0, "cmd": ["fit"], "rc": 0,
+            "classification": "clean", "action": "stop", "backoff_s": 0.0,
+            "started_at": 1.0, "duration_s": 2.0}) + "\n")
+        f.write(json.dumps({"event": "final", "classification": "clean",
+                            "rc": 0, "attempts": 1}) + "\n")
+    return run, runner
+
+
+def test_schema_tripwire_faulted_grid_fit(faulted_run):
+    """EVERY event a faulted supervised grid fit emits validates against the
+    versioned registry — new fields/events cannot drift undocumented."""
+    run, _runner = faulted_run
+    stats = {}
+    recs = read_jsonl(run, stats=stats)
+    assert stats["torn_lines"] == 0
+    events = {r["event"] for r in recs}
+    # the fit actually exercised the interesting emitters
+    assert {"fit_start", "epoch", "span", "fit_end"} <= events
+    bad = schema.validate_records(recs)
+    assert not bad, f"schema drift: {bad[:5]}"
+    ledger = read_jsonl(os.path.join(run, "run_ledger.jsonl"))
+    assert not schema.validate_records(ledger, kind="ledger")
+    # identity triple on every record; seq strictly increasing in the file
+    seqs = [r["seq"] for r in recs]
+    assert all(isinstance(s, int) for s in seqs)
+    assert seqs == sorted(seqs)
+
+
+def test_faults_surface_in_telemetry(faulted_run):
+    run, runner = faulted_run
+    recs = read_jsonl(run)
+    end = [r for r in recs if r["event"] == "fit_end"][-1]
+    causes = {f["cause"] for f in end["failures"]}
+    assert causes == {"nonfinite_grad"} and len(end["failures"]) == 3
+    epochs = [r for r in recs if r["event"] == "epoch"]
+    assert any(r["guarded_steps_skipped"] > 0 for r in epochs)
+    # per-epoch step-cost samples rode along
+    assert all(r["epoch_ms"] > 0 for r in epochs)
+    ds = end["dispatch_stats"]
+    assert ds["train_dispatches"] > 0 and ds["train_time_ms"] > 0
+    assert ds["epochs_by_width"]
+
+
+def test_report_joins_metrics_ledger_and_checkpoint(faulted_run):
+    run, _ = faulted_run
+    rep = build_report(run)
+    json.dumps(rep, allow_nan=False)  # machine-readable, strict
+    tb = rep["time_breakdown_ms"]
+    assert tb["train_dispatch"] > 0 and tb["val_dispatch"] > 0
+    assert rep["dispatches"]["train"] > 0
+    # non-empty per-(shape, G-bucket) cost table with real samples
+    assert rep["cost_table"], "cost table must not be empty"
+    row = rep["cost_table"][0]
+    assert row["g_bucket"] == 4 and row["epochs"] > 0
+    assert row["mean_epoch_ms"] > 0
+    assert "num_chans=4" in row["shape"]
+    # joined inputs: ledger attempts + the checkpointed dispatch_stats
+    assert rep["attempts"]["n"] == 1
+    assert rep["attempts"]["final"] == "clean"
+    cds = rep["checkpoint_dispatch_stats"]
+    assert cds is not None and cds["train_dispatches"] > 0
+    assert rep["numerics"]["quarantined_lanes"] == 3
+    assert not rep["read_audit"]["schema_errors"]
+    assert not rep["read_audit"]["ledger_schema_errors"]
+
+
+def test_report_cli_text_and_json(faulted_run, capsys):
+    from redcliff_tpu.obs.report import main, render_text
+
+    run, _ = faulted_run
+    assert main(["report", run]) == 0
+    text = capsys.readouterr().out
+    assert "cost table" in text and "time breakdown" in text
+    out_json = os.path.join(run, "report.json")
+    assert main(["report", run, "--json", "-o", out_json]) == 0
+    printed = json.loads(capsys.readouterr().out)
+    with open(out_json) as f:
+        written = json.load(f)
+    assert printed["cost_table"] == written["cost_table"]
+    assert render_text(printed)
+
+
+def test_report_cli_module_entry(faulted_run):
+    """``python -m redcliff_tpu.obs report <dir>`` — the documented entry
+    point; jax-free (the report reads artifacts, it does not need a
+    backend)."""
+    run, _ = faulted_run
+    r = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.obs", "report", run, "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-800:]
+    rep = json.loads(r.stdout)
+    assert rep["cost_table"] and rep["attempts"]["n"] == 1
+
+
+def test_cost_table_prefers_exact_dispatch_stats_over_sampled(tmp_path):
+    """A grid with check_every=50 emits ~epochs/50 `epoch` events; the cost
+    table must use fit_end's exact per-width accumulators, not the sampled
+    event count (which would be ~50x low), and fall back to sampled only
+    when the fit died before fit_end."""
+    with MetricLogger(str(tmp_path)) as log:
+        log.log("fit_start", model="RedcliffGridRunner",
+                shape={"num_chans": 4}, grid_width=8)
+        # 100 epochs ran; only 2 were check-window-logged
+        for e in (49, 99):
+            log.log("epoch", epoch=e, grid_width=8, epoch_ms=100.0)
+        log.log("fit_end", dispatch_stats={
+            "epochs": 100, "train_dispatches": 100, "val_dispatches": 100,
+            "epochs_by_width": {"8": 100},
+            "epoch_ms_by_width": {"8": 10_000.0}})
+    rep = build_report(str(tmp_path))
+    [row] = rep["cost_table"]
+    assert row["epochs"] == 100 and not row["sampled"]
+    assert row["mean_epoch_ms"] == 100.0
+    assert rep["lane_epochs"]["by_bucket"] == {"8": 100}
+
+    # crashed-before-fit_end fallback: sampled counts, marked as such
+    crashed = tmp_path / "crashed"
+    with MetricLogger(str(crashed)) as log:
+        log.log("fit_start", model="RedcliffGridRunner",
+                shape={"num_chans": 4}, grid_width=8)
+        log.log("epoch", epoch=49, grid_width=8, epoch_ms=100.0)
+    rep2 = build_report(str(crashed))
+    [row2] = rep2["cost_table"]
+    assert row2["epochs"] == 1 and row2["sampled"]
+
+
+def test_report_on_empty_dir(tmp_path):
+    rep = build_report(str(tmp_path))
+    assert rep["cost_table"] == [] and rep["attempts"]["n"] == 0
+    json.dumps(rep, allow_nan=False)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder on watchdog escalation
+# ---------------------------------------------------------------------------
+def test_hang_incident_dumps_flight_record_with_last_spans(tmp_path):
+    """A watchdog hang incident writes flight_record.json next to
+    metrics.jsonl containing the stalled component's last spans — the ISSUE 7
+    acceptance artifact."""
+    flight.clear()
+    # the stalled component did some traced work before wedging
+    for i in range(3):
+        with obs.span("prefetch.fill", component="prefetch", batch=i):
+            pass
+    reg = HeartbeatRegistry(default_budget_s=0.02)
+    reg.stamp("prefetch")
+    events = []
+
+    logger = MetricLogger(str(tmp_path))
+    wd = Watchdog(policy=WatchdogPolicy(poll_s=0.01, grace_s=60.0,
+                                        hard_exit=False,
+                                        latch_preempt=False),
+                  registry=reg, logger=logger,
+                  on_hang=events.append)
+    import time as _time
+
+    with wd:
+        t0 = _time.monotonic()
+        while wd.incidents == 0 and _time.monotonic() - t0 < 10.0:
+            _time.sleep(0.01)
+    logger.close()
+    assert wd.incidents >= 1
+    fr_path = tmp_path / "flight_record.json"
+    assert fr_path.exists()
+    with open(fr_path) as f:
+        fr = json.load(f)
+    assert fr["reason"] == "hang"
+    names = [r["name"] for r in fr["components"]["prefetch"]]
+    assert names.count("prefetch.fill") == 3
+    assert "prefetch" in fr["extra"]["components"]
+    # the hang event itself landed in metrics.jsonl and validates
+    hang = read_jsonl(str(tmp_path), event="hang")
+    assert hang and not schema.validate_records(hang)
+    # the report surfaces the incident + artifact
+    rep = build_report(str(tmp_path))
+    assert rep["hang_incidents"] and \
+        rep["flight_records"] == ["flight_record.json"]
+
+
+# ---------------------------------------------------------------------------
+# tracing neutrality: the spine observes, never participates
+# ---------------------------------------------------------------------------
+def test_tracing_on_off_bit_identical(tmp_path):
+    model = _model()
+    ds = _data(model, n=32)
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3}])
+    tc = RedcliffTrainConfig(max_iter=2, batch_size=16)
+    was = obs.enabled()
+    try:
+        obs.set_enabled(True)
+        r_on = RedcliffGridRunner(model, tc, spec).fit(
+            jax.random.PRNGKey(0), ds, ds)
+        obs.set_enabled(False)
+        r_off = RedcliffGridRunner(model, tc, spec).fit(
+            jax.random.PRNGKey(0), ds, ds)
+    finally:
+        obs.set_enabled(was)
+    np.testing.assert_array_equal(r_on.val_history, r_off.val_history)
+    for a, b in zip(jax.tree.leaves(r_on.best_params),
+                    jax.tree.leaves(r_off.best_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_payload_carries_dispatch_stats(faulted_run):
+    run, _ = faulted_run
+    ckpt, _src = rck.load_checkpoint(
+        os.path.join(run, "grid_checkpoint.pkl"))
+    assert ckpt is not None
+    ds = ckpt["dispatch_stats"]
+    assert ds["mode"] == "per_batch" and ds["train_dispatches"] > 0
+    # audit payload, NOT fingerprint: the meta dict is untouched by it
+    assert "dispatch_stats" not in ckpt["meta"]

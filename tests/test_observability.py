@@ -1,16 +1,30 @@
 """Structured observability (SURVEY §5): jsonl metric logging schema and the
 opt-in profiler hook, replacing the reference's stdout-scrape observability
-(ref README.md:96, redcliff_s_cmlp.py:1549-1569)."""
+(ref README.md:96, redcliff_s_cmlp.py:1549-1569).
+
+The telemetry spine grew out of this module (redcliff_tpu/obs,
+docs/ARCHITECTURE.md "Telemetry spine"); this file pins its primitives:
+span semantics (parent propagation, zero-cost disabled path, no host sync by
+construction), the flight-recorder rings + dump artifact, the seq/pid/host
+identity triple, torn-tail-tolerant reads, size-capped rotation, and the
+schema validator. The end-to-end report/tripwire suite lives in
+tests/test_obs_report.py. The imports below deliberately go through the
+utils.observability back-compat shim where the original API is exercised.
+"""
 import json
 import os
+import subprocess
+import sys
 
 import jax
 import numpy as np
 import pytest
 
+from redcliff_tpu import obs
 from redcliff_tpu.data import synthetic as S
 from redcliff_tpu.data.datasets import train_val_split
 from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+from redcliff_tpu.obs import flight, schema, spans
 from redcliff_tpu.train.trainer import TrainConfig, Trainer
 from redcliff_tpu.utils.observability import (
     MetricLogger, jsonable, profiler_trace, read_jsonl)
@@ -111,6 +125,262 @@ def test_trainer_emits_epoch_schema(tmp_path):
     with open(os.path.join(run, "metrics.jsonl")) as f:
         for line in f:
             json.loads(line)
+
+
+def test_metric_logger_stamps_identity_triple(tmp_path):
+    """Every record carries seq/pid/host; seq is monotonic across two
+    loggers in one process (total order for interleaved writers)."""
+    with MetricLogger(str(tmp_path / "a")) as la, \
+            MetricLogger(str(tmp_path / "b")) as lb:
+        la.log("epoch", epoch=0)
+        lb.log("epoch", epoch=0)
+        la.log("fit_end")
+    ra = read_jsonl(str(tmp_path / "a"))
+    rb = read_jsonl(str(tmp_path / "b"))
+    for r in ra + rb:
+        assert r["pid"] == os.getpid()
+        assert isinstance(r["host"], str) and r["host"]
+        assert isinstance(r["seq"], int)
+    assert ra[0]["seq"] < rb[0]["seq"] < ra[1]["seq"]
+
+
+def test_read_jsonl_tolerates_torn_tail(tmp_path):
+    """A line torn by a crash mid-append is skipped and counted instead of
+    poisoning the file; strict=True restores raise-on-bad-line."""
+    with MetricLogger(str(tmp_path)) as log:
+        for i in range(3):
+            log.log("epoch", epoch=i)
+    path = tmp_path / "metrics.jsonl"
+    with open(path, "a") as f:
+        f.write('{"event": "epoch", "epoch": 3, "wall_ti')  # torn tail
+    stats = {}
+    recs = read_jsonl(str(tmp_path), stats=stats)
+    assert [r["epoch"] for r in recs] == [0, 1, 2]
+    assert stats["torn_lines"] == 1 and stats["records"] == 3
+    with pytest.raises(json.JSONDecodeError):
+        read_jsonl(str(tmp_path), strict=True)
+
+
+def test_read_jsonl_crash_mid_write(tmp_path):
+    """A REAL SIGKILL mid-append: the child flushes half a record and kills
+    itself with the line unterminated — exactly the on-disk state a
+    preemption leaves; readers must keep working."""
+    child = (
+        "import os, signal\n"
+        "from redcliff_tpu.obs import MetricLogger\n"
+        f"log = MetricLogger({str(tmp_path)!r})\n"
+        "log.log('fit_start', model='X')\n"
+        "log.log('epoch', epoch=0)\n"
+        "log._fh.write('{\"event\": \"epoch\", \"epoch\": 1, \"wall')\n"
+        "log._fh.flush()\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n")
+    r = subprocess.run([sys.executable, "-c", child],
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))),
+                       timeout=120)
+    assert r.returncode == -9
+    stats = {}
+    recs = read_jsonl(str(tmp_path), stats=stats)
+    assert [r["event"] for r in recs] == ["fit_start", "epoch"]
+    assert stats["torn_lines"] == 1
+    # the report CLI reads the same dir without raising
+    from redcliff_tpu.obs import build_report
+
+    rep = build_report(str(tmp_path))
+    assert rep["read_audit"]["metrics"]["torn_lines"] == 1
+
+
+def test_metric_logger_rotation(tmp_path):
+    """Size-capped rotation: metrics.jsonl.1... appear, record order is
+    preserved across the chain, no record is split across files."""
+    with MetricLogger(str(tmp_path), max_bytes=400, max_backups=20) as log:
+        for i in range(40):
+            log.log("epoch", epoch=i)
+    names = sorted(os.listdir(tmp_path))
+    assert "metrics.jsonl" in names and "metrics.jsonl.1" in names
+    recs = read_jsonl(str(tmp_path))
+    assert [r["epoch"] for r in recs] == list(range(40))
+    # every file in the chain is whole-line strict JSON
+    for name in names:
+        with open(tmp_path / name) as f:
+            for line in f:
+                json.loads(line)
+
+
+def test_metric_logger_rotation_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("REDCLIFF_METRICS_MAX_BYTES", "300")
+    with MetricLogger(str(tmp_path)) as log:
+        assert log.max_bytes == 300
+        for i in range(20):
+            log.log("epoch", epoch=i)
+    assert os.path.exists(tmp_path / "metrics.jsonl.1")
+
+
+def test_metric_logger_rotation_drops_oldest(tmp_path):
+    with MetricLogger(str(tmp_path), max_bytes=200, max_backups=2) as log:
+        for i in range(60):
+            log.log("epoch", epoch=i)
+    names = {n for n in os.listdir(tmp_path) if n.startswith("metrics")}
+    assert names <= {"metrics.jsonl", "metrics.jsonl.1", "metrics.jsonl.2"}
+    recs = read_jsonl(str(tmp_path))
+    # the newest records survive; order within the surviving chain holds
+    epochs = [r["epoch"] for r in recs]
+    assert epochs == sorted(epochs) and epochs[-1] == 59
+
+
+# ---------------------------------------------------------------------------
+# trace spans + flight recorder + counters (redcliff_tpu/obs)
+# ---------------------------------------------------------------------------
+def test_span_disabled_is_shared_noop():
+    """REDCLIFF_TRACE=0 semantics: span() returns ONE shared no-op object —
+    the zero-cost-when-disabled contract (one flag check, no allocation)."""
+    was = obs.enabled()
+    try:
+        obs.set_enabled(False)
+        assert obs.span("grid.dispatch") is obs.NOOP
+        assert obs.span("x", kind="y") is obs.NOOP
+        assert obs.record_span("x", 1.0) is None
+        with obs.span("noop.scope") as sp:
+            sp.set(extra=1)  # uniform API on the disabled path
+    finally:
+        obs.set_enabled(was)
+
+
+def test_span_records_parent_chain_and_ring(tmp_path):
+    flight.clear()
+    with obs.span("ckpt.write", component="ckpt", file="a.pkl") as outer:
+        with obs.span("ckpt.fsync") as inner:
+            pass
+    ring = flight.snapshot()["ckpt"]
+    by_name = {r["name"]: r for r in ring}
+    assert by_name["ckpt.fsync"]["parent_id"] == by_name["ckpt.write"][
+        "span_id"]
+    assert by_name["ckpt.write"]["attrs"]["file"] == "a.pkl"
+    for r in ring:
+        assert r["dur_ms"] >= 0 and r["pid"] == os.getpid()
+        assert "t_wall" in r and "t_mono" in r
+    assert outer.dur_ms >= inner.dur_ms
+
+
+def test_span_emit_writes_schema_valid_event(tmp_path):
+    flight.clear()
+    with MetricLogger(str(tmp_path)) as log:
+        with obs.span("grid.check_window", logger=log, emit=True,
+                      epoch=3, width=8):
+            pass
+        obs.record_span("grid.compaction", 12.5, logger=log, emit=True,
+                        epoch=3, from_width=8, to_width=4)
+    recs = read_jsonl(str(tmp_path), event="span")
+    assert [r["name"] for r in recs] == ["grid.check_window",
+                                         "grid.compaction"]
+    assert recs[0]["attrs"]["epoch"] == 3
+    assert not schema.validate_records(recs)
+
+
+def test_span_ring_is_bounded():
+    rec = flight.FlightRecorder(capacity=5)
+    for i in range(20):
+        rec.record("c", {"i": i})
+    ring = rec.snapshot()["c"]
+    assert len(ring) == 5 and [r["i"] for r in ring] == list(range(15, 20))
+
+
+def test_counters_delta():
+    c = spans.Counters()
+    before = c.snapshot()
+    c.add("prefetch_stall_ms", 2.5)
+    c.add("prefetch_stall_ms", 1.5)
+    c.add("prefetch_items")
+    d = c.delta(before)
+    assert d["prefetch_stall_ms"] == 4.0 and d["prefetch_items"] == 1.0
+
+
+def test_flight_dump_artifact_is_strict_json(tmp_path):
+    flight.clear()
+    with obs.span("prefetch.fill", component="prefetch", batch=7):
+        pass
+    p = flight.dump(str(tmp_path), reason="hang",
+                    extra={"components": {"prefetch": {"age_s": 9.0}},
+                           "bad_float": float("nan")})
+    assert os.path.basename(p) == "flight_record.json"
+    with open(p) as f:
+        fr = json.load(f)  # strict parser: NaN would fail
+    assert fr["reason"] == "hang" and fr["event"] == "flight_record"
+    assert fr["extra"]["bad_float"] is None
+    names = [r["name"] for r in fr["components"]["prefetch"]]
+    assert "prefetch.fill" in names
+    # the artifact itself validates as a flight_record event
+    assert not schema.validate_record(fr)
+
+
+def test_flight_dump_for_logger_and_inactive(tmp_path):
+    assert flight.dump_for_logger(None, "hang") is None
+    assert flight.dump_for_logger(MetricLogger(None), "hang") is None
+    with MetricLogger(str(tmp_path)) as log:
+        p = flight.dump_for_logger(log, "numerics_abort")
+    assert p == str(tmp_path / "flight_record.json")
+
+
+def test_spans_never_touch_jax():
+    """No-host-sync tripwire at the source level: the span/flight hot path
+    must never import jax or call block_until_ready — a device sync inside
+    tracing would silently serialize every dispatch it wraps."""
+    import ast
+
+    import redcliff_tpu.obs.flight as fmod
+    import redcliff_tpu.obs.spans as smod
+
+    for mod in (smod, fmod):
+        with open(mod.__file__) as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+            elif isinstance(node, ast.Attribute):
+                assert node.attr != "block_until_ready", mod.__name__
+                continue
+            else:
+                continue
+            assert not any(n.split(".")[0] == "jax" for n in names), \
+                mod.__name__
+
+
+# ---------------------------------------------------------------------------
+# schema registry + validator
+# ---------------------------------------------------------------------------
+def test_schema_validator_accepts_known_rejects_drift():
+    good = {"event": "compile", "wall_time": 1.0, "seq": 1, "pid": 2,
+            "host": "h", "epoch": 0, "programs": 2, "compile_ms": 10.0,
+            "cache_hits": 1, "cache_misses": 1, "grid_width": 8}
+    assert schema.validate_record(good) == []
+    unknown_event = {"event": "mystery", "wall_time": 1.0}
+    assert any("unknown event" in e
+               for e in schema.validate_record(unknown_event))
+    missing = {"event": "compile", "wall_time": 1.0}
+    errs = schema.validate_record(missing)
+    assert any("missing required field 'epoch'" in e for e in errs)
+    drift = dict(good, new_field=1)
+    assert any("unregistered field 'new_field'" in e
+               for e in schema.validate_record(drift))
+    # dynamic GC-tracker families are admitted by pattern, typos are not
+    ep = {"event": "epoch", "wall_time": 1.0, "epoch": 0,
+          "f1_t0.0_factor0": 0.5, "deltacon0_factor1": 0.1,
+          "forecasting_loss": 1.0}
+    assert schema.validate_record(ep) == []
+    assert schema.validate_record(dict(ep, f1x_typo=1))
+
+
+def test_schema_validator_ledger_kind():
+    att = {"event": "attempt", "attempt": 0, "cmd": ["x"], "rc": 0,
+           "classification": "clean", "action": "stop", "backoff_s": 0.0,
+           "started_at": 1.0, "duration_s": 2.0}
+    assert schema.validate_record(att, kind="ledger") == []
+    assert schema.validate_record({"event": "attempt"}, kind="ledger")
+    fin = {"event": "final", "classification": "clean", "rc": 0,
+           "attempts": 1}
+    assert schema.validate_record(fin, kind="ledger") == []
 
 
 def test_profiler_trace_noop_and_real(tmp_path):
