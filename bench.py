@@ -50,6 +50,13 @@ Extra context fields (so "fast" is judgeable against hardware capability):
                     tracing-off throughput of the compiled grid step through
                     the engine's dispatch chokepoint (per-dispatch span +
                     flight ring). Contract: <= 2% on, ~0 off
+  regressions     — the cross-round regression sentinel's findings
+                    (redcliff_tpu/obs/regress.py, run at the end of EVERY
+                    round against the prior BENCH_r*.json trajectory with
+                    per-family noise bands; empty list = clean), plus a
+                    regression_sentinel summary (rounds compared, families
+                    judged, improvements) — the BENCH trajectory audits
+                    itself instead of waiting for a human to eyeball it
   probe_log       — every accelerator probe attempt (the axon TPU tunnel hangs
                     intermittently for minutes; attempts spread with backoff)
   probe_retry     — fixed-schema outcome of the shared probe retry policy
@@ -132,6 +139,28 @@ MEASURE_TIMEOUT_S = 1500.0
 def _emit(payload):
     print(json.dumps(payload))
     sys.stdout.flush()
+
+
+def _attach_regressions(payload):
+    """Run the cross-round regression sentinel (obs/regress.py) on the
+    final payload and embed its machine-readable block — every emitted
+    round records whether it regressed the trajectory. Never fails the
+    bench: a sentinel error is recorded, not raised."""
+    try:
+        from redcliff_tpu.obs import regress
+
+        block = regress.run_sentinel(
+            payload, bench_dir=os.path.dirname(os.path.abspath(__file__)))
+        payload["regressions"] = block["regressions"]
+        payload["regression_sentinel"] = {
+            k: block[k] for k in ("rounds_compared", "families_checked",
+                                  "improvements", "skipped", "notes",
+                                  "tpu_cache")}
+    except Exception as e:  # noqa: BLE001 — the sentinel must never
+        payload["regressions"] = None  # cost a measured round its artifact
+        payload["regression_sentinel"] = {
+            "error": f"{type(e).__name__}: {e}"}
+    return payload
 
 
 def _utcnow_iso():
@@ -379,7 +408,7 @@ def _orchestrate():
         payload["probe_log"] = probe_log
         payload["probe_retry"] = retry_log
         _write_tpu_cache(payload, extras={"probe_retry": retry_log})
-        _emit(payload)
+        _emit(_attach_regressions(payload))
         return
 
     if state["measure_attempts"] > 0:
@@ -431,12 +460,12 @@ def _orchestrate():
                                 if k != "probe_log"}
         out["probe_log"] = probe_log
         out["probe_retry"] = retry_log
-        _emit(out)
+        _emit(_attach_regressions(out))
         return
 
     payload["probe_log"] = probe_log
     payload["probe_retry"] = retry_log
-    _emit(payload)
+    _emit(_attach_regressions(payload))
 
 
 # ---------------------------------------------------------------------------

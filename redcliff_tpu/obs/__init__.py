@@ -16,7 +16,13 @@ production-monitoring shape of large-scale ML systems, arXiv:1605.08695):
 * :mod:`.schema` — the versioned event-schema registry + validator (the
   tier-1 tripwire validates every emitted event against it);
 * :mod:`.report` — the run-analytics CLI: ``python -m redcliff_tpu.obs
-  report <run_dir>``.
+  report <run_dir>``;
+* :mod:`.costmodel` — the learned per-(shape, G-bucket) step/compile cost
+  model (persistent store under the compile-cache dir; stdlib-only);
+* :mod:`.watch` — the live run watch CLI: ``python -m redcliff_tpu.obs
+  watch <run_dir>`` (``--once --json`` for scripts);
+* :mod:`.regress` — the cross-round bench regression sentinel:
+  ``python -m redcliff_tpu.obs regress`` (stdlib-only).
 
 Import discipline: this ``__init__`` (and ``spans``/``flight``/``schema``)
 is stdlib-only — the watchdog, the supervisor, and bench.py's backend-free
@@ -35,7 +41,8 @@ __all__ = [
     "counters",
     "flight", "schema", "spans",
     "MetricLogger", "jsonable", "read_jsonl", "jsonl_files",
-    "profiler_trace", "build_report", "render_text",
+    "profiler_trace", "build_report", "render_text", "build_snapshot",
+    "run_sentinel",
 ]
 
 _LAZY = {
@@ -46,6 +53,8 @@ _LAZY = {
     "profiler_trace": "redcliff_tpu.obs.logging",
     "build_report": "redcliff_tpu.obs.report",
     "render_text": "redcliff_tpu.obs.report",
+    "build_snapshot": "redcliff_tpu.obs.watch",
+    "run_sentinel": "redcliff_tpu.obs.regress",
 }
 
 

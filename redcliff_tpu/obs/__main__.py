@@ -1,4 +1,4 @@
-"""``python -m redcliff_tpu.obs report <run_dir>`` — run-analytics CLI."""
+"""``python -m redcliff_tpu.obs {report,watch,regress}`` — observatory CLIs."""
 import sys
 
 from redcliff_tpu.obs.report import main

@@ -1,0 +1,417 @@
+"""Learned per-(shape, G-bucket) step/compile cost model.
+
+The telemetry spine records what every compiled program family costs (the
+obs report's cost table: observed epoch step time and compile time per
+(shape_key, G-bucket)); this module *consumes* that telemetry the way "A
+Learned Performance Model for Tensor Processing Units" (PAPERS.md) fits
+cost models from measured program executions: fold observations into a
+persistent, versioned store and answer the questions scheduling and
+admission planning will ask — "what will one epoch of this shape at this
+width cost?", "what does a cold compile of its program cost?", "when will
+this fit finish?".
+
+**Store** (``cost_model_v<VERSION>.json``). Lives under the persistent
+compile-cache base directory (``compile_cache_dir`` /
+``REDCLIFF_COMPILE_CACHE``, overridable via ``REDCLIFF_COST_MODEL_DIR``) so
+it ACCUMULATES across runs, restarts, and tenants exactly like the compiled
+programs it prices. One JSON object::
+
+    {"version": 1, "updated_at": <wall>, "runs": <n folds>,
+     "buckets": {"<platform>|<shape_key>|g<width>": {
+         "platform", "shape", "g_bucket",
+         "epochs", "epoch_ms_total",           # step-cost accumulators
+         "compiles", "compile_ms_total",       # compile-cost accumulators
+         "cache_hits", "cache_misses", "runs", "updated_at"}}}
+
+Buckets are keyed by backend platform too — a CPU epoch and a TPU epoch of
+the same program family are different costs, and mixing them would wreck
+both predictions. Updates are read-modify-write under a best-effort
+``flock`` with an atomic replace, so concurrent fits (grid lanes under the
+supervisor, parallel test children) merge instead of clobbering. The store
+is bounded (:data:`MAX_BUCKETS`, oldest-updated evicted) and ADVISORY:
+corrupt or missing files degrade to "no prediction", never to an error on a
+training path.
+
+**Prediction fallback ladder** (:class:`CostModel`): exact (platform,
+shape, width) bucket -> nearest-width bucket of the same (platform, shape)
+scaled linearly by the width ratio (lane math is width-independent in the
+vmapped engine, so per-lane cost is ~flat across buckets; the XLA
+width-rounding caveat is a ~1 ulp numerics effect, not a cost effect) ->
+no prediction (``None``). ``predict_fit_eta`` prices ``epochs`` epochs plus
+``cold_programs`` cold compiles.
+
+**Scoring**: predictions are logged and scored, not yet acted on — the grid
+engine emits a schema-registered ``cost_model`` event each check window
+(prediction vs actual epoch time, residual pct, running MAPE, remaining-fit
+ETA) and ``obs report`` aggregates them into the per-bucket accuracy table.
+Wiring predictions into scheduling decisions is ROADMAP item 4's follow-up.
+
+stdlib only — the supervisor (which must never initialize a jax backend)
+and the watch/report CLIs all import this path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["STORE_VERSION", "STORE_NAME", "ENV_STORE_DIR", "MAX_BUCKETS",
+           "CostModel", "store_path", "load", "update_store",
+           "update_store_from_report", "fit_from_report", "bucket_key",
+           "rows_from_dispatch_stats"]
+
+STORE_VERSION = 1
+STORE_NAME = f"cost_model_v{STORE_VERSION}.json"
+# store dir override; default rides the compile-cache base dir so the model
+# accumulates exactly where the compiled programs it prices live
+ENV_STORE_DIR = "REDCLIFF_COST_MODEL_DIR"
+ENV_CACHE_DIR = "REDCLIFF_COMPILE_CACHE"  # literal: this module stays
+#                                           importable without jax/runtime
+MAX_BUCKETS = 512
+
+_lock = threading.Lock()
+
+
+def bucket_key(platform, shape_key, g_bucket):
+    """The store's bucket id: ``<platform>|<shape_key>|g<width>``."""
+    return f"{platform}|{shape_key}|g{int(g_bucket)}"
+
+
+def store_path(base_dir=None):
+    """Resolve the store file path, or None when no base directory is
+    known (no compile cache configured anywhere)."""
+    base = (base_dir or os.environ.get(ENV_STORE_DIR)
+            or os.environ.get(ENV_CACHE_DIR) or None)
+    if not base:
+        return None
+    if str(base).endswith(".json"):
+        return str(base)
+    return os.path.join(base, STORE_NAME)
+
+
+def _empty_store():
+    return {"version": STORE_VERSION, "updated_at": None, "runs": 0,
+            "buckets": {}}
+
+
+def _read_store(path):
+    """Parse a store file; None on missing/corrupt/wrong-version (the store
+    is advisory — a bad file means 'no model', never an exception)."""
+    try:
+        with open(path) as f:
+            store = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not (isinstance(store, dict)
+            and store.get("version") == STORE_VERSION
+            and isinstance(store.get("buckets"), dict)):
+        return None
+    return store
+
+
+class CostModel:
+    """Read-side view over a store dict (or an in-memory equivalent)."""
+
+    def __init__(self, store, path=None):
+        self._store = store or _empty_store()
+        self.path = path
+
+    @property
+    def buckets(self):
+        return self._store["buckets"]
+
+    @property
+    def runs(self):
+        return int(self._store.get("runs") or 0)
+
+    @property
+    def updated_at(self):
+        return self._store.get("updated_at")
+
+    def staleness_s(self, now=None):
+        """Seconds since the store last absorbed an observation (None for
+        a never-updated store)."""
+        if self.updated_at is None:
+            return None
+        return max((now if now is not None else time.time())
+                   - float(self.updated_at), 0.0)
+
+    # ------------------------------------------------------------------
+    def _candidates(self, shape_key, platform):
+        """Buckets matching (platform?, shape), best-sampled first."""
+        out = []
+        for b in self.buckets.values():
+            if b.get("shape") != shape_key:
+                continue
+            if platform is not None and b.get("platform") != platform:
+                continue
+            out.append(b)
+        # best-sampled first; platform name breaks ties deterministically
+        out.sort(key=lambda b: (-int(b.get("epochs") or 0),
+                                str(b.get("platform"))))
+        return out
+
+    def epoch_ms_mean(self, shape_key, g_bucket, platform=None):
+        """Mean observed epoch time for the EXACT bucket, or None."""
+        for b in self._candidates(shape_key, platform):
+            if int(b.get("g_bucket") or 0) == int(g_bucket) \
+                    and (b.get("epochs") or 0) > 0:
+                return float(b["epoch_ms_total"]) / int(b["epochs"])
+        return None
+
+    def predict_epoch_ms(self, shape_key, g_bucket, platform=None):
+        """Predicted wall ms for one epoch of ``shape_key`` at execution
+        width ``g_bucket``: exact bucket mean, else the nearest-width
+        bucket of the same shape scaled linearly by the width ratio, else
+        None (no evidence)."""
+        exact = self.epoch_ms_mean(shape_key, g_bucket, platform=platform)
+        if exact is not None:
+            return exact
+        want = int(g_bucket)
+        best = None
+        for b in self._candidates(shape_key, platform):
+            w = int(b.get("g_bucket") or 0)
+            n = int(b.get("epochs") or 0)
+            if w <= 0 or n <= 0:
+                continue
+            # nearest width on the (log-spaced) bucket ladder
+            d = abs(w - want) / max(w, want)
+            if best is None or d < best[0]:
+                best = (d, w, float(b["epoch_ms_total"]) / n)
+        if best is None:
+            return None
+        _, w, mean_ms = best
+        return mean_ms * (want / w)
+
+    def predict_compile_ms(self, shape_key, g_bucket, platform=None):
+        """Predicted wall ms of ONE cold compile of the bucket's program
+        family (exact bucket, else nearest-width same-shape unscaled —
+        compile cost is dominated by the program, not the lane count), or
+        None."""
+        want = int(g_bucket)
+        best = None
+        for b in self._candidates(shape_key, platform):
+            n = int(b.get("compiles") or 0)
+            if n <= 0:
+                continue
+            w = int(b.get("g_bucket") or 0)
+            d = 0.0 if w == want else abs(w - want) / max(w, want, 1)
+            mean = float(b.get("compile_ms_total") or 0.0) / n
+            if best is None or d < best[0]:
+                best = (d, mean)
+        return best[1] if best is not None else None
+
+    def predict_fit_eta(self, shape_key, g_bucket, epochs, platform=None,
+                        cold_programs=0):
+        """Predicted wall SECONDS for ``epochs`` epochs of ``shape_key`` at
+        width ``g_bucket`` plus ``cold_programs`` cold compiles; None when
+        the model has no step-cost evidence for the shape."""
+        em = self.predict_epoch_ms(shape_key, g_bucket, platform=platform)
+        if em is None:
+            return None
+        eta_ms = em * max(int(epochs), 0)
+        if cold_programs:
+            cm = self.predict_compile_ms(shape_key, g_bucket,
+                                         platform=platform)
+            if cm is not None:
+                eta_ms += cm * int(cold_programs)
+        return eta_ms / 1e3
+
+    def accuracy_rows(self):
+        """Store-side accuracy context per bucket (sample counts + means;
+        residual MAPE lives in the run's ``cost_model`` events, which obs
+        report joins with these rows)."""
+        rows = []
+        for key in sorted(self.buckets):
+            b = self.buckets[key]
+            n = int(b.get("epochs") or 0)
+            rows.append({
+                "bucket": key, "platform": b.get("platform"),
+                "shape": b.get("shape"), "g_bucket": b.get("g_bucket"),
+                "epochs": n,
+                "mean_epoch_ms": (round(b["epoch_ms_total"] / n, 3)
+                                  if n else None),
+                "compiles": int(b.get("compiles") or 0),
+                "mean_compile_ms": (
+                    round(b["compile_ms_total"] / b["compiles"], 3)
+                    if b.get("compiles") else None),
+                "cache_hits": int(b.get("cache_hits") or 0),
+                "cache_misses": int(b.get("cache_misses") or 0),
+                "runs": int(b.get("runs") or 0),
+                "updated_at": b.get("updated_at"),
+            })
+        return rows
+
+
+def load(base_dir=None):
+    """Load the persistent store as a :class:`CostModel`, or None when no
+    store directory is configured / no usable store file exists yet."""
+    path = store_path(base_dir)
+    if path is None or not os.path.exists(path):
+        return None
+    store = _read_store(path)
+    if store is None:
+        return None
+    return CostModel(store, path=path)
+
+
+def fit_from_report(report, platform="any"):
+    """In-memory model fit from one obs-report dict's ``cost_table`` (no
+    persistence) — offline training / tests."""
+    model = CostModel(_empty_store())
+    _merge_rows(model._store, _rows_from_cost_table(report), platform,
+                now=time.time())
+    return model
+
+
+# ---------------------------------------------------------------------------
+# write side
+# ---------------------------------------------------------------------------
+def _rows_from_cost_table(report):
+    rows = []
+    for r in (report or {}).get("cost_table") or []:
+        rows.append({
+            "shape": r.get("shape"), "g_bucket": r.get("g_bucket"),
+            "epochs": r.get("epochs") or 0,
+            "epoch_ms": r.get("total_epoch_ms") or 0.0,
+            "compiles": r.get("compiles") or 0,
+            "compile_ms": r.get("compile_ms") or 0.0,
+            "cache_hits": r.get("cache_hits") or 0,
+            "cache_misses": r.get("cache_misses") or 0,
+        })
+    return rows
+
+
+def rows_from_dispatch_stats(shape_key, stats):
+    """Store-update rows from one fit's ``dispatch_stats``: one row per
+    execution width from the exact per-width accumulators; the fit-level
+    compile/cache totals attach to the WIDEST row (cold compiles happen at
+    the fit's starting bucket, before compaction shrinks it).
+
+    Each width's FIRST epoch is excluded when more epochs exist: it
+    carries the compile / cache-priming skew (measured 20x the steady
+    state on short fits), and a store that averages it in systematically
+    overpredicts — compile cost is learned separately from the compile
+    accumulators."""
+    by_n = stats.get("epochs_by_width") or {}
+    by_ms = stats.get("epoch_ms_by_width") or {}
+    by_first = stats.get("first_epoch_ms_by_width") or {}
+    widths = sorted((int(w) for w in by_n), reverse=True)
+    rows = []
+    for i, w in enumerate(widths):
+        n = int(by_n.get(str(w), 0))
+        if n <= 0:
+            continue
+        total = float(by_ms.get(str(w), 0.0))
+        first = by_first.get(str(w))
+        if n > 1 and isinstance(first, (int, float)) \
+                and total - first > 0:
+            n -= 1
+            total -= float(first)
+        rows.append({
+            "shape": shape_key, "g_bucket": w, "epochs": n,
+            "epoch_ms": total,
+            "compiles": int(stats.get("compiles") or 0) if i == 0 else 0,
+            "compile_ms": float(stats.get("compile_ms") or 0.0)
+            if i == 0 else 0.0,
+            "cache_hits": int(stats.get("cache_hits") or 0) if i == 0 else 0,
+            "cache_misses": int(stats.get("cache_misses") or 0)
+            if i == 0 else 0,
+        })
+    return rows
+
+
+def _merge_rows(store, rows, platform, now):
+    changed = False
+    for r in rows:
+        shape, width = r.get("shape"), r.get("g_bucket")
+        if not shape or not width or not (r.get("epochs")
+                                          or r.get("compiles")):
+            continue
+        key = bucket_key(platform, shape, width)
+        b = store["buckets"].get(key)
+        if b is None:
+            b = store["buckets"][key] = {
+                "platform": platform, "shape": shape,
+                "g_bucket": int(width), "epochs": 0, "epoch_ms_total": 0.0,
+                "compiles": 0, "compile_ms_total": 0.0, "cache_hits": 0,
+                "cache_misses": 0, "runs": 0}
+        b["epochs"] += int(r.get("epochs") or 0)
+        b["epoch_ms_total"] = round(
+            b["epoch_ms_total"] + float(r.get("epoch_ms") or 0.0), 3)
+        b["compiles"] += int(r.get("compiles") or 0)
+        b["compile_ms_total"] = round(
+            b["compile_ms_total"] + float(r.get("compile_ms") or 0.0), 3)
+        b["cache_hits"] += int(r.get("cache_hits") or 0)
+        b["cache_misses"] += int(r.get("cache_misses") or 0)
+        b["runs"] += 1
+        b["updated_at"] = now
+        changed = True
+    if not changed:
+        return False
+    # bound the store: evict the longest-unobserved buckets past the cap
+    buckets = store["buckets"]
+    if len(buckets) > MAX_BUCKETS:
+        by_age = sorted(buckets, key=lambda k: buckets[k].get("updated_at")
+                        or 0.0)
+        for k in by_age[: len(buckets) - MAX_BUCKETS]:
+            del buckets[k]
+    store["updated_at"] = now
+    store["runs"] += 1
+    return True
+
+
+def update_store(base_dir, rows, platform, now=None):
+    """Fold observation ``rows`` (see :func:`rows_from_dispatch_stats`) into
+    the persistent store under ``base_dir`` — read-modify-write under a
+    best-effort flock, atomic replace, corrupt stores restarted fresh.
+    Returns the store path, or None when no base dir resolves."""
+    path = store_path(base_dir)
+    if path is None:
+        return None
+    now = time.time() if now is None else now
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with _lock:
+        lock_fd = None
+        try:
+            try:
+                import fcntl
+            except ImportError:
+                fcntl = None
+            if fcntl is not None:
+                try:
+                    lock_fd = os.open(path + ".lock",
+                                      os.O_CREAT | os.O_WRONLY)
+                except OSError:
+                    lock_fd = None  # lockless fallback (RO dir): atomic
+                    #                 replace still prevents torn files
+                if lock_fd is not None:
+                    try:
+                        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+                    except OSError:
+                        # flock unsupported (some network mounts): release
+                        # the fd NOW — the finally below only sees lock_fd
+                        os.close(lock_fd)
+                        lock_fd = None
+            store = _read_store(path) or _empty_store()
+            if not _merge_rows(store, rows, platform, now):
+                return path
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(store, f, indent=1, allow_nan=False)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        finally:
+            if lock_fd is not None:
+                os.close(lock_fd)  # closing drops the flock
+
+
+def update_store_from_report(base_dir, report, platform, now=None):
+    """Fold one obs-report's cost table into the persistent store — the
+    offline "train the model from a finished run's telemetry" path."""
+    return update_store(base_dir, _rows_from_cost_table(report), platform,
+                       now=now)

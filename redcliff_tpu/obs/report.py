@@ -16,10 +16,21 @@ wrote — ``metrics.jsonl`` (rotation chain, torn lines tolerated),
   ladders/compaction points by predicted wall-clock) and item 1's admission
   planner (pack requests into G-buckets the mesh can absorb);
 * a schema audit: every record validated against the versioned registry
-  (:mod:`redcliff_tpu.obs.schema`), torn-line counts per file.
+  (:mod:`redcliff_tpu.obs.schema`), torn-line counts per file;
+* the learned-cost-model view (obs/costmodel.py): per-(shape, G-bucket)
+  prediction accuracy from the run's ``cost_model`` residual events (MAPE,
+  sample counts, last ETA) joined with the persistent store's state
+  (bucket sample counts, staleness);
+* provenance of the cached real-TPU evidence
+  (``experiments/TPU_BENCH_CACHE.json``) so dated TPU measurements stay
+  visible next to CPU-fallback telemetry.
 
 ``--json`` prints the full report as one JSON object; ``-o PATH`` writes it.
 The builder is importable (:func:`build_report`) for tests and services.
+A missing or telemetry-less run dir exits with code 2 and a one-line
+diagnosis. This module also hosts the ``obs`` CLI dispatcher: ``report``,
+``watch`` (:mod:`redcliff_tpu.obs.watch`) and ``regress``
+(:mod:`redcliff_tpu.obs.regress`).
 """
 from __future__ import annotations
 
@@ -29,6 +40,7 @@ import json
 import os
 import sys
 
+from redcliff_tpu.obs import costmodel as _costmodel
 from redcliff_tpu.obs import schema as _schema
 from redcliff_tpu.obs.logging import read_jsonl
 
@@ -44,10 +56,49 @@ _SUM_STATS = ("train_dispatches", "val_dispatches", "epochs", "compactions",
               "prefetch_items", "train_time_ms", "val_time_ms")
 
 
-def _shape_key(shape):
-    if not isinstance(shape, dict) or not shape:
-        return "unknown"
-    return ",".join(f"{k}={shape[k]}" for k in sorted(shape))
+# canonical (shape, G-bucket) key shared with the cost-model store
+_shape_key = _schema.shape_key
+
+
+def _tpu_cache_provenance():
+    """Cached real-TPU evidence provenance (lazy import: regress owns the
+    reader and the repo-root default); None when neither cache file
+    parses."""
+    try:
+        from redcliff_tpu.obs import regress as _regress
+
+        return _regress.load_tpu_cache_provenance()
+    except Exception:  # noqa: BLE001 — provenance is garnish, never fatal
+        return None
+
+
+def _cost_model_store_info(run_cache_dir=None):
+    """State of the persistent cost-model store (obs/costmodel.py): where
+    it is, how much evidence it holds, how stale it is.
+
+    ``run_cache_dir`` is the VERSIONED jax compile-cache dir the run's
+    fit_start recorded; when the env-resolved store is absent (the report
+    is read on a host without the writer's env), the store that fit
+    actually wrote — ``<dirname(run_cache_dir)>/cost_model_v*.json`` — is
+    tried next."""
+    path = _costmodel.store_path()
+    if (path is None or not os.path.exists(path)) and run_cache_dir:
+        alt = _costmodel.store_path(os.path.dirname(str(run_cache_dir)))
+        if alt and os.path.exists(alt):
+            path = alt
+    if path is None:
+        return {"configured": False, "path": None}
+    # load(path) handles both base forms store_path supports — a directory
+    # and a direct *.json file (REDCLIFF_COST_MODEL_DIR may be either)
+    model = _costmodel.load(path)
+    if model is None:
+        return {"configured": True, "path": path, "present": False}
+    stale = model.staleness_s()
+    return {"configured": True, "path": path, "present": True,
+            "version": _costmodel.STORE_VERSION, "runs": model.runs,
+            "buckets": len(model.buckets),
+            "updated_at": model.updated_at,
+            "staleness_s": round(stale, 1) if stale is not None else None}
 
 
 def _read_ledger(run_dir, stats):
@@ -89,6 +140,8 @@ def build_report(run_dir):
     fits = []
     cur = None            # current fit context: {"shape_key", "shape", ...}
     cost = {}             # (shape_key, g_bucket) -> accumulators
+    cm_acc = {}           # (shape_key, g_bucket) -> residual-event accuracy
+    run_cache_dir = None  # the versioned compile-cache dir fit_start logs
     compactions, remeshes, failures, hangs = [], [], [], []
     anomalies = rollbacks = aborts = skipped_steps = 0
     quarantined = 0
@@ -126,6 +179,8 @@ def build_report(run_dir):
                    "resumed_from_epoch": rec.get("resumed_from_epoch"),
                    "mesh": rec.get("mesh")}
             fits.append(cur)
+            if rec.get("compile_cache_dir"):
+                run_cache_dir = rec["compile_cache_dir"]
         elif ev == "epoch":
             width = rec.get("grid_width") or 1
             if isinstance(rec.get("epoch_ms"), (int, float)):
@@ -142,6 +197,21 @@ def build_report(run_dir):
             c["compile_ms"] += rec.get("compile_ms") or 0.0
             c["cache_hits"] += rec.get("cache_hits") or 0
             c["cache_misses"] += rec.get("cache_misses") or 0
+        elif ev == "cost_model":
+            # learned-cost-model residual events (one per check window):
+            # the prediction-accuracy evidence the accuracy table reports
+            width = rec.get("grid_width") or (cur or {}).get("grid_width") \
+                or 1
+            key = (cur["shape_key"] if cur else "unknown", int(width))
+            a = cm_acc.setdefault(key, {
+                "samples": 0, "abs_pct_sum": 0.0, "sources": set(),
+                "last": None})
+            a["samples"] += 1
+            if isinstance(rec.get("residual_pct"), (int, float)):
+                a["abs_pct_sum"] += abs(rec["residual_pct"])
+            if rec.get("source"):
+                a["sources"].add(rec["source"])
+            a["last"] = rec
         elif ev == "compaction":
             compactions.append({k: rec.get(k) for k in
                                 ("epoch", "from_width", "to_width",
@@ -215,6 +285,22 @@ def build_report(run_dir):
         if n:
             by_bucket[str(width)] = by_bucket.get(str(width), 0) + n
 
+    # cost-model accuracy table: the run's prediction-vs-actual residuals
+    # per (shape, G-bucket) — the "is the learned model any good yet" view
+    cm_rows = []
+    for (sk, width), a in sorted(cm_acc.items()):
+        last = a["last"] or {}
+        cm_rows.append({
+            "shape": sk, "g_bucket": width, "samples": a["samples"],
+            "mape_pct": (round(a["abs_pct_sum"] / a["samples"], 2)
+                         if a["samples"] else None),
+            "sources": sorted(a["sources"]),
+            "last_predicted_epoch_ms": last.get("predicted_epoch_ms"),
+            "last_actual_epoch_ms": last.get("actual_epoch_ms"),
+            "last_eta_s": last.get("eta_s"),
+            "last_epoch": last.get("epoch"),
+        })
+
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
 
@@ -269,6 +355,9 @@ def build_report(run_dir):
             glob.glob(os.path.join(run_dir, "flight_record*.json"))),
         "checkpoint_dispatch_stats": ck_stats,
         "cost_table": cost_table,
+        "cost_model": {"accuracy": cm_rows,
+                       "store": _cost_model_store_info(run_cache_dir)},
+        "tpu_bench_cache": _tpu_cache_provenance(),
         "read_audit": {
             "metrics": mstats, "ledger": lstats,
             "schema_errors": [
@@ -347,6 +436,40 @@ def render_text(report):
             f"{row['shape']}")
     if not r["cost_table"]:
         out.append("  (no timed epochs recorded)")
+    cm = r.get("cost_model") or {}
+    rows = cm.get("accuracy") or []
+    out.append("cost model accuracy (prediction vs actual per shape x "
+               "G-bucket, obs/costmodel.py):")
+    if rows:
+        out.append(f"  {'g_bucket':>8} {'samples':>8} {'mape_pct':>9} "
+                   f"{'last_pred':>10} {'last_act':>9} {'eta':>8}  shape")
+        for row in rows:
+            out.append(
+                f"  {row['g_bucket']:>8} {row['samples']:>8} "
+                f"{row['mape_pct'] if row['mape_pct'] is not None else '-':>9} "
+                f"{_fmt_ms(row['last_predicted_epoch_ms']):>10} "
+                f"{_fmt_ms(row['last_actual_epoch_ms']):>9} "
+                f"{_fmt_ms((row['last_eta_s'] or 0) * 1e3) if row['last_eta_s'] is not None else '-':>8}  "
+                f"{row['shape']}")
+    else:
+        out.append("  (no cost_model residual events in this run)")
+    st = cm.get("store") or {}
+    if st.get("present"):
+        stale = st.get("staleness_s")
+        out.append(f"  store: {st['buckets']} bucket(s) over {st['runs']} "
+                   f"fold(s), updated {_fmt_ms((stale or 0) * 1e3)} ago "
+                   f"({st['path']})")
+    elif st.get("configured"):
+        out.append(f"  store: not written yet ({st['path']})")
+    else:
+        out.append("  store: no compile-cache dir configured "
+                   "(REDCLIFF_COMPILE_CACHE / compile_cache_dir)")
+    tc = r.get("tpu_bench_cache")
+    if tc:
+        out.append(f"cached real-TPU evidence: {tc.get('value')} w/s on "
+                   f"{tc.get('device')}, measured {tc.get('measured_at')} "
+                   f"({tc.get('file')}; pallas prox max err "
+                   f"{tc.get('pallas_prox_max_abs_err')})")
     audit = r["read_audit"]
     torn = (audit["metrics"].get("torn_lines", 0)
             + audit["ledger"].get("torn_lines", 0))
@@ -362,20 +485,46 @@ def render_text(report):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m redcliff_tpu.obs",
-        description="Telemetry-spine tooling (docs/ARCHITECTURE.md "
-                    "'Telemetry spine').")
+        description="Performance-observatory tooling (docs/ARCHITECTURE.md "
+                    "'Telemetry spine' / 'Performance observatory').")
     sub = ap.add_subparsers(dest="cmd", required=True)
     rp = sub.add_parser(
         "report", help="join metrics.jsonl + run_ledger.jsonl + checkpointed "
-                       "dispatch_stats into a per-run summary and a "
-                       "per-(shape, G-bucket) cost table")
+                       "dispatch_stats into a per-run summary, the "
+                       "per-(shape, G-bucket) cost table, and the "
+                       "cost-model accuracy view")
     rp.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
     rp.add_argument("--json", action="store_true",
                     help="print the full report as one JSON object")
     rp.add_argument("-o", "--output", default=None,
                     help="also write the JSON report to this path")
+    wp = sub.add_parser(
+        "watch", help="live, rotation-chain-aware tail of a run dir: lanes, "
+                      "G-bucket, epoch rate, stalls, numerics, heartbeat "
+                      "ages, cost-model ETA (obs/watch.py)")
+    wp.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
+    wp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    wp.add_argument("--json", action="store_true",
+                    help="with --once: print the snapshot as one "
+                         "schema-valid JSON object")
+    wp.add_argument("--interval", type=float, default=2.0,
+                    help="follow-mode refresh seconds (default 2)")
+    gp = sub.add_parser(
+        "regress", help="compare the newest BENCH_r*.json against the prior "
+                        "trajectory per metric family with noise bands "
+                        "(obs/regress.py; exit 3 when a family regressed)")
+    gp.add_argument("--bench-dir", default=None)
+    gp.add_argument("--current", default=None)
+    gp.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.cmd == "report":
+        from redcliff_tpu.obs.watch import diagnose_run_dir
+
+        diag = diagnose_run_dir(args.run_dir)
+        if diag is not None:
+            print(f"obs report: {diag}", file=sys.stderr)
+            return 2
         report = build_report(args.run_dir)
         if args.output:
             with open(args.output, "w") as f:
@@ -387,6 +536,22 @@ def main(argv=None):
         else:
             print(render_text(report))
         return 0
+    if args.cmd == "watch":
+        from redcliff_tpu.obs.watch import run_watch
+
+        return run_watch(args.run_dir, once=args.once, as_json=args.json,
+                         interval=args.interval)
+    if args.cmd == "regress":
+        from redcliff_tpu.obs.regress import main as regress_main
+
+        rargv = []
+        if args.bench_dir:
+            rargv += ["--bench-dir", args.bench_dir]
+        if args.current:
+            rargv += ["--current", args.current]
+        if args.json:
+            rargv.append("--json")
+        return regress_main(rargv)
     return 2
 
 

@@ -23,7 +23,8 @@ import re
 from dataclasses import dataclass, field
 
 __all__ = ["SCHEMA_VERSION", "EventSchema", "EVENTS", "LEDGER_EVENTS",
-           "validate_record", "validate_records", "SHAPE_KEYS", "shape_desc"]
+           "validate_record", "validate_records", "SHAPE_KEYS", "shape_desc",
+           "shape_key", "SPAN_NAMES"]
 
 SCHEMA_VERSION = 1
 
@@ -41,6 +42,40 @@ def shape_desc(config):
     :data:`SHAPE_KEYS` field the config defines (non-None)."""
     return {k: getattr(config, k) for k in SHAPE_KEYS
             if getattr(config, k, None) is not None}
+
+
+def _shape_val(v):
+    # normalize through the same tuple->list coercion the jsonl round trip
+    # applies, so a key computed live (config tuples) and one computed from
+    # re-read metrics (JSON lists) are IDENTICAL — the cost-model store
+    # merges on this string
+    if isinstance(v, tuple):
+        v = list(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(str(_shape_val(x)) for x in v) + "]"
+    return v
+
+
+def shape_key(shape):
+    """Canonical string key for a ``fit_start.shape`` dict — the shape half
+    of the (shape, G-bucket) cost axis shared by the obs report's cost
+    table and the learned cost model's store (obs/costmodel.py). Stable
+    across the metrics round trip (tuples serialize as JSON lists)."""
+    if not isinstance(shape, dict) or not shape:
+        return "unknown"
+    return ",".join(f"{k}={_shape_val(shape[k])}" for k in sorted(shape))
+
+
+# the CLOSED span-name registry: every `obs.span(...)` / `record_span(...)`
+# name literal in redcliff_tpu/ must appear here (and in the
+# docs/ARCHITECTURE.md span table) — enforced by the AST source tripwire in
+# tests/test_observability.py, the span analog of the event registry below
+SPAN_NAMES = frozenset({
+    "grid.dispatch", "grid.check_window", "grid.compaction", "grid.remesh",
+    "grid.ckpt_save",
+    "ckpt.write", "ckpt.async_write", "ckpt.submit_barrier",
+    "prefetch.fill", "prefetch.stall", "shard.load",
+})
 
 # identity fields the MetricLogger stamps on every record (schema v1);
 # optional on read: pre-v1 files and third-party writers lack them
@@ -94,7 +129,7 @@ EVENTS = {
         optional=("train_config", "resume_epoch", "training_mode", "shape",
                   "grid_size", "grid_width", "lanes_padded", "stream_mode",
                   "mesh", "compile_cache_dir", "resumed_from_epoch",
-                  "resumed_from", "points")),
+                  "resumed_from", "points", "max_iter")),
     "epoch": _ev(
         "trainers + grid engine",
         required=("epoch",),
@@ -164,6 +199,25 @@ EVENTS = {
         "obs.flight (artifact file, not a jsonl line)",
         required=("reason", "components"),
         optional=("schema_version", "extra")),
+    "cost_model": _ev(
+        "grid engine (obs/costmodel.py prediction-vs-actual scoring, one "
+        "per check window once a prediction exists)",
+        required=("epoch", "predicted_epoch_ms", "actual_epoch_ms"),
+        optional=("residual_pct", "grid_width", "source", "eta_s",
+                  "epochs_remaining", "samples", "mape_pct",
+                  "predicted_compile_ms")),
+    "watch": _ev(
+        "obs.watch (snapshot artifact / --once --json output, not a jsonl "
+        "line)",
+        required=("run_dir", "fits"),
+        optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
+                  "heartbeats", "attempts", "incidents", "read_audit")),
+    "regression": _ev(
+        "obs.regress (bench-artifact sentinel block, not a jsonl line)",
+        required=("regressions",),
+        optional=("schema_version", "current_round", "rounds_compared",
+                  "families_checked", "improvements", "skipped", "notes",
+                  "tpu_cache")),
 }
 
 # ---------------------------------------------------------------------------
@@ -174,7 +228,7 @@ LEDGER_EVENTS = {
     "attempt": _ev(
         "supervisor",
         required=("attempt", "cmd", "rc", "classification", "action"),
-        optional=("backoff_s", "started_at", "duration_s", "mesh")),
+        optional=("backoff_s", "started_at", "duration_s", "mesh", "eta")),
     "remesh": _ev(
         "supervisor",
         required=("from_devices", "to_devices"),

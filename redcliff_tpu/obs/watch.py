@@ -1,0 +1,398 @@
+"""Live run watch: what is this run doing RIGHT NOW, and when will it end?
+
+``python -m redcliff_tpu.obs watch <run_dir>`` tails a run directory's
+telemetry — ``metrics.jsonl`` (rotation chain, torn mid-append tails
+tolerated) + ``run_ledger.jsonl`` + the ``dispatch_stats`` snapshot inside
+``grid_checkpoint.pkl`` — and renders the operator view the report CLI's
+post-mortem join cannot give: lanes live, the current G-bucket, epoch rate,
+the stall breakdown (ckpt / barrier / prefetch / compile), numerics skip
+counters, heartbeat ages, and the learned cost model's ETA per fit and for
+the whole run (``cost_model`` events, obs/costmodel.py).
+
+Follow mode re-snapshots the whole rotation chain every ``--interval``
+seconds rather than holding a file offset: a chain re-read is O(run dir)
+and therefore cheap at metrics scale, and it is the only approach that is
+automatically correct across rotation boundaries (``metrics.jsonl`` ->
+``.1``), truncation, a writer SIGKILLed mid-append, and a supervisor
+restart swapping the writing pid — every case a byte-offset tail gets
+wrong.
+
+``--once`` prints a single snapshot and exits; ``--once --json`` prints the
+snapshot as one strict-JSON object that validates against the registered
+``watch`` event schema (:mod:`redcliff_tpu.obs.schema`) — the scriptable /
+testable contract. A missing or telemetry-less run dir exits with code 2
+and a one-line diagnosis (never a traceback).
+
+"Heartbeat ages" here are the OUTSIDE view: seconds since each telemetry
+source (metrics file mtime, newest record, newest ``epoch`` event, newest
+emitted span per component, ledger) last moved. The in-process watchdog
+(runtime/watchdog.py) owns the authoritative in-memory heartbeat registry;
+a watcher on another host only sees what reached disk.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from redcliff_tpu.obs import schema as _schema
+from redcliff_tpu.obs.logging import jsonl_files, read_jsonl
+
+__all__ = ["build_snapshot", "render_text", "diagnose_run_dir", "run_watch"]
+
+
+def diagnose_run_dir(run_dir):
+    """One-line diagnosis for an unwatchable run dir, or None when it holds
+    telemetry (shared by the report CLI's exit-2 contract)."""
+    if not os.path.exists(run_dir):
+        return f"run dir does not exist: {run_dir}"
+    if not os.path.isdir(run_dir):
+        return f"not a directory: {run_dir}"
+    if (not jsonl_files(os.path.join(run_dir, "metrics.jsonl"))
+            and not os.path.exists(os.path.join(run_dir,
+                                                "run_ledger.jsonl"))):
+        return (f"no telemetry in {run_dir}: neither metrics.jsonl (or its "
+                f"rotation chain) nor run_ledger.jsonl — is this a run "
+                f"directory?")
+    return None
+
+
+def _fit_view(rec):
+    shape = rec.get("shape")
+    return {
+        "model": rec.get("model"),
+        "shape": _schema.shape_key(shape),
+        "grid_size": rec.get("grid_size"),
+        "grid_width": rec.get("grid_width"),   # updated by compaction/remesh
+        "stream_mode": rec.get("stream_mode"),
+        "max_iter": rec.get("max_iter"),
+        "started_wall": rec.get("wall_time"),
+        "resumed_from_epoch": rec.get("resumed_from_epoch"),
+        "last_epoch": None, "lanes_live": None, "num_quarantined": 0,
+        "guarded_steps_skipped": 0, "epoch_ms_last": None,
+        "epochs_seen": 0, "first_epoch": None, "first_epoch_wall": None,
+        "last_epoch_wall": None, "epoch_rate_per_min": None,
+        "eta": None, "done": False,
+        # a later fit_start in the same metrics chain (a supervisor
+        # re-attempt / resume) supersedes this one: it is no longer live
+        # even though it never wrote a fit_end (it crashed/was killed)
+        "superseded": False,
+    }
+
+
+def _fit_eta(fit, now):
+    """Remaining-work estimate for one fit: the newest ``cost_model``
+    event's ETA discounted by the time since it was computed; fallback —
+    extrapolate the observed check-window epoch rate to ``max_iter``."""
+    cm = fit.pop("_cost_model_last", None)
+    if cm is not None and isinstance(cm.get("eta_s"), (int, float)):
+        age = max(now - (cm.get("wall_time") or now), 0.0)
+        return {"eta_s": round(max(cm["eta_s"] - age, 0.0), 3),
+                "source": f"cost_model:{cm.get('source') or '?'}",
+                "predicted_epoch_ms": cm.get("predicted_epoch_ms"),
+                "epochs_remaining": cm.get("epochs_remaining"),
+                "as_of_epoch": cm.get("epoch")}
+    rate = fit.get("epoch_rate_per_min")
+    if (rate and fit.get("max_iter") is not None
+            and fit.get("last_epoch") is not None):
+        remaining = max(fit["max_iter"] - fit["last_epoch"] - 1, 0)
+        # discount by time already elapsed since the last observed epoch —
+        # symmetrical with the cost_model branch; a wedged run's eta decays
+        # to 0 instead of promising the same remaining work forever
+        age = max(now - (fit.get("last_epoch_wall") or now), 0.0)
+        return {"eta_s": round(max(remaining / rate * 60.0 - age, 0.0), 3),
+                "source": "epoch_rate",
+                "predicted_epoch_ms": round(60e3 / rate, 3),
+                "epochs_remaining": remaining,
+                "as_of_epoch": fit["last_epoch"]}
+    return None
+
+
+# follow-mode cache for the checkpointed stall breakdown: the grid
+# checkpoint pickles EVERY lane's params (hundreds of MB on real sweeps),
+# so unpickling it each refresh tick would burn the fit host's disk/CPU to
+# extract a handful of scalars — re-read only when the file changes
+_ckpt_stall_cache = {}
+
+
+def _checkpoint_stalls(run_dir):
+    """Stall/compile breakdown from the newest checkpointed dispatch_stats
+    (the only mid-run source: fit_end has not happened yet). Cached on the
+    checkpoint file's (mtime, size) signature."""
+    from redcliff_tpu.obs import report as _report
+
+    path = os.path.join(run_dir, "grid_checkpoint.pkl")
+    try:
+        st = os.stat(path)
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        sig = None
+    cached = _ckpt_stall_cache.get(run_dir)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    ck = _report._checkpoint_stats(run_dir)
+    if not isinstance(ck, dict):
+        _ckpt_stall_cache[run_dir] = (sig, None)
+        return None
+    out = {k: (round(v, 3) if isinstance(v, float) else v)
+           for k in ("ckpt_stall_ms", "ckpt_barrier_stall_ms",
+                     "prefetch_stall_ms", "compile_ms", "train_time_ms",
+                     "val_time_ms", "epochs", "lanes_live", "grid_width")
+           for v in (ck.get(k),)}
+    out["source"] = "grid_checkpoint.pkl"
+    _ckpt_stall_cache[run_dir] = (sig, out)
+    return out
+
+
+def build_snapshot(run_dir, now=None):
+    """One watch snapshot as a plain dict (``event="watch"`` — validates
+    against the registered schema; importable for services and tests)."""
+    now = time.time() if now is None else now
+    mstats = {}
+    try:
+        records = read_jsonl(run_dir, stats=mstats)
+    except FileNotFoundError:
+        records, mstats = [], {"files": [], "records": 0, "torn_lines": 0}
+    lstats = {}
+    ledger_path = os.path.join(run_dir, "run_ledger.jsonl")
+    ledger = (read_jsonl(ledger_path, stats=lstats)
+              if os.path.exists(ledger_path) else [])
+
+    fits, incidents = [], []
+    cur = None
+    anomalies = rollbacks = aborts = 0
+    last_span_by_component = {}
+    last_wall = last_epoch_wall = None
+    for rec in records:
+        wt = rec.get("wall_time")
+        if isinstance(wt, (int, float)):
+            last_wall = wt if last_wall is None else max(last_wall, wt)
+        ev = rec.get("event")
+        if ev == "fit_start":
+            # a run dir's fits are sequential (attempts/resumes append to
+            # one chain): any earlier fit still "live" at this point died
+            # without a fit_end — mark it superseded, not LIVE
+            for f in fits:
+                if not f["done"]:
+                    f["superseded"] = True
+            cur = _fit_view(rec)
+            fits.append(cur)
+        elif ev == "epoch" and cur is not None:
+            e = rec.get("epoch")
+            cur["last_epoch"] = e
+            cur["epochs_seen"] += 1
+            if cur["first_epoch"] is None:
+                cur["first_epoch"], cur["first_epoch_wall"] = e, wt
+            cur["last_epoch_wall"] = wt
+            last_epoch_wall = wt
+            for k_rec, k_fit in (("lanes_live", "lanes_live"),
+                                 ("num_quarantined", "num_quarantined"),
+                                 ("guarded_steps_skipped",
+                                  "guarded_steps_skipped"),
+                                 ("epoch_ms", "epoch_ms_last"),
+                                 ("grid_width", "grid_width")):
+                if rec.get(k_rec) is not None:
+                    cur[k_fit] = rec[k_rec]
+        elif ev == "cost_model" and cur is not None:
+            cur["_cost_model_last"] = rec
+        elif ev in ("compaction", "remesh") and cur is not None:
+            if rec.get("to_width") is not None:
+                cur["grid_width"] = rec["to_width"]
+        elif ev == "anomaly":
+            anomalies += 1
+        elif ev == "numerics":
+            kind = rec.get("kind")
+            rollbacks += kind == "rollback"
+            aborts += kind == "abort"
+        elif ev == "fit_end" and cur is not None:
+            cur["done"] = True
+        elif ev in ("hang", "host_lost", "hang_exit", "host_lost_exit"):
+            incidents.append({"event": ev, "wall_time": wt,
+                              "components": sorted(
+                                  rec.get("components") or {})})
+        elif ev == "span":
+            comp = (rec.get("component")
+                    or str(rec.get("name", "")).partition(".")[0])
+            if comp and isinstance(wt, (int, float)):
+                last_span_by_component[comp] = wt
+
+    for fit in fits:
+        if fit["superseded"]:
+            # dead attempt: no rate extrapolation, no eta contribution
+            fit.pop("_cost_model_last", None)
+            continue
+        n_e, t0, t1 = (fit["epochs_seen"], fit["first_epoch_wall"],
+                       fit["last_epoch_wall"])
+        if (n_e > 1 and isinstance(t0, (int, float))
+                and isinstance(t1, (int, float)) and t1 > t0
+                and fit["last_epoch"] is not None
+                and fit["first_epoch"] is not None
+                and fit["last_epoch"] > fit["first_epoch"]):
+            # epochs advanced per wall minute, from the check-window cadence
+            # (exact even when check_every > 1: the epoch NUMBERS advance)
+            fit["epoch_rate_per_min"] = round(
+                (fit["last_epoch"] - fit["first_epoch"]) / (t1 - t0) * 60.0,
+                3)
+        fit["eta"] = None if fit["done"] else _fit_eta(fit, now)
+        fit.pop("_cost_model_last", None)
+
+    live = [f for f in fits if not f["done"] and not f["superseded"]]
+    etas = [f["eta"]["eta_s"] for f in live
+            if f.get("eta") and isinstance(f["eta"].get("eta_s"),
+                                           (int, float))]
+    attempts = [r for r in ledger if r.get("event") == "attempt"]
+    final = next((r for r in reversed(ledger) if r.get("event") == "final"),
+                 None)
+
+    files = mstats.get("files") or []
+    try:
+        newest_mtime = max(os.path.getmtime(p) for p in files) \
+            if files else None
+    except OSError:
+        newest_mtime = None
+    heartbeats = {
+        "metrics_file_age_s": (round(now - newest_mtime, 3)
+                               if newest_mtime is not None else None),
+        "last_record_age_s": (round(now - last_wall, 3)
+                              if last_wall is not None else None),
+        "last_epoch_age_s": (round(now - last_epoch_wall, 3)
+                             if last_epoch_wall is not None else None),
+        "span_age_s": {c: round(now - t, 3)
+                       for c, t in sorted(last_span_by_component.items())},
+    }
+    # the numerics skip counter of the run as it stands NOW: live (or
+    # completed) fits only — a crashed superseded attempt's stale counter
+    # must not shadow the restarted attempt's
+    current_fits = [f for f in fits if not f["superseded"]] or fits
+    last_skipped = max((f["guarded_steps_skipped"] or 0
+                        for f in current_fits), default=0)
+    return {
+        "event": "watch",
+        "wall_time": now,
+        "schema_version": _schema.SCHEMA_VERSION,
+        "run_dir": os.path.abspath(run_dir),
+        "ok": bool(records or ledger),
+        "fits": fits,
+        "grid_eta_s": round(sum(etas), 3) if etas else None,
+        "stalls": _checkpoint_stalls(run_dir),
+        "numerics": {"anomaly_events": anomalies, "rollbacks": rollbacks,
+                     "aborts": aborts,
+                     "guarded_steps_skipped": int(last_skipped)},
+        "heartbeats": heartbeats,
+        "incidents": incidents,
+        "attempts": {"n": len(attempts),
+                     "last_classification": (attempts[-1].get(
+                         "classification") if attempts else None),
+                     "last_eta": (attempts[-1].get("eta")
+                                  if attempts else None),
+                     "final": (final or {}).get("classification")},
+        "read_audit": {"records": mstats.get("records", 0),
+                       "torn_lines": (mstats.get("torn_lines", 0)
+                                      + lstats.get("torn_lines", 0)),
+                       "files": [os.path.basename(p) for p in files]},
+    }
+
+
+def _fmt_age(s):
+    if s is None:
+        return "-"
+    if s >= 3600:
+        return f"{s / 3600:.1f}h"
+    if s >= 60:
+        return f"{s / 60:.1f}m"
+    return f"{s:.1f}s"
+
+
+def _fmt_eta(eta):
+    if not eta or eta.get("eta_s") is None:
+        return "-"
+    return f"{_fmt_age(eta['eta_s'])} ({eta['source']})"
+
+
+def render_text(snap):
+    """Terminal rendering of one :func:`build_snapshot` dict."""
+    out = [f"watch: {snap['run_dir']}  "
+           f"(records {snap['read_audit']['records']}, torn "
+           f"{snap['read_audit']['torn_lines']})"]
+    hb = snap["heartbeats"]
+    out.append(f"  ages: metrics file {_fmt_age(hb['metrics_file_age_s'])} |"
+               f" last record {_fmt_age(hb['last_record_age_s'])} | last "
+               f"epoch {_fmt_age(hb['last_epoch_age_s'])}")
+    if hb["span_age_s"]:
+        out.append("  span ages: " + "  ".join(
+            f"{c}={_fmt_age(a)}" for c, a in hb["span_age_s"].items()))
+    at = snap["attempts"]
+    if at["n"]:
+        out.append(f"  supervisor: {at['n']} attempt(s), last "
+                   f"{at['last_classification']}"
+                   + (f", final {at['final']}" if at["final"] else "")
+                   + (f", eta-at-exit {_fmt_age(at['last_eta']['eta_s'])}"
+                      if at.get("last_eta")
+                      and at["last_eta"].get("eta_s") is not None else ""))
+    for i, f in enumerate(snap["fits"]):
+        state = ("done" if f["done"]
+                 else "dead" if f.get("superseded") else "LIVE")
+        width = f.get("grid_width")
+        out.append(
+            f"  fit {i} [{state}] {f['model']} G={f['grid_size']} "
+            f"bucket={width} mode={f['stream_mode'] or '?'} epoch "
+            f"{f['last_epoch']}"
+            + (f"/{f['max_iter']}" if f.get("max_iter") is not None else "")
+            + f" lanes_live={f['lanes_live']} "
+            f"quarantined={f['num_quarantined']} "
+            f"skipped={f['guarded_steps_skipped']}")
+        out.append(
+            f"         rate={f['epoch_rate_per_min'] or '-'} epoch/min  "
+            f"last_epoch_ms={f['epoch_ms_last'] or '-'}  "
+            f"eta={_fmt_eta(f['eta'])}")
+    if not snap["fits"]:
+        out.append("  (no fit_start recorded yet)")
+    if snap["grid_eta_s"] is not None:
+        out.append(f"  whole-run ETA: {_fmt_age(snap['grid_eta_s'])}")
+    st = snap["stalls"]
+    if st:
+        out.append(
+            f"  stalls (from {st['source']}, epoch {st.get('epochs')}): "
+            f"ckpt={st.get('ckpt_stall_ms')}ms "
+            f"barrier={st.get('ckpt_barrier_stall_ms')}ms "
+            f"prefetch={st.get('prefetch_stall_ms')}ms "
+            f"compile={st.get('compile_ms')}ms")
+    n = snap["numerics"]
+    out.append(f"  numerics: {n['anomaly_events']} anomaly, "
+               f"{n['rollbacks']} rollback, {n['aborts']} abort, "
+               f"{n['guarded_steps_skipped']} guarded step(s) skipped")
+    if snap["incidents"]:
+        out.append(f"  incidents: " + "; ".join(
+            f"{i['event']}({','.join(i['components'])})"
+            for i in snap["incidents"]))
+    return "\n".join(out)
+
+
+def run_watch(run_dir, once=False, as_json=False, interval=2.0,
+              max_ticks=None, out=None):
+    """CLI body. ``max_ticks`` bounds the follow loop (tests); returns the
+    exit code."""
+    out = out if out is not None else sys.stdout
+    diag = diagnose_run_dir(run_dir)
+    if diag is not None:
+        print(f"obs watch: {diag}", file=sys.stderr)
+        return 2
+    ticks = 0
+    while True:
+        snap = build_snapshot(run_dir)
+        if as_json:
+            json.dump(snap, out, indent=2, allow_nan=False)
+            out.write("\n")
+        else:
+            if not once and out.isatty():
+                out.write("\x1b[H\x1b[2J")  # home + clear: live refresh
+            out.write(render_text(snap) + "\n")
+        out.flush()
+        ticks += 1
+        if once or (max_ticks is not None and ticks >= max_ticks):
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0

@@ -62,6 +62,7 @@ from redcliff_tpu.runtime.preempt import (DeadlineExceeded, Preempted,
                                           PreemptionGuard)
 from redcliff_tpu import obs
 from redcliff_tpu.obs import MetricLogger, profiler_trace
+from redcliff_tpu.obs import costmodel as _costmodel
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.precision import matmul_precision_ctx
 
@@ -1236,13 +1237,25 @@ class RedcliffGridRunner:
             # async-checkpoint submit barriers) folded from obs.counters
             "train_time_ms": 0.0, "val_time_ms": 0.0,
             "epoch_ms_by_width": {}, "epochs_by_width": {},
+            # first observed epoch per width: carries the cold/warm compile
+            # and cache-priming skew, so the cost-model store and the
+            # observed-mean predictor both exclude it (steady-state cost is
+            # what scheduling needs; raw per-epoch wall stays in
+            # epoch_ms_by_width and the epoch events)
+            "first_epoch_ms_by_width": {},
             "prefetch_stall_ms": 0.0, "prefetch_items": 0,
             "ckpt_barrier_stall_ms": 0.0,
             # degraded-mesh resume accounting (parallel/remesh.py): count +
             # the full plan record (old/new width, lanes migrated, plan
             # latency) when THIS attempt re-sharded a checkpoint onto a
             # different mesh
-            "remeshes": 1 if remesh_info else 0, "remesh": remesh_info}
+            "remeshes": 1 if remesh_info else 0, "remesh": remesh_info,
+            # learned-cost-model scoring (obs/costmodel.py): the remaining-
+            # fit ETA and the prediction-residual summary, refreshed every
+            # check window — the obs watch CLI and the supervisor's
+            # per-attempt ledger ETA both read these through the run's
+            # cost_model events
+            "eta": None, "cost_model": None}
         compile_t0 = compileobs.snapshot()
         counters_t0 = obs.counters.snapshot()
         width_nominal = Gx
@@ -1262,6 +1275,23 @@ class RedcliffGridRunner:
         # (audit metadata, NOT part of the resume fingerprint) and in the
         # run's metrics — the other half of the degraded-resume audit trail
         mesh_desc = remesh.mesh_shape(self._mesh_full)
+        # learned cost model (obs/costmodel.py): the persistent store rides
+        # the compile-cache base dir. Loaded once per fit, host-side only;
+        # predictions are scored against observed epoch times each check
+        # window (cost_model events + stats["eta"]) — they do not steer any
+        # scheduling decision yet (ROADMAP item 4's follow-up)
+        # resolution order mirrors costmodel.store_path() so the store this
+        # fit writes is the store obs report reads: the explicit
+        # REDCLIFF_COST_MODEL_DIR override first, then the compile-cache
+        # base (config knob, then env)
+        cm_base = (os.environ.get(_costmodel.ENV_STORE_DIR)
+                   or getattr(tc, "compile_cache_dir", None)
+                   or os.environ.get(compileobs.ENV_CACHE_DIR) or None)
+        cm_platform = jax.default_backend()
+        cost_model = _costmodel.load(cm_base) if cm_base else None
+        cm_shape_key = obs.schema.shape_key(self._shape_desc())
+        cm_n = 0          # residual samples scored this fit
+        cm_abs_pct = 0.0  # running sum of |residual_pct| (MAPE numerator)
         logger = MetricLogger(log_dir)
         if wd is not None:
             # hang incidents land in THIS fit's metrics.jsonl
@@ -1269,7 +1299,7 @@ class RedcliffGridRunner:
         logger.log("fit_start", model="RedcliffGridRunner", grid_size=G_real,
                    grid_width=Gx, lanes_padded=stats["lanes_padded"],
                    training_mode=self.model.config.training_mode,
-                   shape=self._shape_desc(),
+                   shape=self._shape_desc(), max_iter=max_iter,
                    stream_mode=base_stream, mesh=mesh_desc,
                    compile_cache_dir=jax.config.jax_compilation_cache_dir,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
@@ -1448,6 +1478,7 @@ class RedcliffGridRunner:
                 stats["epoch_ms_by_width"].get(wkey, 0.0) + epoch_ms)
             stats["epochs_by_width"][wkey] = (
                 stats["epochs_by_width"].get(wkey, 0) + 1)
+            stats["first_epoch_ms_by_width"].setdefault(wkey, epoch_ms)
             cdelta = obs.counters.delta(counters_t0)
             stats["prefetch_stall_ms"] = cdelta.get("prefetch_stall_ms", 0.0)
             stats["prefetch_items"] = int(cdelta.get("prefetch_items", 0))
@@ -1625,6 +1656,66 @@ class RedcliffGridRunner:
                             num_quarantined=int((failed_host >= 0).sum()),
                             guarded_steps_skipped=int(skipped_host.sum()),
                             epoch_ms=round(epoch_ms, 3))
+                # ---- learned-cost-model scoring (obs/costmodel.py) -------
+                # score the prediction that existed BEFORE this epoch ran:
+                # the persistent store's (shape, G-bucket) estimate when one
+                # is available, else the fit's own prior-epoch mean at this
+                # width. Pure host arithmetic on numbers already measured —
+                # no device sync, nothing when no prediction exists yet.
+                # The width's FIRST epoch is never scored: it carries the
+                # compile/cache-priming skew the model deliberately does
+                # not learn (a steady-state prediction vs a compile epoch
+                # is not a residual, it is a category error that would
+                # dominate MAPE)
+                pred_ms = cm_src = None
+                steady_epoch = stats["epochs_by_width"].get(wkey, 0) > 1
+                if steady_epoch and cost_model is not None:
+                    pred_ms = cost_model.predict_epoch_ms(
+                        cm_shape_key, Gx, platform=cm_platform)
+                    if pred_ms is not None:
+                        cm_src = "store"
+                if pred_ms is None:
+                    # prior-epoch mean at this width, ALWAYS excluding the
+                    # width's first epoch — it carries the compile/
+                    # cache-priming skew (~20x steady state) and using it
+                    # as the lone prior would emit one wildly-wrong scored
+                    # window whose eta could land in a checkpoint or the
+                    # supervisor ledger before the next window corrects it.
+                    # No post-first-epoch prior yet -> no score this window
+                    n_w = stats["epochs_by_width"].get(wkey, 0)
+                    tot_prior = stats["epoch_ms_by_width"][wkey] - epoch_ms
+                    n_prior = n_w - 1
+                    first = stats["first_epoch_ms_by_width"].get(wkey)
+                    if first is not None and n_prior >= 1:
+                        tot_prior -= first
+                        n_prior -= 1
+                    if n_prior > 0 and tot_prior > 0:
+                        pred_ms = tot_prior / n_prior
+                        cm_src = "observed"
+                if pred_ms is not None and pred_ms > 0:
+                    residual_pct = 100.0 * (epoch_ms - pred_ms) / pred_ms
+                    cm_n += 1
+                    cm_abs_pct += abs(residual_pct)
+                    epochs_remaining = max(max_iter - it - 1, 0)
+                    eta_s = epochs_remaining * pred_ms / 1e3
+                    stats["eta"] = {
+                        "epoch": it, "predicted_epoch_ms": round(pred_ms, 3),
+                        "epochs_remaining": epochs_remaining,
+                        "eta_s": round(eta_s, 3), "source": cm_src}
+                    stats["cost_model"] = {
+                        "samples": cm_n,
+                        "mape_pct": round(cm_abs_pct / cm_n, 2),
+                        "source": cm_src}
+                    if logger.active:
+                        logger.log(
+                            "cost_model", epoch=it, grid_width=Gx,
+                            predicted_epoch_ms=round(pred_ms, 3),
+                            actual_epoch_ms=round(epoch_ms, 3),
+                            residual_pct=round(residual_pct, 2),
+                            source=cm_src, eta_s=round(eta_s, 3),
+                            epochs_remaining=epochs_remaining,
+                            samples=cm_n,
+                            mape_pct=stats["cost_model"]["mape_pct"])
                 # global early exit: once EVERY lane has hit its per-point
                 # patience, further epochs are pure masked compute (the
                 # per-point trainer would have broken out of each run long
@@ -1852,6 +1943,18 @@ class RedcliffGridRunner:
         stats["prefetch_items"] = int(cdelta.get("prefetch_items", 0))
         stats["ckpt_barrier_stall_ms"] = cdelta.get(
             "ckpt_barrier_stall_ms", 0.0)
+        # fold this fit's observed per-width epoch costs + compile totals
+        # into the persistent cost-model store (obs/costmodel.py) so the
+        # model accumulates across runs and tenants like the compile cache
+        # it lives beside. Advisory: a store failure must never fail a fit
+        if cm_base and jax.process_index() == 0:
+            try:
+                _costmodel.update_store(
+                    cm_base,
+                    _costmodel.rows_from_dispatch_stats(cm_shape_key, stats),
+                    platform=cm_platform)
+            except Exception:  # noqa: BLE001 — best-effort telemetry fold
+                pass
 
         # ---- result assembly under ORIGINAL point ids -------------------
         # one gather each; live execution lanes scatter through orig_ids,

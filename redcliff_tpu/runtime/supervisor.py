@@ -33,6 +33,16 @@ classification, action, backoff, wall times, and the commanded mesh shape
 after a 12-hour grid search died at 3am, including which attempts ran
 degraded.
 
+Per-attempt ETA: when the driver writes its telemetry next to the ledger
+(the usual layout — ``metrics.jsonl`` in the same run directory), each
+attempt record also carries the learned cost model's remaining-work
+estimate as of the attempt's last check window (the newest ``cost_model``
+event: predicted epoch cost, epochs remaining, ``eta_s``) — so the ledger
+answers not just "why did attempt 3 stop" but "how much work was left when
+it did", the admission-planner input ROADMAP item 1 needs per request.
+Read via a bounded tail of the metrics file (crash-tolerant: torn lines
+skipped), absent when no telemetry or no prediction exists.
+
 stdlib only (the supervisor parent must never initialize a jax backend).
 """
 from __future__ import annotations
@@ -50,7 +60,7 @@ from redcliff_tpu.runtime.retry import RetryPolicy
 from redcliff_tpu.runtime.watchdog import classify_exit
 
 __all__ = ["SupervisorPolicy", "SuperviseOutcome", "supervise", "main",
-           "LEDGER_NAME"]
+           "LEDGER_NAME", "latest_cost_model_eta"]
 
 LEDGER_NAME = "run_ledger.jsonl"
 
@@ -114,6 +124,52 @@ class SuperviseOutcome:
 def _restartable(classification):
     return any(classification == c or classification.startswith(c + ":")
                for c in RESTART_CLASSES)
+
+
+# how much of the metrics file tail to scan for the newest cost_model
+# event: check windows emit one small line each, so 128 KiB covers
+# thousands of windows while keeping the read O(1) in run length
+_ETA_TAIL_BYTES = 128 * 1024
+
+
+def latest_cost_model_eta(ledger_path, since_wall=None,
+                          tail_bytes=_ETA_TAIL_BYTES):
+    """The newest ``cost_model`` event's ETA fields from the metrics.jsonl
+    sitting next to ``ledger_path``, or None (no metrics file, no event in
+    the tail, torn/unparseable lines — all degrade silently: the ETA is
+    audit garnish, never a supervision input).
+
+    ``since_wall`` restricts to events stamped at/after that wall time —
+    the supervisor passes each attempt's start so an attempt that died
+    before its first check window reports NO eta instead of inheriting the
+    previous attempt's."""
+    run_dir = os.path.dirname(ledger_path) or "."
+    path = os.path.join(run_dir, "metrics.jsonl")
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > tail_bytes:
+                f.seek(size - tail_bytes)
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        if '"cost_model"' not in line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn first line of the tail window / mid-append
+        if rec.get("event") != "cost_model":
+            continue
+        if since_wall is not None and not (
+                isinstance(rec.get("wall_time"), (int, float))
+                and rec["wall_time"] >= since_wall):
+            return None  # newest event predates this attempt: no eta
+        return {k: rec.get(k) for k in
+                ("eta_s", "predicted_epoch_ms", "epochs_remaining",
+                 "epoch", "source")}
+    return None
 
 
 class _Ledger:
@@ -207,6 +263,15 @@ def supervise(cmd, ledger_path=None, policy=None, env=None,
             # audit trail (which attempts ran at which width)
             rec["mesh"] = {"n_hosts": cur_hosts, "n_devices": cur_devices,
                            "device_kind": policy.device_kind}
+        if ledger.path:
+            # remaining-work estimate at THIS attempt's last check window
+            # (obs/costmodel.py scoring events written by the driver next
+            # to this ledger); absent when this attempt left no telemetry —
+            # since_wall keeps a compile-crash attempt from inheriting the
+            # previous attempt's eta
+            eta = latest_cost_model_eta(ledger.path, since_wall=started)
+            if eta is not None:
+                rec["eta"] = eta
         ledger.append(rec)
         attempts.append(rec)
         if action == "remesh_restart":

@@ -1,0 +1,230 @@
+"""Learned cost model (redcliff_tpu/obs/costmodel.py, ISSUE 8):
+
+* golden fit on a synthetic cost table: exact-bucket means, nearest-width
+  scaling fallback, fit-ETA arithmetic;
+* the persistent store: versioned file name/format, cross-update
+  accumulation, platform separation, corrupt-store tolerance, bucket cap;
+* the supervisor's per-attempt ETA tail-read of ``cost_model`` events.
+
+Pure host-side (no jax backend work) — runs in milliseconds.
+"""
+import json
+import os
+
+import pytest
+
+from redcliff_tpu.obs import costmodel
+from redcliff_tpu.runtime.supervisor import latest_cost_model_eta
+
+SHAPE = "gen_lag=2,num_chans=4"
+
+
+def _rows(epoch_ms_mean=100.0, epochs=10, width=8, compile_ms=500.0,
+          compiles=2, shape=SHAPE):
+    return [{"shape": shape, "g_bucket": width, "epochs": epochs,
+             "epoch_ms": epoch_ms_mean * epochs, "compiles": compiles,
+             "compile_ms": compile_ms, "cache_hits": 1, "cache_misses": 1}]
+
+
+def test_store_golden_fit_and_predictions(tmp_path):
+    base = str(tmp_path / "cache")
+    path = costmodel.update_store(base, _rows(), platform="cpu")
+    assert path == os.path.join(base, f"cost_model_v"
+                                      f"{costmodel.STORE_VERSION}.json")
+    with open(path) as f:
+        store = json.load(f)
+    assert store["version"] == costmodel.STORE_VERSION
+    assert store["runs"] == 1
+    [bucket] = store["buckets"].values()
+    assert bucket == {
+        "platform": "cpu", "shape": SHAPE, "g_bucket": 8, "epochs": 10,
+        "epoch_ms_total": 1000.0, "compiles": 2, "compile_ms_total": 500.0,
+        "cache_hits": 1, "cache_misses": 1, "runs": 1,
+        "updated_at": bucket["updated_at"]}
+
+    model = costmodel.load(base)
+    # exact bucket: the observed mean
+    assert model.predict_epoch_ms(SHAPE, 8, platform="cpu") == 100.0
+    # nearest-width fallback scales linearly by the width ratio
+    assert model.predict_epoch_ms(SHAPE, 16, platform="cpu") == 200.0
+    assert model.predict_epoch_ms(SHAPE, 4, platform="cpu") == 50.0
+    # compile prediction: per-program mean, width-insensitive
+    assert model.predict_compile_ms(SHAPE, 8) == 250.0
+    assert model.predict_compile_ms(SHAPE, 16) == 250.0
+    # no evidence for the shape at all -> None, never a guess
+    assert model.predict_epoch_ms("other=1", 8) is None
+    assert model.predict_fit_eta("other=1", 8, 10) is None
+    # ETA: epochs x epoch mean (+ cold compiles)
+    assert model.predict_fit_eta(SHAPE, 8, 20) == pytest.approx(2.0)
+    assert model.predict_fit_eta(SHAPE, 8, 20, cold_programs=2) == \
+        pytest.approx(2.5)
+    assert model.staleness_s() is not None and model.staleness_s() >= 0
+
+
+def test_store_accumulates_across_updates_and_platforms(tmp_path):
+    base = str(tmp_path)
+    costmodel.update_store(base, _rows(100.0, epochs=10), platform="cpu")
+    costmodel.update_store(base, _rows(200.0, epochs=30), platform="cpu")
+    costmodel.update_store(base, _rows(1.0, epochs=50), platform="tpu")
+    model = costmodel.load(base)
+    assert model.runs == 3
+    # cpu bucket: (1000 + 6000) / 40 epochs
+    assert model.predict_epoch_ms(SHAPE, 8, platform="cpu") == \
+        pytest.approx(175.0)
+    # platforms never mix
+    assert model.predict_epoch_ms(SHAPE, 8, platform="tpu") == \
+        pytest.approx(1.0)
+    # platform=None picks the best-sampled bucket (tpu: 40 epochs)
+    assert model.predict_epoch_ms(SHAPE, 8) == pytest.approx(1.0)
+
+
+def test_corrupt_store_tolerated_and_rewritten(tmp_path):
+    base = str(tmp_path)
+    path = costmodel.store_path(base)
+    with open(path, "w") as f:
+        f.write('{"version": 1, "buckets": [truncated')
+    assert costmodel.load(base) is None  # advisory: no model, no raise
+    costmodel.update_store(base, _rows(), platform="cpu")
+    model = costmodel.load(base)
+    assert model is not None and model.predict_epoch_ms(SHAPE, 8) == 100.0
+
+
+def test_store_path_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv(costmodel.ENV_STORE_DIR, raising=False)
+    monkeypatch.delenv(costmodel.ENV_CACHE_DIR, raising=False)
+    assert costmodel.store_path() is None
+    assert costmodel.load() is None
+    monkeypatch.setenv(costmodel.ENV_CACHE_DIR, str(tmp_path / "cc"))
+    assert costmodel.store_path() == str(
+        tmp_path / "cc" / costmodel.STORE_NAME)
+    monkeypatch.setenv(costmodel.ENV_STORE_DIR, str(tmp_path / "ov"))
+    assert costmodel.store_path() == str(
+        tmp_path / "ov" / costmodel.STORE_NAME)
+
+
+def test_store_bucket_cap_evicts_oldest(tmp_path, monkeypatch):
+    monkeypatch.setattr(costmodel, "MAX_BUCKETS", 4)
+    base = str(tmp_path)
+    for i in range(6):
+        costmodel.update_store(base, _rows(shape=f"num_chans={i}"),
+                               platform="cpu", now=float(i))
+    model = costmodel.load(base)
+    assert len(model.buckets) == 4
+    # the oldest-updated buckets were evicted
+    assert model.predict_epoch_ms("num_chans=0", 8) is None
+    assert model.predict_epoch_ms("num_chans=5", 8) == 100.0
+
+
+def test_rows_from_dispatch_stats_attaches_compile_to_widest():
+    stats = {"epochs_by_width": {"8": 5, "4": 3},
+             "epoch_ms_by_width": {"8": 500.0, "4": 150.0},
+             "compiles": 6, "compile_ms": 900.0,
+             "cache_hits": 2, "cache_misses": 4}
+    rows = costmodel.rows_from_dispatch_stats(SHAPE, stats)
+    assert [r["g_bucket"] for r in rows] == [8, 4]
+    assert rows[0]["compiles"] == 6 and rows[0]["compile_ms"] == 900.0
+    assert rows[1]["compiles"] == 0 and rows[1]["compile_ms"] == 0.0
+
+
+def test_rows_exclude_compile_skewed_first_epoch():
+    """The store learns STEADY-STATE epoch cost: each width's first epoch
+    (compile/cache-priming skew) is dropped when later epochs exist."""
+    stats = {"epochs_by_width": {"8": 5, "4": 1},
+             "epoch_ms_by_width": {"8": 2040.0, "4": 300.0},
+             # first epoch paid 2000ms of compile; steady state is 10ms
+             "first_epoch_ms_by_width": {"8": 2000.0, "4": 300.0}}
+    rows = costmodel.rows_from_dispatch_stats(SHAPE, stats)
+    assert rows[0]["epochs"] == 4 and rows[0]["epoch_ms"] == 40.0
+    # a single-epoch width keeps its one observation (better than nothing)
+    assert rows[1]["epochs"] == 1 and rows[1]["epoch_ms"] == 300.0
+    # pre-change stats without the accumulator fold unchanged
+    legacy = {"epochs_by_width": {"8": 5},
+              "epoch_ms_by_width": {"8": 500.0}}
+    [row] = costmodel.rows_from_dispatch_stats(SHAPE, legacy)
+    assert row["epochs"] == 5 and row["epoch_ms"] == 500.0
+
+
+def test_fit_from_report_and_report_fold(tmp_path):
+    report = {"cost_table": [
+        {"shape": SHAPE, "g_bucket": 4, "epochs": 8,
+         "total_epoch_ms": 400.0, "compiles": 1, "compile_ms": 100.0,
+         "cache_hits": 0, "cache_misses": 1}]}
+    model = costmodel.fit_from_report(report, platform="cpu")
+    assert model.predict_epoch_ms(SHAPE, 4, platform="cpu") == 50.0
+    costmodel.update_store_from_report(str(tmp_path), report,
+                                       platform="cpu")
+    assert costmodel.load(str(tmp_path)).predict_epoch_ms(
+        SHAPE, 4, platform="cpu") == 50.0
+
+
+# ---------------------------------------------------------------------------
+# supervisor per-attempt ETA (runtime/supervisor.py tail-read)
+# ---------------------------------------------------------------------------
+def test_latest_cost_model_eta_reads_newest_event(tmp_path):
+    ledger = str(tmp_path / "run_ledger.jsonl")
+    metrics = tmp_path / "metrics.jsonl"
+    with open(metrics, "w") as f:
+        f.write(json.dumps({"event": "epoch", "wall_time": 1.0,
+                            "epoch": 0}) + "\n")
+        for e, eta in ((1, 30.0), (2, 20.0)):
+            f.write(json.dumps({
+                "event": "cost_model", "wall_time": 2.0, "epoch": e,
+                "predicted_epoch_ms": 10.0, "actual_epoch_ms": 11.0,
+                "eta_s": eta, "epochs_remaining": 2 - e,
+                "source": "store"}) + "\n")
+        f.write('{"event": "cost_model", "epoch": 3, "torn mid-app')
+    eta = latest_cost_model_eta(ledger)
+    assert eta == {"eta_s": 20.0, "predicted_epoch_ms": 10.0,
+                   "epochs_remaining": 0, "epoch": 2, "source": "store"}
+    # since_wall bounds the scan to THIS attempt's telemetry: an event
+    # stamped before the attempt started is not inherited
+    assert latest_cost_model_eta(ledger, since_wall=1.5) == eta
+    assert latest_cost_model_eta(ledger, since_wall=2.5) is None
+
+
+def test_latest_cost_model_eta_absent_cases(tmp_path):
+    assert latest_cost_model_eta(str(tmp_path / "run_ledger.jsonl")) is None
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        f.write(json.dumps({"event": "epoch", "wall_time": 1.0,
+                            "epoch": 0}) + "\n")
+    assert latest_cost_model_eta(str(tmp_path / "run_ledger.jsonl")) is None
+
+
+def test_supervisor_stamps_eta_on_attempt(tmp_path):
+    """A supervised run whose driver wrote cost_model telemetry DURING the
+    attempt gets the remaining-work ETA on its attempt ledger record
+    (schema-registered optional field); a stale event from a previous
+    attempt is NOT inherited by one that died before its first window."""
+    import sys
+
+    from redcliff_tpu.obs import read_jsonl, schema
+    from redcliff_tpu.runtime.supervisor import (SupervisorPolicy,
+                                                 supervise)
+
+    metrics = str(tmp_path / "metrics.jsonl")
+    ledger = str(tmp_path / "run_ledger.jsonl")
+    # the driver emits a cost_model event mid-attempt, then exits clean
+    child = (
+        "import json, time\n"
+        f"open({metrics!r}, 'a').write(json.dumps({{\n"
+        "    'event': 'cost_model', 'wall_time': time.time(), 'epoch': 5,\n"
+        "    'predicted_epoch_ms': 100.0, 'actual_epoch_ms': 90.0,\n"
+        "    'eta_s': 12.5, 'epochs_remaining': 125,\n"
+        "    'source': 'observed'}) + '\\n')\n")
+    out = supervise([sys.executable, "-c", child], ledger_path=ledger,
+                    policy=SupervisorPolicy(max_restarts=0))
+    assert out.classification == "clean"
+    recs = read_jsonl(ledger)
+    [att] = [r for r in recs if r["event"] == "attempt"]
+    assert att["eta"]["eta_s"] == 12.5
+    assert att["eta"]["epochs_remaining"] == 125
+    assert not schema.validate_records(recs, kind="ledger")
+
+    # second supervised run in the same dir, driver dies instantly: the
+    # previous attempt's event predates this attempt -> NO inherited eta
+    out = supervise([sys.executable, "-c", "raise SystemExit(0)"],
+                    ledger_path=ledger,
+                    policy=SupervisorPolicy(max_restarts=0))
+    assert out.classification == "clean"
+    att2 = [r for r in read_jsonl(ledger) if r["event"] == "attempt"][-1]
+    assert "eta" not in att2

@@ -275,3 +275,299 @@ def test_checkpoint_payload_carries_dispatch_stats(faulted_run):
     assert ds["mode"] == "per_batch" and ds["train_dispatches"] > 0
     # audit payload, NOT fingerprint: the meta dict is untouched by it
     assert "dispatch_stats" not in ckpt["meta"]
+
+
+# ---------------------------------------------------------------------------
+# learned cost model: residual events, report accuracy table, fit ETA
+# (obs/costmodel.py, ISSUE 8)
+# ---------------------------------------------------------------------------
+def test_grid_emits_cost_model_residual_events(faulted_run):
+    """Every check window past the first scores prediction-vs-actual as a
+    schema-registered cost_model event, and dispatch_stats carries the
+    remaining-fit ETA."""
+    run, runner = faulted_run
+    recs = read_jsonl(run)
+    cms = [r for r in recs if r["event"] == "cost_model"]
+    assert len(cms) >= 2  # check_every=1, max_iter=4: epochs 1..3
+    for r in cms:
+        assert r["predicted_epoch_ms"] > 0 and r["actual_epoch_ms"] > 0
+        assert r["source"] in ("store", "observed")
+        assert r["eta_s"] >= 0 and r["epochs_remaining"] >= 0
+    # the all-lanes-quarantined fit exits early, so the last scored window
+    # may still predict remaining work — but never more than the horizon
+    assert cms[-1]["epochs_remaining"] <= 3
+    start = [r for r in recs if r["event"] == "fit_start"][-1]
+    assert start["max_iter"] == 4
+    ds = [r for r in recs if r["event"] == "fit_end"][-1]["dispatch_stats"]
+    assert ds["eta"]["epochs_remaining"] == cms[-1]["epochs_remaining"]
+    assert ds["cost_model"]["samples"] == len(cms)
+    assert ds["cost_model"]["mape_pct"] >= 0
+
+
+def test_report_shows_cost_model_accuracy_table(faulted_run):
+    run, _ = faulted_run
+    rep = build_report(run)
+    acc = rep["cost_model"]["accuracy"]
+    assert acc, "cost-model accuracy table must be populated"
+    [row] = acc
+    assert row["g_bucket"] == 4 and row["samples"] >= 2
+    assert row["mape_pct"] is not None and row["mape_pct"] >= 0
+    assert "num_chans=4" in row["shape"]
+    assert row["last_eta_s"] is not None
+    # store state rides along (the suite-wide compile cache configures one)
+    assert rep["cost_model"]["store"]["configured"]
+    # cached real-TPU provenance is surfaced, not invisible
+    tc = rep["tpu_bench_cache"]
+    assert tc and tc["platform"] == "tpu" and tc["measured_at"]
+    assert tc["pallas_prox_max_abs_err"] == 5e-07
+    text = render_text_of(rep)
+    assert "cost model accuracy" in text
+    assert "cached real-TPU evidence" in text
+
+
+def render_text_of(rep):
+    from redcliff_tpu.obs.report import render_text
+
+    return render_text(rep)
+
+
+def test_predict_fit_eta_within_2x_of_measured_wall(faulted_run):
+    """ISSUE 8 acceptance: a model fit from this run's cost table predicts
+    the fit's own epoch wall time within a generous-but-asserted 2x."""
+    from redcliff_tpu.obs import costmodel
+
+    run, _ = faulted_run
+    rep = build_report(run)
+    [row] = rep["cost_table"]
+    model = costmodel.fit_from_report(rep, platform="cpu")
+    eta_s = model.predict_fit_eta(row["shape"], row["g_bucket"],
+                                  epochs=row["epochs"], platform="cpu")
+    measured_s = row["total_epoch_ms"] / 1e3
+    assert eta_s is not None and measured_s > 0
+    assert 0.5 <= eta_s / measured_s <= 2.0
+    # also within 2x of the engine's own dispatch wall accounting
+    ds = rep["checkpoint_dispatch_stats"]
+    engine_s = (ds["train_time_ms"] + ds["val_time_ms"]) / 1e3
+    assert 0.5 <= eta_s / engine_s <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# obs watch (obs/watch.py, ISSUE 8)
+# ---------------------------------------------------------------------------
+def _strip_fit_end(src_run, dst):
+    """Copy a finished run dir into the shape of a LIVE one: fit_end
+    dropped (the fit is still running as far as readers can tell),
+    checkpoint kept (the mid-run stall source)."""
+    import shutil
+
+    os.makedirs(dst, exist_ok=True)
+    with open(os.path.join(src_run, "metrics.jsonl")) as f, \
+            open(os.path.join(dst, "metrics.jsonl"), "w") as out:
+        for line in f:
+            if '"fit_end"' not in line:
+                out.write(line)
+    for name in ("grid_checkpoint.pkl", "run_ledger.jsonl"):
+        p = os.path.join(src_run, name)
+        if os.path.exists(p):
+            shutil.copy(p, os.path.join(dst, name))
+    return dst
+
+
+def test_watch_snapshot_live_mid_write_run(faulted_run, tmp_path):
+    """ISSUE 8 acceptance: `obs watch --once --json` on a live (mid-write)
+    run dir returns schema-valid output including per-fit ETA."""
+    import io
+
+    from redcliff_tpu.obs.watch import build_snapshot, render_text, run_watch
+
+    run, _ = faulted_run
+    live = _strip_fit_end(run, str(tmp_path / "live"))
+    # a writer is mid-append: unterminated torn tail on disk RIGHT NOW
+    with open(os.path.join(live, "metrics.jsonl"), "a") as f:
+        f.write('{"event": "epoch", "epoch": 99, "wall_ti')
+        f.flush()
+        snap = build_snapshot(live)
+    assert not schema.validate_record(snap), \
+        schema.validate_record(snap)
+    json.dumps(snap, allow_nan=False)
+    [fit] = snap["fits"]
+    assert not fit["done"]
+    assert fit["grid_width"] == 4 and fit["lanes_live"] is not None
+    assert fit["epoch_rate_per_min"] > 0
+    # per-fit ETA from the newest cost_model event
+    assert fit["eta"] is not None
+    assert fit["eta"]["source"].startswith("cost_model:")
+    assert fit["eta"]["eta_s"] >= 0
+    assert snap["grid_eta_s"] is not None
+    assert snap["read_audit"]["torn_lines"] == 1
+    # stall breakdown from the checkpointed dispatch_stats
+    assert snap["stalls"]["source"] == "grid_checkpoint.pkl"
+    assert snap["stalls"]["ckpt_stall_ms"] >= 0
+    # numerics skip counters surfaced
+    assert snap["numerics"]["guarded_steps_skipped"] > 0
+    # heartbeat ages present and sane
+    assert snap["heartbeats"]["metrics_file_age_s"] >= 0
+    assert "grid" in snap["heartbeats"]["span_age_s"]
+    # the CLI body agrees with the builder and renders
+    out = io.StringIO()
+    assert run_watch(live, once=True, as_json=True, out=out) == 0
+    cli_snap = json.loads(out.getvalue())
+    assert cli_snap["fits"][0]["eta"] is not None
+    assert render_text(snap)
+
+
+def test_watch_cli_subcommand_json(faulted_run, capsys):
+    from redcliff_tpu.obs.report import main
+
+    run, _ = faulted_run
+    assert main(["watch", run, "--once", "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["event"] == "watch" and snap["fits"]
+    assert not schema.validate_record(snap)
+    # finished fits report no ETA (nothing left to predict)
+    assert snap["fits"][0]["done"] and snap["fits"][0]["eta"] is None
+
+
+def test_watch_follows_rotation_boundary_while_writer_appends(tmp_path):
+    """Satellite: tail-follow across a metrics.jsonl rotation boundary with
+    a writer appending — the SIGKILL-mid-append harness, plus a byte cap
+    small enough that the chain rotates mid-run. The snapshot must see
+    every whole record across the chain and count the torn tail."""
+    from redcliff_tpu.obs.watch import build_snapshot
+
+    child = (
+        "import os, signal, json\n"
+        "from redcliff_tpu.obs import MetricLogger\n"
+        f"log = MetricLogger({str(tmp_path)!r}, max_bytes=400,\n"
+        "                   max_backups=20)\n"
+        "log.log('fit_start', model='RedcliffGridRunner',\n"
+        "        shape={'num_chans': 4}, grid_size=8, grid_width=8,\n"
+        "        max_iter=50)\n"
+        "for e in range(12):\n"
+        "    log.log('epoch', epoch=e, grid_width=8, epoch_ms=100.0,\n"
+        "            lanes_live=8)\n"
+        "log.log('cost_model', epoch=11, predicted_epoch_ms=100.0,\n"
+        "        actual_epoch_ms=101.0, residual_pct=1.0, source='store',\n"
+        "        eta_s=3.8, epochs_remaining=38)\n"
+        "log._fh.write('{\"event\": \"epoch\", \"epoch\": 12, \"wall')\n"
+        "log._fh.flush()\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n")
+    r = subprocess.run([sys.executable, "-c", child], cwd=REPO, timeout=120)
+    assert r.returncode == -9
+    names = os.listdir(tmp_path)
+    assert "metrics.jsonl.1" in names, "no rotation happened: cap too big"
+    snap = build_snapshot(str(tmp_path))
+    assert not schema.validate_record(snap)
+    [fit] = snap["fits"]
+    # every whole record across the rotation chain was followed
+    assert fit["last_epoch"] == 11 and fit["epochs_seen"] == 12
+    assert fit["eta"]["eta_s"] <= 3.8  # discounted by event age
+    assert fit["eta"]["epochs_remaining"] == 38
+    assert snap["read_audit"]["torn_lines"] == 1
+    assert len(snap["read_audit"]["files"]) > 1
+
+
+def test_watch_supersedes_dead_attempts(tmp_path):
+    """A fit_start with no fit_end followed by another fit_start (a
+    supervisor re-attempt) is a DEAD attempt, not a live fit: it must not
+    contribute a phantom ETA to grid_eta_s forever."""
+    from redcliff_tpu.obs.watch import build_snapshot, render_text
+
+    with MetricLogger(str(tmp_path)) as log:
+        log.log("fit_start", model="RedcliffGridRunner",
+                shape={"num_chans": 4}, grid_size=8, grid_width=8,
+                max_iter=50)
+        for e in (0, 2):
+            log.log("epoch", epoch=e, grid_width=8, epoch_ms=100.0,
+                    lanes_live=8, guarded_steps_skipped=50)
+        # crash: no fit_end. The supervisor restarts -> second fit_start
+        log.log("fit_start", model="RedcliffGridRunner",
+                shape={"num_chans": 4}, grid_size=8, grid_width=8,
+                max_iter=50, resumed_from_epoch=2)
+        log.log("epoch", epoch=3, grid_width=8, epoch_ms=100.0,
+                lanes_live=8)
+        log.log("cost_model", epoch=3, grid_width=8,
+                predicted_epoch_ms=100.0, actual_epoch_ms=100.0,
+                residual_pct=0.0, source="store", eta_s=4.6,
+                epochs_remaining=46)
+    snap = build_snapshot(str(tmp_path))
+    assert not schema.validate_record(snap)
+    dead, live = snap["fits"]
+    assert dead["superseded"] and not dead["done"] and dead["eta"] is None
+    assert not live["superseded"] and live["eta"]["eta_s"] <= 4.6
+    # only the live attempt's eta counts toward the whole-run number
+    assert snap["grid_eta_s"] == live["eta"]["eta_s"]
+    assert "[dead]" in render_text(snap) and "[LIVE]" in render_text(snap)
+    # the dead attempt's stale skip counter (50) must not shadow the live
+    # attempt's state (0 skipped so far)
+    assert snap["numerics"]["guarded_steps_skipped"] == 0
+
+
+def test_watch_checkpoint_stalls_cached_by_file_signature(
+        faulted_run, monkeypatch):
+    """Follow mode must not unpickle the (params-heavy) grid checkpoint
+    every tick: the stall extract is cached on (mtime, size)."""
+    from redcliff_tpu.obs import report as report_mod
+    from redcliff_tpu.obs import watch as watch_mod
+
+    run, _ = faulted_run
+    calls = {"n": 0}
+    real = report_mod._checkpoint_stats
+
+    def counting(run_dir):
+        calls["n"] += 1
+        return real(run_dir)
+
+    monkeypatch.setattr(report_mod, "_checkpoint_stats", counting)
+    watch_mod._ckpt_stall_cache.clear()
+    first = watch_mod._checkpoint_stalls(run)
+    second = watch_mod._checkpoint_stalls(run)
+    assert first == second and first["ckpt_stall_ms"] is not None
+    assert calls["n"] == 1
+    # touching the file invalidates the cache
+    os.utime(os.path.join(run, "grid_checkpoint.pkl"))
+    watch_mod._checkpoint_stalls(run)
+    assert calls["n"] == 2
+
+
+def test_watch_follow_mode_reticks(faulted_run):
+    import io
+
+    from redcliff_tpu.obs.watch import run_watch
+
+    run, _ = faulted_run
+    out = io.StringIO()
+    assert run_watch(run, once=False, interval=0.01, max_ticks=2,
+                     out=out) == 0
+    assert out.getvalue().count("watch: ") == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: missing/empty run dirs exit 2 with a one-line diagnosis
+# ---------------------------------------------------------------------------
+def test_report_and_watch_exit_2_on_missing_or_empty_dir(tmp_path, capsys):
+    from redcliff_tpu.obs.report import main
+
+    missing = str(tmp_path / "nope")
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    for args in (["report", missing], ["watch", missing, "--once"],
+                 ["report", empty], ["watch", empty, "--once", "--json"]):
+        assert main(args) == 2, args
+        err = capsys.readouterr().err
+        assert err.count("\n") == 1, err  # one-line diagnosis
+        assert "obs " in err and "traceback" not in err.lower()
+
+
+def test_report_and_watch_exit_2_module_entry(tmp_path):
+    """The documented CLI shape: `python -m redcliff_tpu.obs {report,watch}`
+    on a missing dir exits 2 without a traceback."""
+    missing = str(tmp_path / "gone")
+    for args in (["report", missing], ["watch", missing, "--once"]):
+        r = subprocess.run(
+            [sys.executable, "-m", "redcliff_tpu.obs"] + args,
+            cwd=REPO, capture_output=True, text=True, timeout=240,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 2, (args, r.stderr[-500:])
+        assert "Traceback" not in r.stderr
+        assert "does not exist" in r.stderr

@@ -347,6 +347,67 @@ def test_spans_never_touch_jax():
                 mod.__name__
 
 
+def _iter_repo_sources():
+    import redcliff_tpu
+
+    pkg_root = os.path.dirname(os.path.abspath(redcliff_tpu.__file__))
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for name in files:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def test_event_and_span_name_literals_are_registered():
+    """Static tripwire (ISSUE 8 satellite): every event/span name LITERAL
+    in redcliff_tpu/ must be registered in the closed schema registry —
+    an emitter added without registration fails here, at the source level,
+    before any runtime path even has to exercise it. Scanned shapes:
+
+    * ``<logger>.log("<event>", ...)``            -> EVENTS u LEDGER_EVENTS
+    * ``span("<name>", ...)`` / ``record_span``    -> schema.SPAN_NAMES
+    * dict literals carrying ``"event": "<name>"`` (the stdlib writers:
+      supervisor ledger lines, flight/watch/regress artifacts)
+    """
+    import ast
+
+    events = set(schema.EVENTS) | set(schema.LEDGER_EVENTS)
+    bad = []
+    for path in _iter_repo_sources():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = (fn.id if isinstance(fn, ast.Name)
+                         else fn.attr if isinstance(fn, ast.Attribute)
+                         else None)
+                if not (fname in ("span", "record_span", "log")
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                if fname == "log":
+                    if name not in events:
+                        bad.append((path, node.lineno, "event", name))
+                elif name not in schema.SPAN_NAMES:
+                    bad.append((path, node.lineno, "span", name))
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "event"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)
+                            and v.value not in events):
+                        bad.append((path, node.lineno, "event", v.value))
+    assert not bad, (
+        "unregistered event/span name literals (register them in "
+        f"redcliff_tpu/obs/schema.py and docs/ARCHITECTURE.md): {bad}")
+    # the new ISSUE 8 kinds are part of the closed registry
+    assert {"cost_model", "watch", "regression"} <= set(schema.EVENTS)
+
+
 # ---------------------------------------------------------------------------
 # schema registry + validator
 # ---------------------------------------------------------------------------
