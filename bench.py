@@ -877,6 +877,96 @@ def _bench_ckpt_stall(jax, grid_state):
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _bench_mem_model(jax, model, grid_state, G, B):
+    """mem_model_err_pct: the analytical HBM footprint model
+    (obs/memory.py) vs the device allocator, on the probe grid.
+
+    The measurement is a LIVE-BYTES DELTA: poll ``bytes_in_use``, allocate
+    one fresh copy of the probe's grid state (params + Adam moments +
+    coeffs — a known, analytically-sized allocation on the default
+    device), poll again, free the copy. Comparing against the allocator's
+    lifetime ``peak_bytes_in_use`` instead would fold in every earlier
+    bench stage's transients (the G-scaling sweep compiles up to G=256
+    here) and flag the model for the allocator's history — the delta
+    isolates exactly the bytes the model claims to predict.
+    ``model_bytes`` (the abstract-shape `grid_footprint` prediction for
+    this (shape, G)) rides along for context. On backends without
+    ``memory_stats()`` — this container's CPU — the error is null WITH a
+    reason, never a fabricated number."""
+    import jax.numpy as jnp
+
+    from redcliff_tpu.obs import memory as obsmem
+
+    p, a, b, coeffs, X, Y = grid_state
+    state = (p, a, b, coeffs)
+    analytical = obsmem.tree_bytes(state)
+    model_bytes = obsmem.grid_footprint(model, None, G)["total_bytes"]
+    out = {"grid_points": G, "analytical_bytes": int(analytical),
+           "model_bytes": int(model_bytes)}
+    wm0 = obsmem.poll_watermark()
+    if wm0 is None or wm0.get("bytes_in_use") is None:
+        out.update(abs_err_pct=None,
+                   reason=f"memory_stats unsupported on "
+                          f"{jax.default_backend()}")
+        return out
+    copy = jax.tree.map(jnp.copy, state)
+    jax.block_until_ready(copy)
+    wm1 = obsmem.poll_watermark()
+    measured = wm1["bytes_in_use"] - wm0["bytes_in_use"]
+    del copy
+    if measured <= 0:
+        out.update(abs_err_pct=None,
+                   reason="allocator live-bytes delta not observable")
+        return out
+    err = 100.0 * (analytical - measured) / measured
+    out.update(abs_err_pct=round(abs(err), 1), err_pct=round(err, 1),
+               measured_delta_bytes=int(measured),
+               measured_peak_bytes=wm1.get("peak_bytes"),
+               bytes_limit=wm1.get("bytes_limit"),
+               n_devices=wm1.get("n_devices"))
+    return out
+
+
+def _bench_trace_export(n_records=2000):
+    """trace_export probe: span -> Perfetto round-trip cost
+    (obs/trace_export.py) on a synthetic but schema-shaped run dir —
+    ``n_records`` span/epoch records written through the real MetricLogger,
+    then one timed build+validate+serialize pass. Deterministic input, so
+    the timing tracks the exporter, not a fit."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.obs.logging import MetricLogger
+    from redcliff_tpu.obs.trace_export import build_trace, validate_trace
+
+    run = tempfile.mkdtemp(prefix="bench_trace_")
+    try:
+        with MetricLogger(run) as log:
+            log.log("fit_start", model="bench_probe", grid_size=8,
+                    grid_width=8, shape={"num_chans": 4})
+            for i in range(n_records):
+                if i % 4 == 0:
+                    log.log("epoch", epoch=i // 4, lanes_live=8,
+                            grid_width=8, epoch_ms=1.0)
+                else:
+                    log.log("span", name="grid.dispatch", dur_ms=0.5,
+                            span_id=i + 1, t_wall=time.time())
+            log.log("fit_end")
+        t0 = time.perf_counter()
+        trace = build_trace(run)
+        errors = validate_trace(trace)
+        blob = json.dumps(trace, allow_nan=False)
+        export_ms = (time.perf_counter() - t0) * 1e3
+        return {"export_ms": round(export_ms, 2),
+                "records": n_records + 2,
+                "events": len(trace["traceEvents"]),
+                "bytes": len(blob),
+                "valid": not errors,
+                "validate_errors": errors[:3]}
+    finally:
+        shutil.rmtree(run, ignore_errors=True)
+
+
 def _measure(platform):
     import jax
 
@@ -1026,6 +1116,21 @@ def _measure(platform):
     except Exception as e:  # never fail the bench over the obs probe
         obs_overhead = {"error": f"{type(e).__name__}: {e}"}
 
+    # device-memory observatory (obs/memory.py): analytical footprint vs
+    # the measured allocator watermark (null-with-reason on CPU)
+    try:
+        mem_model = _bench_mem_model(jax, model, headline["state"], G_HEAD,
+                                     B)
+    except Exception as e:  # never fail the bench over the memory probe
+        mem_model = {"error": f"{type(e).__name__}: {e}",
+                     "abs_err_pct": None}
+
+    # span -> Perfetto round-trip cost (obs/trace_export.py)
+    try:
+        trace_export = _bench_trace_export()
+    except Exception as e:  # never fail the bench over the export probe
+        trace_export = {"error": f"{type(e).__name__}: {e}"}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1054,6 +1159,9 @@ def _measure(platform):
         "compile_cache": compile_cache,
         "obs_overhead_pct": obs_overhead.get("pct"),
         "obs_overhead": obs_overhead,
+        "mem_model_err_pct": mem_model.get("abs_err_pct"),
+        "mem_model": mem_model,
+        "trace_export": trace_export,
         "error": None,
     })
 

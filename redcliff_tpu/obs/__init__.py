@@ -22,7 +22,14 @@ production-monitoring shape of large-scale ML systems, arXiv:1605.08695):
 * :mod:`.watch` — the live run watch CLI: ``python -m redcliff_tpu.obs
   watch <run_dir>`` (``--once --json`` for scripts);
 * :mod:`.regress` — the cross-round bench regression sentinel:
-  ``python -m redcliff_tpu.obs regress`` (stdlib-only).
+  ``python -m redcliff_tpu.obs regress`` (stdlib-only);
+* :mod:`.memory` — the analytical HBM footprint model (abstract shapes, no
+  device work) + live ``device.memory_stats()`` watermark polling;
+* :mod:`.profiling` — bounded ``jax.profiler`` capture windows
+  (``REDCLIFF_PROFILE=epoch:3`` / ``profile_window``) replacing whole-fit
+  traces;
+* :mod:`.trace_export` — Perfetto / Chrome trace-event export:
+  ``python -m redcliff_tpu.obs trace <run_dir> [-o trace.json]``.
 
 Import discipline: this ``__init__`` (and ``spans``/``flight``/``schema``)
 is stdlib-only — the watchdog, the supervisor, and bench.py's backend-free
@@ -32,6 +39,7 @@ lazily on first attribute access.
 from __future__ import annotations
 
 from redcliff_tpu.obs import flight, schema, spans  # noqa: F401 (stdlib-only)
+from redcliff_tpu.obs import memory, profiling  # noqa: F401 (stdlib at import; jax lazy)
 from redcliff_tpu.obs.spans import COUNTERS as counters  # noqa: F401
 from redcliff_tpu.obs.spans import (NOOP, Span, enabled, record_span,  # noqa: F401
                                     set_enabled, span)
@@ -39,10 +47,10 @@ from redcliff_tpu.obs.spans import (NOOP, Span, enabled, record_span,  # noqa: F
 __all__ = [
     "span", "record_span", "Span", "NOOP", "enabled", "set_enabled",
     "counters",
-    "flight", "schema", "spans",
+    "flight", "schema", "spans", "memory", "profiling",
     "MetricLogger", "jsonable", "read_jsonl", "jsonl_files",
     "profiler_trace", "build_report", "render_text", "build_snapshot",
-    "run_sentinel",
+    "run_sentinel", "build_trace", "validate_trace",
 ]
 
 _LAZY = {
@@ -55,6 +63,8 @@ _LAZY = {
     "render_text": "redcliff_tpu.obs.report",
     "build_snapshot": "redcliff_tpu.obs.watch",
     "run_sentinel": "redcliff_tpu.obs.regress",
+    "build_trace": "redcliff_tpu.obs.trace_export",
+    "validate_trace": "redcliff_tpu.obs.trace_export",
 }
 
 

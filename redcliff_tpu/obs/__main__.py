@@ -1,4 +1,4 @@
-"""``python -m redcliff_tpu.obs {report,watch,regress}`` — observatory CLIs."""
+"""``python -m redcliff_tpu.obs {report,watch,trace,regress}`` — observatory CLIs."""
 import sys
 
 from redcliff_tpu.obs.report import main

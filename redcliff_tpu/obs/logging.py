@@ -250,9 +250,11 @@ def read_jsonl(path, event=None, stats=None, strict=False):
 
 @contextlib.contextmanager
 def profiler_trace(log_dir):
-    """Opt-in ``jax.profiler.trace`` context. ``log_dir=None`` is a no-op, so
-    trainers wrap their epoch loops unconditionally and profiling turns on by
-    setting ``profile_dir`` in the train config."""
+    """Opt-in whole-block ``jax.profiler.trace`` context (``log_dir=None``
+    is a no-op). LEGACY for fit loops: the engines now capture bounded
+    windows via :mod:`redcliff_tpu.obs.profiling` (``profile_dir`` is an
+    alias for one bounded window there); this stays for ad-hoc scripts that
+    really do want an entire region traced."""
     if not log_dir:
         yield
         return

@@ -1,0 +1,286 @@
+"""Analytical HBM footprint model + live device-memory watermark polling.
+
+The device-memory half of the observatory (docs/ARCHITECTURE.md "Device
+memory & profile observatory"). Two independent signals:
+
+* **Predicted** — :func:`grid_footprint` / :func:`tree_bytes` compute the
+  HBM bytes a fit will pin from ABSTRACT shapes only (``jax.eval_shape``
+  over the model's init, dataset ``.nbytes`` metadata): per-lane parameter
+  state, Adam moments, best/accepted copies, the device-resident dataset
+  the epoch engine keeps in HBM, and the transient permuted epoch gather.
+  No device work, no allocation — callable before the first dispatch and
+  per (shape, G-bucket) rung of the ladder (:func:`footprint_by_bucket`),
+  which is what ROADMAP item 1's admission planner packs against and what
+  the bucket ladder consults before growing a width
+  (:func:`check_headroom`). The per-shape memory features mirror what a
+  learned TPU cost model consumes (arXiv:2008.01040): bytes, like
+  milliseconds, are a per-(shape, G) property of the compiled program.
+
+* **Measured** — :func:`poll_watermark` reads ``device.memory_stats()``
+  (a host-side allocator API: no dispatch, no sync, no transfer). TPU and
+  GPU backends report ``bytes_in_use`` / ``peak_bytes_in_use`` /
+  ``bytes_limit``; this container's CPU backend returns ``None`` and every
+  consumer degrades to an explicit ``n/a (backend)``. ``REDCLIFF_MEM_POLL=0``
+  disables polling entirely (prediction is unaffected — it never touches a
+  device).
+
+The grid engine emits both as schema-registered ``memory`` events and
+``dispatch_stats["memory"]`` fields; ``obs report`` renders predicted vs
+measured peak per fit and ``obs trace`` exports the watermark as a Perfetto
+counter track.
+
+Import discipline: jax is imported LAZILY inside functions only (the
+no-host-sync source tripwire in obs/schema.py checks this), and nothing
+here may call ``block_until_ready`` — the memory axis must observe, never
+serialize, the dispatch stream.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ENV_MEM_POLL", "polling_enabled", "tree_bytes", "param_bytes",
+           "grid_footprint", "trainer_footprint", "footprint_by_bucket",
+           "device_memory_stats", "poll_watermark", "check_headroom"]
+
+ENV_MEM_POLL = "REDCLIFF_MEM_POLL"
+
+
+def polling_enabled():
+    """Whether live watermark polling is armed (default on; the poll is a
+    host allocator read, so the default costs nothing on backends without
+    ``memory_stats`` support)."""
+    return os.environ.get(ENV_MEM_POLL, "1").strip().lower() not in (
+        "0", "off", "false")
+
+
+# ---------------------------------------------------------------------------
+# analytical footprint (abstract shapes only — no device work)
+# ---------------------------------------------------------------------------
+def _leaf_bytes(leaf):
+    """Bytes of one array-like leaf from its shape/dtype METADATA
+    (ShapeDtypeStruct, jax array, numpy array); 0 for non-array leaves
+    (ints, None, hyperparam scalars inside optimizer states)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(np.dtype(dtype).itemsize)
+
+
+def tree_bytes(tree):
+    """Total bytes of every array leaf in a pytree, from metadata only."""
+    import jax
+
+    return sum(_leaf_bytes(l) for l in jax.tree.leaves(tree))
+
+
+def param_bytes(model):
+    """Per-point parameter bytes of ``model`` by optimizer group, computed
+    abstractly: ``jax.eval_shape`` traces ``model.init`` without running a
+    single device op. Returns ``{"embedder", "factors", "other", "total"}``
+    (groups the model does not define are 0)."""
+    import jax
+    import numpy as np
+
+    key = jax.ShapeDtypeStruct((2,), np.uint32)
+    try:
+        shapes = jax.eval_shape(model.init, key)
+    except Exception:
+        # models whose init consumes the key concretely (e.g. host-side
+        # numpy seeding) cannot be abstractly traced AT ALL — eval_shape
+        # abstracts a concrete key too, so the only fallback is a real
+        # throwaway init (one allocation, host-cheap at these model sizes)
+        shapes = model.init(jax.random.PRNGKey(0))
+    out = {"embedder": 0, "factors": 0, "other": 0}
+    if isinstance(shapes, dict):
+        for group, sub in shapes.items():
+            g = group if group in ("embedder", "factors") else "other"
+            out[g] += tree_bytes(sub)
+    else:
+        out["other"] = tree_bytes(shapes)
+    out["total"] = out["embedder"] + out["factors"] + out["other"]
+    return out
+
+
+def grid_footprint(model, train_config, g_exec, train_ds=None, val_ds=None,
+                   stream_mode=None, freeze=False):
+    """Predicted HBM bytes of one grid fit at execution width ``g_exec``.
+
+    The terms mirror what the engine actually pins (parallel/grid.py):
+
+    * ``params_bytes`` — the live (G, ...) parameter grid;
+    * ``opt_bytes`` — Adam first+second moments per group (2x params);
+    * ``best_bytes`` — the best-criteria parameter copy (+ the Freeze-mode
+      ``accepted`` tree when ``freeze``);
+    * ``dataset_bytes`` — train+val arrays the epoch engine keeps
+      device-resident (0 for host-streamed modes);
+    * ``epoch_gather_bytes`` — the transient permuted epoch copy the
+      one-dispatch epoch scan gathers before scanning (bounded by the
+      dataset size; 0 off the epoch path).
+
+    ``per_lane_bytes`` is the lane-proportional slope (params + opt + best
+    [+ accepted]); ``total_bytes = per_lane_bytes * g_exec + fixed``. All
+    arithmetic is host-side on shape metadata. ``stream_mode`` defaults to
+    ``train_config.stream_mode``; ``freeze`` to whether the model config's
+    training mode runs the accept/revert choreography."""
+    from redcliff_tpu.data import pipeline
+
+    if stream_mode is None and train_config is not None:
+        stream_mode = getattr(train_config, "stream_mode", None)
+    if not freeze:
+        mode = getattr(getattr(model, "config", None), "training_mode", "")
+        freeze = "Freeze" in str(mode)
+    pb = param_bytes(model)
+    per_point = pb["total"]
+    # Adam (scale_by_adam / optax.adam): mu + nu mirror each optimized group
+    opt_per_point = 2 * (pb["embedder"] + pb["factors"])
+    if opt_per_point == 0:
+        opt_per_point = 2 * per_point  # single-group models optimize it all
+    copies = 2 + (1 if freeze else 0)  # live + best (+ accepted)
+    per_lane = per_point * copies + opt_per_point
+    train_bytes = pipeline.dataset_device_bytes(train_ds) or 0
+    val_bytes = pipeline.dataset_device_bytes(val_ds) or 0
+    on_epoch_path = stream_mode in (None, "auto", "epoch")
+    # device-batch-capable datasets stay HBM-resident on the per-batch and
+    # kscan paths too (the engine gathers batches from the device copy);
+    # only the epoch scan additionally pays the transient permuted copy
+    resident = on_epoch_path or bool(
+        getattr(train_ds, "supports_device_batches", False))
+    dataset_bytes = (train_bytes + val_bytes) if resident else 0
+    gather_bytes = train_bytes if on_epoch_path else 0
+    g_exec = int(g_exec)
+    return {
+        "g_bucket": g_exec,
+        "params_bytes": per_point * g_exec,
+        "opt_bytes": opt_per_point * g_exec,
+        "best_bytes": per_point * (copies - 1) * g_exec,
+        "per_lane_bytes": per_lane,
+        "dataset_bytes": dataset_bytes,
+        "epoch_gather_bytes": gather_bytes,
+        "total_bytes": per_lane * g_exec + dataset_bytes + gather_bytes,
+    }
+
+
+def trainer_footprint(params, opt_states=(), extra_copies=2,
+                      train_ds=None, val_ds=None):
+    """Predicted HBM bytes of one per-point trainer fit, from the CONCRETE
+    parameter tree's metadata (shape/dtype reads only — no transfer):
+    live params + ``extra_copies`` full copies (best / accepted / divergence
+    snapshot) + the given optimizer states + the device-batch dataset
+    cache."""
+    from redcliff_tpu.data import pipeline
+
+    pb = tree_bytes(params)
+    opt = sum(tree_bytes(s) for s in opt_states)
+    ds_bytes = ((pipeline.dataset_device_bytes(train_ds) or 0)
+                + (pipeline.dataset_device_bytes(val_ds) or 0))
+    return {
+        "params_bytes": pb * (1 + int(extra_copies)),
+        "opt_bytes": opt,
+        "dataset_bytes": ds_bytes,
+        "total_bytes": pb * (1 + int(extra_copies)) + opt + ds_bytes,
+    }
+
+
+def footprint_by_bucket(model, train_config, g_real, n_devices=1,
+                        max_width=None, train_ds=None, val_ds=None,
+                        stream_mode=None, freeze=False):
+    """Predicted footprint per bucket-ladder rung from the width ``g_real``
+    requires up to ``max_width`` (default: 4 rungs) — the admission
+    planner's packing input: how much HBM each candidate G-bucket pins for
+    this shape. Returns ``[{..., "g_bucket", "total_bytes"}, ...]``."""
+    from redcliff_tpu.parallel import compaction
+
+    return [grid_footprint(model, train_config, w, train_ds=train_ds,
+                           val_ds=val_ds, stream_mode=stream_mode,
+                           freeze=freeze)
+            for w in compaction.ladder_widths(g_real, n_devices,
+                                              max_width=max_width)]
+
+
+# ---------------------------------------------------------------------------
+# live watermark (host allocator API — None where unsupported)
+# ---------------------------------------------------------------------------
+def device_memory_stats(device=None):
+    """``device.memory_stats()`` as a plain dict, or None where the backend
+    does not report (this container's CPU). Host-side allocator metadata —
+    never a dispatch or a sync."""
+    if device is None:
+        import jax
+
+        devs = jax.local_devices()
+        if not devs:
+            return None
+        device = devs[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backends without the API raise
+        return None
+    return dict(stats) if stats else None
+
+
+def poll_watermark(devices=None):
+    """Aggregate live/peak HBM across ``devices`` (default: all local
+    devices): ``{"bytes_in_use", "peak_bytes", "bytes_limit", "n_devices",
+    "device_kind"}`` — per-device MAX for use/peak (the binding constraint
+    on a replicated grid), min for the limit. None when no device reports
+    (CPU backend)."""
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    in_use = peak = limit = None
+    n = 0
+    kind = None
+    for d in devices:
+        stats = device_memory_stats(d)
+        if not stats:
+            continue
+        n += 1
+        kind = getattr(d, "device_kind", None)
+        u = stats.get("bytes_in_use")
+        p = stats.get("peak_bytes_in_use", u)
+        li = stats.get("bytes_limit")
+        if u is not None:
+            in_use = u if in_use is None else max(in_use, u)
+        if p is not None:
+            peak = p if peak is None else max(peak, p)
+        if li is not None:
+            limit = li if limit is None else min(limit, li)
+    if n == 0:
+        return None
+    return {"bytes_in_use": in_use, "peak_bytes": peak,
+            "bytes_limit": limit, "n_devices": n, "device_kind": kind}
+
+
+def check_headroom(predicted_bytes, devices=None, n_devices=None):
+    """Does ``predicted_bytes`` fit the visible devices' HBM? The headroom
+    signal the bucket ladder consults before growing a width and the
+    admission planner will consume per request.
+
+    Returns ``{"fits", "bytes_limit", "budget_bytes", "headroom_bytes",
+    "backend"}``. ``bytes_limit`` is always the PER-DEVICE limit — the same
+    unit every watermark poll reports — while ``budget_bytes`` is the
+    aggregate the verdict is judged against: ``n_devices * bytes_limit``
+    for a grid whose lane axis shards over the mesh. ``fits`` is None (with
+    both limits None) when the backend does not report memory stats —
+    callers degrade to an explicit ``n/a (backend)``, never a guess."""
+    import jax
+
+    wm = poll_watermark(devices)
+    backend = jax.default_backend()
+    if wm is None or wm.get("bytes_limit") is None:
+        return {"fits": None, "bytes_limit": None, "budget_bytes": None,
+                "headroom_bytes": None, "backend": backend}
+    scale = int(n_devices or wm["n_devices"] or 1)
+    budget = wm["bytes_limit"] * scale
+    return {"fits": bool(predicted_bytes <= budget),
+            "bytes_limit": wm["bytes_limit"],
+            "budget_bytes": budget,
+            "headroom_bytes": int(budget - predicted_bytes),
+            "backend": backend}

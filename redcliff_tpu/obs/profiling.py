@@ -1,0 +1,222 @@
+"""Scoped device-profile capture windows.
+
+Before this module, profiling meant wrapping the WHOLE fit in one
+``jax.profiler.trace`` (``profile_dir``): a week-long sweep produced a
+multi-GB trace nobody could open, and the interesting epochs — steady
+state, after compiles and cache priming — were buried under the cold
+start. A *capture window* brackets exactly the epochs you asked for with
+programmatic ``jax.profiler.start_trace`` / ``stop_trace``:
+
+* ``TrainConfig.profile_window`` / env ``REDCLIFF_PROFILE`` take a spec —
+  ``"epoch:3"`` captures epoch 3 only, ``"epoch:2-4"`` an inclusive range;
+  unset/``off`` disables (the shared :data:`NOOP` window, one no-op method
+  call per epoch boundary);
+* the artifact is written under the run dir (``<run_dir>/profile`` by
+  default, or the legacy ``profile_dir``) and announced by a
+  schema-registered ``profile`` event so ``obs report`` can inventory it;
+* ``profile_dir`` is kept as an alias: setting it WITHOUT a window spec now
+  captures one bounded steady-state window (epoch 1, falling back to epoch
+  0 on one-epoch fits) instead of the whole fit — long sweeps stop
+  producing unbounded traces;
+* a fit that ends inside an open window (early stop, exception,
+  preemption) still closes the capture — the window is a context manager
+  scoped around the fit, and ``__exit__`` stops any live trace and marks
+  the event ``truncated``.
+
+Cost discipline (same contract as the spans, pinned by the obs/schema.py
+source tripwire): zero-cost when off — the epoch hooks on :data:`NOOP` do
+nothing — and NEVER a host sync; ``start_trace``/``stop_trace`` run only at
+the requested window's boundaries, so the decision stream is bit-identical
+with profiling on or off. jax is imported lazily inside the start/stop
+methods only.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["ENV_PROFILE", "parse_window", "CaptureWindow", "NOOP",
+           "window_for"]
+
+ENV_PROFILE = "REDCLIFF_PROFILE"
+
+
+def parse_window(spec):
+    """Parse a capture-window spec into ``(first_epoch, last_epoch)`` or
+    None (disabled). Accepted: ``"epoch:N"``, ``"epoch:N-M"`` (inclusive),
+    and off-values (None/empty/``0``/``off``). Raises ValueError on
+    malformed specs — a typo'd knob must fail loudly, not silently profile
+    nothing."""
+    if spec is None:
+        return None
+    spec = str(spec).strip().lower()
+    if spec in ("", "0", "off", "false", "none"):
+        return None
+    kind, sep, rest = spec.partition(":")
+    if kind != "epoch" or not sep:
+        raise ValueError(
+            f"unrecognized profile window spec {spec!r} (expected "
+            f"'epoch:N' or 'epoch:N-M')")
+    first, sep, last = rest.partition("-")
+    try:
+        a = int(first)
+        b = int(last) if sep else a
+    except ValueError:
+        raise ValueError(f"non-integer epoch in profile window {spec!r}")
+    if a < 0 or b < a:
+        raise ValueError(f"invalid epoch range in profile window {spec!r}")
+    return (a, b)
+
+
+class _NoopWindow:
+    """The shared disabled window: every hook is a no-op."""
+
+    __slots__ = ()
+    enabled = False
+
+    def on_epoch_start(self, epoch):
+        pass
+
+    def on_epoch_end(self, epoch, logger=None):
+        pass
+
+    def finish(self, logger=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP = _NoopWindow()
+
+
+class CaptureWindow:
+    """One bounded profiler capture: arms at ``first_epoch``'s start, stops
+    at ``last_epoch``'s end (or at fit teardown, marked truncated). Engines
+    call the two epoch hooks from their loop and scope the window as a
+    context manager around the fit."""
+
+    enabled = True
+
+    def __init__(self, out_dir, first_epoch, last_epoch, spec=None):
+        # absolute: the announcing `profile` event is read post-mortem from
+        # other cwds/hosts, where a fit-cwd-relative path is meaningless
+        self.out_dir = os.path.abspath(str(out_dir))
+        self.first_epoch = int(first_epoch)
+        self.last_epoch = int(last_epoch)
+        self.spec = spec or f"epoch:{first_epoch}-{last_epoch}"
+        self._active = False
+        self._done = False
+        self._t0 = None
+        self._started_epoch = None
+        self._last_seen_epoch = None
+        self._logger = None
+
+    def on_epoch_start(self, epoch):
+        """Start the capture when ``epoch`` enters the window. Late resumes
+        that land past ``first_epoch`` but inside the window still capture
+        their remaining window epochs; a resume past the window never
+        starts it."""
+        if self._active or self._done:
+            return
+        if self.first_epoch <= epoch <= self.last_epoch:
+            import jax
+
+            os.makedirs(self.out_dir, exist_ok=True)
+            jax.profiler.start_trace(self.out_dir)
+            self._active = True
+            self._started_epoch = epoch
+            self._t0 = time.perf_counter()
+
+    def on_epoch_end(self, epoch, logger=None):
+        """Stop the capture when ``epoch`` closes the window; remembers the
+        newest logger so a teardown stop can still announce the artifact."""
+        if logger is not None:
+            self._logger = logger
+        if self._active:
+            # track the newest epoch actually captured so a teardown stop
+            # (fit died mid-window) announces the real captured range
+            self._last_seen_epoch = epoch
+            if epoch >= self.last_epoch:
+                self._stop(last_epoch=epoch, logger=logger)
+
+    def _stop(self, last_epoch, logger=None, truncated=False):
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a double-stop must not kill a fit
+            pass
+        self._active = False
+        self._done = True
+        dur_ms = (time.perf_counter() - self._t0) * 1e3 \
+            if self._t0 is not None else None
+        logger = logger or self._logger
+        if logger is not None and getattr(logger, "active", False):
+            logger.log("profile", path=self.out_dir, spec=self.spec,
+                       first_epoch=self._started_epoch,
+                       last_epoch=last_epoch,
+                       dur_ms=round(dur_ms, 3) if dur_ms is not None
+                       else None,
+                       truncated=truncated)
+
+    def finish(self, logger=None):
+        """Close an open capture early (truncated) — engines call this
+        BEFORE closing their MetricLogger on non-loop exit paths
+        (preemption, deadlines, early exit), so the announcing `profile`
+        event still lands in metrics.jsonl; the context-manager __exit__
+        then has nothing left to do."""
+        if self._active:
+            last = (self._last_seen_epoch
+                    if self._last_seen_epoch is not None
+                    else self._started_epoch)
+            self._stop(last_epoch=last, logger=logger, truncated=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # the fit ended inside the window without a finish() (an exception
+        # escaping the loop): close the capture so the artifact is
+        # readable; the event still lands if the logger is open
+        self.finish()
+        return False
+
+
+def window_for(config, run_dir=None, max_iter=None):
+    """Resolve the capture window for one fit from (in precedence order)
+    ``config.profile_window``, the ``REDCLIFF_PROFILE`` env var, and the
+    legacy ``config.profile_dir`` alias (one bounded steady-state window:
+    epoch 1, or epoch 0 when the fit has a single epoch). An EXPLICIT off
+    spec (``profile_window="off"`` / ``REDCLIFF_PROFILE=0``) disables
+    profiling even when ``profile_dir`` is set — the operator's off switch
+    beats a committed config's alias. Returns the shared :data:`NOOP` when
+    profiling is off or no output location exists (neither ``profile_dir``
+    nor a run dir)."""
+    profile_dir = getattr(config, "profile_dir", None)
+    spec = getattr(config, "profile_window", None)
+    if spec is None:
+        spec = os.environ.get(ENV_PROFILE)
+    if spec is not None:
+        win = parse_window(spec)
+        if win is None:
+            return NOOP  # explicit off — do not fall through to the alias
+    else:
+        win = None
+    if win is None:
+        if not profile_dir:
+            return NOOP
+        # profile_dir alias: one bounded window at the first steady-state
+        # epoch (epoch 0 carries the cold compiles the window should skip)
+        last = (max_iter - 1) if max_iter is not None else 1
+        e = min(1, max(last, 0))
+        win = (e, e)
+        spec = f"epoch:{e}"
+    out_dir = profile_dir or (os.path.join(run_dir, "profile")
+                              if run_dir else None)
+    if out_dir is None:
+        return NOOP
+    return CaptureWindow(out_dir, win[0], win[1], spec=str(spec))

@@ -114,6 +114,18 @@ FAMILIES = [
     # kernel bug
     Family("pallas_prox_max_abs_err", path="pallas_prox_check.max_abs_err",
            better="lower", band=9.0, abs_floor=1e-5, g_dependent=False),
+    # device-memory observatory (ISSUE 9, obs/memory.py): the analytical
+    # HBM model's error vs the measured watermark — null (skipped) on
+    # backends without memory_stats; the ±20% acceptance contract is the
+    # absolute ceiling, judged even when priors were already in breach
+    Family("mem_model_err_pct", path="mem_model.abs_err_pct",
+           better="lower", band=_BAND_TIMING, abs_floor=20.0,
+           g_dependent=False, contract_max=20.0),
+    # span -> Perfetto round-trip cost (obs/trace_export.py): a post-mortem
+    # tool, but an O(n^2) regression in the exporter would make real run
+    # dirs unexportable — keep it on the trajectory
+    Family("trace_export.export_ms", better="lower", band=_BAND_TIMING,
+           abs_floor=250.0, g_dependent=False),
 ]
 
 

@@ -29,7 +29,8 @@ wrote — ``metrics.jsonl`` (rotation chain, torn lines tolerated),
 The builder is importable (:func:`build_report`) for tests and services.
 A missing or telemetry-less run dir exits with code 2 and a one-line
 diagnosis. This module also hosts the ``obs`` CLI dispatcher: ``report``,
-``watch`` (:mod:`redcliff_tpu.obs.watch`) and ``regress``
+``watch`` (:mod:`redcliff_tpu.obs.watch`), ``trace``
+(:mod:`redcliff_tpu.obs.trace_export`) and ``regress``
 (:mod:`redcliff_tpu.obs.regress`).
 """
 from __future__ import annotations
@@ -142,6 +143,7 @@ def build_report(run_dir):
     cost = {}             # (shape_key, g_bucket) -> accumulators
     cm_acc = {}           # (shape_key, g_bucket) -> residual-event accuracy
     run_cache_dir = None  # the versioned compile-cache dir fit_start logs
+    profiles = []         # capture-window artifacts (`profile` events)
     compactions, remeshes, failures, hangs = [], [], [], []
     anomalies = rollbacks = aborts = skipped_steps = 0
     quarantined = 0
@@ -212,6 +214,37 @@ def build_report(run_dir):
             if rec.get("source"):
                 a["sources"].add(rec["source"])
             a["last"] = rec
+        elif ev == "memory":
+            # device-memory observatory (obs/memory.py): the analytical
+            # prediction at fit start + the max measured watermark across
+            # this fit's polls — the predicted-vs-measured view per fit
+            if cur is not None:
+                m = cur.setdefault("_memory", {
+                    "predicted_bytes": None, "g_bucket": None,
+                    "measured_peak_bytes": None, "polls": 0,
+                    "bytes_limit": None, "fits_device": None,
+                    "backend": None})
+                if rec.get("kind") == "predicted":
+                    m["predicted_bytes"] = rec.get("predicted_bytes")
+                    m["g_bucket"] = rec.get("g_bucket")
+                    m["fits_device"] = rec.get("fits")
+                    m["backend"] = rec.get("backend")
+                    if rec.get("bytes_limit") is not None:
+                        m["bytes_limit"] = rec["bytes_limit"]
+                elif rec.get("kind") == "measured":
+                    m["polls"] += 1
+                    peak = rec.get("peak_bytes")
+                    if peak is None:
+                        peak = rec.get("bytes_in_use")
+                    if isinstance(peak, (int, float)):
+                        m["measured_peak_bytes"] = max(
+                            m["measured_peak_bytes"] or 0, peak)
+                    if rec.get("bytes_limit") is not None:
+                        m["bytes_limit"] = rec["bytes_limit"]
+        elif ev == "profile":
+            profiles.append({k: rec.get(k) for k in
+                             ("path", "spec", "first_epoch", "last_epoch",
+                              "dur_ms", "truncated")})
         elif ev == "compaction":
             compactions.append({k: rec.get(k) for k in
                                 ("epoch", "from_width", "to_width",
@@ -301,6 +334,33 @@ def build_report(run_dir):
             "last_epoch": last.get("epoch"),
         })
 
+    # device-memory section: predicted vs measured peak per fit + the
+    # profile-artifact inventory (capture windows announce their artifacts
+    # via `profile` events; stray artifact dirs under the run dir are
+    # globbed too so un-announced traces still surface)
+    mem_fits = []
+    for i, f in enumerate(fits):
+        m = f.pop("_memory", None)
+        if m is None:
+            continue
+        pred, meas = m["predicted_bytes"], m["measured_peak_bytes"]
+        err = (round(100.0 * (pred - meas) / meas, 1)
+               if isinstance(pred, (int, float))
+               and isinstance(meas, (int, float)) and meas else None)
+        mem_fits.append({"fit": i, "model": f.get("model"), **m,
+                         "err_pct": err})
+    artifact_dirs = sorted(
+        os.path.relpath(p, run_dir)
+        for p in glob.glob(os.path.join(run_dir, "profile*"))
+        if os.path.isdir(p))
+    memory_section = {
+        "fits": mem_fits,
+        "measured_available": any(
+            m["measured_peak_bytes"] is not None for m in mem_fits),
+        "profiles": profiles,
+        "profile_artifacts": artifact_dirs,
+    }
+
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
 
@@ -344,6 +404,7 @@ def build_report(run_dir):
                         "by_bucket": by_bucket},
         "compactions": compactions,
         "remeshes": remeshes,
+        "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
                      "guarded_steps_skipped": int(skipped_steps),
                      "rollbacks": rollbacks, "aborts": aborts,
@@ -376,6 +437,15 @@ def _fmt_ms(ms):
     if ms >= 1_000:
         return f"{ms / 1_000:.2f}s"
     return f"{ms:.1f}ms"
+
+
+def _fmt_bytes(b):
+    if not isinstance(b, (int, float)):
+        return "-"
+    for unit, div in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if b >= div:
+            return f"{b / div:.2f}{unit}"
+    return f"{int(b)}B"
 
 
 def render_text(report):
@@ -413,6 +483,39 @@ def render_text(report):
         out.append(f"remeshes: " + "; ".join(
             f"epoch {c['epoch']}: {c['from_devices']}->{c['to_devices']} "
             f"devices" for c in r["remeshes"]))
+    mem = r.get("memory") or {}
+    out.append("device memory (predicted vs measured peak, obs/memory.py):")
+    for m in mem.get("fits") or []:
+        meas = (_fmt_bytes(m["measured_peak_bytes"])
+                if m.get("measured_peak_bytes") is not None
+                else f"n/a ({m.get('backend') or 'backend'})")
+        err = (f", err {m['err_pct']:+.1f}%"
+               if m.get("err_pct") is not None else "")
+        out.append(f"  fit {m['fit']} {m.get('model')} "
+                   f"bucket={m.get('g_bucket')}: predicted "
+                   f"{_fmt_bytes(m.get('predicted_bytes'))}, measured peak "
+                   f"{meas}{err} ({m.get('polls', 0)} poll(s))")
+    if not mem.get("fits"):
+        out.append("  (no memory events recorded)")
+    profs = mem.get("profiles") or []
+    arts = mem.get("profile_artifacts") or []
+    if profs or arts:
+        for p in profs:
+            out.append(f"  profile [{p.get('spec')}] epochs "
+                       f"{p.get('first_epoch')}-{p.get('last_epoch')}"
+                       + (" (truncated)" if p.get("truncated") else "")
+                       + f" -> {p.get('path')}")
+        # compare by leaf name, not absolute path: the `profile` event
+        # holds the WRITER's absolute path, which no longer matches after
+        # the run dir is copied off-host for post-mortem analysis
+        announced = {os.path.basename(os.path.normpath(p["path"]))
+                     for p in profs if p.get("path")}
+        for a in arts:
+            if os.path.basename(os.path.normpath(a)) not in announced:
+                out.append(f"  profile artifact (unannounced): {a}")
+    else:
+        out.append("  profiles: none (REDCLIFF_PROFILE=epoch:N / "
+                   "profile_window to capture a bounded window)")
     n = r["numerics"]
     out.append(f"numerics: {n['anomaly_events']} anomaly event(s), "
                f"{n['guarded_steps_skipped']} guarded step(s) skipped, "
@@ -510,6 +613,13 @@ def main(argv=None):
                          "schema-valid JSON object")
     wp.add_argument("--interval", type=float, default=2.0,
                     help="follow-mode refresh seconds (default 2)")
+    tp = sub.add_parser(
+        "trace", help="export the run's spans + engine events + ledger "
+                      "attempts as Chrome trace-event JSON for Perfetto "
+                      "(obs/trace_export.py)")
+    tp.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
+    tp.add_argument("-o", "--output", default=None,
+                    help="write the trace JSON here (default: stdout)")
     gp = sub.add_parser(
         "regress", help="compare the newest BENCH_r*.json against the prior "
                         "trajectory per metric family with noise bands "
@@ -541,6 +651,13 @@ def main(argv=None):
 
         return run_watch(args.run_dir, once=args.once, as_json=args.json,
                          interval=args.interval)
+    if args.cmd == "trace":
+        from redcliff_tpu.obs.trace_export import main as trace_main
+
+        targv = [args.run_dir]
+        if args.output:
+            targv += ["-o", args.output]
+        return trace_main(targv)
     if args.cmd == "regress":
         from redcliff_tpu.obs.regress import main as regress_main
 

@@ -19,12 +19,14 @@ stdlib only.
 """
 from __future__ import annotations
 
+import ast
+import os
 import re
 from dataclasses import dataclass, field
 
 __all__ = ["SCHEMA_VERSION", "EventSchema", "EVENTS", "LEDGER_EVENTS",
            "validate_record", "validate_records", "SHAPE_KEYS", "shape_desc",
-           "shape_key", "SPAN_NAMES"]
+           "shape_key", "SPAN_NAMES", "check_sources", "main"]
 
 SCHEMA_VERSION = 1
 
@@ -206,12 +208,31 @@ EVENTS = {
         optional=("residual_pct", "grid_width", "source", "eta_s",
                   "epochs_remaining", "samples", "mape_pct",
                   "predicted_compile_ms")),
+    "memory": _ev(
+        "grid engine + trainers (obs/memory.py: kind=predicted — the "
+        "analytical HBM footprint at fit start; kind=measured — a "
+        "device.memory_stats() watermark poll, check-window cadence, only "
+        "on backends that report)",
+        required=("kind",),
+        optional=("epoch", "g_bucket", "grid_width", "predicted_bytes",
+                  "params_bytes", "opt_bytes", "best_bytes",
+                  "per_lane_bytes", "dataset_bytes", "epoch_gather_bytes",
+                  "bytes_in_use", "peak_bytes", "bytes_limit",
+                  "budget_bytes", "headroom_bytes", "fits", "backend",
+                  "device_kind", "n_devices", "note")),
+    "profile": _ev(
+        "obs/profiling.py capture windows (announces the jax.profiler "
+        "artifact a bounded window wrote under the run dir)",
+        required=("path",),
+        optional=("spec", "first_epoch", "last_epoch", "dur_ms",
+                  "truncated")),
     "watch": _ev(
         "obs.watch (snapshot artifact / --once --json output, not a jsonl "
         "line)",
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
-                  "heartbeats", "attempts", "incidents", "read_audit")),
+                  "heartbeats", "attempts", "incidents", "read_audit",
+                  "memory")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -288,3 +309,152 @@ def validate_records(records, kind="metrics"):
         if errs:
             out.append((i, errs))
     return out
+
+
+# ---------------------------------------------------------------------------
+# standalone source tripwires: ``python -m redcliff_tpu.obs.schema --check``
+# runs the AST-level registry/no-host-sync scans as a lint entry point (CI's
+# lint job and tests/test_observability.py both drive these). stdlib only —
+# this must run on a box with no jax backend at all.
+# ---------------------------------------------------------------------------
+
+# observability modules under the no-host-sync discipline. "no-jax": jax may
+# not be imported AT ALL (the span/flight hot path and the post-mortem trace
+# exporter); "lazy-jax": jax only inside function bodies (memory polls and
+# profiler start/stop need the API but must not drag jax into stdlib-only
+# importers). block_until_ready is banned in every one of them — a device
+# sync inside the observability layer would serialize what it observes.
+NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py")
+LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py")
+
+
+def _pkg_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _iter_sources(pkg_root):
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for name in files:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _check_name_literals(tree, path, events, errors):
+    """Every event/span name LITERAL must be registered: ``log("<event>")``
+    -> EVENTS u LEDGER_EVENTS, ``span``/``record_span`` -> SPAN_NAMES, and
+    dict literals carrying ``"event": "<name>"`` (the stdlib writers)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = (fn.id if isinstance(fn, ast.Name)
+                     else fn.attr if isinstance(fn, ast.Attribute)
+                     else None)
+            if not (fname in ("span", "record_span", "log") and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if fname == "log":
+                if name not in events:
+                    errors.append(f"{path}:{node.lineno}: unregistered "
+                                  f"event literal {name!r}")
+            elif name not in SPAN_NAMES:
+                errors.append(f"{path}:{node.lineno}: unregistered span "
+                              f"literal {name!r}")
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "event"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and v.value not in events):
+                    errors.append(f"{path}:{node.lineno}: unregistered "
+                                  f"event literal {v.value!r}")
+
+
+def _check_host_sync(tree, path, rel, errors):
+    """The no-host-sync discipline for the observability modules: no
+    ``block_until_ready`` anywhere; jax imports banned entirely
+    (:data:`NO_JAX_MODULES`) or confined to function bodies
+    (:data:`LAZY_JAX_MODULES`)."""
+    no_jax = rel.endswith(NO_JAX_MODULES)
+    lazy_jax = rel.endswith(LAZY_JAX_MODULES)
+    if not (no_jax or lazy_jax):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "block_until_ready":
+            errors.append(f"{path}:{node.lineno}: block_until_ready in an "
+                          f"observability module (device sync)")
+    if no_jax:
+        banned = ast.walk(tree)
+    else:
+        # lazy-jax: EVERY import outside a function body is module scope —
+        # including ones nested in try:/if: blocks, which a plain
+        # tree.body walk would miss
+        in_func = set()
+        for fn in ast.walk(tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    in_func.add(id(sub))
+        banned = (n for n in ast.walk(tree) if id(n) not in in_func)
+    for node in banned:
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            names = [node.module or ""]
+        else:
+            continue
+        if any(n.split(".")[0] == "jax" for n in names):
+            where = "at all" if no_jax else "at module scope (lazy only)"
+            errors.append(f"{path}:{node.lineno}: jax imported {where}")
+
+
+def check_sources(pkg_root=None):
+    """Run every source tripwire over ``redcliff_tpu/``; returns a list of
+    ``"path:line: message"`` violations (empty = clean)."""
+    pkg_root = pkg_root or _pkg_root()
+    events = set(EVENTS) | set(LEDGER_EVENTS)
+    errors = []
+    for path in sorted(_iter_sources(pkg_root)):
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError as e:
+                errors.append(f"{path}: syntax error: {e}")
+                continue
+        rel = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+        _check_name_literals(tree, path, events, errors)
+        _check_host_sync(tree, path, rel, errors)
+    return errors
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m redcliff_tpu.obs.schema",
+        description="Event-schema registry tools: --check runs the AST "
+                    "source tripwires (event/span literal registration + "
+                    "observability no-host-sync discipline) as a lint "
+                    "step; exits 1 on any violation.")
+    ap.add_argument("--check", action="store_true",
+                    help="scan redcliff_tpu/ sources for unregistered "
+                         "event/span literals and host-sync violations")
+    args = ap.parse_args(argv)
+    if not args.check:
+        ap.print_help()
+        return 2
+    errors = check_sources()
+    for e in errors:
+        print(e)
+    print(f"schema --check: {len(errors)} violation(s); "
+          f"{len(EVENTS)} metric + {len(LEDGER_EVENTS)} ledger event "
+          f"type(s), {len(SPAN_NAMES)} span name(s) registered "
+          f"(schema v{SCHEMA_VERSION})")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

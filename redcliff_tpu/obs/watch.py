@@ -15,7 +15,10 @@ and therefore cheap at metrics scale, and it is the only approach that is
 automatically correct across rotation boundaries (``metrics.jsonl`` ->
 ``.1``), truncation, a writer SIGKILLed mid-append, and a supervisor
 restart swapping the writing pid — every case a byte-offset tail gets
-wrong.
+wrong. The snapshot also carries the device-memory view (obs/memory.py):
+the newest measured HBM watermark + the analytical prediction, rendered
+as a live ``hbm:`` line (``n/a (backend)`` where ``memory_stats()`` is
+unsupported).
 
 ``--once`` prints a single snapshot and exits; ``--once --json`` prints the
 snapshot as one strict-JSON object that validates against the registered
@@ -161,6 +164,7 @@ def build_snapshot(run_dir, now=None):
 
     fits, incidents = [], []
     cur = None
+    mem_pred = mem_meas = None  # newest memory events (obs/memory.py)
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
     last_wall = last_epoch_wall = None
@@ -196,6 +200,11 @@ def build_snapshot(run_dir, now=None):
                     cur[k_fit] = rec[k_rec]
         elif ev == "cost_model" and cur is not None:
             cur["_cost_model_last"] = rec
+        elif ev == "memory":
+            if rec.get("kind") == "measured":
+                mem_meas = rec
+            elif rec.get("kind") == "predicted":
+                mem_pred = rec
         elif ev in ("compaction", "remesh") and cur is not None:
             if rec.get("to_width") is not None:
                 cur["grid_width"] = rec["to_width"]
@@ -267,6 +276,24 @@ def build_snapshot(run_dir, now=None):
     current_fits = [f for f in fits if not f["superseded"]] or fits
     last_skipped = max((f["guarded_steps_skipped"] or 0
                         for f in current_fits), default=0)
+    # live HBM view (obs/memory.py): the newest measured watermark poll +
+    # the newest analytical prediction; measured stays None on backends
+    # without memory_stats (render shows an explicit "n/a (backend)")
+    memory = None
+    if mem_pred is not None or mem_meas is not None:
+        memory = {
+            "predicted_bytes": (mem_pred or {}).get("predicted_bytes"),
+            "g_bucket": (mem_pred or {}).get("g_bucket"),
+            "backend": (mem_pred or {}).get("backend"),
+            "bytes_in_use": (mem_meas or {}).get("bytes_in_use"),
+            "peak_bytes": (mem_meas or {}).get("peak_bytes"),
+            "bytes_limit": ((mem_meas or {}).get("bytes_limit")
+                            or (mem_pred or {}).get("bytes_limit")),
+            "measured_age_s": (
+                round(now - mem_meas["wall_time"], 3)
+                if mem_meas and isinstance(mem_meas.get("wall_time"),
+                                           (int, float)) else None),
+        }
     return {
         "event": "watch",
         "wall_time": now,
@@ -279,6 +306,7 @@ def build_snapshot(run_dir, now=None):
         "numerics": {"anomaly_events": anomalies, "rollbacks": rollbacks,
                      "aborts": aborts,
                      "guarded_steps_skipped": int(last_skipped)},
+        "memory": memory,
         "heartbeats": heartbeats,
         "incidents": incidents,
         "attempts": {"n": len(attempts),
@@ -362,6 +390,22 @@ def render_text(snap):
     out.append(f"  numerics: {n['anomaly_events']} anomaly, "
                f"{n['rollbacks']} rollback, {n['aborts']} abort, "
                f"{n['guarded_steps_skipped']} guarded step(s) skipped")
+    mem = snap.get("memory")
+    if mem:
+        fb = lambda b: (f"{b / (1 << 20):.1f}MB"
+                        if isinstance(b, (int, float)) else "-")
+        if mem.get("bytes_in_use") is not None \
+                or mem.get("peak_bytes") is not None:
+            out.append(
+                f"  hbm: in_use {fb(mem['bytes_in_use'])} | peak "
+                f"{fb(mem['peak_bytes'])} | limit {fb(mem['bytes_limit'])} "
+                f"(age {_fmt_age(mem['measured_age_s'])}; predicted "
+                f"{fb(mem['predicted_bytes'])})")
+        else:
+            out.append(
+                f"  hbm: n/a ({mem.get('backend') or 'backend'}) — "
+                f"predicted {fb(mem['predicted_bytes'])} at bucket "
+                f"{mem.get('g_bucket')}")
     if snap["incidents"]:
         out.append(f"  incidents: " + "; ".join(
             f"{i['event']}({','.join(i['components'])})"

@@ -47,6 +47,7 @@ import numpy as np
 __all__ = [
     "bucket_width",
     "next_pow2",
+    "ladder_widths",
     "plan_compaction",
     "assemble_plan",
     "unretired_frozen_rows",
@@ -81,6 +82,24 @@ def bucket_width(n_lanes, n_devices=1):
     if b >= n_devices:
         return -(-b // n_devices) * n_devices
     return b if n_devices % b == 0 else n_devices
+
+
+def ladder_widths(n_lanes, n_devices=1, max_width=None):
+    """The bucket-ladder rungs from the width ``n_lanes`` requires up to
+    ``max_width`` (default: 8x the base rung), ascending. The enumeration
+    input for the device-memory observatory's per-rung HBM footprints
+    (obs/memory.py ``footprint_by_bucket``) and ROADMAP item 1's admission
+    planner: which widths COULD this shape run at, before asking what each
+    one costs in bytes and milliseconds."""
+    base = bucket_width(n_lanes, n_devices)
+    if max_width is None:
+        max_width = base * 8
+    out = []
+    w = base
+    while w <= int(max_width):
+        out.append(w)
+        w = bucket_width(w + 1, n_devices)
+    return out
 
 
 class CompactionPlan:

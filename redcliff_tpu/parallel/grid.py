@@ -61,8 +61,10 @@ from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.preempt import (DeadlineExceeded, Preempted,
                                           PreemptionGuard)
 from redcliff_tpu import obs
-from redcliff_tpu.obs import MetricLogger, profiler_trace
+from redcliff_tpu.obs import MetricLogger
 from redcliff_tpu.obs import costmodel as _costmodel
+from redcliff_tpu.obs import memory as _obsmem
+from redcliff_tpu.obs import profiling as _profiling
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.precision import matmul_precision_ctx
 
@@ -955,14 +957,24 @@ class RedcliffGridRunner:
         # via `guard`) -> hard exit EXIT_HANG for the supervisor to restart.
         # Daemonized + stopped on every exit path, so no teardown can hang
         wd = rt_watchdog.maybe_start(guard=guard if guard.enabled else None)
-        with guard, profiler_trace(self.tc.profile_dir), wctx, wd as live_wd:
+        # bounded profiler capture window (obs/profiling.py): profile_window
+        # / REDCLIFF_PROFILE bracket the requested steady-state epochs; the
+        # legacy profile_dir knob now means ONE bounded window, not an
+        # unbounded whole-fit jax.profiler.trace wrap (multi-GB artifacts
+        # on long sweeps). Scoped here so a fit dying inside the window
+        # still closes the capture
+        pw = _profiling.window_for(
+            self.tc, run_dir=log_dir,
+            max_iter=max_iter if max_iter is not None else self.tc.max_iter)
+        with guard, pw, wctx, wd as live_wd:
             try:
                 return self._fit(key, train_ds, val_ds, max_iter=max_iter,
                                  log_dir=log_dir, init_params=init_params,
                                  copy_init=copy_init,
                                  checkpoint_dir=checkpoint_dir,
                                  checkpoint_every=checkpoint_every,
-                                 guard=guard, writer=writer, wd=live_wd)
+                                 guard=guard, writer=writer, wd=live_wd,
+                                 pw=pw)
             except (Preempted, DeadlineExceeded, remesh.HostLostError):
                 raise
             except Exception as e:
@@ -980,7 +992,8 @@ class RedcliffGridRunner:
     def _fit(self, key, train_ds, val_ds, max_iter=None,
              log_dir=None, init_params=None, copy_init=True,
              checkpoint_dir=None, checkpoint_every=None,
-             guard=None, writer=None, wd=None) -> GridResult:
+             guard=None, writer=None, wd=None,
+             pw=_profiling.NOOP) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
@@ -1255,7 +1268,11 @@ class RedcliffGridRunner:
             # check window — the obs watch CLI and the supervisor's
             # per-attempt ledger ETA both read these through the run's
             # cost_model events
-            "eta": None, "cost_model": None}
+            "eta": None, "cost_model": None,
+            # device-memory observatory (obs/memory.py): the analytical HBM
+            # prediction for this fit's (shape, G-bucket) + the measured
+            # watermark where the backend reports memory_stats
+            "memory": None}
         compile_t0 = compileobs.snapshot()
         counters_t0 = obs.counters.snapshot()
         width_nominal = Gx
@@ -1309,6 +1326,48 @@ class RedcliffGridRunner:
             # structured re-mesh event: which mesh the checkpoint came from,
             # which it landed on, how many lanes migrated, plan latency
             logger.log("remesh", epoch=start_it - 1, **remesh_info)
+        # ---- device-memory observatory (obs/memory.py) -------------------
+        # the analytical HBM footprint of THIS fit's (shape, G-bucket):
+        # abstract-shape arithmetic only (jax.eval_shape over init + dataset
+        # nbytes metadata — no device work), plus the headroom verdict the
+        # bucket ladder consults at the width it just chose (advisory: on
+        # backends without memory_stats the verdict is an explicit None).
+        # The prediction + live watermark ride dispatch_stats (-> every
+        # checkpoint) and schema-registered `memory` events
+        mem_poll = _obsmem.polling_enabled()
+        mem_devices = (list(self._mesh_full.devices.ravel())
+                       if self._mesh_full is not None else None)
+        n_mesh_dev = len(mem_devices) if mem_devices else 1
+        try:
+            mem_pred = _obsmem.grid_footprint(
+                self.model, tc, Gx, train_ds=train_ds, val_ds=val_ds,
+                stream_mode=base_stream, freeze=self._freeze)
+            headroom = _obsmem.check_headroom(
+                mem_pred["total_bytes"], devices=mem_devices,
+                n_devices=n_mesh_dev)
+        except Exception:  # noqa: BLE001 — the memory axis must never
+            mem_pred = headroom = None  # fail a fit; telemetry is garnish
+        if mem_pred is not None:
+            stats["memory"] = {
+                "predicted_bytes": mem_pred["total_bytes"],
+                "per_lane_bytes": mem_pred["per_lane_bytes"],
+                "g_bucket": Gx, "peak_bytes": None,
+                "bytes_limit": headroom["bytes_limit"],
+                "fits": headroom["fits"], "polls": 0}
+            logger.log(
+                "memory", kind="predicted", epoch=start_it - 1,
+                g_bucket=Gx, grid_width=Gx,
+                predicted_bytes=mem_pred["total_bytes"],
+                params_bytes=mem_pred["params_bytes"],
+                opt_bytes=mem_pred["opt_bytes"],
+                best_bytes=mem_pred["best_bytes"],
+                per_lane_bytes=mem_pred["per_lane_bytes"],
+                dataset_bytes=mem_pred["dataset_bytes"],
+                epoch_gather_bytes=mem_pred["epoch_gather_bytes"],
+                fits=headroom["fits"], bytes_limit=headroom["bytes_limit"],
+                budget_bytes=headroom["budget_bytes"],
+                headroom_bytes=headroom["headroom_bytes"],
+                backend=headroom["backend"], n_devices=n_mesh_dev)
         # fault-injection step index for the host-stream paths (nan_batch /
         # grad_blowup / skip specs); per-process, like the trainers'
         fi_step = 0
@@ -1318,6 +1377,10 @@ class RedcliffGridRunner:
             # op-scoped ``compile`` beat via _call_cold, which excuses this
             # one while XLA runs)
             rt_watchdog.stamp("epoch_engine")
+            # bounded profiler window: arms jax.profiler only when this
+            # epoch enters the requested window (a no-op method call on the
+            # shared NOOP window otherwise — never a sync, never a decision)
+            pw.on_epoch_start(it)
             epoch_width = Gx
             epoch_compile_t0 = compileobs.snapshot()
             # per-epoch host wall clock (enqueue time; no host sync added):
@@ -1716,6 +1779,28 @@ class RedcliffGridRunner:
                             epochs_remaining=epochs_remaining,
                             samples=cm_n,
                             mape_pct=stats["cost_model"]["mape_pct"])
+                # ---- live HBM watermark poll (obs/memory.py) -------------
+                # host allocator metadata read on the check-window cadence —
+                # no dispatch, no sync, nothing on backends that return
+                # None (this container's CPU). The peak rides
+                # dispatch_stats -> every checkpoint, and each poll lands
+                # as a `memory` event (the Perfetto counter track's source)
+                if mem_poll and stats["memory"] is not None:
+                    wm = _obsmem.poll_watermark(mem_devices)
+                    if wm is not None:
+                        sm = stats["memory"]
+                        sm["polls"] += 1
+                        if wm["peak_bytes"] is not None:
+                            sm["peak_bytes"] = max(sm["peak_bytes"] or 0,
+                                                   wm["peak_bytes"])
+                        if logger.active:
+                            logger.log("memory", kind="measured", epoch=it,
+                                       grid_width=Gx,
+                                       bytes_in_use=wm["bytes_in_use"],
+                                       peak_bytes=wm["peak_bytes"],
+                                       bytes_limit=wm["bytes_limit"],
+                                       n_devices=wm["n_devices"],
+                                       device_kind=wm["device_kind"])
                 # global early exit: once EVERY lane has hit its per-point
                 # patience, further epochs are pure masked compute (the
                 # per-point trainer would have broken out of each run long
@@ -1892,6 +1977,9 @@ class RedcliffGridRunner:
                         writer.wait()
                     logger.log("preempted_final_checkpoint", epoch=it,
                                signum=guard.signum if guard else None)
+                    # close an open capture window while the logger can
+                    # still record the truncated `profile` event
+                    pw.finish(logger=logger)
                     logger.close()
                     raise Preempted(guard.signum if guard else None,
                                     epoch=it)
@@ -1907,6 +1995,7 @@ class RedcliffGridRunner:
                            elapsed_s=round(elapsed, 3),
                            deadline_s=float(self.spec.grid_deadline_s),
                            checkpointed=checkpoint_dir is not None)
+                pw.finish(logger=logger)
                 logger.close()
                 raise DeadlineExceeded(
                     "grid", epoch=it, elapsed_s=elapsed,
@@ -1930,6 +2019,9 @@ class RedcliffGridRunner:
                                cache_hits=dc["cache_hits"],
                                cache_misses=dc["cache_misses"],
                                grid_width=Gx)
+            # close the profiler capture when this epoch ends the window
+            # (the `profile` event announcing the artifact rides this call)
+            pw.on_epoch_end(it, logger=logger)
             faultinject.crash_point("epoch_end", epoch=it)
 
         rt_watchdog.retire("epoch_engine")
@@ -1937,6 +2029,14 @@ class RedcliffGridRunner:
             # completion barrier: surface any background write failure and
             # guarantee the last generation is durable before results return
             writer.wait()
+        # final watermark sample so short fits (under one check window) still
+        # record a measured peak where the backend reports one
+        if mem_poll and stats["memory"] is not None:
+            wm = _obsmem.poll_watermark(mem_devices)
+            if wm is not None and wm["peak_bytes"] is not None:
+                stats["memory"]["polls"] += 1
+                stats["memory"]["peak_bytes"] = max(
+                    stats["memory"]["peak_bytes"] or 0, wm["peak_bytes"])
         stats.update(compileobs.delta(compile_t0))
         cdelta = obs.counters.delta(counters_t0)
         stats["prefetch_stall_ms"] = cdelta.get("prefetch_stall_ms", 0.0)
@@ -2013,6 +2113,9 @@ class RedcliffGridRunner:
                    # per-width timing accumulators): the obs report CLI's
                    # primary input for the time breakdown + cost table
                    dispatch_stats=stats)
+        # a window the fit's epochs never closed (early exit inside it, or
+        # a window past the horizon) announces its truncated capture now
+        pw.finish(logger=logger)
         logger.close()
         return GridResult(
             best_params=best_params_full,

@@ -33,7 +33,9 @@ import optax
 from redcliff_tpu import obs
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
-from redcliff_tpu.obs import MetricLogger, profiler_trace
+from redcliff_tpu.obs import MetricLogger
+from redcliff_tpu.obs import memory as _obsmem
+from redcliff_tpu.obs import profiling as _profiling
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
@@ -67,6 +69,12 @@ class RedcliffTrainConfig:
     unsupervised_start_index: int = 0
     max_samples_for_gc_tracking: int = 40  # ref MAX_NUM_SAMPS_FOR_GC_PROGRESS_TRACKING
     profile_dir: str | None = None  # opt-in jax.profiler trace output dir
+    # bounded profiler capture window (obs/profiling.py): "epoch:N" /
+    # "epoch:N-M" brackets jax.profiler around exactly those epochs, with
+    # the artifact under the run dir (or profile_dir) and a `profile` event
+    # announcing it. None = follow REDCLIFF_PROFILE; profile_dir alone now
+    # means ONE bounded steady-state window, never a whole-fit trace
+    profile_window: str | None = None
     # matmul precision for every jit'd step (train/eval/label-pred/freeze,
     # forward + backward): None = backend default; "bfloat16" runs MXU
     # passes in bf16 (params stay f32) — the standard TPU speed/accuracy
@@ -284,13 +292,19 @@ class RedcliffTrainer:
         # escalation contract as the grid engine — no preemption guard here,
         # so a confirmed hang goes straight to the hard-exit rung
         wd = rt_watchdog.maybe_start()
-        with profiler_trace(self.config.profile_dir), wd as live_wd:
+        # bounded profiler capture window (obs/profiling.py): profile_window
+        # / REDCLIFF_PROFILE / the profile_dir alias — scoped around the fit
+        # so an early exit inside the window still closes the capture
+        pw = _profiling.window_for(self.config, run_dir=save_dir,
+                                   max_iter=self.config.max_iter)
+        with pw, wd as live_wd:
             return self._fit(params, train_ds, val_ds, true_GC=true_GC,
                              save_dir=save_dir, resume=resume,
-                             factor_mesh=factor_mesh, wd=live_wd)
+                             factor_mesh=factor_mesh, wd=live_wd, pw=pw)
 
     def _fit(self, params, train_ds, val_ds, true_GC=None, save_dir=None,
-             resume=True, factor_mesh=None, wd=None) -> RedcliffFitResult:
+             resume=True, factor_mesh=None, wd=None,
+             pw=_profiling.NOOP) -> RedcliffFitResult:
         model, cfg = self.model, self.model.config
         tc = self.config
         self._true_GC = true_GC
@@ -408,8 +422,30 @@ class RedcliffTrainer:
             logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
                        shape=obs.schema.shape_desc(cfg),
                        train_config=tc, resume_epoch=iter_start)
+            # analytical HBM prediction (obs/memory.py): live params + best
+            # + accepted copies + Adam moments + the device-batch dataset
+            # cache — shape metadata only, no device work. extra_copies=2
+            # counts best_params and the Freeze-mode accepted tree
+            try:
+                mp = _obsmem.trainer_footprint(
+                    params, (optA_state, optB_state), extra_copies=2,
+                    train_ds=train_ds, val_ds=val_ds)
+                hr = _obsmem.check_headroom(mp["total_bytes"])
+                logger.log("memory", kind="predicted",
+                           epoch=iter_start - 1,
+                           predicted_bytes=mp["total_bytes"],
+                           params_bytes=mp["params_bytes"],
+                           opt_bytes=mp["opt_bytes"],
+                           dataset_bytes=mp["dataset_bytes"],
+                           fits=hr["fits"], bytes_limit=hr["bytes_limit"],
+                           budget_bytes=hr["budget_bytes"],
+                           headroom_bytes=hr["headroom_bytes"],
+                           backend=hr["backend"])
+            except Exception:  # noqa: BLE001 — telemetry must not fail fits
+                pass
             for it in range(iter_start, tc.max_iter):
                 rt_watchdog.stamp("epoch_engine")
+                pw.on_epoch_start(it)
                 t_epoch0 = time.perf_counter()
                 last_it = it
                 # Hungarian alignment at the pretrain->train transition (ref :1304-1309)
@@ -574,6 +610,7 @@ class RedcliffTrainer:
                            epoch_ms=round(
                                (time.perf_counter() - t_epoch0) * 1e3, 3),
                            **val, **(tracker.latest_as_dict() if tracker else {}))
+                pw.on_epoch_end(it, logger=logger)
                 if stop_early or aborted is not None:
                     break
                 if rolled_back:
@@ -588,6 +625,16 @@ class RedcliffTrainer:
                     print(f"epoch {it} phases={phases}: val_combo={val['combo_loss']:.5f}")
 
             final_val = self.validate(best_params, val_ds, None)
+            # measured watermark where the backend reports it (None on CPU)
+            if _obsmem.polling_enabled():
+                wm = _obsmem.poll_watermark()
+                if wm is not None:
+                    logger.log("memory", kind="measured", epoch=last_it,
+                               bytes_in_use=wm["bytes_in_use"],
+                               peak_bytes=wm["peak_bytes"],
+                               bytes_limit=wm["bytes_limit"],
+                               n_devices=wm["n_devices"],
+                               device_kind=wm["device_kind"])
             logger.log("fit_end", best_it=best_it if best_it is not None else 0,
                        best_loss=float(best_loss),
                        final_val_loss=final_val["combo_loss"],
@@ -595,6 +642,10 @@ class RedcliffTrainer:
         finally:
             rt_watchdog.retire("epoch_engine")
             rt_watchdog.retire("batch_loop")
+            # close an open capture window while the logger can still
+            # record the truncated `profile` event (pw's own __exit__ in
+            # fit() unwinds after this logger is closed)
+            pw.finish(logger=logger)
             logger.close()
             if writer is not None:
                 # join the in-flight write on EVERY exit path: a background

@@ -37,7 +37,9 @@ import optax
 
 from redcliff_tpu import obs
 from redcliff_tpu.data import pipeline
-from redcliff_tpu.obs import MetricLogger, profiler_trace
+from redcliff_tpu.obs import MetricLogger
+from redcliff_tpu.obs import memory as _obsmem
+from redcliff_tpu.obs import profiling as _profiling
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
@@ -59,6 +61,10 @@ class TrainConfig:
     prox_lam: float = 0.0
     verbose: int = 0
     profile_dir: str | None = None  # opt-in jax.profiler trace output dir
+    # bounded profiler capture window ("epoch:N" / "epoch:N-M",
+    # obs/profiling.py); None = follow REDCLIFF_PROFILE. profile_dir alone
+    # now captures ONE bounded steady-state window, never the whole fit
+    profile_window: str | None = None
     # double-buffered host prefetch depth for datasets without device-batch
     # support (shard streams): batch assembly + device_put of batch t+1
     # overlap compute of batch t (data/pipeline.py). <= 0 disables
@@ -310,9 +316,34 @@ class Trainer:
             logger.log("fit_start", model=type(self.model).__name__,
                        shape=obs.schema.shape_desc(self.model.config),
                        train_config=cfg, resume_epoch=iter_start)
-            with profiler_trace(cfg.profile_dir), wd:
+            # analytical HBM prediction (obs/memory.py): shape metadata
+            # only — live params + the best copy + optimizer state + the
+            # device-batch dataset cache
+            try:
+                mp = _obsmem.trainer_footprint(
+                    params, (opt_state,), extra_copies=1,
+                    train_ds=train_ds, val_ds=val_ds)
+                hr = _obsmem.check_headroom(mp["total_bytes"])
+                logger.log("memory", kind="predicted",
+                           epoch=iter_start - 1,
+                           predicted_bytes=mp["total_bytes"],
+                           params_bytes=mp["params_bytes"],
+                           opt_bytes=mp["opt_bytes"],
+                           dataset_bytes=mp["dataset_bytes"],
+                           fits=hr["fits"], bytes_limit=hr["bytes_limit"],
+                           budget_bytes=hr["budget_bytes"],
+                           headroom_bytes=hr["headroom_bytes"],
+                           backend=hr["backend"])
+            except Exception:  # noqa: BLE001 — telemetry must not fail fits
+                pass
+            # bounded profiler capture window (obs/profiling.py): replaces
+            # the old unbounded whole-fit profiler_trace wrap
+            pw = _profiling.window_for(cfg, run_dir=save_dir,
+                                       max_iter=cfg.max_iter)
+            with pw, wd:
                 for it in range(iter_start, cfg.max_iter):
                     rt_watchdog.stamp("epoch_engine")
+                    pw.on_epoch_start(it)
                     t_epoch0 = time.perf_counter()
                     last_it = it
                     for X, Y in train_batch_iter():
@@ -345,6 +376,7 @@ class Trainer:
                                    (time.perf_counter() - t_epoch0) * 1e3, 3),
                                **val,
                                **(tracker.latest_as_dict() if tracker else {}))
+                    pw.on_epoch_end(it, logger=logger)
 
                     if monitor is not None:
                         nhost = numerics.numerics_summary(nstate)
@@ -404,6 +436,16 @@ class Trainer:
                         print(f"epoch {it}: val_combo={val['combo_loss']:.5f} criteria={criteria:.5f}")
 
             final_val = self.validate(best_params, val_ds)
+            # measured watermark where the backend reports it (None on CPU)
+            if _obsmem.polling_enabled():
+                wm = _obsmem.poll_watermark()
+                if wm is not None:
+                    logger.log("memory", kind="measured", epoch=last_it,
+                               bytes_in_use=wm["bytes_in_use"],
+                               peak_bytes=wm["peak_bytes"],
+                               bytes_limit=wm["bytes_limit"],
+                               n_devices=wm["n_devices"],
+                               device_kind=wm["device_kind"])
             logger.log("fit_end", best_it=best_it if best_it is not None else 0,
                        best_loss=float(best_loss),
                        final_val_loss=final_val["combo_loss"],

@@ -1,0 +1,397 @@
+"""Device memory & profile observatory acceptance suite (ISSUE 9):
+
+* footprint-model goldens — the analytical HBM model's terms agree with the
+  CONCRETE parameter trees' byte counts at off-ladder and heterogeneous
+  G-buckets (on this CPU container the ±20% vs-measured-watermark contract
+  is golden-valued: there is no ``memory_stats`` to measure against);
+* capture windows — profiling on vs off is bit-identical, the window
+  brackets exactly the requested epochs, and the legacy ``profile_dir``
+  knob now captures ONE bounded window instead of the whole fit;
+* trace export — spans + events + ledger attempts from a ROTATED metrics
+  chain with a torn tail round-trip into valid Chrome trace-event JSON
+  (process/thread lanes, lanes-live + HBM counter tracks), and the CLI
+  exits 2 on missing/empty run dirs like its report/watch siblings;
+* ``memory`` events ride a real grid fit, validate against the closed
+  registry, and surface in ``obs report`` / ``obs watch`` with an explicit
+  ``n/a (backend)`` degradation on this CPU container;
+* the standalone lint entry (``python -m redcliff_tpu.obs.schema --check``)
+  runs the AST source tripwires clean.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu.obs import build_report, schema
+from redcliff_tpu.obs import memory as obsmem
+from redcliff_tpu.obs import profiling
+from redcliff_tpu.obs.logging import MetricLogger, jsonl_files, read_jsonl
+from redcliff_tpu.obs.report import main as obs_main
+from redcliff_tpu.obs.report import render_text
+from redcliff_tpu.obs.trace_export import build_trace, validate_trace
+from redcliff_tpu.obs.watch import build_snapshot
+from redcliff_tpu.obs.watch import render_text as watch_render
+from redcliff_tpu.parallel import compaction
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+from test_parallel_grid import _data, _model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# analytical footprint model (obs/memory.py)
+# ---------------------------------------------------------------------------
+def test_footprint_model_golden_off_ladder():
+    """The abstract-shape model must agree EXACTLY with the concrete
+    parameter trees' byte counts, at an off-ladder G (5 -> bucket 8)."""
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0))
+    per_point = obsmem.tree_bytes(params)
+    emb = obsmem.tree_bytes(params["embedder"])
+    fac = obsmem.tree_bytes(params["factors"])
+    pb = obsmem.param_bytes(model)
+    assert pb["embedder"] == emb and pb["factors"] == fac
+    assert pb["total"] == per_point > 0
+
+    g_exec = compaction.bucket_width(5)  # off-ladder grid pads 5 -> 8
+    assert g_exec == 8
+    fp = obsmem.grid_footprint(model, None, g_exec, stream_mode="per_batch")
+    assert fp["params_bytes"] == per_point * 8
+    assert fp["opt_bytes"] == 2 * (emb + fac) * 8  # Adam mu+nu per group
+    assert fp["best_bytes"] == per_point * 8       # best copy, no freeze
+    assert fp["dataset_bytes"] == 0 and fp["epoch_gather_bytes"] == 0
+    assert fp["total_bytes"] == fp["per_lane_bytes"] * 8
+    # the per-lane slope is exact: heterogeneous buckets differ by
+    # exactly (width delta) x per_lane
+    fp4 = obsmem.grid_footprint(model, None, 4, stream_mode="per_batch")
+    assert fp["total_bytes"] - fp4["total_bytes"] == 4 * fp["per_lane_bytes"]
+
+
+def test_footprint_epoch_mode_counts_dataset_and_gather():
+    model = _model()
+    ds = _data(model)
+    x_bytes = ds.X.nbytes + ds.Y.nbytes
+    fp = obsmem.grid_footprint(model, None, 4, train_ds=ds, val_ds=ds,
+                               stream_mode="epoch")
+    assert fp["dataset_bytes"] == 2 * x_bytes        # train + val resident
+    assert fp["epoch_gather_bytes"] == x_bytes       # permuted train copy
+    # device-batch datasets stay resident on the per-batch path too; only
+    # the epoch scan pays the transient permuted copy
+    off = obsmem.grid_footprint(model, None, 4, train_ds=ds, val_ds=ds,
+                                stream_mode="per_batch")
+    assert off["dataset_bytes"] == 2 * x_bytes
+    assert off["epoch_gather_bytes"] == 0
+
+
+def test_footprint_by_bucket_rides_the_ladder():
+    model = _model()
+    rungs = obsmem.footprint_by_bucket(model, None, g_real=5, n_devices=1)
+    widths = [r["g_bucket"] for r in rungs]
+    assert widths == compaction.ladder_widths(5, 1) == [8, 16, 32, 64]
+    totals = [r["total_bytes"] for r in rungs]
+    assert totals == sorted(totals) and totals[0] < totals[-1]
+
+
+def test_ladder_widths_submesh_rungs():
+    # widths below the mesh stay on divisors (sub-mesh rungs), above it on
+    # multiples — the same ladder bucket_width walks
+    assert compaction.ladder_widths(2, 8, max_width=16) == [2, 4, 8, 16]
+    assert compaction.ladder_widths(9, 4, max_width=64) == [16, 32, 64]
+
+
+def test_headroom_degrades_explicitly_on_cpu():
+    """This container's CPU backend reports no memory_stats: the headroom
+    verdict must be an explicit None (n/a), never a guess."""
+    assert obsmem.device_memory_stats() is None
+    assert obsmem.poll_watermark() is None
+    hr = obsmem.check_headroom(1 << 30)
+    assert hr["fits"] is None and hr["bytes_limit"] is None
+    assert hr["budget_bytes"] is None
+    assert hr["backend"] == "cpu"
+
+
+def test_mem_poll_env_knob(monkeypatch):
+    monkeypatch.setenv(obsmem.ENV_MEM_POLL, "0")
+    assert not obsmem.polling_enabled()
+    monkeypatch.setenv(obsmem.ENV_MEM_POLL, "1")
+    assert obsmem.polling_enabled()
+
+
+# ---------------------------------------------------------------------------
+# capture windows (obs/profiling.py)
+# ---------------------------------------------------------------------------
+def test_parse_window_specs():
+    assert profiling.parse_window(None) is None
+    assert profiling.parse_window("off") is None
+    assert profiling.parse_window("0") is None
+    assert profiling.parse_window("epoch:3") == (3, 3)
+    assert profiling.parse_window("epoch:2-4") == (2, 4)
+    for bad in ("epoch", "step:3", "epoch:x", "epoch:4-2", "epoch:-1"):
+        with pytest.raises(ValueError):
+            profiling.parse_window(bad)
+
+
+def test_window_for_profile_dir_alias_is_bounded(tmp_path):
+    """profile_dir WITHOUT a window spec = one bounded steady-state window
+    (epoch 1), never the whole fit."""
+    class C:
+        profile_dir = str(tmp_path / "prof")
+        profile_window = None
+
+    w = profiling.window_for(C(), max_iter=10)
+    assert (w.first_epoch, w.last_epoch) == (1, 1)
+    one = profiling.window_for(C(), max_iter=1)
+    assert (one.first_epoch, one.last_epoch) == (0, 0)
+
+    class Off:
+        profile_dir = None
+        profile_window = None
+
+    assert profiling.window_for(Off(), run_dir=None) is profiling.NOOP
+
+
+def test_explicit_off_beats_profile_dir_alias(tmp_path, monkeypatch):
+    """The operator's off switch (profile_window='off' / REDCLIFF_PROFILE=0)
+    disables profiling even when a committed config sets profile_dir."""
+    class C:
+        profile_dir = str(tmp_path / "prof")
+        profile_window = "off"
+
+    assert profiling.window_for(C(), max_iter=10) is profiling.NOOP
+
+    class D:
+        profile_dir = str(tmp_path / "prof")
+        profile_window = None
+
+    monkeypatch.setenv(profiling.ENV_PROFILE, "0")
+    assert profiling.window_for(D(), max_iter=10) is profiling.NOOP
+    monkeypatch.delenv(profiling.ENV_PROFILE)
+    assert profiling.window_for(D(), max_iter=10).enabled
+
+
+def test_truncated_window_reports_captured_range(tmp_path):
+    """A fit dying inside an open window announces the epochs actually
+    captured (started..last seen), marked truncated."""
+    win = profiling.CaptureWindow(str(tmp_path / "prof"), 1, 10)
+    with MetricLogger(str(tmp_path)) as log, win:
+        for e in range(4):  # fit ends at epoch 3, inside the 1-10 window
+            win.on_epoch_start(e)
+            win.on_epoch_end(e, logger=log)
+    profs = read_jsonl(str(tmp_path), event="profile")
+    assert len(profs) == 1
+    p = profs[0]
+    assert p["truncated"] and (p["first_epoch"], p["last_epoch"]) == (1, 3)
+
+
+@pytest.fixture(scope="module")
+def profiled_pair(tmp_path_factory):
+    """Two identical grid fits, one with a capture window armed: the
+    bit-identity input and the memory/profile-event fixture."""
+    model = _model()
+    ds = _data(model)
+    points = [{"gen_lr": 1e-3}, {"gen_lr": 5e-3}, {"gen_lr": 2e-3}]
+
+    def run(profile_window, run_dir):
+        tc = RedcliffTrainConfig(max_iter=4, batch_size=32, check_every=1,
+                                 profile_window=profile_window)
+        runner = RedcliffGridRunner(model, tc, GridSpec(points=list(points)))
+        res = runner.fit(jax.random.PRNGKey(0), ds, ds, log_dir=run_dir)
+        return runner, res
+
+    off_dir = str(tmp_path_factory.mktemp("win_off"))
+    on_dir = str(tmp_path_factory.mktemp("win_on"))
+    # the OFF leg also disables watermark polling, so the identity compare
+    # covers BOTH knobs at once: window+polling on vs window+polling off
+    old = os.environ.get(obsmem.ENV_MEM_POLL)
+    os.environ[obsmem.ENV_MEM_POLL] = "0"
+    try:
+        _, res_off = run(None, off_dir)
+    finally:
+        if old is None:
+            os.environ.pop(obsmem.ENV_MEM_POLL, None)
+        else:
+            os.environ[obsmem.ENV_MEM_POLL] = old
+    runner_on, res_on = run("epoch:1-2", on_dir)
+    return res_off, res_on, on_dir, runner_on
+
+
+def test_capture_window_on_off_bit_identical(profiled_pair):
+    """Profiling and memory polling observe, never participate: the
+    decision streams with a capture window + watermark polling armed are
+    BIT-identical to the run with both off."""
+    res_off, res_on, _run, _runner = profiled_pair
+    np.testing.assert_array_equal(np.asarray(res_off.val_history),
+                                  np.asarray(res_on.val_history))
+    np.testing.assert_array_equal(np.asarray(res_off.best_criteria),
+                                  np.asarray(res_on.best_criteria))
+    np.testing.assert_array_equal(np.asarray(res_off.best_epoch),
+                                  np.asarray(res_on.best_epoch))
+
+
+def test_capture_window_brackets_requested_epochs(profiled_pair):
+    _off, _on, run, _runner = profiled_pair
+    profs = read_jsonl(run, event="profile")
+    assert len(profs) == 1
+    p = profs[0]
+    assert (p["first_epoch"], p["last_epoch"]) == (1, 2)
+    assert p["spec"] == "epoch:1-2" and not p["truncated"]
+    assert not schema.validate_record(p)
+    # the jax.profiler artifact tree exists under the announced path
+    produced = [os.path.join(dp, f)
+                for dp, _dn, fs in os.walk(p["path"]) for f in fs]
+    assert produced, "capture window produced no profile artifact"
+
+
+def test_memory_events_ride_the_fit_and_validate(profiled_pair):
+    _off, _on, run, runner = profiled_pair
+    recs = read_jsonl(run)
+    assert not schema.validate_records(recs)
+    mems = [r for r in recs if r["event"] == "memory"]
+    kinds = {m["kind"] for m in mems}
+    assert "predicted" in kinds
+    pred = next(m for m in mems if m["kind"] == "predicted")
+    assert pred["g_bucket"] == 4 and pred["predicted_bytes"] > 0
+    assert pred["backend"] == "cpu" and pred["fits"] is None
+    # dispatch_stats carries the same axis (-> every checkpoint)
+    sm = runner.dispatch_stats["memory"]
+    assert sm["predicted_bytes"] == pred["predicted_bytes"]
+    assert sm["peak_bytes"] is None  # no memory_stats on this backend
+
+
+def test_report_and_watch_surface_memory(profiled_pair):
+    _off, _on, run, _runner = profiled_pair
+    rep = build_report(run)
+    mem = rep["memory"]
+    assert mem["fits"] and mem["fits"][0]["predicted_bytes"] > 0
+    assert not mem["measured_available"]
+    assert mem["profiles"] and mem["profiles"][0]["spec"] == "epoch:1-2"
+    text = render_text(rep)
+    assert "n/a (cpu)" in text and "device memory" in text
+    snap = build_snapshot(run)
+    assert not schema.validate_record(snap)
+    assert snap["memory"]["predicted_bytes"] > 0
+    assert snap["memory"]["bytes_in_use"] is None
+    assert "hbm: n/a (cpu)" in watch_render(snap)
+
+
+# ---------------------------------------------------------------------------
+# trace export (obs/trace_export.py)
+# ---------------------------------------------------------------------------
+def _write_trace_fixture(run, n=40, max_bytes=2000):
+    """A rotation-forcing metrics chain + ledger: fit lifecycle, epochs
+    (lanes_live counter source), spans, measured memory polls (hbm counter
+    source), and a torn tail SIGKILL-style."""
+    with MetricLogger(run, max_bytes=max_bytes) as log:
+        log.log("fit_start", model="RedcliffGridRunner", grid_size=8,
+                grid_width=8, shape={"num_chans": 4})
+        for i in range(n):
+            log.log("span", name="grid.dispatch", dur_ms=1.5, span_id=i + 1)
+            if i % 4 == 0:
+                log.log("epoch", epoch=i // 4, lanes_live=8 - i // 8,
+                        grid_width=8, epoch_ms=2.0)
+            if i % 8 == 0:
+                log.log("memory", kind="measured", epoch=i // 4,
+                        bytes_in_use=1000 + i, peak_bytes=2000 + i,
+                        bytes_limit=10_000)
+        log.log("compaction", epoch=n // 4, from_width=8, to_width=4)
+        log.log("fit_end")
+    with open(os.path.join(run, "metrics.jsonl"), "a") as f:
+        f.write('{"event": "epoch", "wall_time": 99.0, "epo')  # torn tail
+    with open(os.path.join(run, "run_ledger.jsonl"), "w") as f:
+        f.write(json.dumps({
+            "event": "attempt", "attempt": 0, "cmd": ["fit"], "rc": 0,
+            "classification": "clean", "action": "stop",
+            "started_at": 1.0, "duration_s": 2.0}) + "\n")
+
+
+def test_trace_export_round_trip_rotated_torn(tmp_path):
+    run = str(tmp_path)
+    _write_trace_fixture(run)
+    assert len(jsonl_files(os.path.join(run, "metrics.jsonl"))) > 1, \
+        "fixture must exercise the rotation chain"
+    trace = build_trace(run)
+    # valid Chrome trace-event JSON, strict round trip
+    blob = json.dumps(trace, allow_nan=False)
+    assert validate_trace(json.loads(blob)) == []
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X" and e["cat"] == "span"]
+    assert len(spans) == 40 and all(e["dur"] > 0 for e in spans)
+    lanes = [e for e in events if e["ph"] == "C"
+             and e["name"] == "lanes_live"]
+    assert lanes and lanes[-1]["args"]["lanes_live"] == 4
+    hbm = [e for e in events if e["ph"] == "C" and e["name"] == "hbm_bytes"]
+    assert hbm and hbm[0]["args"]["peak_bytes"] == 2000
+    attempts = [e for e in events if e.get("cat") == "attempt"]
+    assert len(attempts) == 1 and attempts[0]["dur"] == 2e6
+    # process/thread metadata names every lane
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {m["name"] for m in meta} == {"process_name", "thread_name"}
+    # the torn line was skipped and counted, not fatal
+    assert trace["otherData"]["torn_lines"] == 1
+
+
+def test_trace_cli_writes_and_exits_2_like_siblings(tmp_path, capsys):
+    run = str(tmp_path / "run")
+    os.makedirs(run)
+    _write_trace_fixture(run, n=8, max_bytes=None)
+    out = str(tmp_path / "trace.json")
+    assert obs_main(["trace", run, "-o", out]) == 0
+    with open(out) as f:
+        assert validate_trace(json.load(f)) == []
+    capsys.readouterr()
+    # exit-2 contract shared with report/watch: one-line diagnosis
+    assert obs_main(["trace", str(tmp_path / "missing")]) == 2
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert obs_main(["trace", empty]) == 2
+    err = capsys.readouterr().err
+    assert "obs trace:" in err and "no telemetry" in err
+
+
+def test_trace_cli_module_entry(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.obs", "trace",
+         str(tmp_path / "nope")],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 2 and "obs trace:" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# standalone source-tripwire entry (CI lint job)
+# ---------------------------------------------------------------------------
+def test_schema_check_sources_clean_and_catches_drift(tmp_path):
+    assert schema.check_sources() == []
+    # an unregistered event literal in a scanned tree is a violation
+    bad = tmp_path / "obs"
+    bad.mkdir()
+    (bad / "rogue.py").write_text(
+        'def f(log):\n    log.log("mystery_event", x=1)\n')
+    errs = schema.check_sources(str(tmp_path))
+    assert errs and "mystery_event" in errs[0]
+    # and so is a module-scope jax import in a lazy-jax module — including
+    # one hidden inside a try: block (a tree.body-only walk would miss it)
+    (bad / "rogue.py").unlink()
+    (bad / "memory.py").write_text("import jax\n")
+    errs = schema.check_sources(str(tmp_path))
+    assert errs and "jax imported" in errs[0]
+    (bad / "memory.py").write_text(
+        "try:\n    import jax\nexcept ImportError:\n    jax = None\n")
+    errs = schema.check_sources(str(tmp_path))
+    assert errs and "jax imported" in errs[0]
+    # a function-scoped (lazy) import is exactly what the discipline allows
+    (bad / "memory.py").write_text("def f():\n    import jax\n    return jax\n")
+    assert schema.check_sources(str(tmp_path)) == []
+
+
+def test_schema_check_module_entry():
+    r = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.obs.schema", "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 violation(s)" in r.stdout

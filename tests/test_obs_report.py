@@ -77,8 +77,9 @@ def test_schema_tripwire_faulted_grid_fit(faulted_run):
     recs = read_jsonl(run, stats=stats)
     assert stats["torn_lines"] == 0
     events = {r["event"] for r in recs}
-    # the fit actually exercised the interesting emitters
-    assert {"fit_start", "epoch", "span", "fit_end"} <= events
+    # the fit actually exercised the interesting emitters (memory: the
+    # ISSUE 9 device-memory axis rides every grid fit)
+    assert {"fit_start", "epoch", "span", "memory", "fit_end"} <= events
     bad = schema.validate_records(recs)
     assert not bad, f"schema drift: {bad[:5]}"
     ledger = read_jsonl(os.path.join(run, "run_ledger.jsonl"))

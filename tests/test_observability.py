@@ -323,14 +323,16 @@ def test_flight_dump_for_logger_and_inactive(tmp_path):
 
 def test_spans_never_touch_jax():
     """No-host-sync tripwire at the source level: the span/flight hot path
-    must never import jax or call block_until_ready — a device sync inside
-    tracing would silently serialize every dispatch it wraps."""
+    (and the post-mortem trace exporter, ISSUE 9) must never import jax or
+    call block_until_ready — a device sync inside tracing would silently
+    serialize every dispatch it wraps."""
     import ast
 
     import redcliff_tpu.obs.flight as fmod
     import redcliff_tpu.obs.spans as smod
+    import redcliff_tpu.obs.trace_export as tmod
 
-    for mod in (smod, fmod):
+    for mod in (smod, fmod, tmod):
         with open(mod.__file__) as f:
             tree = ast.parse(f.read())
         for node in ast.walk(tree):
@@ -345,6 +347,21 @@ def test_spans_never_touch_jax():
                 continue
             assert not any(n.split(".")[0] == "jax" for n in names), \
                 mod.__name__
+
+
+def test_device_obs_modules_keep_jax_lazy():
+    """ISSUE 9 satellite: the PR 7 no-host-sync tripwire extends to the new
+    device-observatory modules — obs/memory.py and obs/profiling.py may use
+    jax (memory_stats polls, profiler start/stop) but only via in-function
+    imports, and block_until_ready is banned across every observability
+    module. The scan is shared with the standalone lint entry
+    (``python -m redcliff_tpu.obs.schema --check``)."""
+    assert schema.check_sources() == []
+    # and the registry the checker enforces is really closed over the new
+    # modules: their module paths are under the discipline lists
+    assert any(m.endswith("memory.py") for m in schema.LAZY_JAX_MODULES)
+    assert any(m.endswith("profiling.py") for m in schema.LAZY_JAX_MODULES)
+    assert any(m.endswith("trace_export.py") for m in schema.NO_JAX_MODULES)
 
 
 def _iter_repo_sources():
@@ -404,8 +421,9 @@ def test_event_and_span_name_literals_are_registered():
     assert not bad, (
         "unregistered event/span name literals (register them in "
         f"redcliff_tpu/obs/schema.py and docs/ARCHITECTURE.md): {bad}")
-    # the new ISSUE 8 kinds are part of the closed registry
-    assert {"cost_model", "watch", "regression"} <= set(schema.EVENTS)
+    # the new ISSUE 8 + ISSUE 9 kinds are part of the closed registry
+    assert {"cost_model", "watch", "regression",
+            "memory", "profile"} <= set(schema.EVENTS)
 
 
 # ---------------------------------------------------------------------------
