@@ -927,6 +927,66 @@ def _bench_mem_model(jax, model, grid_state, G, B):
     return out
 
 
+def _bench_fleet(n_devices=8, budget_bytes=8 << 30):
+    """fleet probe: the admission planner (redcliff_tpu/fleet/planner.py)
+    on a synthetic heterogeneous request mix — mesh-slot utilization of
+    cost/memory-aware packing vs the naive FIFO one-request-per-fit
+    baseline (what the repo did before the fleet service), plus planning
+    latency. Deterministic input, host-only: the numbers track the
+    planner, not a fit."""
+    from redcliff_tpu.fleet import planner
+
+    # 3 shapes x small tenant requests (1-6 points each, mixed priorities/
+    # deadlines): the real service mix — many requests far below one
+    # bucket, which FIFO pads to the mesh one fit at a time
+    shapes = [
+        {"num_chans": 4, "num_factors": 2, "gen_lag": 2},
+        {"num_chans": 8, "num_factors": 4, "gen_lag": 3},
+        {"num_chans": 16, "num_factors": 4, "gen_lag": 5},
+    ]
+    reqs = []
+    for i in range(18):
+        shape = shapes[i % len(shapes)]
+        reqs.append({
+            "request_id": f"req-{i:03d}",
+            "tenant": f"tenant-{i % 5}",
+            "submitted_at": float(i),
+            "priority": (1 if i % 7 == 0 else 0),
+            "deadline_s": (600.0 if i % 5 == 0 else None),
+            "shape": shape,
+            "points": [{"gen_lr": 1e-3 * (j + 1)}
+                       for j in range(1 + (i * 3) % 6)],
+            "epochs": 50,
+            "per_lane_bytes": 64 << 20,
+            "fixed_bytes": 256 << 20,
+            "spec": {"model_config": shape, "epochs": 50},
+        })
+    t0 = time.perf_counter()
+    packed = planner.plan(reqs, n_devices=n_devices,
+                          budget_bytes=budget_bytes)
+    plan_ms = (time.perf_counter() - t0) * 1e3
+    fifo = planner.fifo_plan(reqs, n_devices=n_devices,
+                             budget_bytes=budget_bytes)
+    pu = packed["utilization"]["utilization_pct"]
+    fu = fifo["utilization"]["utilization_pct"]
+    over = [b for b in packed["batches"]
+            if b["predicted_bytes"] is not None
+            and b["predicted_bytes"] > budget_bytes]
+    return {
+        "requests": len(reqs),
+        "n_devices": n_devices,
+        "budget_bytes": budget_bytes,
+        "batches": len(packed["batches"]),
+        "fifo_batches": len(fifo["batches"]),
+        "unschedulable": len(packed["unschedulable"]),
+        "packed_utilization_pct": pu,
+        "fifo_utilization_pct": fu,
+        "utilization_gain": (round(pu / fu, 3) if pu and fu else None),
+        "headroom_violations": len(over),  # contract: always 0
+        "plan_ms": round(plan_ms, 3),
+    }
+
+
 def _bench_trace_export(n_records=2000):
     """trace_export probe: span -> Perfetto round-trip cost
     (obs/trace_export.py) on a synthetic but schema-shaped run dir —
@@ -1131,6 +1191,13 @@ def _measure(platform):
     except Exception as e:  # never fail the bench over the export probe
         trace_export = {"error": f"{type(e).__name__}: {e}"}
 
+    # fleet admission planner: packed vs FIFO mesh-slot utilization + plan
+    # latency on the synthetic heterogeneous request mix
+    try:
+        fleet_probe = _bench_fleet()
+    except Exception as e:  # never fail the bench over the fleet probe
+        fleet_probe = {"error": f"{type(e).__name__}: {e}"}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1162,6 +1229,7 @@ def _measure(platform):
         "mem_model_err_pct": mem_model.get("abs_err_pct"),
         "mem_model": mem_model,
         "trace_export": trace_export,
+        "fleet": fleet_probe,
         "error": None,
     })
 

@@ -1,0 +1,202 @@
+"""``python -m redcliff_tpu.fleet {submit,work,status}`` — fleet CLI.
+
+submit — append fit requests to a fleet root's durable queue
+    (fleet/queue.py). ``--tiny`` uses the built-in canonical tiny spec
+    (the fault-injection harness's small deterministic fit) — the smoke /
+    CI path; real sweeps pass ``--spec-file`` + ``--points``.
+work — run the worker loop (fleet/worker.py): reclaim expired claims,
+    plan admission (fleet/planner.py), supervise batches, settle results.
+status — queue-wide and per-tenant counts (``--json`` for scripts).
+
+The CLI (like the queue/planner/worker) never initializes a jax backend;
+only the supervised ``run_batch`` child does.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# the canonical tiny spec: mirrors runtime/faultinject.py's _tiny_runner
+# model/train shape so fleet smoke fits warm-start from the same persistent
+# compile cache the fault-injection suite already primes
+TINY_SPEC = {
+    "model": "RedcliffSCMLP",
+    "model_config": {
+        "num_chans": 4, "gen_lag": 2, "gen_hidden": [8], "embed_lag": 4,
+        "embed_hidden_sizes": [8], "num_factors": 2,
+        "num_supervised_factors": 2, "factor_weight_l1_coeff": 0.01,
+        "adj_l1_reg_coeff": 0.001, "factor_cos_sim_coeff": 0.01,
+        "factor_score_embedder_type": "Vanilla_Embedder",
+        "primary_gc_est_mode": "fixed_factor_exclusive", "num_sims": 1,
+        "training_mode": "combined"},
+    "train_config": {"batch_size": 16, "check_every": 1, "seed": 0},
+    "data": {"kind": "synthetic", "seed": 0, "n": 48},
+    "epochs": 2,
+}
+TINY_POINTS = [{"gen_lr": 1e-3}, {"gen_lr": 3e-3}]
+
+
+def _cmd_submit(args):
+    from redcliff_tpu.fleet.queue import FleetQueue
+    from redcliff_tpu.obs.logging import MetricLogger
+
+    if args.tiny:
+        spec = json.loads(json.dumps(TINY_SPEC))  # deep copy
+        if args.epochs is not None:
+            spec["epochs"] = args.epochs
+        points = (json.loads(args.points) if args.points
+                  else list(TINY_POINTS))
+    else:
+        if not args.spec_file:
+            print("fleet submit: --spec-file (or --tiny) is required",
+                  file=sys.stderr)
+            return 2
+        with open(args.spec_file) as f:
+            spec = json.load(f)
+        if args.epochs is not None:
+            spec["epochs"] = args.epochs
+        if args.points:
+            points = json.loads(args.points)
+        elif args.points_file:
+            with open(args.points_file) as f:
+                points = json.load(f)
+        else:
+            points = spec.pop("points", None)
+        if not points:
+            print("fleet submit: no grid points (--points / --points-file "
+                  "/ spec['points'])", file=sys.stderr)
+            return 2
+    q = FleetQueue(args.root)
+    rids = []
+    with MetricLogger(args.root) as log:
+        for _ in range(args.n):
+            rid = q.submit(args.tenant, points, spec=spec,
+                           priority=args.priority,
+                           deadline_s=args.deadline_s,
+                           per_lane_bytes=args.per_lane_bytes,
+                           fixed_bytes=args.fixed_bytes)
+            log.log("fleet", kind="submit", requests=[rid],
+                    tenants=[args.tenant], n_points=len(points),
+                    priority=args.priority)
+            rids.append(rid)
+    for rid in rids:
+        print(rid)
+    return 0
+
+
+def _cmd_work(args):
+    from redcliff_tpu.fleet.worker import work
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    policy = SupervisorPolicy(
+        max_restarts=args.max_restarts,
+        backoff=RetryPolicy(max_attempts=1_000_000,
+                            base_delay_s=args.base_delay_s, multiplier=2.0,
+                            max_delay_s=args.max_delay_s))
+    n = work(args.root, worker_id=args.worker_id, lease_s=args.lease_s,
+             poll_s=args.poll_s, max_batches=args.max_batches,
+             drain=args.drain, once=args.once, n_devices=args.n_devices,
+             budget_bytes=args.budget_bytes, max_bucket=args.max_bucket,
+             checkpoint_every=args.checkpoint_every,
+             supervisor_policy=policy)
+    print(f"fleet work: ran {n} batch(es)", file=sys.stderr)
+    return 0
+
+
+def _cmd_status(args):
+    import os
+
+    from redcliff_tpu.fleet.queue import FleetQueue
+
+    if not os.path.exists(args.root):
+        print(f"fleet status: no such fleet root: {args.root}",
+              file=sys.stderr)
+        return 2
+    # create=False: status is a pure reader — no mkdir side effects, and
+    # archived/read-only roots still report
+    st = FleetQueue(args.root, create=False).status()
+    if args.json:
+        json.dump(st, sys.stdout, indent=2, allow_nan=False)
+        sys.stdout.write("\n")
+        return 0
+    c = st["counts"]
+    print(f"fleet: {st['root']}")
+    print(f"  {c['submitted']} submitted | {c['queued']} queued | "
+          f"{c['running']} running | {c['done']} done | "
+          f"{c['failed']} failed"
+          + (f" | {c['expired_claims']} expired claim(s)"
+             if c["expired_claims"] else "")
+          + (f" | {st['torn_spool_lines']} torn spool line(s)"
+             if st["torn_spool_lines"] else ""))
+    for tenant, t in sorted(st["by_tenant"].items()):
+        print(f"  tenant {tenant}: {t['submitted']} submitted, "
+              f"{t['queued']} queued, {t['running']} running, "
+              f"{t['done']} done, {t['failed']} failed")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m redcliff_tpu.fleet",
+        description="Grid-fleet sweep service: durable multi-tenant queue "
+                    "+ cost/memory-aware admission planner "
+                    "(docs/ARCHITECTURE.md 'Fleet sweep service').")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("submit", help="append fit request(s) to the queue")
+    sp.add_argument("--root", required=True, help="fleet root directory")
+    sp.add_argument("--tenant", default="default")
+    sp.add_argument("--priority", type=int, default=0)
+    sp.add_argument("--deadline-s", type=float, default=None)
+    sp.add_argument("--epochs", type=int, default=None)
+    sp.add_argument("--tiny", action="store_true",
+                    help="use the built-in canonical tiny spec (smoke/CI)")
+    sp.add_argument("--spec-file", default=None,
+                    help="JSON spec: {model, model_config, train_config, "
+                         "data, epochs[, points]}")
+    sp.add_argument("--points", default=None,
+                    help="grid points as a JSON list of hparam dicts")
+    sp.add_argument("--points-file", default=None)
+    sp.add_argument("--per-lane-bytes", type=int, default=None,
+                    help="HBM per-lane hint for the admission planner "
+                         "(obs/memory.py per_lane_bytes)")
+    sp.add_argument("--fixed-bytes", type=int, default=None)
+    sp.add_argument("-n", type=int, default=1, dest="n",
+                    help="submit N identical requests")
+    sp.set_defaults(fn=_cmd_submit)
+
+    wp = sub.add_parser("work", help="run the worker loop")
+    wp.add_argument("--root", required=True)
+    wp.add_argument("--worker-id", default=None)
+    wp.add_argument("--lease-s", type=float, default=60.0)
+    wp.add_argument("--poll-s", type=float, default=2.0)
+    wp.add_argument("--max-batches", type=int, default=None)
+    wp.add_argument("--drain", action="store_true",
+                    help="exit once the queue holds no claimable or "
+                         "running work")
+    wp.add_argument("--once", action="store_true")
+    wp.add_argument("--n-devices", type=int, default=1,
+                    help="mesh device count the planner packs buckets for")
+    wp.add_argument("--budget-bytes", type=int, default=None,
+                    help="admission HBM budget (check_headroom's "
+                         "budget_bytes; omit = ungated)")
+    wp.add_argument("--max-bucket", type=int, default=256)
+    wp.add_argument("--checkpoint-every", type=int, default=1)
+    wp.add_argument("--max-restarts", type=int, default=2)
+    wp.add_argument("--base-delay-s", type=float, default=0.5)
+    wp.add_argument("--max-delay-s", type=float, default=30.0)
+    wp.set_defaults(fn=_cmd_work)
+
+    st = sub.add_parser("status", help="queue + per-tenant counts")
+    st.add_argument("--root", required=True)
+    st.add_argument("--json", action="store_true")
+    st.set_defaults(fn=_cmd_status)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,272 @@
+"""Cost/memory-aware admission planner: pack fit requests into G-buckets.
+
+The decision layer between the durable queue (fleet/queue.py) and the grid
+engine (parallel/grid.py). Given the pending heterogeneous request mix —
+shapes, priorities, deadlines, point counts — :func:`plan` produces an
+ordered list of BATCHES, each one grid fit:
+
+* **same-shape requests merge into one fit** — their points concatenate
+  along the grid axis, so the mesh runs one compiled program family at a
+  bucket-ladder width instead of one padded micro-fit per tenant, and the
+  persistent compile cache + cost-model store amortize across tenants.
+  Requests batch together only when their full non-point spec matches
+  (:func:`batch_key`): same model/train config, same data — one merged
+  ``GridSpec`` must mean the same math for every tenant in it;
+* **widths come from the elastic scheduler's ladder**
+  (parallel/compaction.py ``bucket_width`` — the same rungs
+  ``footprint_by_bucket`` enumerates), so the planner's packing unit IS the
+  engine's execution unit;
+* **admission is memory-gated**: with per-request HBM hints
+  (``per_lane_bytes``/``fixed_bytes``, from obs/memory.py
+  ``grid_footprint``) and a device budget (``budget_bytes``, from
+  ``check_headroom``'s ``budget_bytes``), a batch is CLOSED before its
+  predicted footprint at the next bucket would exceed the budget, and a
+  single request that cannot fit at any width is returned as
+  ``unschedulable`` — the planner never admits a batch whose footprint
+  estimate exceeds headroom (pinned by tests/test_fleet.py);
+* **ordering is cost-aware**: batches sort by priority (desc), then
+  earliest tenant deadline, then predicted wall-clock
+  (obs/costmodel.py ``predict_fit_eta`` — shortest first, unknown last),
+  then deterministic tie-breaks, so urgent and cheap work drains ahead of
+  long sweeps.
+
+:func:`fifo_plan` is the naive one-request-per-fit baseline bench.py's
+``fleet`` probe compares against (mesh-slot utilization,
+:func:`utilization`).
+
+stdlib + numpy only, no jax (obs/schema.py ``--check`` enforces it):
+planning runs in control processes that must never initialize a backend.
+All predictions are consumed from persistent stores/hints, never computed
+on device.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from redcliff_tpu.parallel import compaction
+
+__all__ = ["batch_key", "batch_id_for", "plan", "fifo_plan", "utilization",
+           "predicted_batch_bytes", "DEFAULT_MAX_BUCKET"]
+
+# widest bucket a single batch may occupy without an explicit override: a
+# merged sweep past this rides multiple batches (bounded checkpoint size,
+# bounded blast radius of one bad batch)
+DEFAULT_MAX_BUCKET = 256
+
+
+def batch_key(request):
+    """The mergeability key: requests batch into one grid fit only when
+    everything except their points/tenant/priority/deadline is identical
+    (same model config, train config, data spec, and horizon). Returns
+    ``(shape_json, spec_hash)`` — both deterministic strings."""
+    shape = request.get("shape") or {}
+    spec = dict(request.get("spec") or {})
+    spec.pop("points", None)
+    blob = json.dumps({"spec": spec, "epochs": request.get("epochs")},
+                      sort_keys=True)
+    return (json.dumps(shape, sort_keys=True),
+            hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12])
+
+
+def batch_id_for(request_ids):
+    """Deterministic batch id from the ORDERED member request ids — the
+    same composition always lands in the same ``work/<batch_id>`` run dir,
+    which is what lets a reclaiming worker resume the dead worker's grid
+    checkpoint instead of starting a different fit."""
+    h = hashlib.sha1("\n".join(request_ids).encode("utf-8")).hexdigest()
+    return f"batch-{h[:12]}"
+
+
+def predicted_batch_bytes(requests, g_bucket):
+    """Predicted HBM footprint of a merged batch at execution width
+    ``g_bucket``: ``per_lane_bytes * g_bucket + max(fixed_bytes)`` from the
+    members' hints (the obs/memory.py ``grid_footprint`` decomposition —
+    fixed covers the device-resident dataset + epoch gather, shared across
+    lanes). None when no member carries a per-lane hint (no memory
+    evidence: admission degrades to ungated, mirroring
+    ``check_headroom``'s explicit None on backends without memory stats)."""
+    per_lane = [r.get("per_lane_bytes") for r in requests
+                if isinstance(r.get("per_lane_bytes"), (int, float))]
+    if not per_lane:
+        return None
+    fixed = max((r.get("fixed_bytes") or 0) for r in requests)
+    return int(max(per_lane) * int(g_bucket) + fixed)
+
+
+def _order_key(request):
+    """Deterministic urgency ordering: priority desc, earliest deadline,
+    submission order, id."""
+    dl = request.get("deadline_s")
+    return (-int(request.get("priority") or 0),
+            float(dl) if dl is not None else float("inf"),
+            float(request.get("submitted_at") or 0.0),
+            str(request.get("request_id")))
+
+
+def _batch_view(members, n_devices, cost_model=None, platform=None):
+    n_points = sum(len(r.get("points") or ()) for r in members)
+    width = compaction.bucket_width(n_points, n_devices)
+    ids = [r["request_id"] for r in members]
+    shape = members[0].get("shape") or {}
+    epochs = max((r.get("epochs") or 0) for r in members)
+    eta_s = None
+    if cost_model is not None:
+        try:
+            from redcliff_tpu.obs.schema import shape_key as _sk
+
+            eta_s = cost_model.predict_fit_eta(
+                _sk(shape), width, epochs, platform=platform,
+                cold_programs=1)
+        except Exception:  # noqa: BLE001 — predictions are advisory
+            eta_s = None
+    n_dev = int(n_devices or 1)
+    return {
+        "batch_id": batch_id_for(ids),
+        "requests": ids,
+        "tenants": sorted({str(r.get("tenant")) for r in members}),
+        "shape": shape,
+        "n_points": n_points,
+        "g_bucket": width,
+        # lane capacity the mesh is tied up for while this fit runs: a
+        # sub-bucket fit (G' < n_devices) still occupies the whole mesh
+        # serially, so slots round up to the device count — the honest
+        # denominator for mesh-slot utilization
+        "mesh_slots": max(width, n_dev) if width <= n_dev
+        else -(-width // n_dev) * n_dev,
+        "epochs": epochs,
+        "priority": max((int(r.get("priority") or 0) for r in members),
+                        default=0),
+        "deadline_s": min((float(r["deadline_s"]) for r in members
+                           if r.get("deadline_s") is not None),
+                          default=None),
+        "predicted_bytes": predicted_batch_bytes(members, width),
+        "eta_s": (round(eta_s, 3) if isinstance(eta_s, (int, float))
+                  else None),
+    }
+
+
+def _batch_order_key(batch):
+    dl = batch.get("deadline_s")
+    eta = batch.get("eta_s")
+    return (-batch["priority"],
+            dl if dl is not None else float("inf"),
+            eta if eta is not None else float("inf"),
+            batch["batch_id"])
+
+
+def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
+         platform=None, max_bucket=DEFAULT_MAX_BUCKET):
+    """Pack ``requests`` (queue records) into admitted batches.
+
+    Returns ``{"batches": [...], "unschedulable": [...], "queue_depth",
+    "plan_ms", "utilization"}``. Every admitted batch satisfies
+    ``predicted_bytes is None or predicted_bytes <= budget_bytes`` (when a
+    budget is known); requests that cannot fit even alone at their smallest
+    bucket are listed under ``unschedulable`` with a reason instead of
+    being silently admitted."""
+    t0 = time.perf_counter()
+    ordered = sorted(requests, key=_order_key)
+    groups = {}
+    for r in ordered:
+        groups.setdefault(batch_key(r), []).append(r)
+
+    batches, unschedulable = [], []
+    for key in sorted(groups):
+        members = []
+        n_points = 0
+        for r in groups[key]:
+            r_points = len(r.get("points") or ())
+            if r_points == 0:
+                unschedulable.append({"request_id": r["request_id"],
+                                      "reason": "no_points"})
+                continue
+            cand_points = n_points + r_points
+            cand_width = compaction.bucket_width(cand_points, n_devices)
+            cand_bytes = predicted_batch_bytes(members + [r], cand_width)
+            over_budget = (budget_bytes is not None
+                           and cand_bytes is not None
+                           and cand_bytes > budget_bytes)
+            over_width = cand_width > int(max_bucket)
+            if members and (over_budget or over_width):
+                batches.append(_batch_view(members, n_devices,
+                                           cost_model, platform))
+                members, n_points = [], 0
+                cand_width = compaction.bucket_width(r_points, n_devices)
+                cand_bytes = predicted_batch_bytes([r], cand_width)
+                over_budget = (budget_bytes is not None
+                               and cand_bytes is not None
+                               and cand_bytes > budget_bytes)
+                over_width = cand_width > int(max_bucket)
+            if not members and (over_budget or over_width):
+                unschedulable.append({
+                    "request_id": r["request_id"],
+                    "reason": ("exceeds_headroom" if over_budget
+                               else "exceeds_max_bucket"),
+                    "predicted_bytes": cand_bytes,
+                    "budget_bytes": budget_bytes,
+                    "g_bucket": cand_width})
+                continue
+            members.append(r)
+            n_points += r_points
+        if members:
+            batches.append(_batch_view(members, n_devices, cost_model,
+                                       platform))
+    batches.sort(key=_batch_order_key)
+    return {
+        "batches": batches,
+        "unschedulable": unschedulable,
+        "queue_depth": len(ordered),
+        "plan_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        "utilization": utilization(batches),
+    }
+
+
+def fifo_plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
+              platform=None):
+    """The naive baseline: one request per fit, strict submission order, no
+    merging — what the repo did before the fleet service (one driver
+    process per sweep). Same admission gate, so the bench comparison
+    isolates PACKING, not safety."""
+    t0 = time.perf_counter()
+    ordered = sorted(requests,
+                     key=lambda r: (float(r.get("submitted_at") or 0.0),
+                                    str(r.get("request_id"))))
+    batches, unschedulable = [], []
+    for r in ordered:
+        if not r.get("points"):
+            unschedulable.append({"request_id": r["request_id"],
+                                  "reason": "no_points"})
+            continue
+        b = _batch_view([r], n_devices, cost_model, platform)
+        if budget_bytes is not None and b["predicted_bytes"] is not None \
+                and b["predicted_bytes"] > budget_bytes:
+            unschedulable.append({
+                "request_id": r["request_id"],
+                "reason": "exceeds_headroom",
+                "predicted_bytes": b["predicted_bytes"],
+                "budget_bytes": budget_bytes,
+                "g_bucket": b["g_bucket"]})
+            continue
+        batches.append(b)
+    return {
+        "batches": batches,
+        "unschedulable": unschedulable,
+        "queue_depth": len(ordered),
+        "plan_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        "utilization": utilization(batches),
+    }
+
+
+def utilization(batches):
+    """Mesh-slot utilization of a plan: real grid points over the lane
+    capacity the mesh is serially tied up for (``mesh_slots`` — bucket
+    padding plus per-fit mesh rounding are the waste; a 2-point fit on an
+    8-device mesh burns 8 slots). ``{"points", "slots",
+    "utilization_pct"}``."""
+    points = sum(b["n_points"] for b in batches)
+    slots = sum(b.get("mesh_slots", b["g_bucket"]) for b in batches)
+    return {"points": points, "slots": slots,
+            "utilization_pct": (round(100.0 * points / slots, 1)
+                                if slots else None)}

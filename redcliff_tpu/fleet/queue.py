@@ -1,0 +1,479 @@
+"""Durable multi-tenant request queue: JSONL spool + atomic claim leases.
+
+The fleet's persistence layer. Everything is plain files under one root so
+the queue survives any process death and needs no daemon, no database, and
+no locks held across crashes::
+
+    <root>/requests.jsonl        append-only submission spool (one JSON line
+                                 per request; O_APPEND + fsync — a torn tail
+                                 from a killed submitter is skipped+counted)
+    <root>/leases/<id>.json      live claim: created O_CREAT|O_EXCL (the
+                                 atomic claim), renewed by tmp+rename,
+                                 carries an absolute ``expires_at``
+    <root>/done/<id>.json        terminal result record (atomic tmp+rename;
+                                 first writer wins — the never-run-twice
+                                 half of the contract)
+    <root>/failed/<id>.json      terminal failure record (same discipline)
+    <root>/work/<batch_id>/      batch run directories (worker-owned:
+                                 grid checkpoints, metrics, ledger, results)
+
+**Crash safety.** A worker that dies holding a lease simply stops renewing
+it; once ``expires_at`` passes, any worker may RECLAIM the request:
+``os.rename`` the expired lease to a unique tombstone (exactly one racer's
+rename succeeds — rename of a vanished source fails), then re-claim through
+the same ``O_EXCL`` create every fresh claim uses. The lease records the
+batch it was claimed under (``batch_id`` + the batch's ordered request ids),
+so the reclaiming worker re-runs the SAME batch composition in the same
+run directory — the grid fit resumes from its durable checkpoint
+(runtime/checkpoint.py) and the final results are bit-identical to an
+uninterrupted run (pinned by tests/test_fleet.py).
+
+**Exactly-once results.** ``complete()`` writes ``done/<id>.json``
+atomically and refuses to overwrite an existing record; a request with a
+done (or failed) record is never pending and never claimable again. The
+lease protocol guarantees single-claimant only while claimants are LIVE —
+a worker that outlives its own lease (e.g. a multi-minute GC pause) could
+race a reclaimer, which is why ``lease_s`` must comfortably exceed the
+renewal cadence; the first ``complete()`` still wins either way.
+
+stdlib only, no jax (obs/schema.py ``--check`` enforces it): queue scans
+run in control processes that must never initialize a backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+import uuid
+
+__all__ = ["FleetQueue", "Lease", "LeaseLost", "SPOOL_NAME"]
+
+SPOOL_NAME = "requests.jsonl"
+_LEASES = "leases"
+_DONE = "done"
+_FAILED = "failed"
+_WORK = "work"
+
+
+class LeaseLost(RuntimeError):
+    """The lease file no longer belongs to this claimant (it expired and
+    another worker reclaimed the request)."""
+
+
+def _read_json(path):
+    """Parse one JSON file; None on missing/torn (a reader must never crash
+    on a half-written artifact)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json_atomic(path, payload, overwrite=True):
+    """tmp + fsync + rename. With ``overwrite=False`` an existing file wins
+    (os.link is atomic-fail-if-exists on POSIX); returns False then."""
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, allow_nan=False)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        if overwrite:
+            os.replace(tmp, path)
+            return True
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+class Lease:
+    """One live claim on one request. ``renew`` extends ``expires_at``
+    (tmp+rename keeps the file continuously present); ``release`` deletes
+    the lease so the request becomes claimable again. Both verify the
+    on-disk lease still carries this claimant's token — a reclaimed lease
+    raises :class:`LeaseLost` instead of clobbering the new owner."""
+
+    def __init__(self, queue, request_id, data):
+        self._q = queue
+        self.request_id = request_id
+        self.data = data
+
+    @property
+    def path(self):
+        return self._q._lease_path(self.request_id)
+
+    def _check_owner(self):
+        cur = _read_json(self.path)
+        if cur is None or cur.get("token") != self.data["token"]:
+            raise LeaseLost(
+                f"lease on {self.request_id} now belongs to "
+                f"{(cur or {}).get('worker')!r} (expired and reclaimed?)")
+
+    def renew(self, lease_s, now=None):
+        now = time.time() if now is None else now
+        self._check_owner()
+        self.data = dict(self.data, renewed_at=now,
+                         expires_at=now + float(lease_s),
+                         renewals=int(self.data.get("renewals") or 0) + 1)
+        _write_json_atomic(self.path, self.data)
+
+    def release(self):
+        try:
+            self._check_owner()
+        except LeaseLost:
+            return  # not ours anymore: nothing to release
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class FleetQueue:
+    """File-backed fleet queue rooted at ``root`` (created on first use).
+
+    ``create=False`` opens the root READ-ONLY for observers (the watch
+    CLI): nothing is mkdir'd, and the scan methods tolerate missing
+    subdirectories — a pure reader must never mutate the service root (or
+    crash on an archived/read-only mount)."""
+
+    def __init__(self, root, create=True):
+        self.root = str(root)
+        if create:
+            os.makedirs(self.root, exist_ok=True)
+            for d in (_LEASES, _DONE, _FAILED, _WORK):
+                os.makedirs(os.path.join(self.root, d), exist_ok=True)
+        self.spool_path = os.path.join(self.root, SPOOL_NAME)
+
+    # ------------------------------------------------------------------
+    # paths
+    # ------------------------------------------------------------------
+    def _lease_path(self, request_id):
+        return os.path.join(self.root, _LEASES, f"{request_id}.json")
+
+    def _done_path(self, request_id):
+        return os.path.join(self.root, _DONE, f"{request_id}.json")
+
+    def _failed_path(self, request_id):
+        return os.path.join(self.root, _FAILED, f"{request_id}.json")
+
+    def batch_dir(self, batch_id):
+        return os.path.join(self.root, _WORK, str(batch_id))
+
+    # ------------------------------------------------------------------
+    # submit / read the spool
+    # ------------------------------------------------------------------
+    def submit(self, tenant, points, spec=None, shape=None, priority=0,
+               deadline_s=None, epochs=None, per_lane_bytes=None,
+               fixed_bytes=None, request_id=None, now=None):
+        """Append one fit request to the spool; returns its ``request_id``.
+
+        ``points``: the grid points this tenant wants fitted (list of hparam
+        dicts — the unit the planner merges across same-shape requests).
+        ``spec``: what to fit — ``{"model_config", "train_config", "data",
+        "epochs"}`` consumed by :mod:`redcliff_tpu.fleet.run_batch`;
+        requests batch together only when their non-point spec is identical.
+        ``shape``: the (shape-key) dict for the cost/memory models (derived
+        from ``spec["model_config"]`` when omitted). ``per_lane_bytes`` /
+        ``fixed_bytes``: HBM hints for the admission planner (from
+        obs/memory.py ``grid_footprint``/``per_lane_bytes``)."""
+        now = time.time() if now is None else now
+        spec = dict(spec or {})
+        if epochs is None:
+            epochs = spec.get("epochs")
+        if shape is None:
+            shape = _shape_from_model_config(spec.get("model_config") or {})
+        rid = request_id or (
+            f"req-{int(now * 1000):013d}-{os.getpid()}-"
+            f"{uuid.uuid4().hex[:8]}")
+        rec = {
+            "request_id": rid,
+            "tenant": str(tenant),
+            "submitted_at": now,
+            "priority": int(priority),
+            "deadline_s": (float(deadline_s) if deadline_s is not None
+                           else None),
+            "shape": shape,
+            "points": list(points),
+            "epochs": (int(epochs) if epochs is not None else None),
+            "per_lane_bytes": per_lane_bytes,
+            "fixed_bytes": fixed_bytes,
+            "spec": spec,
+        }
+        line = json.dumps(rec, allow_nan=False).encode("utf-8") + b"\n"
+        # one O_APPEND write + fsync: concurrent submitters interleave whole
+        # lines; a submitter killed mid-write leaves one torn tail line the
+        # tolerant reader skips and counts. A torn tail has no newline, so
+        # the NEXT submitter starts with one — otherwise its record would
+        # fuse into the garbage and be lost too (two healers racing just
+        # produce a blank line, which the reader skips)
+        fd = os.open(self.spool_path,
+                     os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            size = os.fstat(fd).st_size
+            if size and os.pread(fd, 1, size - 1) != b"\n":
+                line = b"\n" + line
+            os.write(fd, line)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        return rid
+
+    def requests(self, stats=None):
+        """Every spooled request in submission order (first record wins on a
+        duplicated id). ``stats`` (optional dict out-param) gets
+        ``{"records", "torn_lines"}``."""
+        out, seen = [], set()
+        torn = 0
+        try:
+            with open(self.spool_path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            raw = b""
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                torn += 1
+                continue
+            rid = rec.get("request_id")
+            if not rid or rid in seen:
+                continue
+            seen.add(rid)
+            out.append(rec)
+        if stats is not None:
+            stats["records"] = len(out)
+            stats["torn_lines"] = torn
+        return out
+
+    # ------------------------------------------------------------------
+    # claim protocol
+    # ------------------------------------------------------------------
+    def lease_of(self, request_id):
+        """The current lease record (live or expired), or None."""
+        return _read_json(self._lease_path(request_id))
+
+    def is_terminal(self, request_id):
+        return (os.path.exists(self._done_path(request_id))
+                or os.path.exists(self._failed_path(request_id)))
+
+    def claim(self, request_id, worker, lease_s, batch_id=None,
+              batch_request_ids=None, tenant=None, now=None):
+        """Atomically claim ``request_id``; returns a :class:`Lease` or
+        None (already done/failed, or live-leased by someone else, or lost
+        the reclaim race).
+
+        ``batch_id``/``batch_request_ids`` record the batch this claim
+        belongs to, so a worker reclaiming an expired lease re-runs the
+        SAME batch composition (and therefore resumes the same grid
+        checkpoint) instead of re-planning a different one."""
+        now = time.time() if now is None else now
+        if self.is_terminal(request_id):
+            return None
+        path = self._lease_path(request_id)
+        data = {
+            "request_id": request_id,
+            "worker": str(worker),
+            "token": uuid.uuid4().hex,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "tenant": tenant,
+            "claimed_at": now,
+            "expires_at": now + float(lease_s),
+            "renewals": 0,
+            "batch_id": batch_id,
+            "batch_request_ids": (list(batch_request_ids)
+                                  if batch_request_ids else None),
+            "reclaimed_from": None,
+        }
+        existing = _read_json(path)
+        if existing is None and os.path.exists(path):
+            # torn lease (claimant died mid-create): treat as expired
+            existing = {"expires_at": 0.0}
+        if existing is not None:
+            if float(existing.get("expires_at") or 0.0) > now:
+                return None  # live claim
+            # expired: exactly one racer wins the tombstone rename
+            tomb = (f"{path}.expired.{os.getpid()}."
+                    f"{uuid.uuid4().hex[:8]}")
+            try:
+                os.rename(path, tomb)
+            except OSError:
+                return None  # someone else reclaimed first
+            data["reclaimed_from"] = {
+                "worker": existing.get("worker"),
+                "expires_at": existing.get("expires_at"),
+                "batch_id": existing.get("batch_id"),
+            }
+            # a reclaim inherits the dead worker's batch composition unless
+            # the caller pinned its own
+            if batch_id is None:
+                data["batch_id"] = existing.get("batch_id")
+                data["batch_request_ids"] = existing.get("batch_request_ids")
+        if not _write_json_atomic(path, data, overwrite=False):
+            return None  # another claimant slipped in after the tombstone
+        return Lease(self, request_id, data)
+
+    # ------------------------------------------------------------------
+    # terminal records
+    # ------------------------------------------------------------------
+    def complete(self, request_id, result=None, now=None):
+        """Record the request as done (atomic; FIRST writer wins — the
+        never-run-twice half of the durability contract) and drop any lease
+        file. Returns True when this call wrote the record."""
+        now = time.time() if now is None else now
+        rec = {"request_id": request_id, "completed_at": now,
+               "result": result}
+        wrote = _write_json_atomic(self._done_path(request_id), rec,
+                                   overwrite=False)
+        try:
+            os.unlink(self._lease_path(request_id))
+        except OSError:
+            pass
+        return wrote
+
+    def fail(self, request_id, reason, now=None):
+        """Record a terminal failure (deterministic classifications the
+        supervisor will not restart: numerics_abort, deadline, giving_up)."""
+        now = time.time() if now is None else now
+        rec = {"request_id": request_id, "failed_at": now,
+               "reason": str(reason)}
+        wrote = _write_json_atomic(self._failed_path(request_id), rec,
+                                   overwrite=False)
+        try:
+            os.unlink(self._lease_path(request_id))
+        except OSError:
+            pass
+        return wrote
+
+    def result(self, request_id):
+        """The done record, or None."""
+        return _read_json(self._done_path(request_id))
+
+    # ------------------------------------------------------------------
+    # queue views
+    # ------------------------------------------------------------------
+    def pending(self, now=None, include_leased=False):
+        """Requests with no terminal record (and, by default, no LIVE
+        lease), in submission order — the planner's input."""
+        now = time.time() if now is None else now
+        out = []
+        for rec in self.requests():
+            rid = rec["request_id"]
+            if self.is_terminal(rid):
+                continue
+            if not include_leased:
+                lease = self.lease_of(rid)
+                if lease is not None \
+                        and float(lease.get("expires_at") or 0.0) > now:
+                    continue
+            out.append(rec)
+        return out
+
+    def live_leases(self, now=None):
+        """Current LIVE claims (unexpired, non-terminal) — the watch CLI's
+        per-tenant in-flight view. Sorted by request id."""
+        now = time.time() if now is None else now
+        out = []
+        for lease in self._scan_leases():
+            rid = lease.get("request_id")
+            if not rid or self.is_terminal(rid):
+                continue
+            if float(lease.get("expires_at") or 0.0) > now:
+                out.append(lease)
+        return out
+
+    def _scan_leases(self):
+        lease_dir = os.path.join(self.root, _LEASES)
+        try:
+            names = sorted(os.listdir(lease_dir))
+        except OSError:
+            return  # read-only observer of a root with no leases dir yet
+        for name in names:
+            if not name.endswith(".json") or ".tmp." in name \
+                    or ".expired." in name:
+                continue
+            lease = _read_json(os.path.join(lease_dir, name))
+            if lease is not None:
+                yield lease
+
+    def expired_claims(self, now=None):
+        """Expired (unrenewed) leases of non-terminal requests, grouped by
+        recorded batch id: ``{batch_id_or_None: [lease_record, ...]}`` — the
+        reclaim-first work a scanning worker prefers over fresh planning."""
+        now = time.time() if now is None else now
+        groups = {}
+        for lease in self._scan_leases():
+            rid = lease.get("request_id")
+            if not rid or self.is_terminal(rid):
+                continue
+            if float(lease.get("expires_at") or 0.0) > now:
+                continue
+            groups.setdefault(lease.get("batch_id"), []).append(lease)
+        return groups
+
+    def status(self, now=None):
+        """Queue-wide counts: total/queued/running/done/failed plus the
+        per-tenant breakdown — the ``fleet status`` CLI body and the watch
+        CLI's fleet section."""
+        now = time.time() if now is None else now
+        stats = {}
+        reqs = self.requests(stats=stats)
+        by_tenant = {}
+        counts = {"submitted": len(reqs), "queued": 0, "running": 0,
+                  "done": 0, "failed": 0, "expired_claims": 0}
+
+        def tbucket(tenant):
+            return by_tenant.setdefault(str(tenant), {
+                "submitted": 0, "queued": 0, "running": 0, "done": 0,
+                "failed": 0})
+
+        for rec in reqs:
+            rid = rec["request_id"]
+            t = tbucket(rec.get("tenant"))
+            t["submitted"] += 1
+            if os.path.exists(self._done_path(rid)):
+                counts["done"] += 1
+                t["done"] += 1
+                continue
+            if os.path.exists(self._failed_path(rid)):
+                counts["failed"] += 1
+                t["failed"] += 1
+                continue
+            lease = self.lease_of(rid)
+            if lease is not None \
+                    and float(lease.get("expires_at") or 0.0) > now:
+                counts["running"] += 1
+                t["running"] += 1
+            else:
+                if lease is not None:
+                    counts["expired_claims"] += 1
+                counts["queued"] += 1
+                t["queued"] += 1
+        return {"root": os.path.abspath(self.root), "counts": counts,
+                "by_tenant": by_tenant,
+                "torn_spool_lines": stats.get("torn_lines", 0)}
+
+
+# shape-key fields mirrored from obs/schema.py SHAPE_KEYS; kept as a literal
+# so this module stays importable with zero package dependencies (the
+# supervisor-style control processes must stay jax-free)
+_SHAPE_KEYS = ("num_chans", "gen_lag", "embed_lag", "max_lag", "num_factors",
+               "num_supervised_factors", "gen_hidden", "embed_hidden_sizes",
+               "input_length", "num_sims")
+
+
+def _shape_from_model_config(model_config):
+    return {k: model_config[k] for k in _SHAPE_KEYS
+            if model_config.get(k) is not None}

@@ -1,0 +1,211 @@
+"""Fleet batch driver: one merged multi-tenant grid fit, supervised.
+
+``python -m redcliff_tpu.fleet.run_batch <batch.json>`` is the jax-side
+child the fleet worker runs under the crash-loop supervisor. The batch file
+(written by fleet/worker.py from the claimed composition) holds the merged
+member requests in claim order; this driver:
+
+1. validates that every member shares the identical non-point spec (same
+   model config, train config, data, horizon — the planner's
+   ``batch_key`` contract re-checked at the trust boundary);
+2. concatenates the members' grid points into ONE :class:`~redcliff_tpu
+   .parallel.grid.GridSpec` and fits it with the grid engine — checkpointed
+   into the batch run dir every ``checkpoint_every`` epochs, so a SIGKILLed
+   worker's reclaimed batch RESUMES bit-identically instead of restarting;
+3. logs the tenant manifest (request id -> merged point range) as a
+   ``fleet`` metrics event in the run dir, so ``obs report`` can attribute
+   fits/lane-epochs/quarantines per tenant;
+4. splits the :class:`~redcliff_tpu.parallel.grid.GridResult` back into
+   per-request ``results/<request_id>.json`` records (criteria, epochs,
+   val history slice, quarantine causes — strict JSON, no params: the
+   checkpoint owns the heavy artifacts).
+
+Exit codes follow the watchdog taxonomy (runtime/watchdog.py) exactly like
+the faultinject child: preempted 17, deadline 20, host-lost 21 — so the
+supervisor's restart/stop classification applies unchanged.
+
+This is the ONE fleet module that initializes a jax backend; the queue,
+planner, and worker stay backend-free by design.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+__all__ = ["run_batch_file", "main"]
+
+# spec keys every member of a batch must agree on, byte-for-byte after
+# canonical JSON: one merged GridSpec must mean the same math for everyone
+_MERGE_KEYS = ("model", "model_config", "train_config", "data", "epochs",
+               "mesh")
+
+
+def _canon(spec):
+    return json.dumps({k: spec.get(k) for k in _MERGE_KEYS}, sort_keys=True)
+
+
+def _tupled(d):
+    """JSON round-trips tuples as lists; model/train config dataclasses
+    expect tuples for the size fields."""
+    return {k: tuple(v) if isinstance(v, list) else v for k, v in d.items()}
+
+
+def _build_dataset(data_spec, cfg):
+    import numpy as np
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+
+    kind = (data_spec or {}).get("kind", "synthetic")
+    if kind == "synthetic":
+        # the faultinject tiny-fit contract: deterministic arrays from the
+        # seed + the model's window shape (bit-identical across workers)
+        rng = np.random.default_rng(int(data_spec.get("seed", 0)))
+        n = int(data_spec.get("n", 48))
+        T = cfg.max_lag + cfg.num_sims
+        X = rng.normal(size=(n, T, cfg.num_chans)).astype(np.float32)
+        Y = rng.uniform(size=(n, 3, 1)).astype(np.float32)
+        return ArrayDataset(X, Y), ArrayDataset(X, Y)
+    if kind == "npz":
+        blob = np.load(data_spec["path"])
+        train = ArrayDataset(blob["X"], blob.get("Y"))
+        if "X_val" in blob:
+            return train, ArrayDataset(blob["X_val"], blob.get("Y_val"))
+        return train, train
+    raise ValueError(f"unknown fleet data kind {kind!r}")
+
+
+def run_batch_file(batch_file):
+    """Run one batch file end-to-end; returns the GridResult."""
+    import jax
+
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+    from redcliff_tpu.obs.logging import MetricLogger, jsonable
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    with open(batch_file) as f:
+        batch = json.load(f)
+    run_dir = batch["run_dir"]
+    requests = batch["requests"]
+    if not requests:
+        raise ValueError(f"{batch_file}: empty batch")
+    canon = _canon(requests[0].get("spec") or {})
+    for r in requests[1:]:
+        if _canon(r.get("spec") or {}) != canon:
+            raise ValueError(
+                f"{batch_file}: members disagree on the non-point spec — "
+                f"the planner must never merge them "
+                f"({requests[0]['request_id']} vs {r['request_id']})")
+    spec0 = requests[0].get("spec") or {}
+    model_name = spec0.get("model", "RedcliffSCMLP")
+    if model_name != "RedcliffSCMLP":
+        raise ValueError(f"unsupported fleet model {model_name!r}")
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        **_tupled(spec0.get("model_config") or {})))
+    tc_kwargs = dict(spec0.get("train_config") or {})
+    epochs = spec0.get("epochs") or requests[0].get("epochs")
+    if epochs is not None:
+        tc_kwargs["max_iter"] = int(epochs)
+    if isinstance(tc_kwargs.get("numerics"), dict):
+        # JSON round-trips the sentinel policy as a plain dict
+        from redcliff_tpu.runtime.numerics import NumericsPolicy
+
+        tc_kwargs["numerics"] = NumericsPolicy(**tc_kwargs["numerics"])
+    tc = RedcliffTrainConfig(**_tupled(tc_kwargs))
+    train_ds, val_ds = _build_dataset(spec0.get("data"), model.config)
+
+    merged, manifest, start = [], [], 0
+    for r in requests:
+        pts = list(r.get("points") or ())
+        merged.extend(pts)
+        manifest.append({"request_id": r["request_id"],
+                         "tenant": str(r.get("tenant")),
+                         "start": start, "stop": start + len(pts)})
+        start += len(pts)
+
+    mesh = None
+    if spec0.get("mesh") == "auto":
+        from redcliff_tpu.parallel import remesh as _remesh
+
+        mesh = _remesh.visible_mesh(n_lanes=len(merged))
+
+    # tenant manifest into the run dir's metrics chain BEFORE the fit, so
+    # even a crashed attempt's telemetry is tenant-attributable; the grid
+    # engine appends its own events to the same chain next
+    with MetricLogger(run_dir) as log:
+        log.log("fleet", kind="manifest", batch_id=batch.get("batch_id"),
+                requests=manifest,
+                tenants=sorted({m["tenant"] for m in manifest}),
+                n_points=len(merged))
+
+    runner = RedcliffGridRunner(model, tc, GridSpec(points=merged),
+                                mesh=mesh)
+    result = runner.fit(jax.random.PRNGKey(tc.seed), train_ds, val_ds,
+                        checkpoint_dir=run_dir,
+                        checkpoint_every=int(batch.get("checkpoint_every")
+                                             or 1),
+                        log_dir=run_dir)
+
+    # ---- split the merged result into per-request records ----------------
+    import numpy as np
+
+    results_dir = os.path.join(run_dir, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    val_hist = np.asarray(result.val_history)
+    for row in manifest:
+        lo, hi = row["start"], row["stop"]
+        failures = [dict(f, point=int(f["point"]) - lo,
+                         merged_point=int(f["point"]))
+                    for f in result.failures if lo <= f["point"] < hi]
+        rec = {
+            "request_id": row["request_id"],
+            "tenant": row["tenant"],
+            "batch_id": batch.get("batch_id"),
+            "n_points": hi - lo,
+            "best_criteria": jsonable(result.best_criteria[lo:hi]),
+            "best_epoch": jsonable(result.best_epoch[lo:hi]),
+            "active": jsonable(result.active[lo:hi]),
+            "val_history": jsonable(val_hist[:, lo:hi]),
+            "failures": jsonable(failures),
+        }
+        tmp = os.path.join(results_dir,
+                           f".{row['request_id']}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(rec, f, allow_nan=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(results_dir,
+                                     f"{row['request_id']}.json"))
+    return result
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1:
+        print("usage: python -m redcliff_tpu.fleet.run_batch <batch.json>",
+              file=sys.stderr)
+        return 2
+    from redcliff_tpu.parallel.remesh import HostLostError
+    from redcliff_tpu.runtime.preempt import DeadlineExceeded, Preempted
+    from redcliff_tpu.runtime.watchdog import (EXIT_DEADLINE,
+                                               EXIT_HOST_LOST,
+                                               EXIT_PREEMPTED)
+
+    try:
+        run_batch_file(argv[0])
+    except Preempted as e:
+        print(f"fleet run_batch: {e}", file=sys.stderr)
+        return EXIT_PREEMPTED
+    except DeadlineExceeded as e:
+        print(f"fleet run_batch: {e}", file=sys.stderr)
+        return EXIT_DEADLINE
+    except HostLostError as e:
+        print(f"fleet run_batch: {e}", file=sys.stderr)
+        return EXIT_HOST_LOST
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,355 @@
+"""Fleet worker loop: claim planned batches, supervise fits, mark results.
+
+One worker = one long-lived control process on a host with accelerators::
+
+    python -m redcliff_tpu.fleet work --root /fleet
+
+Each cycle it (1) prefers RECLAIM work — expired leases whose recorded
+batch composition it re-claims so the dead worker's grid fit resumes from
+its durable checkpoint in the same ``work/<batch_id>`` run dir; then (2)
+plans fresh admission over the pending queue (fleet/planner.py) and claims
+the first admitted batch; then (3) runs the batch as a supervised child —
+:func:`redcliff_tpu.runtime.supervisor.supervise` around ``python -m
+redcliff_tpu.fleet.run_batch <batch.json>`` — so crashes, hangs, and
+preemptions restart from checkpoint under the existing exit-code taxonomy,
+while a background thread renews the members' leases on a cadence well
+inside ``lease_s``.
+
+Tenant stamping: before supervising, the worker appends a ``fleet``
+manifest record (batch id + per-request tenant and merged point range) to
+the batch's ``run_ledger.jsonl``; ``run_batch`` logs the same manifest as a
+metrics event. ``obs report`` joins both into its per-tenant section, and
+every planner/claim/batch transition lands as a schema-registered ``fleet``
+event in the FLEET ROOT's ``metrics.jsonl`` (what ``obs watch <root>``
+tails in fleet mode).
+
+Completion discipline: only a ``clean`` supervised outcome marks requests
+done (first ``done/<id>.json`` writer wins — never run twice);
+deterministic-failure classes (``numerics_abort``/``deadline``/
+``giving_up``/``mesh_exhausted``) mark them failed; anything else releases
+the leases so another worker retries.
+
+stdlib-only imports at module scope, and NEVER jax (obs/schema.py
+``--check`` enforces it): the worker is a control process — the jax backend
+initializes only inside the supervised ``run_batch`` child.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+
+from redcliff_tpu.obs import record_span
+from redcliff_tpu.obs import costmodel as _costmodel
+from redcliff_tpu.runtime.supervisor import SupervisorPolicy, supervise
+from redcliff_tpu.fleet import planner as _planner
+from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
+
+__all__ = ["work", "run_one_batch", "default_worker_id",
+           "TERMINAL_FAIL_CLASSES"]
+
+# supervised outcomes a restart cannot fix: the request is terminally failed
+# instead of released for another worker to burn the same budget on
+TERMINAL_FAIL_CLASSES = ("numerics_abort", "deadline", "giving_up",
+                         "mesh_exhausted")
+
+
+def default_worker_id():
+    return f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+
+
+def _logger(root):
+    """The fleet root's MetricLogger (lazy import: obs.logging pulls numpy,
+    which is fine for a control process — only jax is banned here)."""
+    from redcliff_tpu.obs.logging import MetricLogger
+
+    return MetricLogger(root)
+
+
+def _manifest_rows(requests):
+    """Per-request merged-point ranges: [{request_id, tenant, start, stop}]
+    — the tenant-attribution map every report join keys on."""
+    rows, start = [], 0
+    for r in requests:
+        n = len(r.get("points") or ())
+        rows.append({"request_id": r["request_id"],
+                     "tenant": str(r.get("tenant")),
+                     "start": start, "stop": start + n})
+        start += n
+    return rows
+
+
+def _claim_batch(q, worker_id, lease_s, batch_id, request_ids, by_id,
+                 logger, reclaim=False, all_ids=None):
+    """Claim every member of one batch (all-or-nothing); returns
+    {request_id: Lease} or None. ``all_ids`` records the FULL batch
+    composition on each lease (it may exceed ``request_ids`` on a reclaim
+    whose other members already completed)."""
+    leases = {}
+    for rid in request_ids:
+        rec = by_id.get(rid)
+        lease = q.claim(rid, worker_id, lease_s, batch_id=batch_id,
+                        batch_request_ids=list(all_ids or request_ids),
+                        tenant=(rec or {}).get("tenant"))
+        if lease is None:
+            if q.is_terminal(rid):
+                continue  # already finished by someone: not a conflict
+            for l in leases.values():
+                l.release()
+            return None
+        leases[rid] = lease
+    if leases:
+        logger.log("fleet", kind="reclaim" if reclaim else "claim",
+                   batch_id=batch_id, requests=list(leases),
+                   tenants=sorted({str(by_id[r].get("tenant"))
+                                   for r in leases if r in by_id}),
+                   worker=worker_id)
+    return leases or None
+
+
+def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
+                logger):
+    """Reclaim-first, then plan-and-claim. Returns (batch_view, leases,
+    member_requests) or None when nothing is claimable right now."""
+    by_id = {r["request_id"]: r for r in q.requests()}
+
+    # 1) reclaim: an expired lease records the batch it was claimed under —
+    # resume THAT composition so the grid checkpoint fingerprint matches.
+    # The FULL recorded member list stays the batch (manifest offsets must
+    # match the merged grid the checkpoint was written under); only the
+    # not-yet-terminal members need fresh claims
+    for batch_id, stale in sorted(q.expired_claims().items(),
+                                  key=lambda kv: str(kv[0])):
+        if batch_id is None:
+            continue  # no recorded composition: replanned below
+        rids_all = (stale[0].get("batch_request_ids")
+                    or [l["request_id"] for l in stale])
+        rids_all = [r for r in rids_all if r in by_id]
+        claimable = [r for r in rids_all if not q.is_terminal(r)]
+        if not claimable:
+            continue
+        leases = _claim_batch(q, worker_id, lease_s, batch_id, claimable,
+                              by_id, logger, reclaim=True,
+                              all_ids=rids_all)
+        if leases:
+            members = [by_id[r] for r in rids_all]
+            batch = _planner._batch_view(members, n_devices)
+            batch["batch_id"] = batch_id  # preserve the recorded run dir
+            return batch, leases, members
+
+    # 2) fresh admission plan over the pending queue (derived from the one
+    # spool scan above: non-terminal, no live lease, submission order)
+    now = time.time()
+    pending = []
+    for rid, rec in by_id.items():
+        if q.is_terminal(rid):
+            continue
+        lease = q.lease_of(rid)
+        if lease is not None and float(lease.get("expires_at") or 0.0) > now:
+            continue
+        pending.append(rec)
+    if not pending:
+        return None
+    t0 = time.perf_counter()
+    pl = _planner.plan(pending, n_devices=n_devices,
+                       budget_bytes=budget_bytes,
+                       cost_model=_costmodel.load(), max_bucket=max_bucket)
+    record_span("fleet.plan", (time.perf_counter() - t0) * 1e3,
+                component="fleet", logger=logger, emit=True,
+                queue_depth=pl["queue_depth"], batches=len(pl["batches"]))
+    logger.log("fleet", kind="plan", queue_depth=pl["queue_depth"],
+               batches=len(pl["batches"]),
+               unschedulable=len(pl["unschedulable"]),
+               plan_ms=pl["plan_ms"],
+               utilization_pct=pl["utilization"]["utilization_pct"],
+               decisions=[{k: b.get(k) for k in
+                           ("batch_id", "requests", "tenants", "n_points",
+                            "g_bucket", "predicted_bytes", "eta_s",
+                            "priority")}
+                          for b in pl["batches"][:8]],
+               worker=worker_id)
+    for b in pl["batches"]:
+        leases = _claim_batch(q, worker_id, lease_s, b["batch_id"],
+                              b["requests"], by_id, logger)
+        if leases:
+            members = [by_id[r] for r in b["requests"] if r in by_id]
+            return b, leases, members
+    return None
+
+
+class _LeaseHeartbeat:
+    """Renews a batch's leases every ``lease_s / 3`` seconds while the
+    supervised fit runs; a lost lease (reclaimed by another worker after an
+    expiry we slept through) stops renewals and is surfaced to the caller
+    so it will not publish results it no longer owns."""
+
+    def __init__(self, leases, lease_s, logger):
+        self._leases = leases
+        self._lease_s = float(lease_s)
+        self._logger = logger
+        self._stop = threading.Event()
+        self.lost = []
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-lease-heartbeat")
+
+    def _run(self):
+        period = max(self._lease_s / 3.0, 0.05)
+        while not self._stop.wait(period):
+            for rid, lease in list(self._leases.items()):
+                try:
+                    lease.renew(self._lease_s)
+                except LeaseLost:
+                    self.lost.append(rid)
+                    self._leases.pop(rid, None)
+                    self._logger.log("fleet", kind="lease_lost",
+                                     requests=[rid])
+                except OSError:
+                    pass  # transient fs hiccup: retry next period
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=self._lease_s)
+
+
+def run_one_batch(q, batch, leases, members, logger, worker_id,
+                  lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
+                  env=None, python=None):
+    """Run one claimed batch under the crash-loop supervisor and settle its
+    requests; returns the :class:`~redcliff_tpu.runtime.supervisor
+    .SuperviseOutcome`."""
+    batch_id = batch["batch_id"]
+    run_dir = q.batch_dir(batch_id)
+    os.makedirs(run_dir, exist_ok=True)
+    batch_file = os.path.join(run_dir, "batch.json")
+    if not os.path.exists(batch_file):
+        # deterministic from the claimed composition: a reclaiming worker
+        # that finds the file missing (claimant died pre-write) rebuilds
+        # the identical content from the lease-recorded member order
+        tmp = f"{batch_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"batch_id": batch_id, "run_dir": run_dir,
+                       "checkpoint_every": int(checkpoint_every),
+                       "requests": members}, f, allow_nan=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, batch_file)
+    # tenant stamping into the supervisor ledger: the manifest row set the
+    # per-tenant report section joins on (run_batch logs the same manifest
+    # as a metrics event inside the run dir)
+    ledger_path = os.path.join(run_dir, "run_ledger.jsonl")
+    with open(ledger_path, "a") as f:
+        f.write(json.dumps({"event": "fleet", "kind": "manifest",
+                            "batch_id": batch_id, "worker": worker_id,
+                            "requests": _manifest_rows(members)}) + "\n")
+    logger.log("fleet", kind="batch_start", batch_id=batch_id,
+               run_dir=run_dir, requests=batch["requests"],
+               tenants=batch["tenants"], n_points=batch["n_points"],
+               g_bucket=batch["g_bucket"], eta_s=batch.get("eta_s"),
+               predicted_bytes=batch.get("predicted_bytes"),
+               worker=worker_id)
+    cmd = [python or sys.executable, "-m", "redcliff_tpu.fleet.run_batch",
+           batch_file]
+    t0 = time.perf_counter()
+    with _LeaseHeartbeat(leases, lease_s, logger) as hb:
+        outcome = supervise(
+            cmd, ledger_path=ledger_path,
+            policy=supervisor_policy or SupervisorPolicy(max_restarts=2),
+            env=env)
+    dur_ms = (time.perf_counter() - t0) * 1e3
+    record_span("fleet.batch", dur_ms, component="fleet", logger=logger,
+                emit=True, batch_id=batch_id,
+                classification=outcome.classification)
+
+    lost = set(hb.lost)
+    settled = {"done": [], "failed": [], "released": [], "lost": sorted(lost)}
+    for rid, lease in list(leases.items()):
+        if rid in lost:
+            continue
+        rec = next((m for m in members if m["request_id"] == rid), {})
+        if outcome.classification == "clean":
+            result = _read_result(run_dir, rid)
+            q.complete(rid, result=result)
+            settled["done"].append(rid)
+            logger.log("fleet", kind="complete", batch_id=batch_id,
+                       requests=[rid], tenants=[str(rec.get("tenant"))],
+                       worker=worker_id)
+        elif outcome.classification in TERMINAL_FAIL_CLASSES:
+            q.fail(rid, outcome.classification)
+            settled["failed"].append(rid)
+        else:
+            lease.release()
+            settled["released"].append(rid)
+    logger.log("fleet", kind="batch_end", batch_id=batch_id,
+               classification=outcome.classification, rc=outcome.returncode,
+               attempts=len(outcome.attempts),
+               wall_s=round(dur_ms / 1e3, 3),
+               done=len(settled["done"]), failed=len(settled["failed"]),
+               released=len(settled["released"]), worker=worker_id)
+    return outcome
+
+
+def _read_result(run_dir, request_id):
+    path = os.path.join(run_dir, "results", f"{request_id}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        # clean exit but no per-request artifact (should not happen):
+        # record the run dir so the operator can dig
+        return {"run_dir": run_dir, "missing_result": True}
+
+
+def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
+         drain=False, once=False, n_devices=1, budget_bytes=None,
+         max_bucket=_planner.DEFAULT_MAX_BUCKET, checkpoint_every=1,
+         supervisor_policy=None, env=None, python=None):
+    """The worker loop; returns the number of batches run.
+
+    ``drain``: exit once the queue holds no claimable or running work.
+    ``once``: run at most one claim cycle. ``max_batches`` bounds the run.
+    ``budget_bytes``: the admission HBM budget (``check_headroom``'s
+    ``budget_bytes`` on the serving mesh; None = ungated, e.g. this CPU
+    container)."""
+    q = FleetQueue(root)
+    worker_id = worker_id or default_worker_id()
+    batches_run = 0
+    with _logger(root) as logger:
+        logger.log("fleet", kind="worker_start", worker=worker_id,
+                   n_devices=n_devices, budget_bytes=budget_bytes,
+                   lease_s=lease_s)
+        while True:
+            got = _next_batch(q, worker_id, lease_s, n_devices,
+                              budget_bytes, max_bucket, logger)
+            if got is not None:
+                batch, leases, members = got
+                run_one_batch(q, batch, leases, members, logger, worker_id,
+                              lease_s=lease_s,
+                              checkpoint_every=checkpoint_every,
+                              supervisor_policy=supervisor_policy, env=env,
+                              python=python)
+                batches_run += 1
+                if max_batches is not None and batches_run >= max_batches:
+                    break
+                if once:
+                    break
+                continue
+            if once:
+                break
+            # drain: nothing is claimable right now (_next_batch came back
+            # empty — the queue is empty OR holds only unschedulable
+            # requests the planner can never admit) and nothing is in
+            # flight anywhere whose completion/expiry could change that
+            if drain and not q.live_leases():
+                break
+            time.sleep(poll_s)
+        logger.log("fleet", kind="worker_stop", worker=worker_id,
+                   batches=batches_run)
+    return batches_run

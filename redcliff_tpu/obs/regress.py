@@ -126,6 +126,15 @@ FAMILIES = [
     # dirs unexportable — keep it on the trajectory
     Family("trace_export.export_ms", better="lower", band=_BAND_TIMING,
            abs_floor=250.0, g_dependent=False),
+    # fleet admission planner (redcliff_tpu/fleet): the packed-vs-FIFO
+    # mesh-slot utilization gain on the synthetic heterogeneous request mix
+    # must not erode (the packing IS the service's perf claim), and the
+    # host-only planning latency must stay queue-scan cheap
+    Family("fleet.packed_utilization_pct", band=_BAND_TIMING,
+           g_dependent=False),
+    Family("fleet.utilization_gain", band=_BAND_TIMING, g_dependent=False),
+    Family("fleet.plan_ms", better="lower", band=_BAND_TIMING,
+           abs_floor=50.0, g_dependent=False),
 ]
 
 

@@ -140,6 +140,7 @@ def build_report(run_dir):
 
     fits = []
     cur = None            # current fit context: {"shape_key", "shape", ...}
+    manifest = {}         # request_id -> {tenant, start, stop} (fleet runs)
     cost = {}             # (shape_key, g_bucket) -> accumulators
     cm_acc = {}           # (shape_key, g_bucket) -> residual-event accuracy
     run_cache_dir = None  # the versioned compile-cache dir fit_start logs
@@ -241,6 +242,13 @@ def build_report(run_dir):
                             m["measured_peak_bytes"] or 0, peak)
                     if rec.get("bytes_limit") is not None:
                         m["bytes_limit"] = rec["bytes_limit"]
+        elif ev == "fleet":
+            # tenant manifest (fleet/run_batch.py): request id -> merged
+            # point range; restart attempts re-log it, latest wins
+            if rec.get("kind") == "manifest":
+                for row in rec.get("requests") or []:
+                    if isinstance(row, dict) and row.get("request_id"):
+                        manifest[row["request_id"]] = row
         elif ev == "profile":
             profiles.append({k: rec.get(k) for k in
                              ("path", "spec", "first_epoch", "last_epoch",
@@ -287,6 +295,15 @@ def build_report(run_dir):
                           "exit_code": rec.get("exit_code")})
 
     ck_stats = _checkpoint_stats(run_dir)
+
+    # the worker stamps the same tenant manifest into the supervisor ledger
+    # (fleet/worker.py) — it covers attempts that died before the metrics
+    # chain got the run_batch manifest event
+    for rec in ledger:
+        if rec.get("event") == "fleet" and rec.get("kind") == "manifest":
+            for row in rec.get("requests") or []:
+                if isinstance(row, dict) and row.get("request_id"):
+                    manifest.setdefault(row["request_id"], row)
 
     attempts = [r for r in ledger if r.get("event") == "attempt"]
     classes = {}
@@ -361,6 +378,55 @@ def build_report(run_dir):
         "profile_artifacts": artifact_dirs,
     }
 
+    # per-tenant section (fleet runs, docs/ARCHITECTURE.md "Fleet sweep
+    # service"): fits/points/lane-epochs/wall attributed through the tenant
+    # manifest's merged point ranges; quarantine causes keyed by which
+    # range the failing ORIGINAL point id falls in
+    tenants = {}
+    if manifest:
+        # lane-epochs attributed by point share of the engine's EXACT
+        # total (dispatch_stats lane_epochs counts what actually computed,
+        # early-stop/compaction included) — per-tenant numbers always sum
+        # to the run's own lane-epoch accounting above, never beyond it
+        total_pts = sum(
+            max(int(r.get("stop") or 0) - int(r.get("start") or 0), 0)
+            for r in manifest.values()) or 1
+        exact_lane_epochs = int(stats_sum["lane_epochs"])
+        for row in manifest.values():
+            t = tenants.setdefault(str(row.get("tenant")), {
+                "requests": 0, "points": 0, "lane_epochs": 0,
+                "quarantined": {}, "wall_s": (round(t_last - t_first, 3)
+                                              if t_first is not None
+                                              else None)})
+            n = int(row.get("stop") or 0) - int(row.get("start") or 0)
+            t["requests"] += 1
+            t["points"] += n
+        # largest-remainder apportionment of the exact total by point
+        # share (independent rounding could sum past the engine's number)
+        shares = sorted(
+            ((exact_lane_epochs * t["points"]) % total_pts, name)
+            for name, t in tenants.items())
+        leftover = exact_lane_epochs - sum(
+            exact_lane_epochs * t["points"] // total_pts
+            for t in tenants.values())
+        for frac, name in reversed(shares):
+            t = tenants[name]
+            t["lane_epochs"] = exact_lane_epochs * t["points"] // total_pts
+            if leftover > 0 and frac:
+                t["lane_epochs"] += 1
+                leftover -= 1
+        for f in failures:
+            p = f.get("point")
+            if not isinstance(p, int):
+                continue
+            for row in manifest.values():
+                if int(row.get("start") or 0) <= p < int(row.get("stop")
+                                                         or 0):
+                    q = tenants[str(row.get("tenant"))]["quarantined"]
+                    cause = f.get("cause") or "?"
+                    q[cause] = q.get(cause, 0) + 1
+                    break
+
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
 
@@ -404,6 +470,7 @@ def build_report(run_dir):
                         "by_bucket": by_bucket},
         "compactions": compactions,
         "remeshes": remeshes,
+        "tenants": tenants,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
                      "guarded_steps_skipped": int(skipped_steps),
@@ -483,6 +550,17 @@ def render_text(report):
         out.append(f"remeshes: " + "; ".join(
             f"epoch {c['epoch']}: {c['from_devices']}->{c['to_devices']} "
             f"devices" for c in r["remeshes"]))
+    tn = r.get("tenants") or {}
+    if tn:
+        out.append("per-tenant (fleet manifest, redcliff_tpu/fleet):")
+        for tenant, t in sorted(tn.items()):
+            quar = (", ".join(f"{k}x{v}"
+                              for k, v in sorted(t["quarantined"].items()))
+                    or "none")
+            out.append(f"  {tenant}: {t['requests']} request(s), "
+                       f"{t['points']} point(s), {t['lane_epochs']} "
+                       f"lane-epoch(s), wall {_fmt_ms((t['wall_s'] or 0) * 1e3)}, "
+                       f"quarantined: {quar}")
     mem = r.get("memory") or {}
     out.append("device memory (predicted vs measured peak, obs/memory.py):")
     for m in mem.get("fits") or []:

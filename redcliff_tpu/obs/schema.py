@@ -77,6 +77,7 @@ SPAN_NAMES = frozenset({
     "grid.ckpt_save",
     "ckpt.write", "ckpt.async_write", "ckpt.submit_barrier",
     "prefetch.fill", "prefetch.stall", "shard.load",
+    "fleet.plan", "fleet.batch",
 })
 
 # identity fields the MetricLogger stamps on every record (schema v1);
@@ -232,7 +233,19 @@ EVENTS = {
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
-                  "memory")),
+                  "memory", "fleet")),
+    "fleet": _ev(
+        "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
+        "worker loop, run_batch driver; kind=submit | plan | claim | "
+        "reclaim | batch_start | batch_end | complete | lease_lost | "
+        "manifest | worker_start | worker_stop)",
+        required=("kind",),
+        optional=("batch_id", "requests", "tenants", "n_points", "g_bucket",
+                  "queue_depth", "batches", "unschedulable", "plan_ms",
+                  "utilization_pct", "decisions", "eta_s",
+                  "predicted_bytes", "run_dir", "worker", "classification",
+                  "rc", "attempts", "wall_s", "done", "failed", "released",
+                  "priority", "n_devices", "budget_bytes", "lease_s")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -257,6 +270,11 @@ LEDGER_EVENTS = {
     "final": _ev(
         "supervisor",
         required=("classification",), optional=("rc", "attempts")),
+    "fleet": _ev(
+        "fleet worker (tenant manifest: request id -> merged point range, "
+        "the per-tenant attribution map obs report joins on)",
+        required=("kind",),
+        optional=("batch_id", "requests", "worker", "tenants")),
 }
 
 
@@ -318,13 +336,18 @@ def validate_records(records, kind="metrics"):
 # this must run on a box with no jax backend at all.
 # ---------------------------------------------------------------------------
 
-# observability modules under the no-host-sync discipline. "no-jax": jax may
-# not be imported AT ALL (the span/flight hot path and the post-mortem trace
-# exporter); "lazy-jax": jax only inside function bodies (memory polls and
-# profiler start/stop need the API but must not drag jax into stdlib-only
-# importers). block_until_ready is banned in every one of them — a device
-# sync inside the observability layer would serialize what it observes.
-NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py")
+# observability + fleet-control modules under the no-host-sync discipline.
+# "no-jax": jax may not be imported AT ALL — the span/flight hot path, the
+# post-mortem trace exporter, and the fleet CONTROL plane (queue scans,
+# admission planning, the worker loop must never initialize a backend; only
+# the supervised run_batch child does); "lazy-jax": jax only inside function
+# bodies (memory polls and profiler start/stop need the API but must not
+# drag jax into stdlib-only importers). block_until_ready is banned in
+# every one of them — a device sync inside the observability layer would
+# serialize what it observes.
+NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
+                  "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
+                  "fleet/__main__.py")
 LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py")
 
 

@@ -42,7 +42,16 @@ import time
 from redcliff_tpu.obs import schema as _schema
 from redcliff_tpu.obs.logging import jsonl_files, read_jsonl
 
-__all__ = ["build_snapshot", "render_text", "diagnose_run_dir", "run_watch"]
+__all__ = ["build_snapshot", "render_text", "diagnose_run_dir", "run_watch",
+           "is_fleet_root"]
+
+
+def is_fleet_root(path):
+    """Whether ``path`` is a fleet sweep-service root (fleet/queue.py
+    layout) rather than a single-run directory — flips the watch into
+    FLEET mode (queue depth, per-tenant in-flight, planner decisions)."""
+    return (os.path.exists(os.path.join(path, "requests.jsonl"))
+            or os.path.isdir(os.path.join(path, "leases")))
 
 
 def diagnose_run_dir(run_dir):
@@ -54,10 +63,11 @@ def diagnose_run_dir(run_dir):
         return f"not a directory: {run_dir}"
     if (not jsonl_files(os.path.join(run_dir, "metrics.jsonl"))
             and not os.path.exists(os.path.join(run_dir,
-                                                "run_ledger.jsonl"))):
+                                                "run_ledger.jsonl"))
+            and not is_fleet_root(run_dir)):
         return (f"no telemetry in {run_dir}: neither metrics.jsonl (or its "
-                f"rotation chain) nor run_ledger.jsonl — is this a run "
-                f"directory?")
+                f"rotation chain) nor run_ledger.jsonl nor a fleet queue "
+                f"(requests.jsonl) — is this a run directory?")
     return None
 
 
@@ -164,6 +174,8 @@ def build_snapshot(run_dir, now=None):
 
     fits, incidents = [], []
     cur = None
+    fleet_last_plan = None   # newest planner packing decision (fleet event)
+    fleet_workers = {}       # worker id -> last fleet-event wall time
     mem_pred = mem_meas = None  # newest memory events (obs/memory.py)
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
@@ -208,6 +220,12 @@ def build_snapshot(run_dir, now=None):
         elif ev in ("compaction", "remesh") and cur is not None:
             if rec.get("to_width") is not None:
                 cur["grid_width"] = rec["to_width"]
+        elif ev == "fleet":
+            if rec.get("kind") == "plan":
+                fleet_last_plan = rec
+            w = rec.get("worker")
+            if w and isinstance(wt, (int, float)):
+                fleet_workers[str(w)] = wt
         elif ev == "anomaly":
             anomalies += 1
         elif ev == "numerics":
@@ -294,12 +312,20 @@ def build_snapshot(run_dir, now=None):
                 if mem_meas and isinstance(mem_meas.get("wall_time"),
                                            (int, float)) else None),
         }
+    # fleet mode (fleet/queue.py roots): queue depth + per-tenant counts
+    # from the authoritative file queue, live in-flight claims from the
+    # lease files, and the planner's newest packing decision from the
+    # rotation-chain-tailed `fleet` events above
+    fleet = None
+    if is_fleet_root(run_dir):
+        fleet = _fleet_section(run_dir, fleet_last_plan, fleet_workers, now)
     return {
         "event": "watch",
         "wall_time": now,
         "schema_version": _schema.SCHEMA_VERSION,
         "run_dir": os.path.abspath(run_dir),
-        "ok": bool(records or ledger),
+        "ok": bool(records or ledger or fleet is not None),
+        "fleet": fleet,
         "fits": fits,
         "grid_eta_s": round(sum(etas), 3) if etas else None,
         "stalls": _checkpoint_stalls(run_dir),
@@ -319,6 +345,42 @@ def build_snapshot(run_dir, now=None):
                        "torn_lines": (mstats.get("torn_lines", 0)
                                       + lstats.get("torn_lines", 0)),
                        "files": [os.path.basename(p) for p in files]},
+    }
+
+
+def _fleet_section(root, last_plan, workers, now):
+    """The fleet-mode snapshot body: queue/tenant counts (file queue =
+    authoritative), live in-flight claims (lease files), the planner's
+    newest packing decision, and worker liveness ages."""
+    from redcliff_tpu.fleet.queue import FleetQueue
+
+    # create=False: a watcher is a pure reader — it must neither mkdir
+    # under the service root nor crash on a read-only/archived one
+    q = FleetQueue(root, create=False)
+    st = q.status(now=now)
+    in_flight = [{
+        "request_id": l.get("request_id"),
+        "tenant": l.get("tenant"),
+        "worker": l.get("worker"),
+        "batch_id": l.get("batch_id"),
+        "expires_in_s": round(float(l.get("expires_at") or 0.0) - now, 3),
+    } for l in q.live_leases(now=now)]
+    plan = None
+    if last_plan is not None:
+        plan = {k: last_plan.get(k) for k in
+                ("queue_depth", "batches", "unschedulable", "plan_ms",
+                 "utilization_pct", "decisions", "worker")}
+        wt = last_plan.get("wall_time")
+        plan["age_s"] = (round(now - wt, 3)
+                         if isinstance(wt, (int, float)) else None)
+    return {
+        "counts": st["counts"],
+        "by_tenant": st["by_tenant"],
+        "torn_spool_lines": st["torn_spool_lines"],
+        "in_flight": in_flight,
+        "last_plan": plan,
+        "worker_age_s": {w: round(now - t, 3)
+                         for w, t in sorted(workers.items())},
     }
 
 
@@ -343,6 +405,41 @@ def render_text(snap):
     out = [f"watch: {snap['run_dir']}  "
            f"(records {snap['read_audit']['records']}, torn "
            f"{snap['read_audit']['torn_lines']})"]
+    fl = snap.get("fleet")
+    if fl:
+        c = fl["counts"]
+        out.append(f"  fleet queue: {c['queued']} queued | {c['running']} "
+                   f"running | {c['done']} done | {c['failed']} failed "
+                   f"(of {c['submitted']} submitted"
+                   + (f"; {c['expired_claims']} expired claim(s)"
+                      if c["expired_claims"] else "") + ")")
+        for tenant, t in sorted(fl["by_tenant"].items()):
+            out.append(f"    tenant {tenant}: {t['queued']}q "
+                       f"{t['running']}r {t['done']}d {t['failed']}f")
+        for inf in fl["in_flight"]:
+            out.append(f"    in-flight {inf['request_id']} "
+                       f"[{inf['tenant']}] on {inf['worker']} "
+                       f"batch={inf['batch_id']} lease "
+                       f"{_fmt_age(max(inf['expires_in_s'], 0.0))} left")
+        lp = fl.get("last_plan")
+        if lp:
+            out.append(f"    last plan ({_fmt_age(lp['age_s'])} ago): "
+                       f"depth={lp['queue_depth']} -> "
+                       f"{lp['batches']} batch(es), "
+                       f"{lp['unschedulable']} unschedulable, "
+                       f"util={lp['utilization_pct']}%, "
+                       f"plan={lp['plan_ms']}ms")
+            for d in (lp.get("decisions") or [])[:4]:
+                out.append(f"      {d.get('batch_id')}: "
+                           f"{d.get('n_points')} pt -> "
+                           f"bucket {d.get('g_bucket')}, tenants "
+                           f"{','.join(d.get('tenants') or [])}"
+                           + (f", eta {_fmt_age(d['eta_s'])}"
+                              if d.get("eta_s") is not None else ""))
+        if fl["worker_age_s"]:
+            out.append("    workers: " + "  ".join(
+                f"{w}={_fmt_age(a)}"
+                for w, a in fl["worker_age_s"].items()))
     hb = snap["heartbeats"]
     out.append(f"  ages: metrics file {_fmt_age(hb['metrics_file_age_s'])} |"
                f" last record {_fmt_age(hb['last_record_age_s'])} | last "

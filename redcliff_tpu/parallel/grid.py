@@ -11,13 +11,23 @@ Shape-changing hyperparameters (hidden sizes, lags, factor counts) cannot share
 a compiled program; callers group points by shape and run one GridRun per group
 — the grouping helper below does this from a list of config dicts.
 
-Elastic grid scheduling (parallel/compaction.py, docs/ARCHITECTURE.md
-"Elastic grid scheduling & compile caching"): execution widths ride a
-power-of-two bucket ladder (``g_bucket`` pads off-ladder grids with masked
-filler lanes so heterogeneous sweeps reuse a small program set), and at
-check-window boundaries the engine COMPACTS the grid down the ladder once
-enough lanes have early-stopped/quarantined (``compaction``) — retired lanes
-stop riding every dispatch, surviving lanes' update streams stay
+Engine vs. policy: this module is the EXECUTION ENGINE only — vmapped
+dispatch, mesh sharding, durable checkpoint/resume, result assembly. The
+SCHEDULING DECISIONS it consults (which bucket-ladder width a grid runs at,
+when live lanes compact down the ladder, which lanes a wall-clock budget
+evicts) live in :class:`~redcliff_tpu.parallel.policy.GridSchedulingPolicy`
+(parallel/policy.py, joining the pure-host planning in
+parallel/compaction.py). The split lets services — the fleet sweep service's
+admission planner (redcliff_tpu/fleet) foremost — drive the engine directly
+and share the ladder/width logic without instantiating a runner.
+
+Elastic grid scheduling (parallel/policy.py + parallel/compaction.py,
+docs/ARCHITECTURE.md "Elastic grid scheduling & compile caching"): execution
+widths ride a power-of-two bucket ladder (``g_bucket`` pads off-ladder grids
+with masked filler lanes so heterogeneous sweeps reuse a small program set),
+and at check-window boundaries the engine COMPACTS the grid down the ladder
+once enough lanes have early-stopped/quarantined (``compaction``) — retired
+lanes stop riding every dispatch, surviving lanes' update streams stay
 bit-identical, and results/failures always report under original point ids.
 A persistent, versioned XLA compilation cache (``compile_cache_dir``,
 runtime/compileobs.py) makes restarts warm-start their programs; compile
@@ -52,6 +62,7 @@ import optax
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel import compaction, remesh
+from redcliff_tpu.parallel.policy import GridSchedulingPolicy
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import (Mesh, grid_mesh, replicated,
                                         shard_leading_axis)
@@ -227,35 +238,32 @@ class RedcliffGridRunner:
             self._snapshot_fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
         return self._snapshot_fn
 
-    def __init__(self, model, train_config, spec: GridSpec, mesh=None):
+    def __init__(self, model, train_config, spec: GridSpec, mesh=None,
+                 policy=None):
         self.model = model
         self.tc = train_config
         self.spec = spec
-        # elastic scheduling (parallel/compaction.py): ``mesh`` is the FULL
-        # device capacity; ``self.mesh`` is the active mesh, which may be a
-        # sub-mesh after bucketing/compaction shrinks the execution width
-        # below the device count
+        # elastic scheduling: ``mesh`` is the FULL device capacity;
+        # ``self.mesh`` is the active mesh, which may be a sub-mesh after
+        # bucketing/compaction shrinks the execution width below the device
+        # count. The width/compaction/deadline DECISIONS live in the
+        # scheduling policy (parallel/policy.py — engine/policy split);
+        # this engine only executes them
         self._mesh_full = mesh
         self.mesh = mesh
         self._g_real = G_real = len(spec.points)
-        self._g_bucket = bool(getattr(train_config, "g_bucket", True))
-        self._compaction_on = bool(getattr(train_config, "compaction", True))
+        self.policy = (policy if policy is not None
+                       else GridSchedulingPolicy.from_train_config(
+                           train_config))
+        self._g_bucket = self.policy.g_bucket
+        self._compaction_on = self.policy.compaction
         compileobs.enable_cache(
             getattr(train_config, "compile_cache_dir", None))
         compileobs.install()
         n_dev = mesh.devices.size if mesh is not None else 1
-        if self._g_bucket:
-            g_exec = compaction.bucket_width(G_real, n_dev)
-            if mesh is not None:
-                self.mesh = self._mesh_for(g_exec)
-        else:
-            g_exec = G_real
-            if mesh is not None and G_real % n_dev != 0:
-                raise ValueError(
-                    f"grid size {len(spec.points)} must be a multiple of the mesh "
-                    f"device count {n_dev} (pad the grid with duplicate points or "
-                    f"shrink the mesh, or enable g_bucket to pad with masked "
-                    f"filler lanes)")
+        g_exec = self.policy.initial_width(G_real, n_dev)
+        if mesh is not None and self._g_bucket:
+            self.mesh = self._mesh_for(g_exec)
         self._g_exec0 = g_exec
         # original point id per execution lane; -1 marks bucket-padding
         # filler lanes (masked from birth, never surfaced in GridResult)
@@ -1655,9 +1663,12 @@ class RedcliffGridRunner:
                             np.asarray(elapsed)))
                     else:
                         elapsed = None
-            if lane_deadline is not None and elapsed is not None:
-                over = np.logical_and(lane_deadline < elapsed,
-                                      np.logical_not(dl_done))
+            # eviction decisions come from the scheduling policy
+            # (parallel/policy.py); the engine owns the uniform clock above
+            # and the mask/checkpoint mechanics below
+            over = self.policy.lane_evictions(lane_deadline, dl_done,
+                                              elapsed)
+            if over is not None:
                 if over.any():
                     dl_done |= over
                     dl_bad = self._shard(jnp.asarray(over))
@@ -1683,8 +1694,8 @@ class RedcliffGridRunner:
                                    lanes=[int(orig_ids[g])
                                           for g in np.flatnonzero(over)],
                                    num_evicted=n_evict)
-            if (self.spec.grid_deadline_s and elapsed is not None
-                    and elapsed > self.spec.grid_deadline_s):
+            if self.policy.grid_deadline_hit(self.spec.grid_deadline_s,
+                                             elapsed):
                 grid_dl_hit = True
 
             # structured per-epoch record; syncing the grid losses to host
@@ -1812,24 +1823,26 @@ class RedcliffGridRunner:
                     logger.log("early_exit_all_inactive", epoch=it)
                     break
 
-                # ---- elastic lane compaction (parallel/compaction.py) ----
-                # when the live-lane count has dropped below the next bucket
-                # on the power-of-two ladder, gather the survivors into a
-                # compacted grid and stop paying FLOPs for retired lanes.
-                # Runs at check-window boundaries only (the act_host gather
-                # above is the decision input — no extra sync) and BEFORE
-                # the checkpoint block, so the epoch-it checkpoint stores
-                # the compacted state and a resume lands in the same bucket.
-                # Per-lane updates are bit-identical across widths: the
-                # vmapped step is lane-independent, the same property the
-                # active-mask freeze already relies on. Single-process only
-                # (a multi-host grid would have to re-span hosts mid-fit)
-                plan = None
-                if self._compaction_on and jax.process_count() == 1:
-                    plan = compaction.plan_compaction(
-                        act_host, orig_ids, retired.keys(),
-                        self._mesh_full.devices.size
-                        if self._mesh_full is not None else 1)
+                # ---- elastic lane compaction (policy decision, engine
+                # apply) ---- when the live-lane count has dropped below the
+                # next bucket on the power-of-two ladder, gather the
+                # survivors into a compacted grid and stop paying FLOPs for
+                # retired lanes. The DECISION comes from the scheduling
+                # policy (parallel/policy.py -> compaction.plan_compaction);
+                # this engine applies the plan. Runs at check-window
+                # boundaries only (the act_host gather above is the decision
+                # input — no extra sync) and BEFORE the checkpoint block, so
+                # the epoch-it checkpoint stores the compacted state and a
+                # resume lands in the same bucket. Per-lane updates are
+                # bit-identical across widths: the vmapped step is
+                # lane-independent, the same property the active-mask freeze
+                # already relies on. Single-process only (a multi-host grid
+                # would have to re-span hosts mid-fit)
+                plan = self.policy.compaction_plan(
+                    act_host, orig_ids, retired.keys(),
+                    self._mesh_full.devices.size
+                    if self._mesh_full is not None else 1,
+                    n_processes=jax.process_count())
                 if plan is not None:
                     t_comp = time.perf_counter()
                     # retire frozen lanes' results to host before their

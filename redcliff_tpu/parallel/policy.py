@@ -1,0 +1,117 @@
+"""Grid scheduling policy: the decision half of the engine/policy split.
+
+``parallel/grid.py`` is the grid EXECUTION ENGINE — vmapped dispatch,
+sharding, checkpoint/resume mechanics, result assembly. This module owns the
+SCHEDULING DECISIONS the engine consults but never makes itself:
+
+* **which execution width a grid runs at** (:meth:`GridSchedulingPolicy.
+  initial_width`) — the power-of-two bucket ladder (parallel/compaction.py)
+  or the exact width when bucketing is off, including the mesh-divisibility
+  contract;
+* **when live lanes compact down the ladder**
+  (:meth:`GridSchedulingPolicy.compaction_plan`) — the check-window decision
+  that retires dead lanes' FLOPs, gated to single-process runs;
+* **which lanes a wall-clock budget evicts and when the whole grid stops**
+  (:meth:`GridSchedulingPolicy.lane_evictions` /
+  :meth:`GridSchedulingPolicy.grid_deadline_hit`).
+
+Every method is pure host arithmetic on numbers the engine already holds —
+no device work, no sync, no jax import. That is the point of the split: the
+fleet sweep service (redcliff_tpu/fleet) and its admission planner consult
+the SAME ladder/width logic when packing multi-tenant requests into
+G-buckets, without instantiating an engine, and a future cost-model-driven
+policy (ROADMAP item 4) swaps in here without touching dispatch mechanics.
+
+Decision parity: the engine delegating here is a pure code movement — every
+decision is computed from the same inputs by the same expressions as before
+the split, so grid decision streams (and therefore per-lane update streams)
+are bit-identical to the pre-split engine. Pinned by the existing
+compaction/remesh bit-identity tests, which run unmodified.
+
+numpy-only at module scope (like parallel/compaction.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from redcliff_tpu.parallel import compaction
+
+__all__ = ["GridSchedulingPolicy"]
+
+
+class GridSchedulingPolicy:
+    """Bucket-ladder scheduling policy with check-window compaction.
+
+    ``g_bucket``: draw execution widths from the power-of-two bucket ladder,
+    padding with masked filler lanes (off: exact width, mesh-divisibility
+    required). ``compaction``: gather surviving lanes down the ladder at
+    check-window boundaries (single-process only — a multi-host grid would
+    have to re-span hosts mid-fit).
+    """
+
+    def __init__(self, g_bucket=True, compaction=True):
+        self.g_bucket = bool(g_bucket)
+        self.compaction = bool(compaction)
+
+    @classmethod
+    def from_train_config(cls, train_config):
+        """The policy a train config's elastic-scheduling knobs select."""
+        return cls(g_bucket=getattr(train_config, "g_bucket", True),
+                   compaction=getattr(train_config, "compaction", True))
+
+    # ------------------------------------------------------------------
+    # width decisions
+    # ------------------------------------------------------------------
+    def initial_width(self, g_real, n_devices):
+        """Execution width for a fresh ``g_real``-point grid on an
+        ``n_devices`` mesh: the bucket-ladder width (``g_bucket``), or the
+        exact width — which must then divide the mesh evenly."""
+        n_devices = int(n_devices or 1)
+        if self.g_bucket:
+            return compaction.bucket_width(g_real, n_devices)
+        if n_devices > 1 and g_real % n_devices != 0:
+            raise ValueError(
+                f"grid size {g_real} must be a multiple of the mesh "
+                f"device count {n_devices} (pad the grid with duplicate "
+                f"points or shrink the mesh, or enable g_bucket to pad "
+                f"with masked filler lanes)")
+        return g_real
+
+    def ladder(self, n_lanes, n_devices=1, max_width=None):
+        """The candidate bucket-ladder rungs for ``n_lanes`` lanes — what
+        the fleet admission planner enumerates footprints/ETAs over."""
+        return compaction.ladder_widths(n_lanes, n_devices,
+                                        max_width=max_width)
+
+    # ------------------------------------------------------------------
+    # check-window compaction decision
+    # ------------------------------------------------------------------
+    def compaction_plan(self, active_host, orig_ids, retired_ids, n_devices,
+                        n_processes=1):
+        """Plan a live-lane compaction for this check window, or None (the
+        current width is already the right bucket, compaction is disabled,
+        or the run spans multiple processes)."""
+        if not self.compaction or n_processes != 1:
+            return None
+        return compaction.plan_compaction(active_host, orig_ids, retired_ids,
+                                          int(n_devices or 1))
+
+    # ------------------------------------------------------------------
+    # wall-clock deadline decisions
+    # ------------------------------------------------------------------
+    @staticmethod
+    def lane_evictions(lane_deadline, dl_done, elapsed):
+        """Boolean mask of execution lanes whose per-lane budget expired
+        this epoch (excluding already-evicted ones), or None when there is
+        nothing to decide (no per-lane deadlines / no uniform clock this
+        epoch)."""
+        if lane_deadline is None or elapsed is None:
+            return None
+        return np.logical_and(lane_deadline < elapsed,
+                              np.logical_not(dl_done))
+
+    @staticmethod
+    def grid_deadline_hit(grid_deadline_s, elapsed):
+        """Whether the whole-grid budget is spent as of ``elapsed``."""
+        return bool(grid_deadline_s and elapsed is not None
+                    and elapsed > grid_deadline_s)

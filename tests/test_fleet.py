@@ -1,0 +1,420 @@
+"""Fleet sweep service tests (redcliff_tpu/fleet, ISSUE 10).
+
+Queue durability units (spool/claim/lease/terminal protocol), admission
+planner units (same-shape batching, headroom gate, ordering, packed-vs-FIFO
+utilization), worker end-to-end (submit -> plan -> supervise -> complete,
+with tenant-stamped telemetry the watch/report CLIs join), and the
+crash-safety ACCEPTANCE: SIGKILL the worker mid-fit -> lease expires -> a
+second worker reclaims the recorded batch and resumes from the grid
+checkpoint -> final per-request results bit-identical to an uninterrupted
+run, no request lost, none run twice.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.fleet import planner
+from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
+from redcliff_tpu.fleet.__main__ import TINY_POINTS, TINY_SPEC
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs.logging import read_jsonl
+
+
+def _submit_tiny(q, tenant, epochs=2, points=None, **kw):
+    spec = json.loads(json.dumps(TINY_SPEC))
+    spec["epochs"] = epochs
+    return q.submit(tenant, points or list(TINY_POINTS), spec=spec, **kw)
+
+
+# ---------------------------------------------------------------------------
+# queue durability units
+# ---------------------------------------------------------------------------
+def test_submit_pending_claim_complete_roundtrip(tmp_path):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "alice", priority=3)
+    assert [r["request_id"] for r in q.pending()] == [rid]
+    rec = q.pending()[0]
+    assert rec["tenant"] == "alice" and rec["priority"] == 3
+    assert rec["spec"]["model_config"]["num_chans"] == 4
+
+    lease = q.claim(rid, "w1", lease_s=30.0)
+    assert lease is not None
+    # live lease: not pending, not claimable by another worker
+    assert q.pending() == []
+    assert q.claim(rid, "w2", lease_s=30.0) is None
+
+    assert q.complete(rid, result={"ok": True}) is True
+    assert q.is_terminal(rid)
+    assert q.result(rid)["result"] == {"ok": True}
+    # never run twice: the done record is first-writer-wins and the request
+    # is no longer claimable
+    assert q.complete(rid, result={"ok": False}) is False
+    assert q.result(rid)["result"] == {"ok": True}
+    assert q.claim(rid, "w3", lease_s=30.0) is None
+    assert q.status()["counts"]["done"] == 1
+
+
+def test_release_requeues(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    lease = q.claim(rid, "w1", lease_s=30.0)
+    lease.release()
+    assert [r["request_id"] for r in q.pending()] == [rid]
+    assert q.claim(rid, "w2", lease_s=30.0) is not None
+
+
+def test_lease_expiry_reclaim_inherits_batch(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    lease = q.claim(rid, "w1", lease_s=60.0, batch_id="batch-abc",
+                    batch_request_ids=[rid])
+    assert lease is not None
+    # live: a second claim loses
+    assert q.claim(rid, "w2", lease_s=30.0) is None
+    # deterministic expiry (no load-sensitive sleep): renew with a zero
+    # lease, so the claim is expired at the very next clock read
+    lease.renew(0.0)
+    assert q.expired_claims().get("batch-abc")
+    re = q.claim(rid, "w2", lease_s=30.0)
+    assert re is not None
+    # the reclaim inherits the dead worker's batch composition so the new
+    # worker resumes the SAME run dir/checkpoint
+    assert re.data["batch_id"] == "batch-abc"
+    assert re.data["batch_request_ids"] == [rid]
+    assert re.data["reclaimed_from"]["worker"] == "w1"
+    # the original holder's renew/release must not clobber the new owner
+    with pytest.raises(LeaseLost):
+        lease.renew(30.0)
+    lease.release()
+    assert q.lease_of(rid)["worker"] == "w2"
+
+
+def test_renew_extends_expiry(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    lease = q.claim(rid, "w1", lease_s=0.2)
+    e0 = lease.data["expires_at"]
+    lease.renew(30.0)
+    assert q.lease_of(rid)["expires_at"] > e0
+    assert q.lease_of(rid)["renewals"] == 1
+
+
+def test_fail_is_terminal(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    assert q.fail(rid, "numerics_abort")
+    assert q.pending() == []
+    assert q.claim(rid, "w", lease_s=5.0) is None
+    assert q.status()["counts"]["failed"] == 1
+
+
+def test_torn_spool_line_skipped(tmp_path):
+    q = FleetQueue(tmp_path)
+    a = _submit_tiny(q, "a")
+    # a submitter SIGKILLed mid-append leaves a torn tail; readers skip it
+    with open(q.spool_path, "a") as f:
+        f.write('{"request_id": "req-torn", "tenant"')
+    b = _submit_tiny(q, "b")
+    ids = [r["request_id"] for r in q.pending()]
+    assert ids == [a, b]
+    st = q.status()
+    assert st["torn_spool_lines"] == 1
+    assert st["counts"]["submitted"] == 2
+
+
+# ---------------------------------------------------------------------------
+# admission planner units
+# ---------------------------------------------------------------------------
+def _req(i, shape, n_points, tenant="t", priority=0, deadline_s=None,
+         per_lane=None, fixed=0, epochs=10):
+    return {
+        "request_id": f"req-{i:03d}", "tenant": tenant,
+        "submitted_at": float(i), "priority": priority,
+        "deadline_s": deadline_s, "shape": shape,
+        "points": [{"gen_lr": 1e-3 * (j + 1)} for j in range(n_points)],
+        "epochs": epochs, "per_lane_bytes": per_lane, "fixed_bytes": fixed,
+        "spec": {"model_config": shape, "epochs": epochs},
+    }
+
+
+SHAPE_A = {"num_chans": 4, "num_factors": 2}
+SHAPE_B = {"num_chans": 8, "num_factors": 4}
+
+
+def test_same_shape_requests_merge_into_one_batch():
+    reqs = [_req(0, SHAPE_A, 2), _req(1, SHAPE_A, 3), _req(2, SHAPE_B, 2)]
+    pl = planner.plan(reqs, n_devices=1)
+    assert len(pl["batches"]) == 2
+    merged = next(b for b in pl["batches"] if b["n_points"] == 5)
+    assert merged["requests"] == ["req-000", "req-001"]
+    assert merged["g_bucket"] == 8  # bucket ladder, not exact width
+    assert pl["unschedulable"] == []
+
+
+def test_spec_mismatch_never_merges():
+    # same shape key but different horizons: one merged GridSpec would not
+    # mean the same math for both tenants
+    a = _req(0, SHAPE_A, 2, epochs=10)
+    b = _req(1, SHAPE_A, 2, epochs=50)
+    pl = planner.plan([a, b], n_devices=1)
+    assert len(pl["batches"]) == 2
+
+
+def test_headroom_gate_never_admits_over_budget():
+    per_lane = 1 << 30  # 1 GiB per lane
+    budget = 9 << 30    # fits an 8-bucket, not a 16-bucket
+    reqs = [_req(i, SHAPE_A, 3, per_lane=per_lane) for i in range(6)]
+    pl = planner.plan(reqs, n_devices=1, budget_bytes=budget)
+    assert pl["batches"], "planner dropped everything"
+    for b in pl["batches"]:
+        assert b["predicted_bytes"] is not None
+        assert b["predicted_bytes"] <= budget  # the acceptance contract
+    # all 18 points admitted across multiple batches
+    assert sum(b["n_points"] for b in pl["batches"]) == 18
+
+
+def test_oversized_single_request_unschedulable_not_admitted():
+    r = _req(0, SHAPE_A, 4, per_lane=4 << 30)  # 16 GiB at its own bucket
+    pl = planner.plan([r], n_devices=1, budget_bytes=8 << 30)
+    assert pl["batches"] == []
+    assert pl["unschedulable"][0]["request_id"] == "req-000"
+    assert pl["unschedulable"][0]["reason"] == "exceeds_headroom"
+
+
+def test_no_memory_hints_degrade_to_ungated():
+    pl = planner.plan([_req(0, SHAPE_A, 2)], n_devices=1,
+                      budget_bytes=1024)
+    assert len(pl["batches"]) == 1
+    assert pl["batches"][0]["predicted_bytes"] is None
+
+
+def test_priority_then_deadline_orders_batches():
+    lo = _req(0, SHAPE_A, 2, priority=0)
+    hi = _req(1, SHAPE_B, 2, priority=5)
+    dl = _req(2, {"num_chans": 16}, 2, priority=0, deadline_s=60.0)
+    pl = planner.plan([lo, hi, dl], n_devices=1)
+    order = [b["requests"][0] for b in pl["batches"]]
+    assert order == ["req-001", "req-002", "req-000"]
+
+
+def test_plan_deterministic_and_batch_id_stable():
+    reqs = [_req(i, SHAPE_A, 2) for i in range(4)]
+    p1 = planner.plan(list(reversed(reqs)), n_devices=2)
+    p2 = planner.plan(reqs, n_devices=2)
+    assert [b["batch_id"] for b in p1["batches"]] \
+        == [b["batch_id"] for b in p2["batches"]]
+    assert planner.batch_id_for(["a", "b"]) != planner.batch_id_for(["b", "a"])
+
+
+def test_packed_beats_fifo_mesh_slot_utilization():
+    # the bench probe's claim, pinned: heterogeneous small requests on an
+    # 8-device mesh — FIFO pads every micro-fit to the mesh, packing fills
+    # buckets
+    reqs = [_req(i, (SHAPE_A, SHAPE_B)[i % 2], 1 + (i * 3) % 5,
+                 per_lane=64 << 20) for i in range(12)]
+    packed = planner.plan(reqs, n_devices=8, budget_bytes=8 << 30)
+    fifo = planner.fifo_plan(reqs, n_devices=8, budget_bytes=8 << 30)
+    pu = packed["utilization"]["utilization_pct"]
+    fu = fifo["utilization"]["utilization_pct"]
+    assert pu > fu
+    assert len(packed["batches"]) < len(fifo["batches"])
+
+
+def test_fleet_sources_pass_schema_check():
+    # fleet control modules are under the no-host-sync discipline (no jax);
+    # fleet event/span literals must be registered
+    assert obs_schema.check_sources() == []
+
+
+# ---------------------------------------------------------------------------
+# worker end-to-end (supervised jax child; warm-starts from the suite cache)
+# ---------------------------------------------------------------------------
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_fault_env():
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    return env
+
+
+def _drain(root, **kw):
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    from redcliff_tpu.fleet.worker import work
+
+    policy = SupervisorPolicy(
+        max_restarts=2,
+        backoff=RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+    return work(str(root), drain=True, poll_s=0.2, lease_s=20.0,
+                supervisor_policy=policy, env=_clean_fault_env(), **kw)
+
+
+def test_worker_drains_multi_tenant_queue(tmp_path):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    ra = _submit_tiny(q, "alice")
+    rb = _submit_tiny(q, "bob")
+    n = _drain(root)
+    assert n == 1, "same-spec requests should merge into ONE batch"
+    st = q.status()
+    assert st["counts"]["done"] == 2 and st["counts"]["failed"] == 0
+    for rid in (ra, rb):
+        res = q.result(rid)["result"]
+        assert res["n_points"] == 2
+        assert len(res["best_criteria"]) == 2
+        assert all(np.isfinite(v) for v in res["best_criteria"])
+
+    # fleet-root events are schema-valid and carry the lifecycle
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    kinds = {r.get("kind") for r in recs if r.get("event") == "fleet"}
+    assert {"plan", "claim", "batch_start", "batch_end",
+            "complete"} <= kinds
+
+    # watch fleet mode: schema-valid snapshot with queue/tenant state
+    from redcliff_tpu.obs.watch import build_snapshot
+
+    snap = build_snapshot(str(root))
+    assert obs_schema.validate_record(snap) == []
+    assert snap["fleet"]["counts"]["done"] == 2
+    assert snap["fleet"]["by_tenant"]["alice"]["done"] == 1
+    assert snap["fleet"]["last_plan"]["batches"] == 1
+
+    # per-tenant report section from the batch run dir's tenant manifest
+    from redcliff_tpu.obs.report import build_report
+
+    batch_id = next(r["batch_id"] for r in recs
+                    if r.get("event") == "fleet"
+                    and r.get("kind") == "batch_end")
+    report = build_report(q.batch_dir(batch_id))
+    assert set(report["tenants"]) == {"alice", "bob"}
+    assert report["tenants"]["alice"]["points"] == 2
+    assert report["read_audit"]["schema_errors"] == []
+    assert report["read_audit"]["ledger_schema_errors"] == []
+
+
+def test_drain_exits_on_unschedulable_only_queue(tmp_path):
+    # a queue holding only requests the planner can never admit must not
+    # wedge --drain forever: nothing claimable + nothing in flight = done
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rid = _submit_tiny(q, "big", per_lane_bytes=1 << 40)
+    t0 = time.time()
+    n = _drain(root, budget_bytes=1 << 30)
+    assert n == 0
+    assert time.time() - t0 < 30.0
+    assert q.status()["counts"]["queued"] == 1  # still queued, never lost
+
+
+def test_watch_fleet_root_is_read_only(tmp_path):
+    # a watcher must not mkdir under (or crash on) the observed root
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    _submit_tiny(q, "t")
+    for d in ("leases", "done", "failed", "work"):
+        os.rmdir(root / d)
+    from redcliff_tpu.obs.watch import build_snapshot
+
+    snap = build_snapshot(str(root))
+    assert snap["fleet"]["counts"]["queued"] == 1
+    for d in ("leases", "done", "failed", "work"):
+        assert not os.path.exists(root / d), f"watch created {d}/"
+
+
+def test_worker_fleet_status_cli(tmp_path):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    _submit_tiny(q, "cli")
+    out = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "status", "--root",
+         str(root), "--json"], capture_output=True, text=True,
+        env=_clean_fault_env(), cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr
+    st = json.loads(out.stdout)
+    assert st["counts"]["queued"] == 1
+
+
+def test_sigkill_worker_lease_reclaim_resume_bit_identical(tmp_path):
+    """The crash-safety acceptance (ISSUE 10): SIGKILL the worker (and its
+    supervised fit) mid-batch -> the lease expires -> a second worker
+    reclaims the RECORDED batch and resumes from the grid checkpoint ->
+    final per-request results bit-identical to an uninterrupted run; the
+    request is neither lost nor executed twice."""
+    root_kill = tmp_path / "fleet_kill"
+    root_ref = tmp_path / "fleet_ref"
+    qk = FleetQueue(root_kill)
+    qr = FleetQueue(root_ref)
+    rid_kill = _submit_tiny(qk, "crash", epochs=4)
+    rid_ref = _submit_tiny(qr, "crash", epochs=4)
+
+    # worker 1: its own process group (so the supervised run_batch child
+    # dies with it), fault-armed to drop a marker at the end of epoch 1 —
+    # by then the epoch-1 checkpoint is durable
+    marker = str(tmp_path / "epoch1.marker")
+    env = _clean_fault_env()
+    env["REDCLIFF_FAULT_INJECT"] = "marker_after_epoch:1"
+    env["REDCLIFF_FAULT_MARKER"] = marker
+    w1 = subprocess.Popen(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "work", "--root",
+         str(root_kill), "--max-batches", "1", "--lease-s", "2",
+         "--poll-s", "0.2"],
+        env=env, start_new_session=True, cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 240
+        while not os.path.exists(marker):
+            assert time.time() < deadline, "fit never reached epoch 1"
+            assert w1.poll() is None, "worker 1 exited before the marker"
+            time.sleep(0.05)
+        os.killpg(w1.pid, signal.SIGKILL)
+    finally:
+        if w1.poll() is None:
+            os.killpg(w1.pid, signal.SIGKILL)
+        w1.wait()
+
+    # the claim is still on disk; once its 2 s lease expires the request is
+    # reclaimable — never lost
+    lease = qk.lease_of(rid_kill)
+    assert lease is not None and lease["batch_id"]
+    while time.time() < float(lease["expires_at"]):
+        time.sleep(0.05)
+    assert qk.status()["counts"]["queued"] == 1
+
+    # worker 2 (clean env): reclaims the recorded batch, resumes, completes
+    n = _drain(root_kill)
+    assert n == 1
+    assert qk.status()["counts"]["done"] == 1
+
+    # reference leg: uninterrupted run of the identical spec
+    assert _drain(root_ref) == 1
+    res_kill = qk.result(rid_kill)["result"]
+    res_ref = qr.result(rid_ref)["result"]
+    for key in ("best_criteria", "best_epoch", "val_history", "active",
+                "failures"):
+        assert res_kill[key] == res_ref[key], f"{key} diverged after resume"
+
+    # resumed, not re-run: the killed batch's run dir shows exactly one
+    # fresh fit_start and at least one resumed attempt, and only one done
+    # record exists (never run twice)
+    batch_id = lease["batch_id"]
+    recs = read_jsonl(qk.batch_dir(batch_id))
+    starts = [r for r in recs if r.get("event") == "fit_start"]
+    fresh = [r for r in starts if r.get("resumed_from_epoch") is None]
+    resumed = [r for r in starts if r.get("resumed_from_epoch") is not None]
+    assert len(fresh) == 1 and len(resumed) >= 1
+    done_dir = os.path.join(str(root_kill), "done")
+    assert os.listdir(done_dir) == [f"{rid_kill}.json"]
+    # the reclaim is audited in the fleet events
+    froot = read_jsonl(str(root_kill))
+    assert any(r.get("event") == "fleet" and r.get("kind") == "reclaim"
+               for r in froot)
