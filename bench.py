@@ -987,6 +987,71 @@ def _bench_fleet(n_devices=8, budget_bytes=8 << 30):
     }
 
 
+def _bench_fleet_containment():
+    """fleet_containment probe (ISSUE 11): healthy-sibling completion
+    latency WITH vs WITHOUT a poison co-tenant, end-to-end through two real
+    fleet drains. Both legs run at the same bucket width (3 healthy 1-point
+    requests -> width 4; +1 attributable nan-poison -> still width 4), so
+    the ratio isolates the containment machinery — attribution, dead-letter
+    routing, attempt accounting — not a program-family change. The
+    ``contained`` flag is the correctness contract: 3 done, 1 dead-lettered,
+    0 failed."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.fleet.__main__ import TINY_SPEC
+    from redcliff_tpu.fleet.chaos import poison_point
+    from redcliff_tpu.fleet.queue import FleetQueue
+    from redcliff_tpu.fleet.worker import work
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+
+    def drain(root, poison):
+        q = FleetQueue(root)
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = 1
+        for i in range(3):
+            q.submit(f"bench-h{i}", [{"gen_lr": 1e-3 * (i + 1)}], spec=spec)
+        if poison is not None:
+            q.submit("bench-poison", [poison], spec=spec)
+        policy = SupervisorPolicy(
+            max_restarts=1,
+            backoff=RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                                multiplier=1.0, max_delay_s=0.05))
+        t0 = time.perf_counter()
+        work(str(root), drain=True, poll_s=0.1, lease_s=60.0,
+             supervisor_policy=policy, env=env, max_attempts=2)
+        return time.perf_counter() - t0, q.status()["counts"]
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_containment_")
+    try:
+        healthy_wall, hc = drain(os.path.join(tmp, "healthy"), None)
+        poisoned_wall, pc = drain(os.path.join(tmp, "poisoned"),
+                                  poison_point("nan"))
+        # a broken BASELINE leg (e.g. requests dead-lettered by a
+        # durability bug) would make latency_ratio garbage, so the
+        # correctness flag covers both legs
+        baseline_ok = (hc["done"] == 3 and hc["failed"] == 0
+                       and hc["deadletter"] == 0)
+        return {
+            "healthy_wall_s": round(healthy_wall, 3),
+            "poisoned_wall_s": round(poisoned_wall, 3),
+            "latency_ratio": (round(poisoned_wall / healthy_wall, 3)
+                              if healthy_wall and baseline_ok else None),
+            "healthy_done": pc["done"],
+            "deadlettered": pc["deadletter"],
+            "contained": (baseline_ok and pc["done"] == 3
+                          and pc["deadletter"] == 1
+                          and pc["failed"] == 0),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_trace_export(n_records=2000):
     """trace_export probe: span -> Perfetto round-trip cost
     (obs/trace_export.py) on a synthetic but schema-shaped run dir —
@@ -1198,6 +1263,14 @@ def _measure(platform):
     except Exception as e:  # never fail the bench over the fleet probe
         fleet_probe = {"error": f"{type(e).__name__}: {e}"}
 
+    # fleet failure containment: healthy-sibling latency with vs without a
+    # poison co-tenant (two real drains, same bucket width)
+    try:
+        fleet_containment = _bench_fleet_containment()
+    except Exception as e:  # never fail the bench over the containment probe
+        fleet_containment = {"error": f"{type(e).__name__}: {e}",
+                             "latency_ratio": None}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1230,6 +1303,7 @@ def _measure(platform):
         "mem_model": mem_model,
         "trace_export": trace_export,
         "fleet": fleet_probe,
+        "fleet_containment": fleet_containment,
         "error": None,
     })
 

@@ -24,9 +24,22 @@ applied to sweep serving:
   and metrics events, and marks requests complete from the batch's
   per-request results;
 * :mod:`.run_batch` — the jax-side batch driver the worker supervises: one
-  merged grid fit per batch (checkpointed + resumable), split back into
-  per-request result records;
-* CLI — ``python -m redcliff_tpu.fleet submit|work|status``.
+  merged grid fit per batch (checkpointed + resumable, content-derived
+  per-lane seeds so a request fits identically whatever batch it lands
+  in), split back into per-request result records plus the merged-grid
+  ``failures.json`` attribution artifact;
+* :mod:`.chaos` — the fleet chaos harness (ISSUE 11): poison request
+  specs, worker SIGKILL storms, lease-expiry races, torn/corrupt durable
+  state — seeded schedules for the containment soak;
+* CLI — ``python -m redcliff_tpu.fleet submit|work|status|cancel|requeue``.
+
+Blast-radius containment (docs/ARCHITECTURE.md "Fleet failure
+containment"): per-request retry budgets persisted in ``attempts/``,
+poison attribution from the grid engine's per-lane quarantine causes,
+blind-failure batch bisection over pinned compositions, suspect-solo
+admission planning, and a durable ``deadletter/`` with failure dossiers —
+so one poison tenant can never fail a healthy co-tenant's request or
+crash-loop a worker fleet forever.
 
 Import discipline: ``queue``/``planner``/``worker`` are under the
 observability no-host-sync discipline (obs/schema.py ``--check``): no jax

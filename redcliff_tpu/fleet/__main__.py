@@ -1,12 +1,21 @@
-"""``python -m redcliff_tpu.fleet {submit,work,status}`` — fleet CLI.
+"""``python -m redcliff_tpu.fleet {submit,work,status,cancel,requeue}``.
 
 submit — append fit requests to a fleet root's durable queue
     (fleet/queue.py). ``--tiny`` uses the built-in canonical tiny spec
     (the fault-injection harness's small deterministic fit) — the smoke /
     CI path; real sweeps pass ``--spec-file`` + ``--points``.
 work — run the worker loop (fleet/worker.py): reclaim expired claims,
-    plan admission (fleet/planner.py), supervise batches, settle results.
+    run pinned bisection halves, plan admission (fleet/planner.py),
+    supervise batches, settle results under the containment discipline
+    (``--max-attempts`` is the per-request retry budget).
 status — queue-wide and per-tenant counts (``--json`` for scripts).
+cancel — first-writer-wins ``canceled`` terminal record: the request is
+    never re-planned, a running worker's settle stands down, and no lease
+    is orphaned (tombstone-reclaim path, docs/ARCHITECTURE.md "Fleet
+    failure containment").
+requeue — resurrect a dead-lettered request with a fresh retry budget
+    (its dossier is archived; the planner treats it as a solo suspect
+    until it proves clean).
 
 The CLI (like the queue/planner/worker) never initializes a jax backend;
 only the supervised ``run_batch`` child does.
@@ -100,9 +109,42 @@ def _cmd_work(args):
              drain=args.drain, once=args.once, n_devices=args.n_devices,
              budget_bytes=args.budget_bytes, max_bucket=args.max_bucket,
              checkpoint_every=args.checkpoint_every,
-             supervisor_policy=policy)
+             supervisor_policy=policy, max_attempts=args.max_attempts)
     print(f"fleet work: ran {n} batch(es)", file=sys.stderr)
     return 0
+
+
+def _cmd_cancel(args):
+    from redcliff_tpu.fleet.queue import FleetQueue
+    from redcliff_tpu.obs.logging import MetricLogger
+
+    q = FleetQueue(args.root)
+    if q.cancel(args.request_id, reason=args.reason):
+        with MetricLogger(args.root) as log:
+            log.log("fleet", kind="cancel", requests=[args.request_id],
+                    reason=args.reason)
+        print(f"canceled {args.request_id}")
+        return 0
+    state = q.terminal_state(args.request_id)
+    print(f"fleet cancel: {args.request_id} not canceled "
+          + (f"(already terminal: {state})" if state
+             else "(unknown request id)"), file=sys.stderr)
+    return 1
+
+
+def _cmd_requeue(args):
+    from redcliff_tpu.fleet.queue import FleetQueue
+    from redcliff_tpu.obs.logging import MetricLogger
+
+    q = FleetQueue(args.root)
+    if q.requeue(args.request_id):
+        with MetricLogger(args.root) as log:
+            log.log("fleet", kind="requeue", requests=[args.request_id])
+        print(f"requeued {args.request_id} (fresh retry budget)")
+        return 0
+    print(f"fleet requeue: {args.request_id} has no dead-letter record "
+          f"to resurrect", file=sys.stderr)
+    return 1
 
 
 def _cmd_status(args):
@@ -125,7 +167,8 @@ def _cmd_status(args):
     print(f"fleet: {st['root']}")
     print(f"  {c['submitted']} submitted | {c['queued']} queued | "
           f"{c['running']} running | {c['done']} done | "
-          f"{c['failed']} failed"
+          f"{c['failed']} failed | {c['deadletter']} dead-lettered | "
+          f"{c['canceled']} canceled"
           + (f" | {c['expired_claims']} expired claim(s)"
              if c["expired_claims"] else "")
           + (f" | {st['torn_spool_lines']} torn spool line(s)"
@@ -133,7 +176,8 @@ def _cmd_status(args):
     for tenant, t in sorted(st["by_tenant"].items()):
         print(f"  tenant {tenant}: {t['submitted']} submitted, "
               f"{t['queued']} queued, {t['running']} running, "
-              f"{t['done']} done, {t['failed']} failed")
+              f"{t['done']} done, {t['failed']} failed, "
+              f"{t['deadletter']} dead-lettered, {t['canceled']} canceled")
     return 0
 
 
@@ -187,12 +231,30 @@ def main(argv=None):
     wp.add_argument("--max-restarts", type=int, default=2)
     wp.add_argument("--base-delay-s", type=float, default=0.5)
     wp.add_argument("--max-delay-s", type=float, default=30.0)
+    wp.add_argument("--max-attempts", type=int, default=3,
+                    help="per-request retry budget: failure attempts before "
+                         "a request is dead-lettered (fleet/worker.py)")
     wp.set_defaults(fn=_cmd_work)
 
     st = sub.add_parser("status", help="queue + per-tenant counts")
     st.add_argument("--root", required=True)
     st.add_argument("--json", action="store_true")
     st.set_defaults(fn=_cmd_status)
+
+    cp = sub.add_parser("cancel",
+                        help="terminal 'canceled' record (first writer "
+                             "wins; never re-planned, no orphaned lease)")
+    cp.add_argument("request_id")
+    cp.add_argument("--root", required=True)
+    cp.add_argument("--reason", default=None)
+    cp.set_defaults(fn=_cmd_cancel)
+
+    rq = sub.add_parser("requeue",
+                        help="resurrect a dead-lettered request with a "
+                             "fresh retry budget (dossier archived)")
+    rq.add_argument("request_id")
+    rq.add_argument("--root", required=True)
+    rq.set_defaults(fn=_cmd_requeue)
 
     args = ap.parse_args(argv)
     return args.fn(args)

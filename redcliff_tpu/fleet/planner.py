@@ -105,7 +105,8 @@ def _order_key(request):
             str(request.get("request_id")))
 
 
-def _batch_view(members, n_devices, cost_model=None, platform=None):
+def _batch_view(members, n_devices, cost_model=None, platform=None,
+                suspect=False):
     n_points = sum(len(r.get("points") or ()) for r in members)
     width = compaction.bucket_width(n_points, n_devices)
     ids = [r["request_id"] for r in members]
@@ -144,6 +145,10 @@ def _batch_view(members, n_devices, cost_model=None, platform=None):
         "predicted_bytes": predicted_batch_bytes(members, width),
         "eta_s": (round(eta_s, 3) if isinstance(eta_s, (int, float))
                   else None),
+        # containment circuit breaker: this batch was planned SOLO because
+        # its request has prior failed attempts (never merged with healthy
+        # tenants until it proves clean)
+        "suspect": bool(suspect),
     }
 
 
@@ -157,7 +162,7 @@ def _batch_order_key(batch):
 
 
 def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
-         platform=None, max_bucket=DEFAULT_MAX_BUCKET):
+         platform=None, max_bucket=DEFAULT_MAX_BUCKET, suspects=None):
     """Pack ``requests`` (queue records) into admitted batches.
 
     Returns ``{"batches": [...], "unschedulable": [...], "queue_depth",
@@ -165,8 +170,15 @@ def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
     ``predicted_bytes is None or predicted_bytes <= budget_bytes`` (when a
     budget is known); requests that cannot fit even alone at their smallest
     bucket are listed under ``unschedulable`` with a reason instead of
-    being silently admitted."""
+    being silently admitted.
+
+    ``suspects`` (request-id set): containment circuit breaker — a request
+    with prior failed attempts is planned into a SOLO batch, never merged
+    with healthy tenants, until it proves clean. One poison tenant can then
+    cost at most its own solo fits, not a merged batch's blast radius (the
+    ~3x-utilization merge path stays open to everyone else)."""
     t0 = time.perf_counter()
+    suspects = frozenset(suspects or ())
     ordered = sorted(requests, key=_order_key)
     groups = {}
     for r in ordered:
@@ -181,6 +193,24 @@ def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
             if r_points == 0:
                 unschedulable.append({"request_id": r["request_id"],
                                       "reason": "no_points"})
+                continue
+            if r["request_id"] in suspects:
+                solo_width = compaction.bucket_width(r_points, n_devices)
+                solo_bytes = predicted_batch_bytes([r], solo_width)
+                if (budget_bytes is not None and solo_bytes is not None
+                        and solo_bytes > budget_bytes) \
+                        or solo_width > int(max_bucket):
+                    unschedulable.append({
+                        "request_id": r["request_id"],
+                        "reason": ("exceeds_headroom"
+                                   if solo_width <= int(max_bucket)
+                                   else "exceeds_max_bucket"),
+                        "predicted_bytes": solo_bytes,
+                        "budget_bytes": budget_bytes,
+                        "g_bucket": solo_width})
+                    continue
+                batches.append(_batch_view([r], n_devices, cost_model,
+                                           platform, suspect=True))
                 continue
             cand_points = n_points + r_points
             cand_width = compaction.bucket_width(cand_points, n_devices)

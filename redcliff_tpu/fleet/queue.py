@@ -14,6 +14,25 @@ no locks held across crashes::
                                  first writer wins — the never-run-twice
                                  half of the contract)
     <root>/failed/<id>.json      terminal failure record (same discipline)
+    <root>/deadletter/<id>.json  terminal containment record: the request
+                                 exhausted its retry budget or was attributed
+                                 as the poison member of a merged batch; the
+                                 record carries a failure DOSSIER (attempts,
+                                 classifications, run dirs, flight-record
+                                 paths) so an operator can judge it without
+                                 spelunking run dirs. ``requeue`` resurrects
+                                 it with a fresh budget (dossier archived)
+    <root>/canceled/<id>.json    terminal cancellation record (first writer
+                                 wins; a canceled leased request is never
+                                 re-planned and never orphans its lease)
+    <root>/attempts/<id>.json    durable per-request attempt ledger: failure
+                                 attempt count + reclaim count + a bounded
+                                 classification history — the retry-budget
+                                 state every release/reclaim updates
+    <root>/pinned/<batch_id>.json  pinned batch composition (ordered request
+                                 ids): work a bisecting worker requeued as
+                                 exact halves — claimed AS THAT COMPOSITION,
+                                 bypassing the admission planner
     <root>/work/<batch_id>/      batch run directories (worker-owned:
                                  grid checkpoints, metrics, ledger, results)
 
@@ -47,13 +66,26 @@ import socket
 import time
 import uuid
 
-__all__ = ["FleetQueue", "Lease", "LeaseLost", "SPOOL_NAME"]
+__all__ = ["FleetQueue", "Lease", "LeaseLost", "SPOOL_NAME",
+           "TERMINAL_STATES"]
 
 SPOOL_NAME = "requests.jsonl"
 _LEASES = "leases"
 _DONE = "done"
 _FAILED = "failed"
+_DEADLETTER = "deadletter"
+_CANCELED = "canceled"
+_ATTEMPTS = "attempts"
+_PINNED = "pinned"
 _WORK = "work"
+
+# every request ends in EXACTLY one of these (the containment invariant
+# tests/test_fleet_containment.py pins under the chaos soak)
+TERMINAL_STATES = ("done", "failed", "deadletter", "canceled")
+
+# bounded attempt history: enough to read a crash-loop's shape from the
+# dossier without letting a pathological requeue loop grow the file forever
+_MAX_HISTORY = 20
 
 
 class LeaseLost(RuntimeError):
@@ -150,7 +182,8 @@ class FleetQueue:
         self.root = str(root)
         if create:
             os.makedirs(self.root, exist_ok=True)
-            for d in (_LEASES, _DONE, _FAILED, _WORK):
+            for d in (_LEASES, _DONE, _FAILED, _DEADLETTER, _CANCELED,
+                      _ATTEMPTS, _PINNED, _WORK):
                 os.makedirs(os.path.join(self.root, d), exist_ok=True)
         self.spool_path = os.path.join(self.root, SPOOL_NAME)
 
@@ -165,6 +198,18 @@ class FleetQueue:
 
     def _failed_path(self, request_id):
         return os.path.join(self.root, _FAILED, f"{request_id}.json")
+
+    def _deadletter_path(self, request_id):
+        return os.path.join(self.root, _DEADLETTER, f"{request_id}.json")
+
+    def _canceled_path(self, request_id):
+        return os.path.join(self.root, _CANCELED, f"{request_id}.json")
+
+    def _attempts_path(self, request_id):
+        return os.path.join(self.root, _ATTEMPTS, f"{request_id}.json")
+
+    def _pin_path(self, batch_id):
+        return os.path.join(self.root, _PINNED, f"{batch_id}.json")
 
     def batch_dir(self, batch_id):
         return os.path.join(self.root, _WORK, str(batch_id))
@@ -264,9 +309,37 @@ class FleetQueue:
         """The current lease record (live or expired), or None."""
         return _read_json(self._lease_path(request_id))
 
+    def terminal_state(self, request_id):
+        """Which terminal record exists — one of :data:`TERMINAL_STATES` —
+        or None while the request is still live. Checked in a fixed order so
+        racing writers (e.g. cancel vs complete) always read ONE winner."""
+        for state, path_of in (("done", self._done_path),
+                               ("failed", self._failed_path),
+                               ("deadletter", self._deadletter_path),
+                               ("canceled", self._canceled_path)):
+            if os.path.exists(path_of(request_id)):
+                return state
+        return None
+
+    def terminal_ids(self):
+        """``{state: set(request_ids)}`` in ONE listdir per state — the
+        batch view the whole-queue scans (status/pending) use instead of
+        4 stat calls per request (the watch CLI re-runs status every
+        tick)."""
+        dirs = {"done": _DONE, "failed": _FAILED,
+                "deadletter": _DEADLETTER, "canceled": _CANCELED}
+        out = {}
+        for state in TERMINAL_STATES:
+            try:
+                names = os.listdir(os.path.join(self.root, dirs[state]))
+            except OSError:
+                names = []
+            out[state] = {n[:-len(".json")] for n in names
+                          if n.endswith(".json")}
+        return out
+
     def is_terminal(self, request_id):
-        return (os.path.exists(self._done_path(request_id))
-                or os.path.exists(self._failed_path(request_id)))
+        return self.terminal_state(request_id) is not None
 
     def claim(self, request_id, worker, lease_s, batch_id=None,
               batch_request_ids=None, tenant=None, now=None):
@@ -328,38 +401,240 @@ class FleetQueue:
     # ------------------------------------------------------------------
     # terminal records
     # ------------------------------------------------------------------
+    def _settle(self, request_id, state, rec):
+        """Write one terminal record (first writer wins within a state) and
+        drop any lease file so a settled request never orphans its claim.
+
+        Cross-STATE exclusivity (a request terminal in exactly ONE of
+        done/failed/deadletter/canceled) cannot ride the pre-write
+        ``is_terminal`` check alone: two racers aiming at DIFFERENT states
+        (cancel vs complete) can both pass it. So after a successful write
+        each writer re-scans in the fixed :data:`TERMINAL_STATES` priority
+        order and CONVERGES: it deletes any lower-priority record its own
+        outranks, and deletes its own (returning False) when a
+        higher-priority record exists. Whichever write lands last sees the
+        other's record, so every interleaving ends with exactly the
+        highest-priority state on disk (done > failed > deadletter >
+        canceled: finished work outranks a racing cancel)."""
+        paths = {"done": self._done_path, "failed": self._failed_path,
+                 "deadletter": self._deadletter_path,
+                 "canceled": self._canceled_path}
+        path = paths[state](request_id)
+        wrote = (not self.is_terminal(request_id)
+                 and _write_json_atomic(path, rec, overwrite=False))
+        if wrote:
+            idx = TERMINAL_STATES.index(state)
+            if any(os.path.exists(paths[s](request_id))
+                   for s in TERMINAL_STATES[:idx]):
+                # a higher-priority racer landed between our check and our
+                # write: defer to it
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                wrote = False
+            else:
+                for s in TERMINAL_STATES[idx + 1:]:
+                    try:
+                        os.unlink(paths[s](request_id))
+                    except OSError:
+                        pass
+        try:
+            os.unlink(self._lease_path(request_id))
+        except OSError:
+            pass
+        return wrote
+
     def complete(self, request_id, result=None, now=None):
         """Record the request as done (atomic; FIRST writer wins — the
         never-run-twice half of the durability contract) and drop any lease
         file. Returns True when this call wrote the record."""
         now = time.time() if now is None else now
-        rec = {"request_id": request_id, "completed_at": now,
-               "result": result}
-        wrote = _write_json_atomic(self._done_path(request_id), rec,
-                                   overwrite=False)
-        try:
-            os.unlink(self._lease_path(request_id))
-        except OSError:
-            pass
-        return wrote
+        return self._settle(request_id, "done",
+                            {"request_id": request_id, "completed_at": now,
+                             "result": result})
 
     def fail(self, request_id, reason, now=None):
         """Record a terminal failure (deterministic classifications the
-        supervisor will not restart: numerics_abort, deadline, giving_up)."""
+        supervisor will not restart: numerics_abort, deadline,
+        mesh_exhausted)."""
         now = time.time() if now is None else now
-        rec = {"request_id": request_id, "failed_at": now,
-               "reason": str(reason)}
-        wrote = _write_json_atomic(self._failed_path(request_id), rec,
-                                   overwrite=False)
+        return self._settle(request_id, "failed",
+                            {"request_id": request_id, "failed_at": now,
+                             "reason": str(reason)})
+
+    def deadletter(self, request_id, dossier=None, now=None):
+        """Route the request to the durable dead-letter directory instead of
+        re-planning it (retry budget exhausted, or attributed as the poison
+        member of a merged batch). ``dossier`` is the failure dossier the
+        worker assembled: attempts, classifications, run dirs, flight-record
+        paths, quarantine causes."""
+        now = time.time() if now is None else now
+        return self._settle(request_id, "deadletter",
+                            {"request_id": request_id,
+                             "deadlettered_at": now,
+                             "dossier": dossier})
+
+    def cancel(self, request_id, reason=None, now=None):
+        """Cancel a request: first-writer-wins ``canceled`` terminal record
+        riding the same settle discipline as complete/fail. A canceled
+        request is never claimable or re-plannable again; if a worker is
+        mid-batch on it, the worker's own settle finds the terminal record
+        and skips publishing (its lease is unlinked here and by the settle).
+        Returns True when this call canceled it (False: already terminal)."""
+        now = time.time() if now is None else now
+        known = {r["request_id"] for r in self.requests()}
+        if request_id not in known:
+            return False
+        return self._settle(request_id, "canceled",
+                            {"request_id": request_id, "canceled_at": now,
+                             "reason": (str(reason) if reason is not None
+                                        else None)})
+
+    def requeue(self, request_id, now=None):
+        """Resurrect a dead-letter request with a FRESH retry budget: the
+        dead-letter record is archived beside itself (audit trail, no longer
+        terminal) and the attempt ledger reset, so the request is pending
+        again and plannable — but SOLO: the fresh ledger carries a
+        ``suspect`` marker so the planner keeps quarantining it away from
+        healthy tenants until it proves clean (a zeroed budget alone would
+        let a known-poison request re-merge). Returns True when resurrected
+        (False: no dead-letter record to resurrect)."""
+        now = time.time() if now is None else now
+        path = self._deadletter_path(request_id)
+        # archive name does not end in .json, so terminal scans skip it
+        archive = f"{path}.requeued.{int(now)}.{uuid.uuid4().hex[:6]}"
         try:
-            os.unlink(self._lease_path(request_id))
+            os.rename(path, archive)
         except OSError:
-            pass
-        return wrote
+            return False  # no dossier (or a racing requeue won)
+        _write_json_atomic(self._attempts_path(request_id), {
+            "request_id": request_id, "attempts": 0, "reclaims": 0,
+            "last": None, "history": [], "suspect": True,
+            "requeued_at": now})
+        return True
 
     def result(self, request_id):
         """The done record, or None."""
         return _read_json(self._done_path(request_id))
+
+    def deadletter_record(self, request_id):
+        """The dead-letter record (with its dossier), or None."""
+        return _read_json(self._deadletter_path(request_id))
+
+    def deadletters(self):
+        """Every dead-letter record, sorted by request id — the containment
+        view obs watch/report render."""
+        d = os.path.join(self.root, _DEADLETTER)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json"):
+                continue  # .requeued archives, .tmp droppings
+            rec = _read_json(os.path.join(d, name))
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    # ------------------------------------------------------------------
+    # per-request attempt ledger (the retry-budget state)
+    # ------------------------------------------------------------------
+    def attempt_record(self, request_id):
+        """The durable attempt ledger for one request, or None (never
+        failed/reclaimed). ``{"attempts", "reclaims", "last", "history"}``:
+        ``attempts`` counts FAILURE attempts (what the retry budget bounds),
+        ``reclaims`` counts lease-expiry reclaims (recorded for the dossier;
+        infra faults like a worker SIGKILL storm must not eat a healthy
+        tenant's budget)."""
+        return _read_json(self._attempts_path(request_id))
+
+    def record_attempt(self, request_id, classification, batch_id=None,
+                       run_dir=None, kind="failure", now=None):
+        """Append one attempt to the request's durable ledger and return the
+        updated record. ``kind="failure"`` increments the budgeted attempt
+        count; ``kind="reclaim"`` increments the reclaim count only. Last
+        writer wins on a racing update (atomic tmp+rename): attempt counts
+        are containment accounting, not the exactly-once surface — that is
+        the terminal records'."""
+        now = time.time() if now is None else now
+        rec = self.attempt_record(request_id) or {
+            "request_id": request_id, "attempts": 0, "reclaims": 0,
+            "last": None, "history": []}
+        entry = {"at": now, "kind": kind,
+                 "classification": str(classification),
+                 "batch_id": batch_id, "run_dir": run_dir}
+        if kind == "failure":
+            rec["attempts"] = int(rec.get("attempts") or 0) + 1
+        else:
+            rec["reclaims"] = int(rec.get("reclaims") or 0) + 1
+        rec["last"] = entry
+        rec["history"] = (list(rec.get("history") or [])
+                          + [entry])[-_MAX_HISTORY:]
+        _write_json_atomic(self._attempts_path(request_id), rec)
+        return rec
+
+    def attempt_records(self):
+        """Every request's attempt ledger, sorted by request id — the
+        per-request attempt-count view obs watch/report render."""
+        d = os.path.join(self.root, _ATTEMPTS)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            rec = _read_json(os.path.join(d, name))
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    def reset_attempts(self, request_id):
+        try:
+            os.unlink(self._attempts_path(request_id))
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    # pinned batches (bisection halves: exact compositions, planner-bypass)
+    # ------------------------------------------------------------------
+    def pin_batch(self, batch_id, request_ids, parent_batch_id=None,
+                  now=None):
+        """Durably pin an exact batch composition for the next claiming
+        worker (the bisection requeue path: halves must run AS HALVES, not
+        be re-merged by the admission planner)."""
+        now = time.time() if now is None else now
+        _write_json_atomic(self._pin_path(batch_id), {
+            "batch_id": batch_id, "requests": list(request_ids),
+            "parent_batch_id": parent_batch_id, "pinned_at": now})
+
+    def unpin_batch(self, batch_id):
+        try:
+            os.unlink(self._pin_path(batch_id))
+        except OSError:
+            pass
+
+    def pinned_batches(self):
+        """Every pinned composition, sorted by batch id (deterministic claim
+        order across workers)."""
+        d = os.path.join(self.root, _PINNED)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if not name.endswith(".json") or ".tmp." in name:
+                continue
+            rec = _read_json(os.path.join(d, name))
+            if rec is not None and rec.get("batch_id") \
+                    and rec.get("requests"):
+                out.append(rec)
+        return out
 
     # ------------------------------------------------------------------
     # queue views
@@ -369,9 +644,10 @@ class FleetQueue:
         lease), in submission order — the planner's input."""
         now = time.time() if now is None else now
         out = []
+        terminal = set().union(*self.terminal_ids().values())
         for rec in self.requests():
             rid = rec["request_id"]
-            if self.is_terminal(rid):
+            if rid in terminal:
                 continue
             if not include_leased:
                 lease = self.lease_of(rid)
@@ -416,9 +692,21 @@ class FleetQueue:
         groups = {}
         for lease in self._scan_leases():
             rid = lease.get("request_id")
-            if not rid or self.is_terminal(rid):
+            if not rid:
                 continue
-            if float(lease.get("expires_at") or 0.0) > now:
+            expired = float(lease.get("expires_at") or 0.0) <= now
+            if self.is_terminal(rid):
+                if expired:
+                    # GC: the claimant died AFTER the request went terminal
+                    # (e.g. canceled out from under a dead worker) — the
+                    # stale lease would otherwise sit forever ("never
+                    # orphans a lease")
+                    try:
+                        os.unlink(self._lease_path(rid))
+                    except OSError:
+                        pass
+                continue
+            if not expired:
                 continue
             groups.setdefault(lease.get("batch_id"), []).append(lease)
         return groups
@@ -430,26 +718,26 @@ class FleetQueue:
         now = time.time() if now is None else now
         stats = {}
         reqs = self.requests(stats=stats)
+        terminal = self.terminal_ids()
         by_tenant = {}
         counts = {"submitted": len(reqs), "queued": 0, "running": 0,
-                  "done": 0, "failed": 0, "expired_claims": 0}
+                  "done": 0, "failed": 0, "deadletter": 0, "canceled": 0,
+                  "expired_claims": 0}
 
         def tbucket(tenant):
             return by_tenant.setdefault(str(tenant), {
                 "submitted": 0, "queued": 0, "running": 0, "done": 0,
-                "failed": 0})
+                "failed": 0, "deadletter": 0, "canceled": 0})
 
         for rec in reqs:
             rid = rec["request_id"]
             t = tbucket(rec.get("tenant"))
             t["submitted"] += 1
-            if os.path.exists(self._done_path(rid)):
-                counts["done"] += 1
-                t["done"] += 1
-                continue
-            if os.path.exists(self._failed_path(rid)):
-                counts["failed"] += 1
-                t["failed"] += 1
+            state = next((s for s in TERMINAL_STATES
+                          if rid in terminal[s]), None)
+            if state is not None:
+                counts[state] += 1
+                t[state] += 1
                 continue
             lease = self.lease_of(rid)
             if lease is not None \

@@ -18,7 +18,20 @@ member requests in claim order; this driver:
 4. splits the :class:`~redcliff_tpu.parallel.grid.GridResult` back into
    per-request ``results/<request_id>.json`` records (criteria, epochs,
    val history slice, quarantine causes — strict JSON, no params: the
-   checkpoint owns the heavy artifacts).
+   checkpoint owns the heavy artifacts), and writes the merged-grid
+   ``failures.json`` (every quarantined point with its owning request and
+   tenant) — the worker's poison-attribution artifact.
+
+Containment plumbing (docs/ARCHITECTURE.md "Fleet failure containment"):
+every lane's init key derives from a CONTENT hash of its own point
+(``GridSpec.lane_seeds``), never from its position or the grid width — so a
+request fits identically whatever batch the planner (or a bisection) lands
+it in, which is what makes bisected survivors bit-identical to an
+uninterrupted merged run. ``__chaos__`` sentinel keys in points (the fleet
+chaos harness's poison request specs, fleet/chaos.py) are always STRIPPED
+before the fit and only ACTED on when the fault grammar arms
+``fleet_poison`` — an unarmed replay of a chaos spool completes instead of
+crash-looping.
 
 Exit codes follow the watchdog taxonomy (runtime/watchdog.py) exactly like
 the faultinject child: preempted 17, deadline 20, host-lost 21 — so the
@@ -29,11 +42,12 @@ planner, and worker stay backend-free by design.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import sys
 
-__all__ = ["run_batch_file", "main"]
+__all__ = ["run_batch_file", "main", "lane_seed"]
 
 # spec keys every member of a batch must agree on, byte-for-byte after
 # canonical JSON: one merged GridSpec must mean the same math for everyone
@@ -49,6 +63,15 @@ def _tupled(d):
     """JSON round-trips tuples as lists; model/train config dataclasses
     expect tuples for the size fields."""
     return {k: tuple(v) if isinstance(v, list) else v for k, v in d.items()}
+
+
+def lane_seed(point):
+    """Composition-independent lane seed: a stable hash of the point's own
+    (chaos-stripped) content. Two submissions of the same point — in any
+    batch, any position, any leg of a chaos test — init identically."""
+    blob = json.dumps(point, sort_keys=True)
+    return int(hashlib.sha1(blob.encode("utf-8")).hexdigest()[:8], 16) \
+        & 0x7FFFFFFF
 
 
 def _build_dataset(data_spec, cfg):
@@ -116,14 +139,24 @@ def run_batch_file(batch_file):
     tc = RedcliffTrainConfig(**_tupled(tc_kwargs))
     train_ds, val_ds = _build_dataset(spec0.get("data"), model.config)
 
+    from redcliff_tpu.fleet import chaos as _chaos
+    from redcliff_tpu.runtime import faultinject as _fi
+
     merged, manifest, start = [], [], 0
+    chaos_specs = []
     for r in requests:
-        pts = list(r.get("points") or ())
+        pts = [_chaos.strip_chaos(p, chaos_specs) for p in
+               (r.get("points") or ())]
         merged.extend(pts)
         manifest.append({"request_id": r["request_id"],
                          "tenant": str(r.get("tenant")),
                          "start": start, "stop": start + len(pts)})
         start += len(pts)
+    if chaos_specs and _fi.fleet_poison_armed():
+        # a poison request spec (fleet chaos harness): die the way the
+        # sentinel says, BEFORE any fit — the blind-failure mode the
+        # worker's bisection must corner without attribution
+        _chaos.detonate(chaos_specs[0])
 
     mesh = None
     if spec0.get("mesh") == "auto":
@@ -140,8 +173,11 @@ def run_batch_file(batch_file):
                 tenants=sorted({m["tenant"] for m in manifest}),
                 n_points=len(merged))
 
-    runner = RedcliffGridRunner(model, tc, GridSpec(points=merged),
-                                mesh=mesh)
+    runner = RedcliffGridRunner(
+        model, tc,
+        GridSpec(points=merged,
+                 lane_seeds=[lane_seed(p) for p in merged]),
+        mesh=mesh)
     result = runner.fit(jax.random.PRNGKey(tc.seed), train_ds, val_ds,
                         checkpoint_dir=run_dir,
                         checkpoint_every=int(batch.get("checkpoint_every")
@@ -154,6 +190,27 @@ def run_batch_file(batch_file):
     results_dir = os.path.join(run_dir, "results")
     os.makedirs(results_dir, exist_ok=True)
     val_hist = np.asarray(result.val_history)
+
+    # merged-grid failures.json (train/driver.py's artifact, with per-point
+    # request/tenant attribution): the worker's poison-attribution input
+    # and the dead-letter dossier's quarantine evidence
+    def _owner(point):
+        return next((m for m in manifest
+                     if m["start"] <= point < m["stop"]), None)
+
+    attributed = []
+    for f in result.failures:
+        own = _owner(int(f["point"])) or {}
+        attributed.append(dict(f, request_id=own.get("request_id"),
+                               tenant=own.get("tenant")))
+    tmp = os.path.join(run_dir, f".failures.json.tmp.{os.getpid()}")
+    with open(tmp, "w") as fh:
+        json.dump({"batch_id": batch.get("batch_id"),
+                   "grid_size": len(merged),
+                   "failures": jsonable(attributed)}, fh, allow_nan=False)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, os.path.join(run_dir, "failures.json"))
     for row in manifest:
         lo, hi = row["start"], row["stop"]
         failures = [dict(f, point=int(f["point"]) - lo,

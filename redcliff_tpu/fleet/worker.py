@@ -23,11 +23,25 @@ every planner/claim/batch transition lands as a schema-registered ``fleet``
 event in the FLEET ROOT's ``metrics.jsonl`` (what ``obs watch <root>``
 tails in fleet mode).
 
-Completion discipline: only a ``clean`` supervised outcome marks requests
-done (first ``done/<id>.json`` writer wins — never run twice);
-deterministic-failure classes (``numerics_abort``/``deadline``/
-``giving_up``/``mesh_exhausted``) mark them failed; anything else releases
-the leases so another worker retries.
+Settle discipline (blast-radius containment, docs/ARCHITECTURE.md "Fleet
+failure containment"): a ``clean`` supervised outcome marks requests done
+(first ``done/<id>.json`` writer wins — never run twice) — except a member
+whose per-request artifact is missing (routed through the retry budget) or
+whose EVERY point the grid engine quarantined for a deterministic-numerics
+cause (the attribution path: the poison tenant is dead-lettered with its
+quarantine causes while healthy co-tenants still complete; wall-clock
+``deadline`` evictions never attribute). A terminal failure of a MERGED
+batch is never blamed on its members: with 2+ live leases the batch is
+split in half and the halves requeued as pinned compositions, so repeated
+halving deterministically corners a poison request while its siblings
+finish; with <=1 live lease (the rest lost or terminal) the survivor —
+possibly a healthy co-tenant — is budget-routed, never verdicted. Only a
+terminal failure of a genuinely SOLO composition is charged as that
+request's own: deterministic classes fail it outright, a crash/hang loop
+(``giving_up``) releases it against its durable retry budget (queue
+``attempts/``) until the budget is spent, then routes it to ``deadletter/``
+with a failure dossier. Anything non-terminal releases the leases so
+another worker retries.
 
 stdlib-only imports at module scope, and NEVER jax (obs/schema.py
 ``--check`` enforces it): the worker is a control process — the jax backend
@@ -35,6 +49,7 @@ initializes only inside the supervised ``run_batch`` child.
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
 import socket
@@ -50,12 +65,28 @@ from redcliff_tpu.fleet import planner as _planner
 from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
 
 __all__ = ["work", "run_one_batch", "default_worker_id",
-           "TERMINAL_FAIL_CLASSES"]
+           "TERMINAL_FAIL_CLASSES", "DETERMINISTIC_FAIL_CLASSES",
+           "DEFAULT_MAX_ATTEMPTS"]
 
-# supervised outcomes a restart cannot fix: the request is terminally failed
-# instead of released for another worker to burn the same budget on
+# supervised outcomes a restart cannot fix: the batch will not be re-run
+# as-is (solo requests are failed or budget-routed; merged batches bisect)
 TERMINAL_FAIL_CLASSES = ("numerics_abort", "deadline", "giving_up",
                          "mesh_exhausted")
+
+# the subset that is a deterministic VERDICT on a solo request (a replay
+# provably repeats it): recorded in failed/, not dead-lettered. giving_up
+# is deliberately absent — a crash loop is *suspicious*, not proven
+# deterministic (the host may be at fault), so it burns retry budget and
+# dead-letters only when the budget is spent
+DETERMINISTIC_FAIL_CLASSES = ("numerics_abort", "deadline", "mesh_exhausted")
+
+# default per-request retry budget: failure attempts (giving_up /
+# missing_result) a request may accumulate before it is dead-lettered.
+# Lease-expiry reclaims deliberately do NOT count — a worker SIGKILL storm
+# is an infrastructure fault, and letting it spend tenants' budgets would
+# dead-letter healthy requests (the exact blast radius this layer exists
+# to contain)
+DEFAULT_MAX_ATTEMPTS = 3
 
 
 def default_worker_id():
@@ -136,28 +167,78 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
                               by_id, logger, reclaim=True,
                               all_ids=rids_all)
         if leases:
+            # the reclaim is recorded on each member's durable attempt
+            # ledger (kind="reclaim": dossier evidence, NOT budget — worker
+            # deaths are infra faults, see DEFAULT_MAX_ATTEMPTS)
+            for rid in leases:
+                q.record_attempt(rid, "lease_expired", batch_id=batch_id,
+                                 run_dir=q.batch_dir(batch_id),
+                                 kind="reclaim")
             members = [by_id[r] for r in rids_all]
             batch = _planner._batch_view(members, n_devices)
             batch["batch_id"] = batch_id  # preserve the recorded run dir
             return batch, leases, members
 
+    # 1b) pinned compositions (bisection halves): claimed EXACTLY as
+    # pinned, bypassing the planner — a just-bisected suspect must never be
+    # re-merged with healthy tenants. The pin is consumed at claim time;
+    # from then on the lease records carry the composition (so a worker
+    # dying mid-half lands back in the reclaim path above)
+    pinned = q.pinned_batches()
+    pinned_ids = {rid for p in pinned for rid in (p.get("requests") or ())}
+    for pin in pinned:
+        batch_id = pin["batch_id"]
+        rids_all = [r for r in pin["requests"] if r in by_id]
+        claimable = [r for r in rids_all if not q.is_terminal(r)]
+        if not claimable:
+            q.unpin_batch(batch_id)  # everyone settled elsewhere
+            continue
+        if claimable != rids_all:
+            # a member settled elsewhere (canceled/dead-lettered) between
+            # pin and claim: its points must NOT ride back into the fit —
+            # unlike a RECLAIM there is no checkpoint fingerprint to
+            # preserve here, so re-key the half to the surviving
+            # composition (same content-derived lane seeds, so any prior
+            # run of this exact composition still resumes cleanly)
+            new_id = _planner.batch_id_for(claimable)
+            q.pin_batch(new_id, claimable,
+                        parent_batch_id=pin.get("parent_batch_id"))
+            q.unpin_batch(batch_id)
+            batch_id, rids_all = new_id, claimable
+        leases = _claim_batch(q, worker_id, lease_s, batch_id, claimable,
+                              by_id, logger, all_ids=rids_all)
+        if leases:
+            q.unpin_batch(batch_id)
+            members = [by_id[r] for r in rids_all]
+            batch = _planner._batch_view(members, n_devices)
+            batch["batch_id"] = batch_id
+            return batch, leases, members
+
     # 2) fresh admission plan over the pending queue (derived from the one
-    # spool scan above: non-terminal, no live lease, submission order)
+    # spool scan above: non-terminal, no live lease, not pinned, submission
+    # order), with prior-failure suspects quarantined into solo batches
     now = time.time()
-    pending = []
+    pending, suspects = [], set()
     for rid, rec in by_id.items():
-        if q.is_terminal(rid):
+        if rid in pinned_ids or q.is_terminal(rid):
             continue
         lease = q.lease_of(rid)
         if lease is not None and float(lease.get("expires_at") or 0.0) > now:
             continue
         pending.append(rec)
+        att = q.attempt_record(rid)
+        if att and (int(att.get("attempts") or 0) > 0
+                    or att.get("suspect")):
+            # prior failed attempts, or a requeued dead-letter (fresh
+            # budget but still a suspect until it proves clean)
+            suspects.add(rid)
     if not pending:
         return None
     t0 = time.perf_counter()
     pl = _planner.plan(pending, n_devices=n_devices,
                        budget_bytes=budget_bytes,
-                       cost_model=_costmodel.load(), max_bucket=max_bucket)
+                       cost_model=_costmodel.load(), max_bucket=max_bucket,
+                       suspects=suspects)
     record_span("fleet.plan", (time.perf_counter() - t0) * 1e3,
                 component="fleet", logger=logger, emit=True,
                 queue_depth=pl["queue_depth"], batches=len(pl["batches"]))
@@ -165,14 +246,25 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
                batches=len(pl["batches"]),
                unschedulable=len(pl["unschedulable"]),
                plan_ms=pl["plan_ms"],
+               suspects=sorted(suspects),
                utilization_pct=pl["utilization"]["utilization_pct"],
                decisions=[{k: b.get(k) for k in
                            ("batch_id", "requests", "tenants", "n_points",
                             "g_bucket", "predicted_bytes", "eta_s",
-                            "priority")}
+                            "priority", "suspect")}
                           for b in pl["batches"][:8]],
                worker=worker_id)
     for b in pl["batches"]:
+        rids = [r for r in b["requests"]
+                if r in by_id and not q.is_terminal(r)]
+        if not rids:
+            continue
+        if rids != b["requests"]:
+            # a member settled (e.g. canceled) between planning and this
+            # claim: its points must not ride into the fit — rebuild the
+            # batch from the survivors (fresh id, fresh run dir; same
+            # content-derived lane seeds, so results are unchanged)
+            b = _planner._batch_view([by_id[r] for r in rids], n_devices)
         leases = _claim_batch(q, worker_id, lease_s, b["batch_id"],
                               b["requests"], by_id, logger)
         if leases:
@@ -185,16 +277,31 @@ class _LeaseHeartbeat:
     """Renews a batch's leases every ``lease_s / 3`` seconds while the
     supervised fit runs; a lost lease (reclaimed by another worker after an
     expiry we slept through) stops renewals and is surfaced to the caller
-    so it will not publish results it no longer owns."""
+    so it will not publish results it no longer owns.
 
-    def __init__(self, leases, lease_s, logger):
+    Renewal ERRORS are not silent: each miss logs a structured ``fleet``
+    event with the error kind, and ``max_renew_misses`` CONSECUTIVE misses
+    on one lease escalate to lease-lost handling — after that many failed
+    renewals we can no longer prove the on-disk lease is ours (it may have
+    expired and been reclaimed behind the unreadable filesystem), so
+    publishing results would race the new owner."""
+
+    def __init__(self, leases, lease_s, logger, max_renew_misses=3):
         self._leases = leases
         self._lease_s = float(lease_s)
         self._logger = logger
+        self._max_misses = max(int(max_renew_misses), 1)
+        self._misses = {}
         self._stop = threading.Event()
         self.lost = []
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="fleet-lease-heartbeat")
+
+    def _log(self, **kw):
+        try:
+            self._logger.log("fleet", **kw)
+        except Exception:  # noqa: BLE001 — the same fs trouble that broke
+            pass           # the renewal must not kill the heartbeat thread
 
     def _run(self):
         period = max(self._lease_s / 3.0, 0.05)
@@ -205,10 +312,23 @@ class _LeaseHeartbeat:
                 except LeaseLost:
                     self.lost.append(rid)
                     self._leases.pop(rid, None)
-                    self._logger.log("fleet", kind="lease_lost",
-                                     requests=[rid])
-                except OSError:
-                    pass  # transient fs hiccup: retry next period
+                    self._misses.pop(rid, None)
+                    self._log(kind="lease_lost", requests=[rid])
+                except OSError as e:
+                    n = self._misses.get(rid, 0) + 1
+                    self._misses[rid] = n
+                    self._log(kind="renew_error", requests=[rid],
+                              consecutive=n,
+                              error=f"{type(e).__name__}: {e}")
+                    if n >= self._max_misses:
+                        self.lost.append(rid)
+                        self._leases.pop(rid, None)
+                        self._misses.pop(rid, None)
+                        self._log(kind="lease_lost", requests=[rid],
+                                  consecutive=n,
+                                  error="renewal misses exhausted")
+                else:
+                    self._misses.pop(rid, None)
 
     def __enter__(self):
         self._thread.start()
@@ -221,10 +341,11 @@ class _LeaseHeartbeat:
 
 def run_one_batch(q, batch, leases, members, logger, worker_id,
                   lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
-                  env=None, python=None):
+                  env=None, python=None,
+                  max_attempts=DEFAULT_MAX_ATTEMPTS):
     """Run one claimed batch under the crash-loop supervisor and settle its
-    requests; returns the :class:`~redcliff_tpu.runtime.supervisor
-    .SuperviseOutcome`."""
+    requests (containment discipline — see the module docstring); returns
+    the :class:`~redcliff_tpu.runtime.supervisor.SuperviseOutcome`."""
     batch_id = batch["batch_id"]
     run_dir = q.batch_dir(batch_id)
     os.makedirs(run_dir, exist_ok=True)
@@ -269,22 +390,98 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                 classification=outcome.classification)
 
     lost = set(hb.lost)
-    settled = {"done": [], "failed": [], "released": [], "lost": sorted(lost)}
-    for rid, lease in list(leases.items()):
-        if rid in lost:
-            continue
-        rec = next((m for m in members if m["request_id"] == rid), {})
-        if outcome.classification == "clean":
+    settled = {"done": [], "failed": [], "released": [], "deadletter": [],
+               "bisected": [], "lost": sorted(lost)}
+    cls = outcome.classification
+    live = [(rid, leases[rid]) for rid in leases if rid not in lost]
+
+    def member_of(rid):
+        return next((m for m in members if m["request_id"] == rid), {})
+
+    def send_to_deadletter(rid, att, reason, causes=None):
+        rec = member_of(rid)
+        q.deadletter(rid, dossier=_dossier(rec, att, reason, run_dir,
+                                           causes=causes))
+        settled["deadletter"].append(rid)
+        logger.log("fleet", kind="deadletter", batch_id=batch_id,
+                   requests=[rid], tenants=[str(rec.get("tenant"))],
+                   reason=reason, attempts=(att or {}).get("attempts"),
+                   run_dir=run_dir, worker=worker_id)
+
+    if cls == "clean":
+        for rid, lease in live:
+            rec = member_of(rid)
             result = _read_result(run_dir, rid)
+            if result is None:
+                # clean exit, no per-request artifact (should not happen):
+                # a durability bug, not a verdict — retry on the budget,
+                # dead-letter when it is spent (never a stub "done")
+                att = q.record_attempt(rid, "missing_result",
+                                       batch_id=batch_id, run_dir=run_dir)
+                if att["attempts"] >= max_attempts:
+                    send_to_deadletter(rid, att, "missing_result")
+                else:
+                    lease.release()
+                    settled["released"].append(rid)
+                continue
+            causes = _poison_causes(result)
+            if causes is not None:
+                # attribution: the grid engine quarantined EVERY point of
+                # this request (deterministic per-lane causes) — the poison
+                # tenant is contained without touching its siblings
+                att = q.record_attempt(rid, "poison_quarantine",
+                                       batch_id=batch_id, run_dir=run_dir)
+                send_to_deadletter(rid, att, "poison_quarantine",
+                                   causes=causes)
+                continue
             q.complete(rid, result=result)
             settled["done"].append(rid)
             logger.log("fleet", kind="complete", batch_id=batch_id,
                        requests=[rid], tenants=[str(rec.get("tenant"))],
                        worker=worker_id)
-        elif outcome.classification in TERMINAL_FAIL_CLASSES:
-            q.fail(rid, outcome.classification)
-            settled["failed"].append(rid)
-        else:
+    elif cls in TERMINAL_FAIL_CLASSES and len(live) > 1:
+        # terminal failure of a MERGED batch with no per-lane attribution:
+        # never blame every member — bisect, so halving corners the poison
+        # while healthy siblings still finish (as pinned compositions the
+        # planner cannot re-merge)
+        _bisect(q, batch_id, run_dir, cls, live, member_of, settled,
+                logger, worker_id)
+    elif cls in TERMINAL_FAIL_CLASSES and len(members) == 1:
+        # genuinely SOLO composition: the verdict is attributable to this
+        # request alone
+        for rid, lease in live:
+            att = q.record_attempt(rid, cls, batch_id=batch_id,
+                                   run_dir=run_dir)
+            if cls in DETERMINISTIC_FAIL_CLASSES:
+                q.fail(rid, cls)
+                settled["failed"].append(rid)
+            elif att["attempts"] >= max_attempts:
+                # a solo crash/hang loop (giving_up) past its budget
+                send_to_deadletter(rid, att, "crash_loop")
+            else:
+                lease.release()
+                settled["released"].append(rid)
+    elif cls in TERMINAL_FAIL_CLASSES:
+        # MERGED composition but at most one lease is still ours (the rest
+        # were lost or already terminal): the batch the child ran still
+        # carried co-tenants' lanes, so the terminal class cannot be
+        # pinned on the lone survivor — it may be a healthy co-tenant of
+        # the real poison. Budget-route instead of issuing a verdict; the
+        # dossier reason keeps the recorded class (`merged_<class>`) so an
+        # operator never misreads a deterministic deadline/numerics death
+        # as an infra crash loop
+        for rid, lease in live:
+            att = q.record_attempt(rid, cls, batch_id=batch_id,
+                                   run_dir=run_dir)
+            if att["attempts"] >= max_attempts:
+                send_to_deadletter(rid, att,
+                                   "crash_loop" if cls == "giving_up"
+                                   else f"merged_{cls}")
+            else:
+                lease.release()
+                settled["released"].append(rid)
+    else:
+        for rid, lease in live:
             lease.release()
             settled["released"].append(rid)
     logger.log("fleet", kind="batch_end", batch_id=batch_id,
@@ -292,32 +489,116 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                attempts=len(outcome.attempts),
                wall_s=round(dur_ms / 1e3, 3),
                done=len(settled["done"]), failed=len(settled["failed"]),
-               released=len(settled["released"]), worker=worker_id)
+               released=len(settled["released"]),
+               deadlettered=len(settled["deadletter"]),
+               bisected=len(settled["bisected"]), worker=worker_id)
     return outcome
 
 
+def _bisect(q, batch_id, run_dir, classification, live, member_of, settled,
+            logger, worker_id):
+    """Split a blind-failed merged batch into two pinned halves (claim
+    order) and release the leases: the next claim cycles — this worker's or
+    any other's — run the halves as exact compositions. Each member's
+    durable attempt ledger is charged one failure (the classification the
+    batch died with), so the eventual solo culprit carries its history."""
+    rids = [rid for rid, _ in live]
+    mid = (len(rids) + 1) // 2
+    halves = []
+    for ids in (rids[:mid], rids[mid:]):
+        half_id = _planner.batch_id_for(ids)
+        q.pin_batch(half_id, ids, parent_batch_id=batch_id)
+        halves.append({"batch_id": half_id, "requests": ids})
+    for rid, lease in live:
+        q.record_attempt(rid, classification, batch_id=batch_id,
+                         run_dir=run_dir)
+        lease.release()
+        settled["bisected"].append(rid)
+    logger.log("fleet", kind="bisect", batch_id=batch_id, requests=rids,
+               classification=classification, halves=halves,
+               worker=worker_id)
+
+
+# quarantine causes that are a DETERMINISTIC verdict on the point itself
+# (a replay provably diverges again). deadline is deliberately absent:
+# eviction at a wall-clock budget depends on how loaded the host was, so a
+# fully-deadline-evicted request completes done-with-failures, not poison
+_POISON_CAUSES = ("nonfinite_grad", "nonfinite_val")
+
+
+def _poison_causes(result):
+    """The per-cause quarantine counts when EVERY point of this request was
+    quarantined by the grid engine for a deterministic-numerics cause (the
+    poison-attribution signal), else None. A partial quarantine — or any
+    wall-clock-dependent cause like ``deadline`` — is normal sweep behavior
+    and completes as done with the failures recorded."""
+    n = result.get("n_points") or 0
+    fails = result.get("failures") or []
+    points = {f.get("point") for f in fails
+              if isinstance(f.get("point"), int)}
+    if not n or len(points) < n:
+        return None
+    causes = {}
+    for f in fails:
+        cause = str(f.get("cause") or "?")
+        causes[cause] = causes.get(cause, 0) + 1
+    if any(c not in _POISON_CAUSES for c in causes):
+        return None
+    return causes
+
+
+def _dossier(rec, att, reason, run_dir, causes=None):
+    """The dead-letter failure dossier: everything an operator needs to
+    judge the request without spelunking run dirs — attempt/classification
+    history, the run dirs it burned, and any crash flight records they
+    hold."""
+    att = att or {}
+    history = att.get("history") or []
+    run_dirs = sorted({h.get("run_dir") for h in history
+                       if h.get("run_dir")} | {run_dir})
+    flights = []
+    for d in run_dirs:
+        flights.extend(sorted(
+            glob.glob(os.path.join(d, "flight_record*.json"))))
+    return {
+        "request_id": rec.get("request_id"),
+        "tenant": str(rec.get("tenant")),
+        "reason": reason,
+        "attempts": int(att.get("attempts") or 0),
+        "reclaims": int(att.get("reclaims") or 0),
+        "classifications": [h.get("classification") for h in history],
+        "last_classification": (att.get("last") or {}).get("classification"),
+        "run_dirs": run_dirs,
+        "flight_records": flights,
+        "quarantine_causes": causes,
+    }
+
+
 def _read_result(run_dir, request_id):
+    """The per-request result record, or None when the clean-exited child
+    left no artifact — the caller routes that through the retry budget
+    instead of recording a stub done."""
     path = os.path.join(run_dir, "results", f"{request_id}.json")
     try:
         with open(path) as f:
             return json.load(f)
     except (OSError, ValueError):
-        # clean exit but no per-request artifact (should not happen):
-        # record the run dir so the operator can dig
-        return {"run_dir": run_dir, "missing_result": True}
+        return None
 
 
 def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
          drain=False, once=False, n_devices=1, budget_bytes=None,
          max_bucket=_planner.DEFAULT_MAX_BUCKET, checkpoint_every=1,
-         supervisor_policy=None, env=None, python=None):
+         supervisor_policy=None, env=None, python=None,
+         max_attempts=DEFAULT_MAX_ATTEMPTS):
     """The worker loop; returns the number of batches run.
 
     ``drain``: exit once the queue holds no claimable or running work.
     ``once``: run at most one claim cycle. ``max_batches`` bounds the run.
     ``budget_bytes``: the admission HBM budget (``check_headroom``'s
     ``budget_bytes`` on the serving mesh; None = ungated, e.g. this CPU
-    container)."""
+    container). ``max_attempts``: the per-request retry budget (failure
+    attempts before a request is dead-lettered)."""
     q = FleetQueue(root)
     worker_id = worker_id or default_worker_id()
     batches_run = 0
@@ -334,7 +615,7 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
                               lease_s=lease_s,
                               checkpoint_every=checkpoint_every,
                               supervisor_policy=supervisor_policy, env=env,
-                              python=python)
+                              python=python, max_attempts=max_attempts)
                 batches_run += 1
                 if max_batches is not None and batches_run >= max_batches:
                     break

@@ -135,6 +135,13 @@ FAMILIES = [
     Family("fleet.utilization_gain", band=_BAND_TIMING, g_dependent=False),
     Family("fleet.plan_ms", better="lower", band=_BAND_TIMING,
            abs_floor=50.0, g_dependent=False),
+    # fleet failure containment (ISSUE 11): healthy-sibling completion
+    # latency with a poison co-tenant over without one, end-to-end through
+    # real drains at the same bucket width. ~1.0 means the poison tenant
+    # costs its siblings nothing; a creeping ratio means containment is
+    # leaking wall-clock back into healthy requests
+    Family("fleet_containment.latency_ratio", better="lower",
+           band=_BAND_TIMING, g_dependent=False),
 ]
 
 

@@ -141,6 +141,7 @@ def build_report(run_dir):
     fits = []
     cur = None            # current fit context: {"shape_key", "shape", ...}
     manifest = {}         # request_id -> {tenant, start, stop} (fleet runs)
+    fleet_kind_counts = {}  # fleet-event lifecycle counts (fleet roots)
     cost = {}             # (shape_key, g_bucket) -> accumulators
     cm_acc = {}           # (shape_key, g_bucket) -> residual-event accuracy
     run_cache_dir = None  # the versioned compile-cache dir fit_start logs
@@ -245,7 +246,10 @@ def build_report(run_dir):
         elif ev == "fleet":
             # tenant manifest (fleet/run_batch.py): request id -> merged
             # point range; restart attempts re-log it, latest wins
-            if rec.get("kind") == "manifest":
+            kind = rec.get("kind")
+            fleet_kind_counts[str(kind)] = \
+                fleet_kind_counts.get(str(kind), 0) + 1
+            if kind == "manifest":
                 for row in rec.get("requests") or []:
                     if isinstance(row, dict) and row.get("request_id"):
                         manifest[row["request_id"]] = row
@@ -427,6 +431,31 @@ def build_report(run_dir):
                     q[cause] = q.get(cause, 0) + 1
                     break
 
+    # fleet containment section (fleet ROOTS only, docs/ARCHITECTURE.md
+    # "Fleet failure containment"): dead-letter dossiers, per-request
+    # attempt budgets, and the containment-lifecycle event counts (bisect /
+    # deadletter / cancel / requeue / renew_error)
+    containment = None
+    if os.path.exists(os.path.join(run_dir, "requests.jsonl")) \
+            or os.path.isdir(os.path.join(run_dir, "leases")):
+        from redcliff_tpu.fleet.queue import FleetQueue
+
+        q = FleetQueue(run_dir, create=False)  # pure reader
+        st = q.status()
+        containment = {
+            "counts": st["counts"],
+            "deadletters": [{
+                "request_id": rec.get("request_id"),
+                "deadlettered_at": rec.get("deadlettered_at"),
+                "dossier": rec.get("dossier"),
+            } for rec in q.deadletters()],
+            "attempt_records": q.attempt_records(),
+            "events": {k: fleet_kind_counts[k] for k in sorted(
+                fleet_kind_counts)
+                if k in ("deadletter", "bisect", "cancel", "requeue",
+                         "renew_error", "lease_lost", "reclaim")},
+        }
+
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
 
@@ -471,6 +500,7 @@ def build_report(run_dir):
         "compactions": compactions,
         "remeshes": remeshes,
         "tenants": tenants,
+        "fleet_containment": containment,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
                      "guarded_steps_skipped": int(skipped_steps),
@@ -561,6 +591,34 @@ def render_text(report):
                        f"{t['points']} point(s), {t['lane_epochs']} "
                        f"lane-epoch(s), wall {_fmt_ms((t['wall_s'] or 0) * 1e3)}, "
                        f"quarantined: {quar}")
+    fc = r.get("fleet_containment")
+    if fc:
+        c = fc["counts"]
+        out.append("fleet containment (docs/ARCHITECTURE.md 'Fleet failure "
+                   "containment'):")
+        out.append(f"  terminal states: {c['done']} done | {c['failed']} "
+                   f"failed | {c['deadletter']} dead-lettered | "
+                   f"{c['canceled']} canceled "
+                   f"(of {c['submitted']} submitted)")
+        if fc["events"]:
+            out.append("  lifecycle events: " + "  ".join(
+                f"{k}x{v}" for k, v in sorted(fc["events"].items())))
+        for d in fc["deadletters"]:
+            doss = d.get("dossier") or {}
+            causes = doss.get("quarantine_causes")
+            out.append(f"  dead-letter {d['request_id']} "
+                       f"[{doss.get('tenant')}]: {doss.get('reason')} after "
+                       f"{doss.get('attempts')} attempt(s), classifications "
+                       f"{doss.get('classifications')}"
+                       + (f", quarantine causes {causes}" if causes else ""))
+            for fr in doss.get("flight_records") or []:
+                out.append(f"    flight record: {fr}")
+        budgets = [a for a in fc["attempt_records"]
+                   if a.get("attempts") or a.get("reclaims")]
+        if budgets:
+            out.append("  attempt budgets: " + "  ".join(
+                f"{a['request_id']}={a.get('attempts', 0)}f/"
+                f"{a.get('reclaims', 0)}r" for a in budgets))
     mem = r.get("memory") or {}
     out.append("device memory (predicted vs measured peak, obs/memory.py):")
     for m in mem.get("fits") or []:

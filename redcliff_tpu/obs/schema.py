@@ -236,16 +236,22 @@ EVENTS = {
                   "memory", "fleet")),
     "fleet": _ev(
         "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
-        "worker loop, run_batch driver; kind=submit | plan | claim | "
-        "reclaim | batch_start | batch_end | complete | lease_lost | "
-        "manifest | worker_start | worker_stop)",
+        "worker loop, run_batch driver, containment layer; kind=submit | "
+        "plan | claim | reclaim | batch_start | batch_end | complete | "
+        "lease_lost | renew_error | deadletter | bisect | cancel | requeue "
+        "| manifest | worker_start | worker_stop)",
         required=("kind",),
         optional=("batch_id", "requests", "tenants", "n_points", "g_bucket",
                   "queue_depth", "batches", "unschedulable", "plan_ms",
                   "utilization_pct", "decisions", "eta_s",
                   "predicted_bytes", "run_dir", "worker", "classification",
                   "rc", "attempts", "wall_s", "done", "failed", "released",
-                  "priority", "n_devices", "budget_bytes", "lease_s")),
+                  "priority", "n_devices", "budget_bytes", "lease_s",
+                  # containment fields (ISSUE 11): retry budgets, bisection,
+                  # dead-letter routing, heartbeat renewal escalation,
+                  # suspect-solo planning
+                  "reason", "halves", "error", "consecutive", "suspects",
+                  "deadlettered", "bisected", "max_attempts")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -347,7 +353,7 @@ def validate_records(records, kind="metrics"):
 # serialize what it observes.
 NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
-                  "fleet/__main__.py")
+                  "fleet/chaos.py", "fleet/__main__.py")
 LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py")
 
 

@@ -373,12 +373,53 @@ def _fleet_section(root, last_plan, workers, now):
         wt = last_plan.get("wall_time")
         plan["age_s"] = (round(now - wt, 3)
                          if isinstance(wt, (int, float)) else None)
+    # containment view (ISSUE 11): dead-letter depth + dossier headlines,
+    # and every request's durable attempt/reclaim counts (the retry-budget
+    # state a release/reclaim updates). One terminal_ids() batch view for
+    # the whole tick — a follow-mode watcher re-renders this every tick,
+    # so no per-request stat probes and only the rendered dossiers read
+    term = q.terminal_ids()
+    terminal_rids = set().union(*term.values())
+    deadletters = []
+    for rid in sorted(term["deadletter"])[:16]:
+        rec = q.deadletter_record(rid)
+        if rec is None:
+            continue  # raced a requeue; depth still counts the listing
+        deadletters.append({
+            "request_id": rec.get("request_id"),
+            "tenant": (rec.get("dossier") or {}).get("tenant"),
+            "reason": (rec.get("dossier") or {}).get("reason"),
+            "attempts": (rec.get("dossier") or {}).get("attempts"),
+            "last_classification": (rec.get("dossier") or {}).get(
+                "last_classification"),
+        })
+    # live requests only (a terminal request's budget lives in its
+    # dossier), bounded like the dead-letter list so snapshot size never
+    # grows with root history
+    attempts = {}
+    for rec in q.attempt_records():
+        rid = rec.get("request_id")
+        if not rid or rid in terminal_rids:
+            continue
+        if not (rec.get("attempts") or rec.get("reclaims")
+                or rec.get("suspect")):
+            continue
+        attempts[rid] = {
+            "attempts": int(rec.get("attempts") or 0),
+            "reclaims": int(rec.get("reclaims") or 0),
+            "last": (rec.get("last") or {}).get("classification"),
+        }
+        if len(attempts) >= 64:
+            break
     return {
         "counts": st["counts"],
         "by_tenant": st["by_tenant"],
         "torn_spool_lines": st["torn_spool_lines"],
         "in_flight": in_flight,
         "last_plan": plan,
+        "deadletter": {"depth": len(term["deadletter"]),
+                       "requests": deadletters},
+        "attempts": attempts,
         "worker_age_s": {w: round(now - t, 3)
                          for w, t in sorted(workers.items())},
     }
@@ -409,13 +450,31 @@ def render_text(snap):
     if fl:
         c = fl["counts"]
         out.append(f"  fleet queue: {c['queued']} queued | {c['running']} "
-                   f"running | {c['done']} done | {c['failed']} failed "
+                   f"running | {c['done']} done | {c['failed']} failed | "
+                   f"{c.get('deadletter', 0)} dead-lettered | "
+                   f"{c.get('canceled', 0)} canceled "
                    f"(of {c['submitted']} submitted"
                    + (f"; {c['expired_claims']} expired claim(s)"
                       if c["expired_claims"] else "") + ")")
         for tenant, t in sorted(fl["by_tenant"].items()):
             out.append(f"    tenant {tenant}: {t['queued']}q "
-                       f"{t['running']}r {t['done']}d {t['failed']}f")
+                       f"{t['running']}r {t['done']}d {t['failed']}f"
+                       + (f" {t['deadletter']}dl"
+                          if t.get("deadletter") else "")
+                       + (f" {t['canceled']}c" if t.get("canceled") else ""))
+        dl = fl.get("deadletter") or {}
+        if dl.get("depth"):
+            out.append(f"    dead-letter depth: {dl['depth']}")
+            for d in dl.get("requests") or []:
+                out.append(f"      {d['request_id']} [{d['tenant']}] "
+                           f"{d['reason']} after {d['attempts']} attempt(s)"
+                           + (f" (last {d['last_classification']})"
+                              if d.get("last_classification") else ""))
+        att = fl.get("attempts") or {}
+        if att:
+            out.append("    attempt budgets: " + "  ".join(
+                f"{rid}={a['attempts']}f/{a['reclaims']}r"
+                for rid, a in sorted(att.items())))
         for inf in fl["in_flight"]:
             out.append(f"    in-flight {inf['request_id']} "
                        f"[{inf['tenant']}] on {inf['worker']} "

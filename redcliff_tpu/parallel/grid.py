@@ -114,11 +114,22 @@ class GridSpec:
     the run exits resumable, like a self-inflicted preemption. Budgets are
     per-process wall clock (a resumed attempt gets a fresh budget) and are
     deliberately NOT part of the resume fingerprint: changing them changes
-    how long you search, never what a lane computes."""
+    how long you search, never what a lane computes.
+
+    ``lane_seeds`` (optional, one int per point) makes per-lane
+    initialization COMPOSITION-INDEPENDENT: lane ``i`` derives its init key
+    as ``fold_in(key, lane_seeds[i])`` instead of ``split(key, G)[i]``, so a
+    point's fit no longer depends on its position or its co-tenants in the
+    grid. The fleet batch driver derives these from point content, which is
+    what lets a bisected sub-batch's survivors finish bit-identical to the
+    uninterrupted merged run (docs/ARCHITECTURE.md "Fleet failure
+    containment"). Part of the resume fingerprint: changed seeds are a
+    different fit."""
 
     points: Sequence[dict]
     fit_deadline_s: Any = None   # scalar | per-point sequence | None
     grid_deadline_s: float | None = None
+    lane_seeds: Sequence[int] | None = None
 
     def __post_init__(self):
         valid = set(COEFF_AXES) | set(OPT_AXES) | set(STOP_AXES)
@@ -128,6 +139,11 @@ class GridSpec:
                 raise ValueError(
                     f"grid point {i} has unknown hyperparameter axes "
                     f"{sorted(unknown)}; valid axes: {sorted(valid)}")
+        if self.lane_seeds is not None \
+                and len(self.lane_seeds) != len(self.points):
+            raise ValueError(
+                f"lane_seeds has {len(self.lane_seeds)} entries for "
+                f"{len(self.points)} grid points")
         if self.grid_deadline_s is not None and self.grid_deadline_s <= 0:
             raise ValueError("grid_deadline_s must be positive")
         if self.fit_deadline_s is not None:
@@ -300,9 +316,19 @@ class RedcliffGridRunner:
         return optA_state, optB_state
 
     def init_grid(self, key):
-        """G independently-seeded parameter sets, stacked on axis 0."""
+        """G independently-seeded parameter sets, stacked on axis 0.
+
+        With ``spec.lane_seeds`` each lane's key is ``fold_in(key, seed)``
+        — a function of the point's own seed only, so the same point inits
+        identically whatever grid it is merged into; without them, the
+        historical ``split(key, G)`` derivation (position- and
+        width-dependent) is kept bit-for-bit."""
         G = len(self.spec.points)
-        keys = jax.random.split(key, G)
+        if self.spec.lane_seeds is not None:
+            keys = jnp.stack([jax.random.fold_in(key, int(s))
+                              for s in self.spec.lane_seeds])
+        else:
+            keys = jax.random.split(key, G)
         params = jax.vmap(self.model.init)(keys)
         return (params,) + self._opt_states(params)
 
@@ -698,6 +724,11 @@ class RedcliffGridRunner:
         tc = self.tc
         return {
             "points": list(self.spec.points),
+            # lane-seed derivation changes every lane's init stream, so a
+            # checkpoint written under one derivation must never resume
+            # under another (absent key == the historical split(key, G))
+            "lane_seeds": (list(int(s) for s in self.spec.lane_seeds)
+                           if self.spec.lane_seeds is not None else None),
             "seed": tc.seed,
             "training_mode": self.model.config.training_mode,
             "batch_size": tc.batch_size,
@@ -883,6 +914,17 @@ class RedcliffGridRunner:
             # is what every such checkpoint trained under, so resuming under
             # the default is sound — a non-default precision still rejects
             want_meta.pop("matmul_precision")
+        if "lane_seeds" not in meta:
+            # pre-containment checkpoint: written before per-lane content
+            # seeds joined the fingerprint. Lane seeds are consulted ONLY
+            # by init_grid and a resumed fit never re-initializes — the
+            # checkpointed params already embody whatever derivation wrote
+            # them — so finishing under any current lane_seeds is sound
+            # (a changed point set still rejects via "points"). Without
+            # this an upgraded fleet worker reclaiming an old in-flight
+            # batch would crash-loop a healthy request into the
+            # dead-letter queue.
+            want_meta.pop("lane_seeds", None)
         diff = ([k for k in want_meta if meta.get(k) != want_meta[k]]
                 + [k for k in meta if k not in want_meta])
         if diff:

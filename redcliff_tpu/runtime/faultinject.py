@@ -80,6 +80,16 @@ specs are either one step ``"5"`` or an inclusive range ``"5-8"``):
   guard: a guarded fit with ``nan_batch:K`` must end bit-identical to a
   clean fit with ``skip_update:K``.
 
+Fleet fault point (the fleet chaos harness, fleet/chaos.py — the service
+half of the containment story, docs/ARCHITECTURE.md "Fleet failure
+containment"):
+
+- ``fleet_poison`` — arms the ``__chaos__`` poison sentinels in fleet grid
+  points: the batch driver :func:`detonates <redcliff_tpu.fleet.chaos
+  .detonate>` (SIGKILL / exit N / hang) BEFORE the fit, simulating a tenant
+  request that deterministically kills any batch it is merged into. Unarmed,
+  the driver strips the sentinels and fits the underlying healthy points.
+
 jax is imported lazily: the module is importable by backend-free processes.
 """
 from __future__ import annotations
@@ -95,10 +105,11 @@ import sys
 from redcliff_tpu.runtime.watchdog import (EXIT_DEADLINE, EXIT_HOST_LOST,
                                            EXIT_PREEMPTED)
 
-__all__ = ["armed", "crash_point", "ckpt_write_point", "poison_batch",
-           "skip_update", "hang_point", "io_point", "io_error_point",
-           "corrupt_checkpoint", "flaky", "random_fault_schedule",
-           "random_host_fault_schedule", "tiny_grid_fit", "tiny_sharded_fit"]
+__all__ = ["armed", "fleet_poison_armed", "crash_point", "ckpt_write_point",
+           "poison_batch", "skip_update", "hang_point", "io_point",
+           "io_error_point", "corrupt_checkpoint", "flaky",
+           "random_fault_schedule", "random_host_fault_schedule",
+           "tiny_grid_fit", "tiny_sharded_fit"]
 
 ENV_SPEC = "REDCLIFF_FAULT_INJECT"
 ENV_MARKER = "REDCLIFF_FAULT_MARKER"
@@ -124,6 +135,13 @@ def armed():
     otherwise-asynchronous work (e.g. wait for the background checkpoint
     writer before a crash point) so fault tests stay deterministic."""
     return bool(os.environ.get(ENV_SPEC))
+
+
+def fleet_poison_armed():
+    """True when the fleet chaos grammar's ``fleet_poison`` fault is armed:
+    the fleet batch driver then ACTS on ``__chaos__`` poison sentinels in
+    grid points (fleet/chaos.py) instead of only stripping them."""
+    return any(name == "fleet_poison" for name, _ in _active_faults())
 
 
 def ckpt_write_point(stage, path=None):

@@ -1,0 +1,945 @@
+"""Fleet blast-radius containment tests (redcliff_tpu/fleet, ISSUE 11).
+
+Queue containment units (deadletter/cancel/requeue/attempt-ledger/pinned
+batches), planner suspect quarantine, lease-heartbeat renewal escalation,
+worker settle discipline (retry budgets, missing-result routing, poison
+attribution, blind bisection) against a stubbed supervisor, the fleet
+chaos-harness primitives, and the end-to-end acceptance: a 6-request
+merged batch with 1 injected poison request converges to exactly 1
+dead-letter entry and 5 ``done`` records — bit-identical survivor results
+vs an uninterrupted run — under both the attribution (quarantine-cause)
+and blind (SIGKILL bisection) failure modes, plus the seeded multi-worker
+chaos soak pinning the containment invariant: every request terminal in
+exactly one of done/failed/deadletter/canceled, never lost, never
+duplicated, healthy requests always complete.
+"""
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from redcliff_tpu.fleet import chaos, planner
+from redcliff_tpu.fleet import worker as worker_mod
+from redcliff_tpu.fleet.queue import (FleetQueue, LeaseLost,
+                                      TERMINAL_STATES)
+from redcliff_tpu.fleet.worker import _LeaseHeartbeat, run_one_batch
+from redcliff_tpu.fleet.__main__ import TINY_SPEC
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs.logging import MetricLogger, read_jsonl
+from redcliff_tpu.runtime.supervisor import SuperviseOutcome
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _submit_tiny(q, tenant, epochs=2, points=None, **kw):
+    spec = json.loads(json.dumps(TINY_SPEC))
+    spec["epochs"] = epochs
+    return q.submit(tenant, points or [{"gen_lr": 1e-3}], spec=spec, **kw)
+
+
+def _clean_fault_env():
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# queue containment units
+# ---------------------------------------------------------------------------
+def test_deadletter_is_terminal_with_dossier(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    dossier = {"reason": "crash_loop", "attempts": 3, "tenant": "t"}
+    assert q.deadletter(rid, dossier=dossier) is True
+    assert q.terminal_state(rid) == "deadletter"
+    assert q.pending() == []
+    assert q.claim(rid, "w", lease_s=5.0) is None
+    assert q.deadletter_record(rid)["dossier"] == dossier
+    assert [r["request_id"] for r in q.deadletters()] == [rid]
+    st = q.status()
+    assert st["counts"]["deadletter"] == 1
+    assert st["by_tenant"]["t"]["deadletter"] == 1
+
+
+def test_terminal_states_mutually_exclusive(tmp_path):
+    # every terminal write goes through one settle that defers to any
+    # existing record in ANY terminal directory: exactly one state wins
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    assert q.deadletter(rid, dossier={}) is True
+    assert q.complete(rid, result={"late": True}) is False
+    assert q.fail(rid, "numerics_abort") is False
+    assert q.cancel(rid) is False
+    states = [s for s in TERMINAL_STATES
+              if os.path.exists(os.path.join(str(tmp_path), s,
+                                             f"{rid}.json"))]
+    assert states == ["deadletter"]
+
+
+def test_cancel_rides_tombstone_path(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    # canceling a LEASED request drops the lease (never orphaned) and the
+    # request is never re-planned
+    lease = q.claim(rid, "w1", lease_s=60.0)
+    assert q.cancel(rid, reason="operator") is True
+    assert q.terminal_state(rid) == "canceled"
+    assert not os.path.exists(lease.path)
+    assert q.pending() == []
+    assert q.claim(rid, "w2", lease_s=5.0) is None
+    # first writer wins: a racing cancel (or the worker's settle) loses
+    assert q.cancel(rid) is False
+    # the standing owner's publish must lose to the cancel record
+    assert q.complete(rid, result={"ok": True}) is False
+    assert q.status()["counts"]["canceled"] == 1
+
+
+def test_cancel_unknown_request_id_refused(tmp_path):
+    q = FleetQueue(tmp_path)
+    assert q.cancel("req-never-submitted") is False
+
+
+def test_expired_lease_of_canceled_request_is_gcd(tmp_path):
+    # a worker dies holding a lease, then the request is canceled out from
+    # under it: the stale lease must not sit forever once it expires
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    lease = q.claim(rid, "w1", lease_s=60.0, batch_id="b1",
+                    batch_request_ids=[rid])
+    assert q.cancel(rid) is True          # settle already unlinked it...
+    # ...so recreate the orphan: a dead claimant's lease file outliving
+    # the cancel, expired
+    with open(lease.path, "w") as f:
+        json.dump(dict(lease.data, expires_at=0.0), f)
+    assert q.expired_claims() == {}       # scan GCs it, no reclaim offered
+    assert not os.path.exists(lease.path)
+
+
+def test_requeue_resurrects_with_fresh_budget(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    for _ in range(3):
+        q.record_attempt(rid, "giving_up", batch_id="b")
+    q.deadletter(rid, dossier={"reason": "crash_loop"})
+    assert q.requeue(rid) is True
+    # pending again with a zeroed budget — but still marked suspect, so
+    # the planner keeps it solo; the dossier is archived (not a terminal
+    # record anymore, but kept for audit)
+    assert [r["request_id"] for r in q.pending()] == [rid]
+    att = q.attempt_record(rid)
+    assert att["attempts"] == 0 and att["suspect"] is True
+    assert q.deadletters() == []
+    archived = [n for n in os.listdir(tmp_path / "deadletter")
+                if ".requeued." in n]
+    assert len(archived) == 1
+    # idempotence: nothing left to resurrect
+    assert q.requeue(rid) is False
+    assert q.requeue("req-unknown") is False
+
+
+def test_settle_race_converges_to_priority_winner(tmp_path):
+    # two racers aiming at DIFFERENT terminal states can both pass the
+    # pre-write is_terminal check; the post-write re-scan must converge
+    # every interleaving onto the fixed priority (done > canceled).
+    # Simulate the stale check by forcing is_terminal to say "not yet".
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    assert q.complete(rid, result={"ok": True}) is True
+    real = q.is_terminal
+    q.is_terminal = lambda r: False          # the racing cancel's stale view
+    try:
+        assert q.cancel(rid) is False        # defers to the done record
+    finally:
+        q.is_terminal = real
+    assert not os.path.exists(os.path.join(str(tmp_path), "canceled",
+                                           f"{rid}.json"))
+    assert q.terminal_state(rid) == "done"
+
+    # the mirror interleaving: cancel landed first, the done writer's
+    # check was stale — done outranks and the canceled record is removed
+    rid2 = _submit_tiny(q, "t2")
+    assert q.cancel(rid2) is True
+    q.is_terminal = lambda r: False
+    try:
+        assert q.complete(rid2, result={"ok": True}) is True
+    finally:
+        q.is_terminal = real
+    assert not os.path.exists(os.path.join(str(tmp_path), "canceled",
+                                           f"{rid2}.json"))
+    assert q.terminal_state(rid2) == "done"
+
+
+def test_attempt_ledger_failure_vs_reclaim_and_bounded_history(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    assert q.attempt_record(rid) is None
+    rec = q.record_attempt(rid, "giving_up", batch_id="b1", run_dir="/r1")
+    assert rec["attempts"] == 1 and rec["reclaims"] == 0
+    # reclaims are dossier evidence, NOT budget (infra faults must not
+    # spend a healthy tenant's budget)
+    rec = q.record_attempt(rid, "lease_expired", kind="reclaim")
+    assert rec["attempts"] == 1 and rec["reclaims"] == 1
+    assert rec["last"]["classification"] == "lease_expired"
+    for i in range(30):
+        rec = q.record_attempt(rid, f"c{i}")
+    assert rec["attempts"] == 31
+    assert len(rec["history"]) == 20      # bounded
+    assert [a["request_id"] for a in q.attempt_records()] == [rid]
+
+
+def test_pinned_batch_roundtrip(tmp_path):
+    q = FleetQueue(tmp_path)
+    q.pin_batch("half-a", ["r1", "r2"], parent_batch_id="parent")
+    pins = q.pinned_batches()
+    assert [p["batch_id"] for p in pins] == ["half-a"]
+    assert pins[0]["requests"] == ["r1", "r2"]
+    assert pins[0]["parent_batch_id"] == "parent"
+    q.unpin_batch("half-a")
+    assert q.pinned_batches() == []
+    q.unpin_batch("half-a")               # idempotent
+
+
+# ---------------------------------------------------------------------------
+# planner suspect quarantine (the containment circuit breaker)
+# ---------------------------------------------------------------------------
+def _req(i, n_points=1, per_lane=None, tenant="t"):
+    shape = {"num_chans": 4, "num_factors": 2}
+    return {"request_id": f"req-{i:03d}", "tenant": tenant,
+            "submitted_at": float(i), "priority": 0, "deadline_s": None,
+            "shape": shape,
+            "points": [{"gen_lr": 1e-3 * (j + 1)} for j in range(n_points)],
+            "epochs": 10, "per_lane_bytes": per_lane, "fixed_bytes": 0,
+            "spec": {"model_config": shape, "epochs": 10}}
+
+
+def test_suspects_planned_solo_never_merged():
+    reqs = [_req(i) for i in range(4)]
+    pl = planner.plan(reqs, n_devices=1, suspects={"req-001"})
+    by_len = sorted(pl["batches"], key=lambda b: len(b["requests"]))
+    assert [b["requests"] for b in by_len] == \
+        [["req-001"], ["req-000", "req-002", "req-003"]]
+    assert by_len[0]["suspect"] is True
+    assert by_len[1]["suspect"] is False
+    # without the suspect flag the same mix merges into one batch
+    assert len(planner.plan(reqs, n_devices=1)["batches"]) == 1
+
+
+def test_suspect_over_budget_is_unschedulable_not_admitted():
+    r = _req(0, n_points=4, per_lane=4 << 30)  # 16 GiB at its solo bucket
+    pl = planner.plan([r], n_devices=1, budget_bytes=8 << 30,
+                      suspects={"req-000"})
+    assert pl["batches"] == []
+    assert pl["unschedulable"][0]["reason"] == "exceeds_headroom"
+
+
+# ---------------------------------------------------------------------------
+# lease-renewal heartbeat escalation (satellite: no silent fs hiccup)
+# ---------------------------------------------------------------------------
+class _StubLogger:
+    def __init__(self):
+        self.events = []
+
+    def log(self, event, **kw):
+        self.events.append(dict(kw, event=event))
+
+
+class _FlakyLease:
+    """renew() raises OSError for the first ``n_errors`` calls."""
+
+    def __init__(self, n_errors):
+        self.n_errors = n_errors
+        self.calls = 0
+
+    def renew(self, lease_s, now=None):
+        self.calls += 1
+        if self.calls <= self.n_errors:
+            raise OSError("disk on fire")
+
+
+def _wait_for(cond, timeout=8.0):
+    deadline = time.time() + timeout
+    while not cond():
+        assert time.time() < deadline, "condition never held"
+        time.sleep(0.02)
+
+
+def test_renew_errors_escalate_to_lease_lost():
+    log = _StubLogger()
+    leases = {"r1": _FlakyLease(n_errors=10 ** 6)}
+    with _LeaseHeartbeat(leases, lease_s=0.3, logger=log,
+                         max_renew_misses=3) as hb:
+        _wait_for(lambda: "r1" in hb.lost)
+    errors = [e for e in log.events if e.get("kind") == "renew_error"]
+    assert [e["consecutive"] for e in errors] == [1, 2, 3]
+    assert "OSError" in errors[0]["error"]
+    lost = [e for e in log.events if e.get("kind") == "lease_lost"]
+    assert lost and lost[0]["error"] == "renewal misses exhausted"
+    # escalated exactly once, then the lease left the renewal set
+    assert hb.lost == ["r1"] and not leases
+
+
+def test_renew_error_recovery_resets_consecutive_count():
+    log = _StubLogger()
+    lease = _FlakyLease(n_errors=2)       # recovers before the 3rd miss
+    with _LeaseHeartbeat({"r1": lease}, lease_s=0.3, logger=log,
+                         max_renew_misses=3) as hb:
+        _wait_for(lambda: lease.calls >= 5)
+        assert hb.lost == []
+    errors = [e for e in log.events if e.get("kind") == "renew_error"]
+    assert [e["consecutive"] for e in errors] == [1, 2]
+    assert not any(e.get("kind") == "lease_lost" for e in log.events)
+
+
+def test_lost_lease_stops_renewals():
+    class _GoneLease:
+        def __init__(self):
+            self.calls = 0
+
+        def renew(self, lease_s, now=None):
+            self.calls += 1
+            raise LeaseLost("reclaimed")
+
+    log = _StubLogger()
+    lease = _GoneLease()
+    with _LeaseHeartbeat({"r1": lease}, lease_s=0.3, logger=log) as hb:
+        _wait_for(lambda: "r1" in hb.lost)
+    assert lease.calls == 1               # dropped from the set immediately
+    assert any(e.get("kind") == "lease_lost" for e in log.events)
+
+
+# ---------------------------------------------------------------------------
+# worker settle discipline against a stubbed supervisor (no jax child)
+# ---------------------------------------------------------------------------
+def _stub_supervise(monkeypatch, classification, rc=1):
+    def fake(cmd, ledger_path=None, policy=None, env=None, **kw):
+        return SuperviseOutcome(classification=classification,
+                                returncode=rc, attempts=[{"rc": rc}])
+
+    monkeypatch.setattr(worker_mod, "supervise", fake)
+
+
+def _claimed_batch(q, n, lease_s=60.0):
+    members = [dict(r) for r in q.requests()][:n]
+    batch = planner._batch_view(members, 1)
+    leases = {}
+    for m in members:
+        lease = q.claim(m["request_id"], "w-test", lease_s,
+                        batch_id=batch["batch_id"],
+                        batch_request_ids=batch["requests"],
+                        tenant=m["tenant"])
+        assert lease is not None
+        leases[m["request_id"]] = lease
+    return batch, leases, members
+
+
+def _write_result(q, batch_id, rid, n_points=1, failures=()):
+    d = os.path.join(q.batch_dir(batch_id), "results")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"{rid}.json"), "w") as f:
+        json.dump({"request_id": rid, "n_points": n_points,
+                   "failures": list(failures),
+                   "best_criteria": [0.5] * n_points}, f)
+
+
+def test_missing_result_released_once_then_deadlettered(tmp_path,
+                                                        monkeypatch):
+    # a clean exit with NO per-request artifact is a durability bug, not a
+    # verdict: budget-routed (released), never a stub done
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+    _stub_supervise(monkeypatch, "clean", rc=0)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 1)
+        run_one_batch(q, batch, leases, members, logger, "w-test",
+                      max_attempts=2)
+        assert q.terminal_state(rid) is None          # released, not done
+        assert q.attempt_record(rid)["attempts"] == 1
+        assert q.attempt_record(rid)["last"]["classification"] \
+            == "missing_result"
+        assert [r["request_id"] for r in q.pending()] == [rid]
+        # second clean-but-empty run exhausts the budget -> dead-letter
+        batch, leases, members = _claimed_batch(q, 1)
+        run_one_batch(q, batch, leases, members, logger, "w-test",
+                      max_attempts=2)
+    assert q.terminal_state(rid) == "deadletter"
+    doss = q.deadletter_record(rid)["dossier"]
+    assert doss["reason"] == "missing_result" and doss["attempts"] == 2
+
+
+def test_solo_deterministic_class_fails_outright(tmp_path, monkeypatch):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+    _stub_supervise(monkeypatch, "numerics_abort", rc=18)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        run_one_batch(q, *_claimed_batch(q, 1), logger, "w-test")
+    assert q.terminal_state(rid) == "failed"
+    assert q.attempt_record(rid)["attempts"] == 1
+
+
+def test_solo_crash_loop_burns_budget_then_deadletters(tmp_path,
+                                                       monkeypatch):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+    _stub_supervise(monkeypatch, "giving_up", rc=139)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        for expect_attempts in (1, 2):
+            run_one_batch(q, *_claimed_batch(q, 1), logger, "w-test",
+                          max_attempts=2)
+            assert q.attempt_record(rid)["attempts"] == expect_attempts
+    assert q.terminal_state(rid) == "deadletter"
+    assert q.deadletter_record(rid)["dossier"]["reason"] == "crash_loop"
+    recs = read_jsonl(str(tmp_path / "fleet"))
+    assert any(r.get("kind") == "deadletter" for r in recs
+               if r.get("event") == "fleet")
+
+
+def test_merged_terminal_failure_bisects_into_pinned_halves(tmp_path,
+                                                            monkeypatch):
+    # a blind terminal failure of a MERGED batch never blames every member:
+    # exact halves are pinned (the planner cannot re-merge them) and every
+    # member is charged one attempt
+    q = FleetQueue(tmp_path / "fleet")
+    rids = [_submit_tiny(q, f"t{i}") for i in range(4)]
+    _stub_supervise(monkeypatch, "giving_up", rc=137)
+    root = str(tmp_path / "fleet")
+    with MetricLogger(root) as logger:
+        run_one_batch(q, *_claimed_batch(q, 4), logger, "w-test")
+        for rid in rids:
+            assert q.terminal_state(rid) is None      # nobody failed
+            assert q.attempt_record(rid)["attempts"] == 1
+        pins = q.pinned_batches()
+        assert sorted(p["requests"] for p in pins) \
+            == sorted([rids[:2], rids[2:]])
+        assert {p["parent_batch_id"] for p in pins} == \
+            {planner.batch_id_for(rids)}
+        # the next claim cycle runs a pinned half EXACTLY as pinned, and
+        # consumes the pin
+        got = worker_mod._next_batch(q, "w2", 60.0, 1, None,
+                                     planner.DEFAULT_MAX_BUCKET, logger)
+        assert got is not None
+        batch, leases, members = got
+        assert batch["requests"] in (rids[:2], rids[2:])
+        assert len(q.pinned_batches()) == 1
+        for lease in leases.values():
+            lease.release()
+    recs = read_jsonl(root)
+    bisects = [r for r in recs if r.get("event") == "fleet"
+               and r.get("kind") == "bisect"]
+    assert len(bisects) == 1
+    assert [h["requests"] for h in bisects[0]["halves"]] \
+        == [rids[:2], rids[2:]]
+    assert obs_schema.validate_records(recs) == []
+
+
+def test_clean_fully_quarantined_member_deadlettered_siblings_done(
+        tmp_path, monkeypatch):
+    # the attribution path: the grid engine named the culprit (every point
+    # of one request quarantined) — no bisection, siblings complete
+    q = FleetQueue(tmp_path / "fleet")
+    rid_ok = _submit_tiny(q, "healthy")
+    rid_bad = _submit_tiny(q, "poison")
+    _stub_supervise(monkeypatch, "clean", rc=0)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 2)
+        _write_result(q, batch["batch_id"], rid_ok)
+        _write_result(q, batch["batch_id"], rid_bad, failures=[
+            {"point": 0, "cause": "nonfinite_grad"}])
+        run_one_batch(q, batch, leases, members, logger, "w-test")
+    assert q.terminal_state(rid_ok) == "done"
+    assert q.terminal_state(rid_bad) == "deadletter"
+    doss = q.deadletter_record(rid_bad)["dossier"]
+    assert doss["reason"] == "poison_quarantine"
+    assert doss["quarantine_causes"] == {"nonfinite_grad": 1}
+
+
+def test_deadline_eviction_is_not_poison(tmp_path, monkeypatch):
+    # a request whose every lane hit its wall-clock fit deadline is NOT a
+    # deterministic poison: it completes done-with-failures, never
+    # dead-lettered as poison_quarantine
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+    _stub_supervise(monkeypatch, "clean", rc=0)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 1)
+        _write_result(q, batch["batch_id"], rid, failures=[
+            {"point": 0, "cause": "deadline"}])
+        run_one_batch(q, batch, leases, members, logger, "w-test")
+    assert q.terminal_state(rid) == "done"
+
+
+def test_merged_batch_with_lost_leases_never_verdicts_survivor(
+        tmp_path, monkeypatch):
+    # a MERGED batch whose other leases were lost mid-run dies with a
+    # deterministic class: the lone survivor may be a healthy co-tenant of
+    # the real poison, so it is budget-routed (released), never terminally
+    # failed with the batch's verdict
+    q = FleetQueue(tmp_path / "fleet")
+    rid_a = _submit_tiny(q, "healthy")
+    rid_b = _submit_tiny(q, "other")
+
+    def fake(cmd, ledger_path=None, policy=None, env=None, **kw):
+        # another worker reclaims B's lease mid-run (a chaos expire race):
+        # force expiry, steal it, and let the heartbeat notice LeaseLost.
+        # Retried because the heartbeat may re-extend between our expiry
+        # write and the claim.
+        path = q._lease_path(rid_b)
+        for _ in range(50):
+            with open(path) as f:
+                lease = json.load(f)
+            lease["expires_at"] = 0.0
+            with open(path, "w") as f:
+                json.dump(lease, f)
+            if q.claim(rid_b, "thief", lease_s=60.0) is not None:
+                break
+        else:
+            raise AssertionError("never stole the lease")
+        time.sleep(0.5)                      # > one heartbeat period
+        return SuperviseOutcome(classification="numerics_abort",
+                                returncode=18, attempts=[])
+
+    monkeypatch.setattr(worker_mod, "supervise", fake)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 2, lease_s=0.6)
+        run_one_batch(q, batch, leases, members, logger, "w-test",
+                      lease_s=0.6, max_attempts=3)
+    # survivor: released with one budgeted attempt, NOT failed
+    assert q.terminal_state(rid_a) is None
+    assert q.attempt_record(rid_a)["attempts"] == 1
+    # the stolen member was never settled by the losing worker
+    assert q.terminal_state(rid_b) is None
+    assert q.lease_of(rid_b)["worker"] == "thief"
+
+
+def test_pinned_half_drops_terminal_members(tmp_path, monkeypatch):
+    # a pinned member canceled between pin and claim must not ride back
+    # into the fit: the half is re-keyed to the surviving composition
+    q = FleetQueue(tmp_path / "fleet")
+    rids = [_submit_tiny(q, f"t{i}") for i in range(3)]
+    q.pin_batch(planner.batch_id_for(rids), rids, parent_batch_id="parent")
+    q.cancel(rids[1])
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        got = worker_mod._next_batch(q, "w", 60.0, 1, None,
+                                     planner.DEFAULT_MAX_BUCKET, logger)
+        assert got is not None
+        batch, leases, members = got
+        survivors = [rids[0], rids[2]]
+        assert batch["requests"] == survivors
+        assert batch["batch_id"] == planner.batch_id_for(survivors)
+        assert [m["request_id"] for m in members] == survivors
+        assert q.pinned_batches() == []      # old pin gone, new consumed
+        for lease in leases.values():
+            lease.release()
+
+
+def test_requeued_deadletter_is_planned_solo(tmp_path):
+    # the worker derives the planner's suspect set from the attempt
+    # ledger: a requeued dead-letter (attempts back to 0) must still be
+    # quarantined solo via its suspect marker
+    q = FleetQueue(tmp_path / "fleet")
+    bad = _submit_tiny(q, "bad")
+    healthy = [_submit_tiny(q, f"h{i}") for i in range(2)]
+    q.record_attempt(bad, "giving_up")
+    q.deadletter(bad, dossier={"reason": "crash_loop"})
+    assert q.requeue(bad) is True
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        got = worker_mod._next_batch(q, "w", 60.0, 1, None,
+                                     planner.DEFAULT_MAX_BUCKET, logger)
+        assert got is not None
+        batch, leases, members = got
+        # whichever batch was claimed first, the suspect is never merged
+        # with the healthy tenants
+        assert batch["requests"] in ([bad], healthy)
+        for lease in leases.values():
+            lease.release()
+    plan_ev = [r for r in read_jsonl(str(tmp_path / "fleet"))
+               if r.get("event") == "fleet" and r.get("kind") == "plan"]
+    assert plan_ev and plan_ev[-1]["suspects"] == [bad]
+
+
+def test_partial_quarantine_is_normal_sweep_behavior(tmp_path, monkeypatch):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t", points=[{"gen_lr": 1e-3}, {"gen_lr": 3e-3}])
+    _stub_supervise(monkeypatch, "clean", rc=0)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 1)
+        _write_result(q, batch["batch_id"], rid, n_points=2, failures=[
+            {"point": 1, "cause": "nonfinite_val"}])
+        run_one_batch(q, batch, leases, members, logger, "w-test")
+    assert q.terminal_state(rid) == "done"
+
+
+def test_canceled_member_is_never_published(tmp_path, monkeypatch):
+    # cancel lands while the batch is in flight: the worker's settle finds
+    # the terminal record and its publish loses
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+
+    def fake(cmd, ledger_path=None, policy=None, env=None, **kw):
+        q.cancel(rid, reason="mid-flight")
+        return SuperviseOutcome(classification="clean", returncode=0,
+                                attempts=[])
+
+    monkeypatch.setattr(worker_mod, "supervise", fake)
+    with MetricLogger(str(tmp_path / "fleet")) as logger:
+        batch, leases, members = _claimed_batch(q, 1)
+        _write_result(q, batch["batch_id"], rid)
+        run_one_batch(q, batch, leases, members, logger, "w-test")
+    assert q.terminal_state(rid) == "canceled"
+    assert q.result(rid) is None
+
+
+# ---------------------------------------------------------------------------
+# chaos harness primitives
+# ---------------------------------------------------------------------------
+def test_poison_point_modes_and_strip():
+    nan = chaos.poison_point("nan")
+    assert chaos.CHAOS_KEY not in nan          # attributable: a real point
+    assert nan["gen_lr"] > 1e19
+    blind = chaos.poison_point("sigkill")
+    assert blind[chaos.CHAOS_KEY] == "sigkill"
+    sink = []
+    stripped = chaos.strip_chaos(blind, sink)
+    assert chaos.CHAOS_KEY not in stripped and sink == ["sigkill"]
+    assert chaos.strip_chaos({"gen_lr": 1e-3}) == {"gen_lr": 1e-3}
+
+
+def test_detonate_exit_specs():
+    with pytest.raises(SystemExit) as e:
+        chaos.detonate("exit:7")
+    assert e.value.code == 7
+    with pytest.raises(SystemExit) as e:
+        chaos.detonate("hang:0.01")
+    assert e.value.code == 19                  # watchdog EXIT_HANG
+    with pytest.raises(SystemExit):
+        chaos.detonate("wat")
+
+
+def test_unarmed_sentinels_are_inert():
+    from redcliff_tpu.runtime.faultinject import fleet_poison_armed
+
+    assert not fleet_poison_armed()
+
+
+def test_torn_spool_fault_skipped_and_healed(tmp_path):
+    q = FleetQueue(tmp_path)
+    a = _submit_tiny(q, "a")
+    chaos.tear_spool_tail(tmp_path)
+    b = _submit_tiny(q, "b")                   # heals the line boundary
+    assert [r["request_id"] for r in q.requests()] == [a, b]
+    assert q.status()["torn_spool_lines"] == 1
+
+
+def test_corrupt_lease_fault_is_reclaimable(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    q.claim(rid, "w1", lease_s=60.0)
+    assert chaos.corrupt_random_lease(tmp_path, random.Random(0)) \
+        == f"{rid}.json"
+    # torn lease == expired: the request is reclaimable, never wedged
+    lease = q.claim(rid, "w2", lease_s=30.0)
+    assert lease is not None and lease.data["worker"] == "w2"
+
+
+def test_expire_lease_race_old_owner_stands_down(tmp_path):
+    q = FleetQueue(tmp_path)
+    rid = _submit_tiny(q, "t")
+    l1 = q.claim(rid, "w1", lease_s=600.0)
+    assert chaos.expire_random_lease(tmp_path, random.Random(0)) == rid
+    l2 = q.claim(rid, "w2", lease_s=30.0)
+    assert l2 is not None
+    with pytest.raises(LeaseLost):
+        l1.renew(600.0)                        # exactly one live publisher
+
+
+def test_random_fleet_fault_schedule_deterministic():
+    a = chaos.random_fleet_fault_schedule(7, n_ops=12)
+    assert a == chaos.random_fleet_fault_schedule(7, n_ops=12)
+    assert a != chaos.random_fleet_fault_schedule(8, n_ops=12)
+    assert set(a) <= set(chaos.FLEET_FAULT_KINDS)
+    with pytest.raises(ValueError):
+        chaos.apply_fault("wat", ".", random.Random(0))
+
+
+# ---------------------------------------------------------------------------
+# cancel / requeue CLI verbs
+# ---------------------------------------------------------------------------
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.fleet", *args],
+        capture_output=True, text=True, env=_clean_fault_env(),
+        cwd=REPO_ROOT)
+
+
+def test_cancel_requeue_cli_verbs(tmp_path):
+    root = str(tmp_path / "fleet")
+    q = FleetQueue(root)
+    rid = _submit_tiny(q, "cli")
+    out = _cli("cancel", rid, "--root", root, "--reason", "operator")
+    assert out.returncode == 0, out.stderr
+    assert q.terminal_state(rid) == "canceled"
+    # a second cancel reports the existing terminal state and fails
+    out = _cli("cancel", rid, "--root", root)
+    assert out.returncode == 1 and "canceled" in out.stderr
+
+    rid2 = _submit_tiny(q, "cli")
+    q.record_attempt(rid2, "giving_up")
+    q.deadletter(rid2, dossier={"reason": "crash_loop"})
+    out = _cli("requeue", rid2, "--root", root)
+    assert out.returncode == 0, out.stderr
+    assert q.terminal_state(rid2) is None
+    assert q.attempt_record(rid2)["attempts"] == 0
+    assert q.attempt_record(rid2)["suspect"] is True
+    out = _cli("requeue", rid2, "--root", root)
+    assert out.returncode == 1
+    # the verbs are audited as schema-registered fleet events
+    kinds = {r.get("kind") for r in read_jsonl(root)
+             if r.get("event") == "fleet"}
+    assert {"cancel", "requeue"} <= kinds
+    assert obs_schema.validate_records(read_jsonl(root)) == []
+
+
+# ---------------------------------------------------------------------------
+# resume-fingerprint compatibility across the lane_seeds upgrade
+# ---------------------------------------------------------------------------
+def test_resume_accepts_pre_lane_seeds_checkpoint(tmp_path):
+    """A grid checkpoint written BEFORE per-lane content seeds joined the
+    resume fingerprint must still resume under a lane_seeds-carrying spec:
+    seeds are consulted only by init_grid and a resumed fit never
+    re-initializes, so rejecting would crash-loop an upgraded fleet
+    worker's reclaim of an old in-flight batch straight into the
+    dead-letter queue. A checkpoint that RECORDED its derivation
+    (``lane_seeds`` key present, even as None) still rejects a different
+    one — that genuinely is a different fit."""
+    import dataclasses
+
+    import jax
+
+    from redcliff_tpu.data.datasets import ArrayDataset
+    from redcliff_tpu.fleet.run_batch import lane_seed
+    from redcliff_tpu.parallel.grid import RedcliffGridRunner
+    from redcliff_tpu.runtime import checkpoint as rck
+    from redcliff_tpu.runtime.faultinject import _tiny_runner
+
+    runner, X, Y = _tiny_runner(3)
+    ds = ArrayDataset(X, Y)
+    ck = str(tmp_path / "ck")
+    runner.fit(jax.random.PRNGKey(2), ds, ds, max_iter=2,
+               checkpoint_dir=ck, checkpoint_every=1)
+    seeded = dataclasses.replace(
+        runner.spec, lane_seeds=[lane_seed(p) for p in runner.spec.points])
+
+    # control: the checkpoint RECORDED lane_seeds=None — resuming under a
+    # content-seeded spec is a fingerprint mismatch, named
+    with pytest.raises(ValueError, match="lane_seeds"):
+        RedcliffGridRunner(runner.model, runner.tc, seeded).fit(
+            jax.random.PRNGKey(2), ds, ds, checkpoint_dir=ck,
+            checkpoint_every=1)
+
+    # rewrite as a pre-containment checkpoint (no lane_seeds key at all):
+    # the carve-out must resume it and finish the remaining epoch
+    path = os.path.join(ck, "grid_checkpoint.pkl")
+    blob = rck.read_checkpoint(path)
+    del blob["meta"]["lane_seeds"]
+    rck.write_checkpoint(path, blob)
+    res = RedcliffGridRunner(runner.model, runner.tc, seeded).fit(
+        jax.random.PRNGKey(2), ds, ds, checkpoint_dir=ck,
+        checkpoint_every=1)
+    assert res.val_history.shape[0] == 3  # resumed epoch 2, not rejected
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance (supervised jax children; warm suite compile cache)
+# ---------------------------------------------------------------------------
+def _drain(root, env=None, max_restarts=2, **kw):
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    from redcliff_tpu.fleet.worker import work
+
+    policy = SupervisorPolicy(
+        max_restarts=max_restarts,
+        backoff=RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+    return work(str(root), drain=True, poll_s=0.2, lease_s=20.0,
+                supervisor_policy=policy, env=env or _clean_fault_env(),
+                **kw)
+
+
+def _submit_mix(q, poison=None, n_healthy=5, epochs=2):
+    """n_healthy 1-point requests (tenant h<i>) + optionally one poison
+    request (tenant 'poison'); returns ({tenant: rid}, poison_rid)."""
+    rids = {}
+    for i in range(n_healthy):
+        rids[f"h{i}"] = _submit_tiny(q, f"h{i}", epochs=epochs,
+                                     points=[{"gen_lr": 1e-3 * (i + 1)}])
+    prid = None
+    if poison is not None:
+        prid = _submit_tiny(q, "poison", epochs=epochs, points=[poison])
+    return rids, prid
+
+
+def _payload(result):
+    """A per-request result minus its identity fields (request id / batch
+    id differ across legs by construction; the numeric payload — criteria,
+    epochs, val history, active mask, failures — is the bit-identity
+    surface)."""
+    return {k: v for k, v in result.items()
+            if k not in ("request_id", "batch_id")}
+
+
+def _assert_invariant(q, rids):
+    """Every request terminal in exactly ONE of done/failed/deadletter/
+    canceled — never lost, never duplicated."""
+    for rid in rids:
+        states = [s for s in TERMINAL_STATES if os.path.exists(
+            os.path.join(q.root, s, f"{rid}.json"))]
+        assert len(states) == 1, f"{rid}: terminal in {states}"
+
+
+def test_attribution_containment_6way_bit_identical(tmp_path):
+    """The bisection-determinism contract, attribution mode: a 6-request
+    merged batch with 1 nan-poison request converges to exactly 1
+    dead-letter entry and 5 done records, survivors bit-identical to an
+    uninterrupted (poison-free) run — the poison co-tenant costs its
+    siblings nothing, not even an ulp (same G-bucket both legs)."""
+    root_p, root_r = tmp_path / "poisoned", tmp_path / "ref"
+    qp, qr = FleetQueue(root_p), FleetQueue(root_r)
+    rids_p, prid = _submit_mix(qp, poison=chaos.poison_point("nan"))
+    rids_r, _ = _submit_mix(qr)
+
+    assert _drain(root_p, max_attempts=2) == 1   # ONE merged batch
+    cp = qp.status()["counts"]
+    assert cp["done"] == 5 and cp["deadletter"] == 1 and cp["failed"] == 0
+    _assert_invariant(qp, list(rids_p.values()) + [prid])
+    doss = qp.deadletter_record(prid)["dossier"]
+    assert doss["reason"] == "poison_quarantine"
+    assert set(doss["quarantine_causes"]) <= {"nonfinite_grad",
+                                              "nonfinite_val"}
+    assert doss["attempts"] == 1                 # never crash-looped
+
+    assert _drain(root_r) == 1
+    for tenant, rid in rids_p.items():
+        res = _payload(qp.result(rid)["result"])
+        ref = _payload(qr.result(rids_r[tenant])["result"])
+        assert res == ref, f"{tenant} diverged beside the poison co-tenant"
+
+    # observability: watch fleet mode renders dead-letter depth + attempt
+    # budgets; report grows the containment section; all schema-valid
+    from redcliff_tpu.obs.report import build_report, render_text
+    from redcliff_tpu.obs.watch import build_snapshot
+
+    snap = build_snapshot(str(root_p))
+    assert obs_schema.validate_record(snap) == []
+    assert snap["fleet"]["deadletter"]["depth"] == 1
+    dl0 = snap["fleet"]["deadletter"]["requests"][0]
+    assert dl0["tenant"] == "poison" and dl0["attempts"] == 1
+    # terminal budgets live in the dossier headline; the live attempts
+    # map only carries in-flight/queued requests (everything settled here)
+    assert prid not in snap["fleet"]["attempts"]
+    report = build_report(str(root_p))
+    fc = report["fleet_containment"]
+    assert fc["counts"]["deadletter"] == 1
+    assert fc["deadletters"][0]["dossier"]["reason"] == "poison_quarantine"
+    assert fc["events"].get("deadletter") == 1
+    assert "dead-letter" in render_text(report)
+    recs = read_jsonl(str(root_p))
+    assert obs_schema.validate_records(recs) == []
+
+
+@pytest.mark.slow
+def test_blind_sigkill_poison_bisection_bit_identical(tmp_path):
+    """The bisection-determinism contract, blind mode: the poison child
+    SIGKILLs itself before any attribution exists, so the worker corners
+    it by halving — 6 requests converge to 5 done + exactly 1 dead-letter,
+    and the survivors (finishing in width-4 and width-2 halves) are
+    bit-identical to the uninterrupted width-8 merged run on the
+    width-exact legacy CPU runtime."""
+    env = _clean_fault_env()
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_cpu_use_thunk_runtime=false").strip()
+    armed = dict(env, REDCLIFF_FAULT_INJECT="fleet_poison")
+
+    root_a, root_r = tmp_path / "armed", tmp_path / "ref"
+    qa, qr = FleetQueue(root_a), FleetQueue(root_r)
+    rids_a, prid = _submit_mix(qa, poison=chaos.poison_point("sigkill"))
+    rids_r, prid_r = _submit_mix(qr, poison=chaos.poison_point("sigkill"))
+
+    _drain(root_a, env=armed, max_restarts=0, max_attempts=3)
+    ca = qa.status()["counts"]
+    assert ca["done"] == 5 and ca["deadletter"] == 1 and ca["failed"] == 0
+    _assert_invariant(qa, list(rids_a.values()) + [prid])
+    doss = qa.deadletter_record(prid)["dossier"]
+    assert doss["reason"] == "crash_loop"
+    assert doss["attempts"] >= 3
+    assert "giving_up" in doss["classifications"]
+    recs = read_jsonl(str(root_a))
+    bisects = [r for r in recs if r.get("event") == "fleet"
+               and r.get("kind") == "bisect"]
+    assert len(bisects) >= 2, "halving never cornered the poison"
+    assert obs_schema.validate_records(recs) == []
+
+    # reference: the SAME spool unarmed — sentinels stripped, all 6 fit in
+    # one uninterrupted width-8 batch
+    assert _drain(root_r, env=env) == 1
+    assert qr.status()["counts"]["done"] == 6
+    for tenant, rid in rids_a.items():
+        res = _payload(qa.result(rid)["result"])
+        ref = _payload(qr.result(rids_r[tenant])["result"])
+        assert res == ref, f"{tenant} diverged across bisection widths"
+
+
+@pytest.mark.slow
+def test_chaos_soak_containment_invariant(tmp_path):
+    """The seeded multi-worker chaos soak: real worker processes, a
+    nan-poison co-tenant, SIGKILL storms, forced lease-expiry races, and
+    torn/corrupt durable state — every request must end terminal in
+    exactly one state, healthy requests all done with results
+    bit-identical to a fault-free drain, the poison dead-lettered."""
+    seed = 11
+    env = _clean_fault_env()
+    root, ref = tmp_path / "soak", tmp_path / "ref"
+    q, qr = FleetQueue(root), FleetQueue(ref)
+    rids, prid = _submit_mix(q, poison=chaos.poison_point("nan"),
+                             n_healthy=4, epochs=3)
+    rids_r, prid_r = _submit_mix(qr, poison=chaos.poison_point("nan"),
+                                 n_healthy=4, epochs=3)
+    all_rids = list(rids.values()) + [prid]
+
+    rng = random.Random(seed)
+    schedule = chaos.random_fleet_fault_schedule(seed, n_ops=5)
+    ops = iter(schedule)
+    applied = []
+    with chaos.WorkerFleet(root, n_workers=2, lease_s=3.0, poll_s=0.2,
+                           max_attempts=3, env=env) as fleet:
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if all(q.is_terminal(r) for r in all_rids):
+                break
+            op = next(ops, None)
+            if op is not None:
+                applied.append(chaos.apply_fault(op, root, rng,
+                                                 fleet=fleet))
+            fleet.respawn()
+            time.sleep(2.0)
+        else:
+            raise AssertionError(
+                f"soak never settled; status={q.status()['counts']} "
+                f"applied={applied}")
+
+    _assert_invariant(q, all_rids)
+    counts = q.status()["counts"]
+    assert counts["done"] == 4, (counts, applied)
+    assert counts["deadletter"] == 1 and counts["failed"] == 0
+    assert q.terminal_state(prid) == "deadletter"
+    # healthy requests bit-identical to a fault-free drain of the same mix
+    assert _drain(ref, max_attempts=3) >= 1
+    for tenant, rid in rids.items():
+        assert _payload(q.result(rid)["result"]) \
+            == _payload(qr.result(rids_r[tenant])["result"]), \
+            f"{tenant} diverged under chaos (applied={applied})"
+    assert qr.terminal_state(prid_r) == "deadletter"
