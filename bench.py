@@ -1092,6 +1092,60 @@ def _bench_trace_export(n_records=2000):
         shutil.rmtree(run, ignore_errors=True)
 
 
+def _bench_fleet_trace(n_requests=50):
+    """fleet_trace probe (ISSUE 12): the whole-fleet Perfetto join cost
+    (obs/trace_export.py ``--fleet``) on a synthetic ``n_requests``-request
+    lifecycle history — every request submitted / claimed / attempted /
+    settled with deterministic timings, then one timed
+    build+validate+serialize pass. Deterministic input, so the timing
+    tracks the ledger join, not a fit."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.fleet import history as fleet_history
+    from redcliff_tpu.obs.slo import compute_slo
+    from redcliff_tpu.obs.trace_export import (build_fleet_trace,
+                                               validate_trace)
+
+    root = tempfile.mkdtemp(prefix="bench_fleet_trace_")
+    try:
+        t = 1_000_000_000.0
+        for i in range(n_requests):
+            rid, tr = f"req-{i:04d}", f"tr-{i:032x}"
+            tenant = f"tenant-{i % 5}"
+            fleet_history.append_event(root, "submitted", request_id=rid,
+                                       trace_id=tr, tenant=tenant,
+                                       now=t + i, submitted_at=t + i,
+                                       deadline_s=600.0)
+            fleet_history.append_event(root, "claimed", request_id=rid,
+                                       trace_id=tr, tenant=tenant,
+                                       batch_id=f"b-{i % 8}",
+                                       now=t + i + 2, worker="w-bench")
+            fleet_history.append_event(root, "attempt", request_id=rid,
+                                       trace_id=tr, tenant=tenant,
+                                       batch_id=f"b-{i % 8}",
+                                       now=t + i + 5, started_at=t + i + 3,
+                                       attempts=1, classification="clean")
+            fleet_history.append_event(root, "settled", request_id=rid,
+                                       trace_id=tr, now=t + i + 30,
+                                       state="done")
+        t0 = time.perf_counter()
+        trace = build_fleet_trace(root)
+        errors = validate_trace(trace)
+        blob = json.dumps(trace, allow_nan=False)
+        export_ms = (time.perf_counter() - t0) * 1e3
+        slo = compute_slo(fleet_history.read_history(root), thresholds={})
+        return {"export_ms": round(export_ms, 2),
+                "requests": n_requests,
+                "history_records": 4 * n_requests,
+                "events": len(trace["traceEvents"]),
+                "bytes": len(blob),
+                "valid": not errors and slo["settled"] == n_requests,
+                "validate_errors": errors[:3]}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _measure(platform):
     import jax
 
@@ -1271,6 +1325,13 @@ def _measure(platform):
         fleet_containment = {"error": f"{type(e).__name__}: {e}",
                              "latency_ratio": None}
 
+    # fleet trace export: the whole-fleet Perfetto join on a synthetic
+    # 50-request lifecycle history (obs/trace_export.py --fleet)
+    try:
+        fleet_trace = _bench_fleet_trace()
+    except Exception as e:  # never fail the bench over the trace probe
+        fleet_trace = {"error": f"{type(e).__name__}: {e}"}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1304,6 +1365,7 @@ def _measure(platform):
         "trace_export": trace_export,
         "fleet": fleet_probe,
         "fleet_containment": fleet_containment,
+        "fleet_trace": fleet_trace,
         "error": None,
     })
 

@@ -8,7 +8,9 @@ work — run the worker loop (fleet/worker.py): reclaim expired claims,
     run pinned bisection halves, plan admission (fleet/planner.py),
     supervise batches, settle results under the containment discipline
     (``--max-attempts`` is the per-request retry budget).
-status — queue-wide and per-tenant counts (``--json`` for scripts).
+status — queue-wide and per-tenant counts plus a per-request age table:
+    queue age (now − ``submitted_at``) for live requests, terminal-state
+    age for settled ones (``--json`` for scripts).
 cancel — first-writer-wins ``canceled`` terminal record: the request is
     never re-planned, a running worker's settle stands down, and no lease
     is orphaned (tombstone-reclaim path, docs/ARCHITECTURE.md "Fleet
@@ -157,8 +159,10 @@ def _cmd_status(args):
               file=sys.stderr)
         return 2
     # create=False: status is a pure reader — no mkdir side effects, and
-    # archived/read-only roots still report
-    st = FleetQueue(args.root, create=False).status()
+    # archived/read-only roots still report. include_requests: the
+    # per-request age view (queue age = now - submitted_at for live
+    # requests, terminal-state age for settled ones)
+    st = FleetQueue(args.root, create=False).status(include_requests=True)
     if args.json:
         json.dump(st, sys.stdout, indent=2, allow_nan=False)
         sys.stdout.write("\n")
@@ -178,6 +182,24 @@ def _cmd_status(args):
               f"{t['queued']} queued, {t['running']} running, "
               f"{t['done']} done, {t['failed']} failed, "
               f"{t['deadletter']} dead-lettered, {t['canceled']} canceled")
+
+    def _age(s):
+        if s is None:
+            return "-"
+        if s >= 3600:
+            return f"{s / 3600:.1f}h"
+        if s >= 60:
+            return f"{s / 60:.1f}m"
+        return f"{s:.1f}s"
+
+    rows = st.get("requests") or []
+    if rows:
+        print(f"  {'request':<40} {'tenant':<12} {'state':<10} "
+              f"{'queue age':>10} {'settled for':>12}")
+        for r in rows:
+            print(f"  {r['request_id']:<40} {r['tenant']:<12} "
+                  f"{r['state']:<10} {_age(r['queue_age_s']):>10} "
+                  f"{_age(r['terminal_age_s']):>12}")
     return 0
 
 

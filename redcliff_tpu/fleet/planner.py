@@ -126,6 +126,12 @@ def _batch_view(members, n_devices, cost_model=None, platform=None,
     return {
         "batch_id": batch_id_for(ids),
         "requests": ids,
+        # the members' durable trace identities (queue.submit) ride every
+        # planning decision, so the worker's trace context — and the
+        # `planned` lifecycle event — link the merge decision back to each
+        # request's submit-to-settle timeline
+        "trace_ids": {r["request_id"]: r["trace_id"]
+                      for r in members if r.get("trace_id")},
         "tenants": sorted({str(r.get("tenant")) for r in members}),
         "shape": shape,
         "n_points": n_points,

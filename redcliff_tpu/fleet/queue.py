@@ -55,6 +55,16 @@ a worker that outlives its own lease (e.g. a multi-minute GC pause) could
 race a reclaimer, which is why ``lease_s`` must comfortably exceed the
 renewal cadence; the first ``complete()`` still wins either way.
 
+**Trace identity & lifecycle ledger (ISSUE 12).** ``submit`` mints a
+durable ``trace_id`` on every spool record — the one identity a request
+keeps across the submit CLI, the planner, every worker that claims it, and
+every supervised run_batch child that fits it. Each lifecycle transition
+the queue itself performs (submitted / claimed / settled / requeued) is
+additionally appended to the ``<root>/history.jsonl`` ledger
+(fleet/history.py) — best-effort, multi-process-safe — which is what the
+SLO layer (obs/slo.py) and the fleet trace export (``obs trace --fleet``)
+join after the workers are gone.
+
 stdlib only, no jax (obs/schema.py ``--check`` enforces it): queue scans
 run in control processes that must never initialize a backend.
 """
@@ -65,6 +75,8 @@ import os
 import socket
 import time
 import uuid
+
+from redcliff_tpu.fleet import history as _history
 
 __all__ = ["FleetQueue", "Lease", "LeaseLost", "SPOOL_NAME",
            "TERMINAL_STATES"]
@@ -159,7 +171,7 @@ class Lease:
                          renewals=int(self.data.get("renewals") or 0) + 1)
         _write_json_atomic(self.path, self.data)
 
-    def release(self):
+    def release(self, now=None):
         try:
             self._check_owner()
         except LeaseLost:
@@ -167,7 +179,17 @@ class Lease:
         try:
             os.unlink(self.path)
         except OSError:
-            pass
+            return  # lease file stuck: the claim is still visibly live
+        # the request is back in the queue: without this transition the
+        # SLO layer would end its queue wait at the aborted claim and the
+        # trace export's in-flight counter would stay high through exactly
+        # the crash-loop incidents the timeline exists to diagnose
+        _history.append_event(
+            self._q.root, "released", request_id=self.request_id,
+            trace_id=self.data.get("trace_id"),
+            batch_id=self.data.get("batch_id"),
+            tenant=self.data.get("tenant"),
+            worker=self.data.get("worker"), now=now)
 
 
 class FleetQueue:
@@ -230,7 +252,12 @@ class FleetQueue:
         ``shape``: the (shape-key) dict for the cost/memory models (derived
         from ``spec["model_config"]`` when omitted). ``per_lane_bytes`` /
         ``fixed_bytes``: HBM hints for the admission planner (from
-        obs/memory.py ``grid_footprint``/``per_lane_bytes``)."""
+        obs/memory.py ``grid_footprint``/``per_lane_bytes``).
+
+        Mints the request's durable ``trace_id`` — the identity every
+        lifecycle event, span, and metrics record downstream joins on —
+        and appends the ``submitted`` lifecycle transition to the history
+        ledger."""
         now = time.time() if now is None else now
         spec = dict(spec or {})
         if epochs is None:
@@ -240,8 +267,10 @@ class FleetQueue:
         rid = request_id or (
             f"req-{int(now * 1000):013d}-{os.getpid()}-"
             f"{uuid.uuid4().hex[:8]}")
+        trace_id = f"tr-{uuid.uuid4().hex[:16]}"
         rec = {
             "request_id": rid,
+            "trace_id": trace_id,
             "tenant": str(tenant),
             "submitted_at": now,
             "priority": int(priority),
@@ -254,23 +283,20 @@ class FleetQueue:
             "fixed_bytes": fixed_bytes,
             "spec": spec,
         }
-        line = json.dumps(rec, allow_nan=False).encode("utf-8") + b"\n"
-        # one O_APPEND write + fsync: concurrent submitters interleave whole
-        # lines; a submitter killed mid-write leaves one torn tail line the
-        # tolerant reader skips and counts. A torn tail has no newline, so
-        # the NEXT submitter starts with one — otherwise its record would
-        # fuse into the garbage and be lost too (two healers racing just
-        # produce a blank line, which the reader skips)
-        fd = os.open(self.spool_path,
-                     os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
-        try:
-            size = os.fstat(fd).st_size
-            if size and os.pread(fd, 1, size - 1) != b"\n":
-                line = b"\n" + line
-            os.write(fd, line)
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        # one guarded O_APPEND write + fsync (fleet/history.py append_line,
+        # the shared torn-tail-healing invariant): concurrent submitters
+        # interleave whole lines; a submitter killed mid-write leaves one
+        # torn tail line the tolerant reader skips and counts. Raises on
+        # failure — the spool IS the durability contract
+        _history.append_line(
+            self.spool_path,
+            json.dumps(rec, allow_nan=False).encode("utf-8") + b"\n")
+        _history.append_event(self.root, "submitted", request_id=rid,
+                              trace_id=trace_id, tenant=tenant, now=now,
+                              priority=int(priority),
+                              deadline_s=rec["deadline_s"],
+                              n_points=len(rec["points"]),
+                              submitted_at=now)
         return rid
 
     def requests(self, stats=None):
@@ -342,7 +368,7 @@ class FleetQueue:
         return self.terminal_state(request_id) is not None
 
     def claim(self, request_id, worker, lease_s, batch_id=None,
-              batch_request_ids=None, tenant=None, now=None):
+              batch_request_ids=None, tenant=None, trace_id=None, now=None):
         """Atomically claim ``request_id``; returns a :class:`Lease` or
         None (already done/failed, or live-leased by someone else, or lost
         the reclaim race).
@@ -350,7 +376,9 @@ class FleetQueue:
         ``batch_id``/``batch_request_ids`` record the batch this claim
         belongs to, so a worker reclaiming an expired lease re-runs the
         SAME batch composition (and therefore resumes the same grid
-        checkpoint) instead of re-planning a different one."""
+        checkpoint) instead of re-planning a different one. ``trace_id``
+        (from the spool record) rides the ``claimed`` lifecycle event —
+        the queue-wait endpoint the SLO layer measures."""
         now = time.time() if now is None else now
         if self.is_terminal(request_id):
             return None
@@ -362,6 +390,7 @@ class FleetQueue:
             "pid": os.getpid(),
             "host": socket.gethostname(),
             "tenant": tenant,
+            "trace_id": trace_id,
             "claimed_at": now,
             "expires_at": now + float(lease_s),
             "renewals": 0,
@@ -396,12 +425,17 @@ class FleetQueue:
                 data["batch_request_ids"] = existing.get("batch_request_ids")
         if not _write_json_atomic(path, data, overwrite=False):
             return None  # another claimant slipped in after the tombstone
+        _history.append_event(
+            self.root, "claimed", request_id=request_id, trace_id=trace_id,
+            batch_id=data["batch_id"], tenant=tenant, now=now,
+            worker=str(worker),
+            reclaim=(True if data["reclaimed_from"] is not None else None))
         return Lease(self, request_id, data)
 
     # ------------------------------------------------------------------
     # terminal records
     # ------------------------------------------------------------------
-    def _settle(self, request_id, state, rec):
+    def _settle(self, request_id, state, rec, trace_id=None, now=None):
         """Write one terminal record (first writer wins within a state) and
         drop any lease file so a settled request never orphans its claim.
 
@@ -443,27 +477,39 @@ class FleetQueue:
             os.unlink(self._lease_path(request_id))
         except OSError:
             pass
+        if wrote:
+            # the terminal lifecycle transition the SLO layer keys on
+            # (settled-at minus submitted-at = end-to-end latency; state
+            # splits the deadline-hit / dead-letter-rate numerators). `now`
+            # is the caller's clock — the SAME timestamp the terminal
+            # record carries, so an injected-time settle (tests, replays)
+            # stays synthetic-timing-exact in the ledger too
+            _history.append_event(self.root, "settled",
+                                  request_id=request_id, trace_id=trace_id,
+                                  state=state, now=now,
+                                  reason=rec.get("reason"))
         return wrote
 
-    def complete(self, request_id, result=None, now=None):
+    def complete(self, request_id, result=None, trace_id=None, now=None):
         """Record the request as done (atomic; FIRST writer wins — the
         never-run-twice half of the durability contract) and drop any lease
         file. Returns True when this call wrote the record."""
         now = time.time() if now is None else now
         return self._settle(request_id, "done",
                             {"request_id": request_id, "completed_at": now,
-                             "result": result})
+                             "result": result}, trace_id=trace_id, now=now)
 
-    def fail(self, request_id, reason, now=None):
+    def fail(self, request_id, reason, trace_id=None, now=None):
         """Record a terminal failure (deterministic classifications the
         supervisor will not restart: numerics_abort, deadline,
         mesh_exhausted)."""
         now = time.time() if now is None else now
         return self._settle(request_id, "failed",
                             {"request_id": request_id, "failed_at": now,
-                             "reason": str(reason)})
+                             "reason": str(reason)}, trace_id=trace_id,
+                            now=now)
 
-    def deadletter(self, request_id, dossier=None, now=None):
+    def deadletter(self, request_id, dossier=None, trace_id=None, now=None):
         """Route the request to the durable dead-letter directory instead of
         re-planning it (retry budget exhausted, or attributed as the poison
         member of a merged batch). ``dossier`` is the failure dossier the
@@ -473,7 +519,8 @@ class FleetQueue:
         return self._settle(request_id, "deadletter",
                             {"request_id": request_id,
                              "deadlettered_at": now,
-                             "dossier": dossier})
+                             "dossier": dossier}, trace_id=trace_id,
+                            now=now)
 
     def cancel(self, request_id, reason=None, now=None):
         """Cancel a request: first-writer-wins ``canceled`` terminal record
@@ -483,13 +530,15 @@ class FleetQueue:
         and skips publishing (its lease is unlinked here and by the settle).
         Returns True when this call canceled it (False: already terminal)."""
         now = time.time() if now is None else now
-        known = {r["request_id"] for r in self.requests()}
+        known = {r["request_id"]: r for r in self.requests()}
         if request_id not in known:
             return False
         return self._settle(request_id, "canceled",
                             {"request_id": request_id, "canceled_at": now,
                              "reason": (str(reason) if reason is not None
-                                        else None)})
+                                        else None)},
+                            trace_id=known[request_id].get("trace_id"),
+                            now=now)
 
     def requeue(self, request_id, now=None):
         """Resurrect a dead-letter request with a FRESH retry budget: the
@@ -512,6 +561,14 @@ class FleetQueue:
             "request_id": request_id, "attempts": 0, "reclaims": 0,
             "last": None, "history": [], "suspect": True,
             "requeued_at": now})
+        # the resurrected request keeps its submit-minted identity: look the
+        # spool record back up so the `requeued` transition carries the same
+        # join keys every other queue-written transition does
+        spool = next((r for r in self.requests()
+                      if r["request_id"] == request_id), {})
+        _history.append_event(self.root, "requeued", request_id=request_id,
+                              trace_id=spool.get("trace_id"),
+                              tenant=spool.get("tenant"), now=now)
         return True
 
     def result(self, request_id):
@@ -711,15 +768,30 @@ class FleetQueue:
             groups.setdefault(lease.get("batch_id"), []).append(lease)
         return groups
 
-    def status(self, now=None):
+    # terminal-record timestamp field per state (the terminal-state age the
+    # status CLI renders)
+    _TERMINAL_AT = {"done": "completed_at", "failed": "failed_at",
+                    "deadletter": "deadlettered_at",
+                    "canceled": "canceled_at"}
+
+    def status(self, now=None, include_requests=False):
         """Queue-wide counts: total/queued/running/done/failed plus the
         per-tenant breakdown — the ``fleet status`` CLI body and the watch
-        CLI's fleet section."""
+        CLI's fleet section.
+
+        ``include_requests=True`` adds a per-request ``requests`` list with
+        lifecycle ages: ``queue_age_s`` (now − ``submitted_at``) for live
+        requests — how long each tenant has been waiting — and
+        ``terminal_age_s`` (now − the terminal record's own timestamp) for
+        settled ones. Off by default: it reads one terminal record per
+        settled request, which a follow-mode watcher re-running status
+        every tick must not pay."""
         now = time.time() if now is None else now
         stats = {}
         reqs = self.requests(stats=stats)
         terminal = self.terminal_ids()
         by_tenant = {}
+        rows = []
         counts = {"submitted": len(reqs), "queued": 0, "running": 0,
                   "done": 0, "failed": 0, "deadletter": 0, "canceled": 0,
                   "expired_claims": 0}
@@ -728,6 +800,30 @@ class FleetQueue:
             return by_tenant.setdefault(str(tenant), {
                 "submitted": 0, "queued": 0, "running": 0, "done": 0,
                 "failed": 0, "deadletter": 0, "canceled": 0})
+
+        def row(rec, state, terminal_state=None):
+            if not include_requests:
+                return
+            sub = rec.get("submitted_at")
+            r = {"request_id": rec["request_id"],
+                 "tenant": str(rec.get("tenant")),
+                 "trace_id": rec.get("trace_id"),
+                 "state": state,
+                 "queue_age_s": None, "terminal_age_s": None}
+            if terminal_state is None:
+                if isinstance(sub, (int, float)):
+                    r["queue_age_s"] = round(now - sub, 3)
+            else:
+                trec = _read_json(
+                    {"done": self._done_path,
+                     "failed": self._failed_path,
+                     "deadletter": self._deadletter_path,
+                     "canceled": self._canceled_path}[terminal_state](
+                         rec["request_id"])) or {}
+                at = trec.get(self._TERMINAL_AT[terminal_state])
+                if isinstance(at, (int, float)):
+                    r["terminal_age_s"] = round(now - at, 3)
+            rows.append(r)
 
         for rec in reqs:
             rid = rec["request_id"]
@@ -738,20 +834,26 @@ class FleetQueue:
             if state is not None:
                 counts[state] += 1
                 t[state] += 1
+                row(rec, state, terminal_state=state)
                 continue
             lease = self.lease_of(rid)
             if lease is not None \
                     and float(lease.get("expires_at") or 0.0) > now:
                 counts["running"] += 1
                 t["running"] += 1
+                row(rec, "running")
             else:
                 if lease is not None:
                     counts["expired_claims"] += 1
                 counts["queued"] += 1
                 t["queued"] += 1
-        return {"root": os.path.abspath(self.root), "counts": counts,
-                "by_tenant": by_tenant,
-                "torn_spool_lines": stats.get("torn_lines", 0)}
+                row(rec, "queued")
+        out = {"root": os.path.abspath(self.root), "counts": counts,
+               "by_tenant": by_tenant,
+               "torn_spool_lines": stats.get("torn_lines", 0)}
+        if include_requests:
+            out["requests"] = rows
+        return out
 
 
 # shape-key fields mirrored from obs/schema.py SHAPE_KEYS; kept as a literal

@@ -12,9 +12,13 @@ member requests in claim order; this driver:
    .parallel.grid.GridSpec` and fits it with the grid engine — checkpointed
    into the batch run dir every ``checkpoint_every`` epochs, so a SIGKILLed
    worker's reclaimed batch RESUMES bit-identically instead of restarting;
-3. logs the tenant manifest (request id -> merged point range) as a
-   ``fleet`` metrics event in the run dir, so ``obs report`` can attribute
-   fits/lane-epochs/quarantines per tenant;
+3. logs the tenant manifest (request id + trace id -> merged point range)
+   as a ``fleet`` metrics event in the run dir, so ``obs report`` can
+   attribute fits/lane-epochs/quarantines per tenant. The worker exports
+   ``REDCLIFF_TRACE_CTX`` into this child, so every span and metrics
+   record the fit writes additionally carries the batch/request trace
+   join keys (obs/spans.py trace context — zero-cost when
+   ``REDCLIFF_TRACE=0``);
 4. splits the :class:`~redcliff_tpu.parallel.grid.GridResult` back into
    per-request ``results/<request_id>.json`` records (criteria, epochs,
    val history slice, quarantine causes — strict JSON, no params: the
@@ -150,6 +154,7 @@ def run_batch_file(batch_file):
         merged.extend(pts)
         manifest.append({"request_id": r["request_id"],
                          "tenant": str(r.get("tenant")),
+                         "trace_id": r.get("trace_id"),
                          "start": start, "stop": start + len(pts)})
         start += len(pts)
     if chaos_specs and _fi.fleet_poison_armed():

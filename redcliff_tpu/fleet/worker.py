@@ -60,7 +60,10 @@ import uuid
 
 from redcliff_tpu.obs import record_span
 from redcliff_tpu.obs import costmodel as _costmodel
+from redcliff_tpu.obs import flight as _flight
+from redcliff_tpu.obs import spans as _spans
 from redcliff_tpu.runtime.supervisor import SupervisorPolicy, supervise
+from redcliff_tpu.fleet import history as _history
 from redcliff_tpu.fleet import planner as _planner
 from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
 
@@ -102,16 +105,32 @@ def _logger(root):
 
 
 def _manifest_rows(requests):
-    """Per-request merged-point ranges: [{request_id, tenant, start, stop}]
-    — the tenant-attribution map every report join keys on."""
+    """Per-request merged-point ranges: [{request_id, tenant, trace_id,
+    start, stop}] — the tenant-attribution map every report join keys on
+    (``trace_id`` links each range back to the request's lifecycle
+    trace)."""
     rows, start = [], 0
     for r in requests:
         n = len(r.get("points") or ())
         rows.append({"request_id": r["request_id"],
                      "tenant": str(r.get("tenant")),
+                     "trace_id": r.get("trace_id"),
                      "start": start, "stop": start + n})
         start += n
     return rows
+
+
+def _trace_context(batch_id, members):
+    """The cross-process trace context for one batch: batch id + every
+    member's durable trace identity (minted at submit). Set in-process for
+    the worker's own spans/events and exported to the supervised run_batch
+    child via ``REDCLIFF_TRACE_CTX`` (obs/spans.py)."""
+    tids = {m["request_id"]: m["trace_id"]
+            for m in members if m.get("trace_id")}
+    ctx = {"batch_id": batch_id}
+    if tids:
+        ctx["trace_ids"] = tids
+    return ctx
 
 
 def _claim_batch(q, worker_id, lease_s, batch_id, request_ids, by_id,
@@ -125,7 +144,8 @@ def _claim_batch(q, worker_id, lease_s, batch_id, request_ids, by_id,
         rec = by_id.get(rid)
         lease = q.claim(rid, worker_id, lease_s, batch_id=batch_id,
                         batch_request_ids=list(all_ids or request_ids),
-                        tenant=(rec or {}).get("tenant"))
+                        tenant=(rec or {}).get("tenant"),
+                        trace_id=(rec or {}).get("trace_id"))
         if lease is None:
             if q.is_terminal(rid):
                 continue  # already finished by someone: not a conflict
@@ -268,6 +288,15 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
         leases = _claim_batch(q, worker_id, lease_s, b["batch_id"],
                               b["requests"], by_id, logger)
         if leases:
+            # the merge decision that actually claimed work becomes a
+            # durable `planned` lifecycle event (the decisions that were
+            # merely proposed this cycle re-plan next cycle — recording
+            # them all every poll would spam the ledger)
+            _history.append_event(
+                q.root, "planned", batch_id=b["batch_id"],
+                requests=b["requests"], trace_ids=b.get("trace_ids"),
+                n_points=b["n_points"], g_bucket=b["g_bucket"],
+                worker=worker_id)
             members = [by_id[r] for r in b["requests"] if r in by_id]
             return b, leases, members
     return None
@@ -345,7 +374,29 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                   max_attempts=DEFAULT_MAX_ATTEMPTS):
     """Run one claimed batch under the crash-loop supervisor and settle its
     requests (containment discipline — see the module docstring); returns
-    the :class:`~redcliff_tpu.runtime.supervisor.SuperviseOutcome`."""
+    the :class:`~redcliff_tpu.runtime.supervisor.SuperviseOutcome`.
+
+    The batch runs under its TRACE CONTEXT (batch id + each member's
+    submit-minted trace id): set process-wide for the worker's own spans
+    and fleet events, exported into the supervised run_batch child via
+    ``REDCLIFF_TRACE_CTX`` (so every record the jax child writes carries
+    the same join keys), and scoped — restored on every exit path."""
+    ctx = _trace_context(batch["batch_id"], members)
+    prev_ctx = _spans.set_trace_ctx(ctx)
+    try:
+        return _run_one_batch(q, batch, leases, members, logger, worker_id,
+                              ctx, lease_s=lease_s,
+                              checkpoint_every=checkpoint_every,
+                              supervisor_policy=supervisor_policy, env=env,
+                              python=python, max_attempts=max_attempts)
+    finally:
+        _spans.set_trace_ctx(prev_ctx)
+
+
+def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
+                   lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
+                   env=None, python=None,
+                   max_attempts=DEFAULT_MAX_ATTEMPTS):
     batch_id = batch["batch_id"]
     run_dir = q.batch_dir(batch_id)
     os.makedirs(run_dir, exist_ok=True)
@@ -378,12 +429,18 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                worker=worker_id)
     cmd = [python or sys.executable, "-m", "redcliff_tpu.fleet.run_batch",
            batch_file]
+    # the trace context crosses the process boundary as env: the jax child
+    # (and any grand-children the supervisor restarts) stamps every span
+    # and metrics record with the same batch/request join keys
+    child_env = dict(env if env is not None else os.environ)
+    child_env[_spans.ENV_TRACE_CTX] = json.dumps(trace_ctx)
+    started_at = time.time()
     t0 = time.perf_counter()
     with _LeaseHeartbeat(leases, lease_s, logger) as hb:
         outcome = supervise(
             cmd, ledger_path=ledger_path,
             policy=supervisor_policy or SupervisorPolicy(max_restarts=2),
-            env=env)
+            env=child_env)
     dur_ms = (time.perf_counter() - t0) * 1e3
     record_span("fleet.batch", dur_ms, component="fleet", logger=logger,
                 emit=True, batch_id=batch_id,
@@ -398,10 +455,26 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
     def member_of(rid):
         return next((m for m in members if m["request_id"] == rid), {})
 
+    def trace_of(rid):
+        return member_of(rid).get("trace_id")
+
+    # one durable `attempt` lifecycle transition per still-owned member:
+    # when the supervised run STARTED (the SLO layer's time-to-first-
+    # attempt endpoint), how it classified, and how many supervisor
+    # attempts it burned. Lost leases are the new owner's story to record.
+    for rid, _lease in live:
+        _history.append_event(
+            q.root, "attempt", request_id=rid, trace_id=trace_of(rid),
+            batch_id=batch_id, tenant=member_of(rid).get("tenant"),
+            worker=worker_id, classification=cls,
+            attempts=len(outcome.attempts), started_at=started_at,
+            run_dir=run_dir)
+
     def send_to_deadletter(rid, att, reason, causes=None):
         rec = member_of(rid)
         q.deadletter(rid, dossier=_dossier(rec, att, reason, run_dir,
-                                           causes=causes))
+                                           causes=causes),
+                     trace_id=trace_of(rid))
         settled["deadletter"].append(rid)
         logger.log("fleet", kind="deadletter", batch_id=batch_id,
                    requests=[rid], tenants=[str(rec.get("tenant"))],
@@ -434,7 +507,7 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                 send_to_deadletter(rid, att, "poison_quarantine",
                                    causes=causes)
                 continue
-            q.complete(rid, result=result)
+            q.complete(rid, result=result, trace_id=trace_of(rid))
             settled["done"].append(rid)
             logger.log("fleet", kind="complete", batch_id=batch_id,
                        requests=[rid], tenants=[str(rec.get("tenant"))],
@@ -453,7 +526,7 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
             att = q.record_attempt(rid, cls, batch_id=batch_id,
                                    run_dir=run_dir)
             if cls in DETERMINISTIC_FAIL_CLASSES:
-                q.fail(rid, cls)
+                q.fail(rid, cls, trace_id=trace_of(rid))
                 settled["failed"].append(rid)
             elif att["attempts"] >= max_attempts:
                 # a solo crash/hang loop (giving_up) past its budget
@@ -517,6 +590,14 @@ def _bisect(q, batch_id, run_dir, classification, live, member_of, settled,
     logger.log("fleet", kind="bisect", batch_id=batch_id, requests=rids,
                classification=classification, halves=halves,
                worker=worker_id)
+    # the bisection round stays on each member's lifecycle timeline: the
+    # halves' batch ids link the pinned re-runs back to the same traces
+    _history.append_event(
+        q.root, "bisected", batch_id=batch_id, requests=rids,
+        trace_ids={rid: member_of(rid).get("trace_id") for rid in rids
+                   if member_of(rid).get("trace_id")},
+        halves=[h["batch_id"] for h in halves],
+        classification=classification, worker=worker_id)
 
 
 # quarantine causes that are a DETERMINISTIC verdict on the point itself
@@ -606,31 +687,55 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
         logger.log("fleet", kind="worker_start", worker=worker_id,
                    n_devices=n_devices, budget_bytes=budget_bytes,
                    lease_s=lease_s)
-        while True:
-            got = _next_batch(q, worker_id, lease_s, n_devices,
-                              budget_bytes, max_bucket, logger)
-            if got is not None:
-                batch, leases, members = got
-                run_one_batch(q, batch, leases, members, logger, worker_id,
-                              lease_s=lease_s,
-                              checkpoint_every=checkpoint_every,
-                              supervisor_policy=supervisor_policy, env=env,
-                              python=python, max_attempts=max_attempts)
-                batches_run += 1
-                if max_batches is not None and batches_run >= max_batches:
-                    break
+        try:
+            while True:
+                got = _next_batch(q, worker_id, lease_s, n_devices,
+                                  budget_bytes, max_bucket, logger)
+                if got is not None:
+                    batch, leases, members = got
+                    run_one_batch(q, batch, leases, members, logger,
+                                  worker_id, lease_s=lease_s,
+                                  checkpoint_every=checkpoint_every,
+                                  supervisor_policy=supervisor_policy,
+                                  env=env, python=python,
+                                  max_attempts=max_attempts)
+                    batches_run += 1
+                    if max_batches is not None \
+                            and batches_run >= max_batches:
+                        break
+                    if once:
+                        break
+                    continue
                 if once:
                     break
-                continue
-            if once:
-                break
-            # drain: nothing is claimable right now (_next_batch came back
-            # empty — the queue is empty OR holds only unschedulable
-            # requests the planner can never admit) and nothing is in
-            # flight anywhere whose completion/expiry could change that
-            if drain and not q.live_leases():
-                break
-            time.sleep(poll_s)
+                # drain: nothing is claimable right now (_next_batch came
+                # back empty — the queue is empty OR holds only
+                # unschedulable requests the planner can never admit) and
+                # nothing is in flight anywhere whose completion/expiry
+                # could change that
+                if drain and not q.live_leases():
+                    break
+                time.sleep(poll_s)
+        except Exception as e:
+            # an uncaught worker-loop exception used to die without a
+            # record: mirror the watchdog's escalation path — dump the
+            # flight recorder (the worker's last spans/events) next to the
+            # fleet root's metrics and emit a structured worker_crash
+            # event, THEN re-raise so the exit code still says crash
+            path = None
+            try:
+                path = _flight.dump(str(root), "worker_crash", extra={
+                    "worker": worker_id,
+                    "error": f"{type(e).__name__}: {e}"})
+            except Exception:  # noqa: BLE001 — the dump must not mask
+                pass           # the original crash
+            try:
+                logger.log("fleet", kind="worker_crash", worker=worker_id,
+                           error=f"{type(e).__name__}: {e}",
+                           flight_record=path, batches=batches_run)
+            except Exception:  # noqa: BLE001 — same: the crash record is
+                pass           # best-effort, the original exception wins
+            raise
         logger.log("fleet", kind="worker_stop", worker=worker_id,
                    batches=batches_run)
     return batches_run

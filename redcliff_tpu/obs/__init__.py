@@ -29,7 +29,13 @@ production-monitoring shape of large-scale ML systems, arXiv:1605.08695):
   (``REDCLIFF_PROFILE=epoch:3`` / ``profile_window``) replacing whole-fit
   traces;
 * :mod:`.trace_export` — Perfetto / Chrome trace-event export:
-  ``python -m redcliff_tpu.obs trace <run_dir> [-o trace.json]``.
+  ``python -m redcliff_tpu.obs trace <run_dir> [-o trace.json]``; with
+  ``--fleet`` a whole fleet root joins into one timeline (per-request
+  tracks spanning processes, queue counter tracks);
+* :mod:`.slo` — fleet service-level objectives from the request-lifecycle
+  ledger (per-tenant queue-wait percentiles, time-to-first-attempt,
+  deadline hit-rate, attempts-per-request, dead-letter rate;
+  ``REDCLIFF_SLO_*`` breach thresholds; stdlib-only).
 
 Import discipline: this ``__init__`` (and ``spans``/``flight``/``schema``)
 is stdlib-only — the watchdog, the supervisor, and bench.py's backend-free
@@ -50,7 +56,8 @@ __all__ = [
     "flight", "schema", "spans", "memory", "profiling",
     "MetricLogger", "jsonable", "read_jsonl", "jsonl_files",
     "profiler_trace", "build_report", "render_text", "build_snapshot",
-    "run_sentinel", "build_trace", "validate_trace",
+    "run_sentinel", "build_trace", "build_fleet_trace", "validate_trace",
+    "compute_slo", "slo_for_root",
 ]
 
 _LAZY = {
@@ -64,7 +71,10 @@ _LAZY = {
     "build_snapshot": "redcliff_tpu.obs.watch",
     "run_sentinel": "redcliff_tpu.obs.regress",
     "build_trace": "redcliff_tpu.obs.trace_export",
+    "build_fleet_trace": "redcliff_tpu.obs.trace_export",
     "validate_trace": "redcliff_tpu.obs.trace_export",
+    "compute_slo": "redcliff_tpu.obs.slo",
+    "slo_for_root": "redcliff_tpu.obs.slo",
 }
 
 

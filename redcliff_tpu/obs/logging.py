@@ -137,6 +137,15 @@ class MetricLogger:
             return
         rec = {"event": event, "wall_time": time.time(),
                "seq": next(_seq), "pid": self._pid, "host": self._host}
+        # fleet trace identity (spans.set_trace_ctx / REDCLIFF_TRACE_CTX):
+        # while a request-scoped context is live and tracing is on, every
+        # record this process writes carries the batch/request join keys —
+        # the cross-process half of the identity triple. One None check
+        # when no context is set; REDCLIFF_TRACE=0 drops the stamping
+        # entirely (the zero-cost contract)
+        ctx = _spans.trace_ctx()
+        if ctx is not None and _spans.enabled() and "trace" not in fields:
+            rec["trace"] = ctx
         rec.update({k: jsonable(v) for k, v in fields.items()})
         # allow_nan=False is the strictness backstop: jsonable already maps
         # non-finite floats to null, so a violation here is a bug, not data

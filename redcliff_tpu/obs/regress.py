@@ -142,6 +142,11 @@ FAMILIES = [
     # leaking wall-clock back into healthy requests
     Family("fleet_containment.latency_ratio", better="lower",
            band=_BAND_TIMING, g_dependent=False),
+    # fleet trace export (ISSUE 12, obs/trace_export.py --fleet): the
+    # ledger-join cost on a synthetic 50-request history — the whole-fleet
+    # post-mortem must stay cheap enough to run on every incident
+    Family("fleet_trace.export_ms", better="lower", band=_BAND_TIMING,
+           abs_floor=250.0, g_dependent=False),
 ]
 
 

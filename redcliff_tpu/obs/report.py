@@ -436,10 +436,17 @@ def build_report(run_dir):
     # attempt budgets, and the containment-lifecycle event counts (bisect /
     # deadletter / cancel / requeue / renew_error)
     containment = None
+    fleet_slo = None
     if os.path.exists(os.path.join(run_dir, "requests.jsonl")) \
             or os.path.isdir(os.path.join(run_dir, "leases")):
         from redcliff_tpu.fleet.queue import FleetQueue
+        from redcliff_tpu.obs import slo as _slo
 
+        # fleet-SLO section (ISSUE 12): per-tenant queue-wait percentiles,
+        # time-to-first-attempt, deadline hit-rate, attempts-per-request,
+        # dead-letter rate from the durable lifecycle ledger, with
+        # REDCLIFF_SLO_* breach flags
+        fleet_slo = _slo.slo_for_root(run_dir)
         q = FleetQueue(run_dir, create=False)  # pure reader
         st = q.status()
         containment = {
@@ -501,6 +508,7 @@ def build_report(run_dir):
         "remeshes": remeshes,
         "tenants": tenants,
         "fleet_containment": containment,
+        "fleet_slo": fleet_slo,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
                      "guarded_steps_skipped": int(skipped_steps),
@@ -619,6 +627,39 @@ def render_text(report):
             out.append("  attempt budgets: " + "  ".join(
                 f"{a['request_id']}={a.get('attempts', 0)}f/"
                 f"{a.get('reclaims', 0)}r" for a in budgets))
+    slo = r.get("fleet_slo")
+    if slo:
+        out.append("fleet SLOs (lifecycle ledger history.jsonl, "
+                   "obs/slo.py; docs/ARCHITECTURE.md 'Request lifecycle "
+                   "tracing & SLOs'):")
+        out.append(f"  {'scope':<14} {'req':>4} {'setl':>5} "
+                   f"{'qwait p50/p99':>16} {'ttfa p50/p99':>15} "
+                   f"{'deadline':>9} {'att/req':>8} {'dl%':>6}")
+
+        def _s(v):
+            return f"{v:.2f}s" if isinstance(v, (int, float)) else "-"
+
+        def _pair(dist):
+            d = dist or {}
+            return f"{_s(d.get('p50'))}/{_s(d.get('p99'))}"
+
+        for name, b in ([("overall", slo["overall"])]
+                        + sorted(slo["tenants"].items())):
+            dl = b.get("deadline") or {}
+            hit = (f"{dl['hit_pct']:.0f}%" if dl.get("hit_pct") is not None
+                   else "-")
+            att = b.get("attempts_per_request")
+            dlp = b.get("deadletter_pct")
+            out.append(
+                f"  {name:<14} {b['requests']:>4} {b['settled']:>5} "
+                f"{_pair(b.get('queue_wait_s')):>16} "
+                f"{_pair(b.get('ttfa_s')):>15} {hit:>9} "
+                f"{(f'{att:.2f}' if att is not None else '-'):>8} "
+                f"{(f'{dlp:.1f}' if dlp is not None else '-'):>6}")
+        for br in slo.get("breaches") or []:
+            out.append(f"  SLO BREACH [{br['scope']}] {br['slo']}: "
+                       f"{br['value']:.3f} vs threshold "
+                       f"{br['threshold']:.3f}")
     mem = r.get("memory") or {}
     out.append("device memory (predicted vs measured peak, obs/memory.py):")
     for m in mem.get("fits") or []:
@@ -753,9 +794,15 @@ def main(argv=None):
         "trace", help="export the run's spans + engine events + ledger "
                       "attempts as Chrome trace-event JSON for Perfetto "
                       "(obs/trace_export.py)")
-    tp.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
+    tp.add_argument("run_dir", help="run directory (holds metrics.jsonl), "
+                                    "or a fleet root with --fleet")
     tp.add_argument("-o", "--output", default=None,
                     help="write the trace JSON here (default: stdout)")
+    tp.add_argument("--fleet", action="store_true",
+                    help="treat run_dir as a fleet root: join the "
+                         "lifecycle ledger, worker metrics, and every "
+                         "batch run dir into one timeline (per-request "
+                         "tracks + queue counter tracks)")
     gp = sub.add_parser(
         "regress", help="compare the newest BENCH_r*.json against the prior "
                         "trajectory per metric family with noise bands "
@@ -793,6 +840,8 @@ def main(argv=None):
         targv = [args.run_dir]
         if args.output:
             targv += ["-o", args.output]
+        if args.fleet:
+            targv.append("--fleet")
         return trace_main(targv)
     if args.cmd == "regress":
         from redcliff_tpu.obs.regress import main as regress_main

@@ -81,8 +81,13 @@ SPAN_NAMES = frozenset({
 })
 
 # identity fields the MetricLogger stamps on every record (schema v1);
-# optional on read: pre-v1 files and third-party writers lack them
-_IDENTITY = ("seq", "pid", "host")
+# optional on read: pre-v1 files and third-party writers lack them.
+# ``trace`` is the cross-process half (ISSUE 12): the fleet trace context
+# ({"batch_id", "trace_ids": {request_id: trace_id}}) stamped on every
+# record — and every emitted span — a process writes while serving a fleet
+# batch (spans.set_trace_ctx / REDCLIFF_TRACE_CTX), so post-mortem joins
+# can attribute any record to the requests it was serving
+_IDENTITY = ("seq", "pid", "host", "trace")
 
 # numerics-sentinel summary fields (runtime/numerics.py numerics_summary),
 # splatted into anomaly/numerics events by the trainers
@@ -239,7 +244,7 @@ EVENTS = {
         "worker loop, run_batch driver, containment layer; kind=submit | "
         "plan | claim | reclaim | batch_start | batch_end | complete | "
         "lease_lost | renew_error | deadletter | bisect | cancel | requeue "
-        "| manifest | worker_start | worker_stop)",
+        "| manifest | worker_start | worker_stop | worker_crash)",
         required=("kind",),
         optional=("batch_id", "requests", "tenants", "n_points", "g_bucket",
                   "queue_depth", "batches", "unschedulable", "plan_ms",
@@ -251,7 +256,21 @@ EVENTS = {
                   # dead-letter routing, heartbeat renewal escalation,
                   # suspect-solo planning
                   "reason", "halves", "error", "consecutive", "suspects",
-                  "deadlettered", "bisected", "max_attempts")),
+                  "deadlettered", "bisected", "max_attempts",
+                  # worker_crash (ISSUE 12): the uncaught-exception record
+                  # + the flight-record artifact dumped before exit
+                  "flight_record")),
+    "fleet_lifecycle": _ev(
+        "fleet history ledger (fleet/history.py — the durable per-request "
+        "lifecycle transitions obs/slo.py and the fleet trace export join; "
+        "kind=submitted | planned | claimed | attempt | released | "
+        "bisected | settled | requeued)",
+        required=("kind",),
+        optional=("request_id", "trace_id", "batch_id", "tenant", "worker",
+                  "state", "classification", "attempt", "attempts",
+                  "started_at", "requests", "trace_ids", "halves", "reason",
+                  "priority", "deadline_s", "n_points", "submitted_at",
+                  "g_bucket", "reclaim", "run_dir", "parent_batch_id")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -352,8 +371,10 @@ def validate_records(records, kind="metrics"):
 # every one of them — a device sync inside the observability layer would
 # serialize what it observes.
 NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
+                  "obs/slo.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
-                  "fleet/chaos.py", "fleet/__main__.py")
+                  "fleet/chaos.py", "fleet/__main__.py",
+                  "fleet/history.py")
 LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py")
 
 

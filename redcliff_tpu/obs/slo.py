@@ -1,0 +1,297 @@
+"""Fleet service-level objectives from the request-lifecycle ledger.
+
+A multi-tenant queue lives or dies by numbers no single run dir holds:
+how long tenants WAIT (queue-wait percentiles), how fast submitted work
+first touches a device (time-to-first-attempt), whether deadlines are met
+(hit-rate), how much retry churn each request costs (attempts-per-request),
+and how often requests die in containment (dead-letter rate). This module
+computes all of them from the durable lifecycle ledger
+(``<root>/history.jsonl``, fleet/history.py) — which survives worker
+restarts and SIGKILL storms — per tenant and fleet-wide, and flags
+threshold breaches via the ``REDCLIFF_SLO_*`` knobs.
+
+Definitions (docs/ARCHITECTURE.md "Request lifecycle tracing & SLOs"):
+
+* **queue_wait_s** — first EFFECTIVE ``claimed`` wall time −
+  ``submitted_at``: how long the request sat before a worker picked it up
+  and actually did something with the claim. A claim rolled back by a
+  lease ``released`` transition before any attempt (an all-or-nothing
+  batch-claim rollback, a budget-route back to the queue) does not end
+  the wait — the request is back in line and the tenant is still waiting;
+  a claim that reaches an attempt or a settle locks the wait in, and
+  reclaims after that do not reset it;
+* **ttfa_s** — earliest ``attempt.started_at`` − ``submitted_at``: time to
+  the first supervised run actually starting (claim + plan + spawn);
+* **deadline hit-rate** — among SETTLED requests submitted with a
+  ``deadline_s``: settled ``done`` with (settle wall − ``submitted_at``)
+  <= deadline. A request that finished late, failed, or was dead-lettered
+  counts as a miss; an unsettled request is not yet judged, and a
+  ``canceled`` request is excluded from the denominator entirely — a
+  voluntary tenant cancel is not a service miss;
+* **attempts_per_request** — mean total supervisor attempts per request
+  over requests with at least one recorded ``attempt`` transition;
+* **deadletter rate** — settled ``deadletter`` over all settled, percent.
+
+Percentiles are **nearest-rank** (p-th percentile of n sorted values =
+value at rank ``ceil(p/100 * n)``): exact on small populations — a ledger
+with known synthetic timings yields exactly predictable p50/p99 (pinned by
+tests/test_fleet_obs.py), no interpolation surprises.
+
+Requeued dead-letters re-enter the live population: a ``requeued``
+transition clears the settled state, and the request's eventual re-settle
+is judged afresh. Racing settle writers (the queue's converging-settle
+discipline) may leave two ``settled`` transitions for one request — the
+winner is the queue's fixed priority order (done > failed > deadletter >
+canceled), mirroring what actually survives on disk.
+
+Thresholds (each unset by default = no breach checking for that SLO)::
+
+    REDCLIFF_SLO_QUEUE_P99_S      max acceptable queue-wait p99, seconds
+    REDCLIFF_SLO_TTFA_P99_S       max acceptable time-to-first-attempt p99
+    REDCLIFF_SLO_DEADLINE_PCT     min acceptable deadline hit-rate, percent
+    REDCLIFF_SLO_DEADLETTER_PCT   max acceptable dead-letter rate, percent
+
+stdlib only, no jax (obs/schema.py ``--check`` enforces it): SLO math runs
+in observer processes that must never initialize a backend.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+__all__ = ["percentile", "compute_slo", "slo_for_root",
+           "thresholds_from_env", "ENV_QUEUE_P99_S", "ENV_TTFA_P99_S",
+           "ENV_DEADLINE_PCT", "ENV_DEADLETTER_PCT"]
+
+ENV_QUEUE_P99_S = "REDCLIFF_SLO_QUEUE_P99_S"
+ENV_TTFA_P99_S = "REDCLIFF_SLO_TTFA_P99_S"
+ENV_DEADLINE_PCT = "REDCLIFF_SLO_DEADLINE_PCT"
+ENV_DEADLETTER_PCT = "REDCLIFF_SLO_DEADLETTER_PCT"
+
+# the queue's converging-settle priority (fleet/queue.py TERMINAL_STATES):
+# when racing writers recorded two settles, this is the one that survived
+_STATE_PRIORITY = ("done", "failed", "deadletter", "canceled")
+
+
+def percentile(values, p):
+    """Nearest-rank percentile: the value at rank ``ceil(p/100 * n)`` of
+    the sorted population (p in (0, 100]). Exact — never interpolates —
+    so known synthetic timings yield exactly predictable results. None on
+    an empty population."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    rank = min(max(int(math.ceil(p / 100.0 * len(ordered))), 1),
+               len(ordered))
+    return ordered[rank - 1]
+
+
+def _env_float(name):
+    raw = os.environ.get(name)
+    if raw is None or not str(raw).strip():
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def thresholds_from_env():
+    """The breach thresholds from the ``REDCLIFF_SLO_*`` env knobs (None =
+    that SLO is not checked)."""
+    return {
+        "queue_p99_s": _env_float(ENV_QUEUE_P99_S),
+        "ttfa_p99_s": _env_float(ENV_TTFA_P99_S),
+        "deadline_hit_pct": _env_float(ENV_DEADLINE_PCT),
+        "deadletter_pct": _env_float(ENV_DEADLETTER_PCT),
+    }
+
+
+def _wall(rec):
+    wt = rec.get("wall_time")
+    return wt if isinstance(wt, (int, float)) else None
+
+
+def _requests_from_history(records):
+    """Fold the lifecycle ledger into per-request summaries:
+    ``{request_id: {tenant, submitted_at, deadline_s, first_claimed,
+    first_attempt_start, attempts, settled_state, settled_at}}``."""
+    reqs = {}
+    ordered = sorted((r for r in records if r.get("kind")),
+                     key=lambda r: (_wall(r) or 0.0, r.get("seq") or 0))
+    for rec in ordered:
+        kind = rec.get("kind")
+        rid = rec.get("request_id")
+        if rid is None:
+            continue  # batch-scoped transitions (planned/bisected)
+        r = reqs.setdefault(rid, {
+            "request_id": rid, "tenant": None, "trace_id": None,
+            "submitted_at": None, "deadline_s": None,
+            "first_claimed": None, "first_attempt_start": None,
+            "attempts": 0, "settled_state": None, "settled_at": None,
+            "_pending_claim": None})
+        if rec.get("tenant") is not None:
+            r["tenant"] = str(rec["tenant"])
+        if rec.get("trace_id") is not None and r["trace_id"] is None:
+            r["trace_id"] = rec["trace_id"]
+        if kind == "submitted":
+            sub = rec.get("submitted_at")
+            r["submitted_at"] = sub if isinstance(sub, (int, float)) \
+                else _wall(rec)
+            if rec.get("deadline_s") is not None:
+                r["deadline_s"] = float(rec["deadline_s"])
+        elif kind == "claimed":
+            # provisional until the claim leads to an attempt or a settle:
+            # a claim rolled back by a lease release never did any work,
+            # so it must not end the tenant's queue wait
+            wt = _wall(rec)
+            if wt is not None and r["first_claimed"] is None \
+                    and r["_pending_claim"] is None:
+                r["_pending_claim"] = wt
+        elif kind == "released":
+            if r["first_claimed"] is None:
+                r["_pending_claim"] = None
+        elif kind == "attempt":
+            if r["first_claimed"] is None \
+                    and r["_pending_claim"] is not None:
+                r["first_claimed"] = r["_pending_claim"]
+            start = rec.get("started_at")
+            start = start if isinstance(start, (int, float)) else _wall(rec)
+            if start is not None and (r["first_attempt_start"] is None
+                                      or start < r["first_attempt_start"]):
+                r["first_attempt_start"] = start
+            n = rec.get("attempts")
+            r["attempts"] += int(n) if isinstance(n, int) and n > 0 else 1
+        elif kind == "settled":
+            if r["first_claimed"] is None \
+                    and r["_pending_claim"] is not None:
+                r["first_claimed"] = r["_pending_claim"]
+            state = str(rec.get("state") or "?")
+            prev = r["settled_state"]
+            if prev is None or (state in _STATE_PRIORITY
+                                and (prev not in _STATE_PRIORITY
+                                     or _STATE_PRIORITY.index(state)
+                                     < _STATE_PRIORITY.index(prev))):
+                r["settled_state"] = state
+                r["settled_at"] = _wall(rec)
+        elif kind == "requeued":
+            # back into the live population: the re-settle is judged fresh
+            r["settled_state"] = None
+            r["settled_at"] = None
+    for r in reqs.values():
+        # a claim still pending at ledger end is live right now (the
+        # worker holds the lease mid-batch): it did end the queue wait
+        if r["first_claimed"] is None and r["_pending_claim"] is not None:
+            r["first_claimed"] = r["_pending_claim"]
+        del r["_pending_claim"]
+    return reqs
+
+
+def _dist(values):
+    if not values:
+        return None
+    return {"n": len(values),
+            "p50": percentile(values, 50.0),
+            "p99": percentile(values, 99.0),
+            "mean": sum(values) / len(values),
+            "max": max(values)}
+
+
+def _block(reqs):
+    """One SLO block (per tenant, or fleet-wide) from request summaries."""
+    queue_waits, ttfas, attempt_counts = [], [], []
+    states = {s: 0 for s in _STATE_PRIORITY}
+    with_deadline = hits = 0
+    for r in reqs:
+        sub = r["submitted_at"]
+        if sub is not None and r["first_claimed"] is not None:
+            queue_waits.append(r["first_claimed"] - sub)
+        if sub is not None and r["first_attempt_start"] is not None:
+            ttfas.append(r["first_attempt_start"] - sub)
+        if r["attempts"]:
+            attempt_counts.append(r["attempts"])
+        state = r["settled_state"]
+        if state in states:
+            states[state] += 1
+            if r["deadline_s"] is not None and sub is not None \
+                    and r["settled_at"] is not None \
+                    and state != "canceled":
+                with_deadline += 1
+                if state == "done" \
+                        and (r["settled_at"] - sub) <= r["deadline_s"]:
+                    hits += 1
+    settled = sum(states.values())
+    return {
+        "requests": len(reqs),
+        "settled": settled,
+        "states": states,
+        "queue_wait_s": _dist(queue_waits),
+        "ttfa_s": _dist(ttfas),
+        "deadline": ({"with_deadline": with_deadline, "hits": hits,
+                      "hit_pct": 100.0 * hits / with_deadline}
+                     if with_deadline else None),
+        "attempts_per_request": (sum(attempt_counts) / len(attempt_counts)
+                                 if attempt_counts else None),
+        "deadletter_pct": (100.0 * states["deadletter"] / settled
+                           if settled else None),
+    }
+
+
+def _breaches_of(scope, block, thr):
+    out = []
+
+    def breach(slo, value, threshold, worse_above=True):
+        if value is None or threshold is None:
+            return
+        if (value > threshold) if worse_above else (value < threshold):
+            out.append({"scope": scope, "slo": slo, "value": value,
+                        "threshold": threshold})
+
+    qw, tt = block.get("queue_wait_s"), block.get("ttfa_s")
+    breach("queue_p99_s", (qw or {}).get("p99"), thr.get("queue_p99_s"))
+    breach("ttfa_p99_s", (tt or {}).get("p99"), thr.get("ttfa_p99_s"))
+    breach("deadline_hit_pct", (block.get("deadline") or {}).get("hit_pct"),
+           thr.get("deadline_hit_pct"), worse_above=False)
+    breach("deadletter_pct", block.get("deadletter_pct"),
+           thr.get("deadletter_pct"))
+    return out
+
+
+def compute_slo(records, thresholds=None):
+    """Compute the fleet SLO view from lifecycle-ledger records
+    (fleet/history.py). Returns ``{"requests", "settled", "overall",
+    "tenants": {tenant: block}, "thresholds", "breaches", "window"}`` —
+    strict-JSON-able; ``None`` sub-blocks mean no evidence yet, never
+    zero. ``thresholds`` defaults to :func:`thresholds_from_env`."""
+    thr = dict(thresholds_from_env(), **(thresholds or {}))
+    reqs = list(_requests_from_history(records).values())
+    by_tenant = {}
+    for r in reqs:
+        by_tenant.setdefault(r["tenant"] or "?", []).append(r)
+    overall = _block(reqs)
+    tenants = {t: _block(rs) for t, rs in sorted(by_tenant.items())}
+    breaches = _breaches_of("overall", overall, thr)
+    for t, block in tenants.items():
+        breaches.extend(_breaches_of(t, block, thr))
+    walls = [w for rec in records for w in (_wall(rec),) if w is not None]
+    return {
+        "requests": overall["requests"],
+        "settled": overall["settled"],
+        "overall": overall,
+        "tenants": tenants,
+        "thresholds": thr,
+        "breaches": breaches,
+        "window": {"first_wall": min(walls) if walls else None,
+                   "last_wall": max(walls) if walls else None},
+    }
+
+
+def slo_for_root(root, thresholds=None, stats=None):
+    """The SLO view for a fleet root (reads ``<root>/history.jsonl``), or
+    None when the root holds no lifecycle ledger yet."""
+    from redcliff_tpu.fleet.history import read_history
+
+    records = read_history(root, stats=stats)
+    if not records:
+        return None
+    return compute_slo(records, thresholds=thresholds)

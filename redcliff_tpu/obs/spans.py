@@ -27,12 +27,25 @@ have no natural span emission point — prefetch stall milliseconds, async
 checkpoint submit-barrier stalls — which the grid engine folds into its
 per-fit ``dispatch_stats``.
 
+**Trace context** (the fleet's cross-process request identity,
+docs/ARCHITECTURE.md "Request lifecycle tracing & SLOs"): a fleet worker
+runs each batch under a context ``{"batch_id": ..., "trace_ids":
+{request_id: trace_id}}`` — set in-process via :func:`set_trace_ctx` and
+handed to the supervised run_batch child through the ``REDCLIFF_TRACE_CTX``
+env var (parsed once at import). While a context is live (and tracing is
+on), every finished span — and, via :class:`~redcliff_tpu.obs.logging
+.MetricLogger`, every metrics record — carries it as a ``trace`` field, so
+a post-mortem join can attribute any span in any process to the fleet
+requests it was serving. One ``is not None`` check on the hot path; no
+context, no cost.
+
 stdlib only — no numpy, no jax: the watchdog and the backend-free bench
 parent import this path.
 """
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import threading
 import time
@@ -40,9 +53,11 @@ import time
 from redcliff_tpu.obs import flight as _flight
 
 __all__ = ["span", "record_span", "enabled", "set_enabled", "Span", "NOOP",
-           "Counters", "COUNTERS", "ENV_TRACE", "PID", "HOST"]
+           "Counters", "COUNTERS", "ENV_TRACE", "ENV_TRACE_CTX",
+           "trace_ctx", "set_trace_ctx", "PID", "HOST"]
 
 ENV_TRACE = "REDCLIFF_TRACE"
+ENV_TRACE_CTX = "REDCLIFF_TRACE_CTX"
 
 # tracing defaults ON: the spine's steady-state cost is ring appends and a
 # handful of jsonl lines per check window (bench pins it <= 2% of wps);
@@ -62,6 +77,39 @@ except (AttributeError, OSError):  # non-posix
 # across the run's processes (both ride every span record)
 _ids = itertools.count(1)
 _tls = threading.local()  # per-thread open-span stack (parent propagation)
+
+
+# cross-process trace context: a fleet worker exports REDCLIFF_TRACE_CTX
+# (JSON {"batch_id", "trace_ids": {request_id: trace_id}}) into its
+# supervised run_batch child; a non-dict / unparseable value is ignored —
+# identity stamping must never crash the process it identifies
+def _ctx_from_env():
+    raw = os.environ.get(ENV_TRACE_CTX)
+    if not raw:
+        return None
+    try:
+        ctx = json.loads(raw)
+    except ValueError:
+        return None
+    return ctx if isinstance(ctx, dict) and ctx else None
+
+
+_trace_ctx = _ctx_from_env()
+
+
+def trace_ctx():
+    """The live trace context dict, or None (one attribute read)."""
+    return _trace_ctx
+
+
+def set_trace_ctx(ctx):
+    """Set (or clear, with None) the process-wide trace context; returns
+    the PREVIOUS context so callers can scope it (the fleet worker brackets
+    each batch)."""
+    global _trace_ctx
+    prev = _trace_ctx
+    _trace_ctx = ctx if isinstance(ctx, dict) and ctx else None
+    return prev
 
 
 def enabled():
@@ -144,6 +192,8 @@ class Span:
             rec["error"] = exc_type.__name__
         if self.attrs:
             rec["attrs"] = dict(self.attrs)
+        if _trace_ctx is not None:
+            rec["trace"] = _trace_ctx
         _flight.record(self.component, rec)
         if self.emit and self.logger is not None \
                 and getattr(self.logger, "active", False):
@@ -189,6 +239,8 @@ def record_span(name, dur_ms, *, component=None, logger=None, emit=False,
     }
     if attrs:
         rec["attrs"] = dict(attrs)
+    if _trace_ctx is not None:
+        rec["trace"] = _trace_ctx
     _flight.record(component or name.partition(".")[0], rec)
     if emit and logger is not None and getattr(logger, "active", False):
         logger.log("span", **{k: v for k, v in rec.items()

@@ -26,6 +26,16 @@ Mapping (the trace-event format's vocabulary):
 * every other registered event lands as an instant (``ph="i"``) carrying
   its fields in ``args``.
 
+**Fleet mode** (``obs trace --fleet <root>``, ISSUE 12): pointed at a
+fleet sweep-service root (fleet/queue.py layout), :func:`build_fleet_trace`
+joins the root's own metrics chain, the request-lifecycle ledger
+(``history.jsonl``, fleet/history.py), and every ``work/<batch_id>`` run
+dir + supervisor ledger into ONE timeline: per-worker / per-child
+(host, pid) process lanes, a ``fleet-requests`` process with one track
+per request spanning submit -> settle across every process that touched
+it (under its submit-minted ``trace_id``), and queue-depth / in-flight /
+dead-letter-depth counter tracks replayed from the ledger.
+
 stdlib + the spine's jsonl reader only — no jax, never a backend; the
 export runs post-mortem on any machine holding the run dir.
 """
@@ -38,7 +48,8 @@ import sys
 
 from redcliff_tpu.obs.logging import read_jsonl
 
-__all__ = ["build_trace", "validate_trace", "write_trace", "main"]
+__all__ = ["build_trace", "build_fleet_trace", "validate_trace",
+           "write_trace", "main"]
 
 # events never rendered as instants: spans get their own "X" events, and a
 # record that already fed a counter sample this pass is not duplicated as
@@ -105,10 +116,9 @@ def _span_start(rec):
     return t_wall
 
 
-def build_trace(run_dir):
-    """Export one run directory as a Chrome trace-event JSON dict:
-    ``{"traceEvents": [...], "displayTimeUnit": "ms", "otherData": {...}}``.
-    Timestamps are microseconds relative to the run's earliest record."""
+def _read_run_dir(run_dir):
+    """(records, ledger, mstats, lstats) for one run dir — missing files
+    degrade to empty, torn lines counted by the spine's reader."""
     mstats, lstats = {}, {}
     try:
         records = read_jsonl(run_dir, stats=mstats)
@@ -117,7 +127,11 @@ def build_trace(run_dir):
     ledger_path = os.path.join(run_dir, "run_ledger.jsonl")
     ledger = (read_jsonl(ledger_path, stats=lstats)
               if os.path.exists(ledger_path) else [])
+    return records, ledger, mstats, lstats
 
+
+def _walls_of(records, ledger):
+    """Every wall-clock timestamp that must bound the trace's time base."""
     walls = [r["wall_time"] for r in records
              if _num(r.get("wall_time")) is not None]
     # span STARTS bound the time base too (a long first span would
@@ -126,11 +140,13 @@ def build_trace(run_dir):
               for s in (_span_start(r),) if s is not None]
     walls += [r["started_at"] for r in ledger
               if _num(r.get("started_at")) is not None]
-    t0 = min(walls) if walls else 0.0
-    ts = lambda wall: round((wall - t0) * 1e6, 1)
+    return walls
 
-    ids = _Ids()
-    events = []
+
+def _metric_events(records, ids, ts, events):
+    """Map one metrics-chain record list into trace events: spans ->
+    ``X``, epoch/memory -> counter samples, everything else -> instants —
+    each on its writing (host, pid)'s process lane."""
     for rec in records:
         ev = rec.get("event")
         wall = _num(rec.get("wall_time"))
@@ -146,7 +162,7 @@ def build_trace(run_dir):
                  "ts": ts(start if start is not None else wall),
                  "dur": round(dur * 1e3, 1),
                  "pid": pid, "tid": ids.tid(pid, comp)}
-            args = {k: rec[k] for k in ("span_id", "parent_id")
+            args = {k: rec[k] for k in ("span_id", "parent_id", "trace")
                     if rec.get(k) is not None}
             args.update(rec.get("attrs") or {})
             if args:
@@ -184,7 +200,10 @@ def build_trace(run_dir):
                        "ts": ts(wall), "pid": pid, "tid": tid,
                        "args": _args_of(rec)})
 
-    # supervisor ledger: attempts as spans on a synthetic process
+
+def _ledger_events(ledger, ids, ts, events, proc_name="supervisor"):
+    """Supervisor ledger attempts as ``X`` events on a synthetic
+    process."""
     sup_pid = None
     for rec in ledger:
         if rec.get("event") != "attempt":
@@ -193,7 +212,7 @@ def build_trace(run_dir):
         if start is None:
             continue
         if sup_pid is None:
-            sup_pid = ids.pid("supervisor", 0)
+            sup_pid = ids.pid(proc_name, 0)
         dur_s = _num(rec.get("duration_s")) or 0.0
         events.append({
             "ph": "X",
@@ -203,6 +222,21 @@ def build_trace(run_dir):
             "dur": round(dur_s * 1e6, 1),
             "pid": sup_pid, "tid": ids.tid(sup_pid, "attempts"),
             "args": _args_of(rec)})
+
+
+def build_trace(run_dir):
+    """Export one run directory as a Chrome trace-event JSON dict:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms", "otherData": {...}}``.
+    Timestamps are microseconds relative to the run's earliest record."""
+    records, ledger, mstats, lstats = _read_run_dir(run_dir)
+    walls = _walls_of(records, ledger)
+    t0 = min(walls) if walls else 0.0
+    ts = lambda wall: round((wall - t0) * 1e6, 1)
+
+    ids = _Ids()
+    events = []
+    _metric_events(records, ids, ts, events)
+    _ledger_events(ledger, ids, ts, events)
 
     events.sort(key=lambda e: e.get("ts", 0.0))
     return {
@@ -215,6 +249,199 @@ def build_trace(run_dir):
             "torn_lines": (mstats.get("torn_lines", 0)
                            + lstats.get("torn_lines", 0)),
             "ledger_records": len(ledger),
+        },
+    }
+
+
+def _request_track_events(history, ids, ts, events):
+    """Per-request tracks from the lifecycle ledger: one thread per
+    request on a synthetic ``fleet-requests`` process, holding one ``X``
+    event spanning submit -> settle (the whole cross-process lifetime
+    under one trace_id) plus an instant per transition (claimed / attempt
+    / settled ...). Batch-scoped transitions (planned / bisected) land on
+    a ``fleet-batches`` thread."""
+    per_req = {}
+    batch_events = []
+    for rec in history:
+        if rec.get("request_id") is not None:
+            per_req.setdefault(rec["request_id"], []).append(rec)
+        elif rec.get("kind") in ("planned", "bisected"):
+            batch_events.append(rec)
+    if not per_req and not batch_events:
+        return
+    pid = ids.pid("fleet-requests", 0)
+    for rid in sorted(per_req):
+        recs = sorted(per_req[rid],
+                      key=lambda r: (_num(r.get("wall_time")) or 0.0,
+                                     r.get("seq") or 0))
+        tenant = next((r.get("tenant") for r in recs
+                       if r.get("tenant") is not None), "?")
+        trace_id = next((r.get("trace_id") for r in recs
+                         if r.get("trace_id") is not None), None)
+        walls = [w for r in recs for w in (_num(r.get("wall_time")),)
+                 if w is not None]
+        if not walls:
+            continue
+        sub = next((_num(r.get("submitted_at")) or _num(r.get("wall_time"))
+                    for r in recs if r.get("kind") == "submitted"),
+                   min(walls))
+        settled = next((r for r in recs if r.get("kind") == "settled"),
+                       None)
+        end = (_num(settled.get("wall_time")) if settled is not None
+               else None)
+        tid = ids.tid(pid, rid)
+        args = {"request_id": rid, "tenant": tenant}
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        args["state"] = (settled.get("state") if settled is not None
+                         else "live")
+        events.append({"ph": "X", "name": f"{tenant}/{rid}",
+                       "cat": "request", "ts": ts(min(sub, min(walls))),
+                       "dur": round(max((end if end is not None
+                                         else max(walls)) - sub, 0.0) * 1e6,
+                                    1),
+                       "pid": pid, "tid": tid, "args": args})
+        for r in recs:
+            wall = _num(r.get("wall_time"))
+            if wall is None:
+                continue
+            events.append({"ph": "i", "name": str(r.get("kind")),
+                           "cat": "fleet_lifecycle", "s": "t",
+                           "ts": ts(wall), "pid": pid, "tid": tid,
+                           "args": _args_of(r)})
+    if batch_events:
+        tid = ids.tid(pid, "fleet-batches")
+        for r in batch_events:
+            wall = _num(r.get("wall_time"))
+            if wall is None:
+                continue
+            events.append({"ph": "i", "name": str(r.get("kind")),
+                           "cat": "fleet_lifecycle", "s": "t",
+                           "ts": ts(wall), "pid": pid, "tid": tid,
+                           "args": _args_of(r)})
+
+
+def _queue_counter_events(history, ids, ts, events):
+    """Replay the lifecycle ledger into queue-depth / in-flight /
+    dead-letter-depth counter tracks (one sample per transition)."""
+    ordered = sorted((r for r in history if r.get("request_id") is not None),
+                     key=lambda r: (_num(r.get("wall_time")) or 0.0,
+                                    r.get("seq") or 0))
+    if not ordered:
+        return
+    pid = ids.pid("fleet-queue", 0)
+    tid = ids.tid(pid, "counters")
+    state = {}  # request_id -> "queued" | "running" | terminal state
+    queued = in_flight = deadletter = 0
+    for rec in ordered:
+        kind, rid = rec.get("kind"), rec["request_id"]
+        wall = _num(rec.get("wall_time"))
+        if wall is None:
+            continue
+        prev = state.get(rid)
+        if kind == "submitted" and prev is None:
+            state[rid] = "queued"
+            queued += 1
+        elif kind == "claimed" and prev == "queued":
+            state[rid] = "running"
+            queued -= 1
+            in_flight += 1
+        elif kind == "released" and prev == "running":
+            # a lease release (budget-route, bisection, all-or-nothing
+            # claim rollback) returns the request to the queue — without
+            # this the in-flight curve would stay high through exactly the
+            # crash-loop incidents the counters exist to diagnose
+            state[rid] = "queued"
+            in_flight -= 1
+            queued += 1
+        elif kind == "settled" and prev in ("queued", "running"):
+            if prev == "queued":
+                queued -= 1
+            else:
+                in_flight -= 1
+            state[rid] = str(rec.get("state") or "settled")
+            if state[rid] == "deadletter":
+                deadletter += 1
+        elif kind == "requeued" and prev not in ("queued", "running"):
+            if prev == "deadletter":
+                deadletter -= 1
+            state[rid] = "queued"
+            queued += 1
+        else:
+            continue
+        events.append({"ph": "C", "name": "queue_depth", "ts": ts(wall),
+                       "pid": pid, "tid": tid, "args": {"queued": queued}})
+        events.append({"ph": "C", "name": "in_flight", "ts": ts(wall),
+                       "pid": pid, "tid": tid,
+                       "args": {"in_flight": in_flight}})
+        events.append({"ph": "C", "name": "deadletter_depth",
+                       "ts": ts(wall), "pid": pid, "tid": tid,
+                       "args": {"deadletter": deadletter}})
+
+
+def build_fleet_trace(root):
+    """Export a FLEET ROOT (fleet/queue.py layout) as one joined Chrome
+    trace: the root's own metrics chain (worker fleet events + spans), the
+    lifecycle ledger's per-request tracks and queue/in-flight/dead-letter
+    counter curves, and every ``work/<batch_id>`` run dir's records +
+    supervisor ledger — each writing (host, pid) its own process lane, so
+    one request's track visibly spans submit CLI -> worker -> supervised
+    jax child (and any reclaiming worker after a SIGKILL) under one
+    trace_id."""
+    from redcliff_tpu.fleet.history import read_history
+
+    hstats = {}
+    root_records, _ledger, rstats, _ = _read_run_dir(root)
+    # the root chain never has a run_ledger; fleet_lifecycle records ride
+    # history.jsonl, not metrics.jsonl
+    history = read_history(root, stats=hstats)
+    work_dir = os.path.join(root, "work")
+    try:
+        batch_dirs = sorted(
+            os.path.join(work_dir, d) for d in os.listdir(work_dir)
+            if os.path.isdir(os.path.join(work_dir, d)))
+    except OSError:
+        batch_dirs = []
+    runs = []
+    torn = rstats.get("torn_lines", 0) + hstats.get("torn_lines", 0)
+    n_records = rstats.get("records", 0) + hstats.get("records", 0)
+    for d in batch_dirs:
+        records, ledger, mstats, lstats = _read_run_dir(d)
+        runs.append((d, records, ledger))
+        torn += mstats.get("torn_lines", 0) + lstats.get("torn_lines", 0)
+        n_records += mstats.get("records", 0)
+
+    walls = _walls_of(root_records, [])
+    walls += [w for r in history
+              for w in (_num(r.get("wall_time")),
+                        _num(r.get("submitted_at")),
+                        _num(r.get("started_at"))) if w is not None]
+    for _d, records, ledger in runs:
+        walls += _walls_of(records, ledger)
+    t0 = min(walls) if walls else 0.0
+    ts = lambda wall: round((wall - t0) * 1e6, 1)
+
+    ids = _Ids()
+    events = []
+    _metric_events(root_records, ids, ts, events)
+    for d, records, ledger in runs:
+        _metric_events(records, ids, ts, events)
+        _ledger_events(ledger, ids, ts, events,
+                       proc_name=f"supervisor:{os.path.basename(d)}")
+    _request_track_events(history, ids, ts, events)
+    _queue_counter_events(history, ids, ts, events)
+
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": ids.meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "fleet_root": os.path.abspath(root),
+            "t0_wall": t0,
+            "records": n_records,
+            "history_records": hstats.get("records", 0),
+            "batch_run_dirs": len(runs),
+            "torn_lines": torn,
         },
     }
 
@@ -263,9 +490,10 @@ def validate_trace(trace):
     return errors
 
 
-def write_trace(run_dir, output):
-    """Build and write the trace; returns the trace dict."""
-    trace = build_trace(run_dir)
+def write_trace(run_dir, output, fleet=False):
+    """Build and write the trace; returns the trace dict. ``fleet=True``
+    treats ``run_dir`` as a fleet root (:func:`build_fleet_trace`)."""
+    trace = build_fleet_trace(run_dir) if fleet else build_trace(run_dir)
     with open(output, "w") as f:
         json.dump(trace, f, allow_nan=False)
         f.write("\n")
@@ -277,24 +505,35 @@ def main(argv=None):
         prog="python -m redcliff_tpu.obs trace",
         description="Export a run directory's telemetry as Chrome "
                     "trace-event JSON (open in ui.perfetto.dev).")
-    ap.add_argument("run_dir", help="run directory (holds metrics.jsonl)")
+    ap.add_argument("run_dir", help="run directory (holds metrics.jsonl), "
+                                    "or a fleet root with --fleet")
     ap.add_argument("-o", "--output", default=None,
                     help="write the trace JSON here (default: stdout)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat run_dir as a fleet sweep-service root: "
+                         "join the lifecycle ledger, worker metrics, and "
+                         "every batch run dir into one timeline "
+                         "(per-request tracks + queue counter tracks)")
     args = ap.parse_args(argv)
-    from redcliff_tpu.obs.watch import diagnose_run_dir
+    from redcliff_tpu.obs.watch import diagnose_run_dir, is_fleet_root
 
     diag = diagnose_run_dir(args.run_dir)
+    if diag is None and args.fleet and not is_fleet_root(args.run_dir):
+        diag = (f"not a fleet root (no requests.jsonl / leases/): "
+                f"{args.run_dir}")
     if diag is not None:
         print(f"obs trace: {diag}", file=sys.stderr)
         return 2
     if args.output:
-        trace = write_trace(args.run_dir, args.output)
+        trace = write_trace(args.run_dir, args.output, fleet=args.fleet)
         od = trace["otherData"]
         print(f"obs trace: {len(trace['traceEvents'])} event(s) from "
               f"{od['records']} record(s) ({od['torn_lines']} torn line(s) "
               f"skipped) -> {args.output}")
     else:
-        json.dump(build_trace(args.run_dir), sys.stdout, allow_nan=False)
+        trace = (build_fleet_trace(args.run_dir) if args.fleet
+                 else build_trace(args.run_dir))
+        json.dump(trace, sys.stdout, allow_nan=False)
         sys.stdout.write("\n")
     return 0
 

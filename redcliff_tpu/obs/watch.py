@@ -158,6 +158,31 @@ def _checkpoint_stalls(run_dir):
     return out
 
 
+# follow-mode cache for the fleet-SLO view: slo_for_root re-reads and
+# re-aggregates the WHOLE lifecycle ledger, which only grows — so a busy
+# root would pay an ever-larger parse on every refresh tick even when no
+# request moved. Cached on the ledger head file's (mtime, size) signature
+# (appends grow it, rotation replaces it — either invalidates).
+_fleet_slo_cache = {}
+
+
+def _fleet_slo(root):
+    from redcliff_tpu.fleet import history as _history
+    from redcliff_tpu.obs import slo as _slo
+
+    try:
+        st = os.stat(_history.history_path(root))
+        sig = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        sig = None
+    cached = _fleet_slo_cache.get(str(root))
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    out = _slo.slo_for_root(root)
+    _fleet_slo_cache[str(root)] = (sig, out)
+    return out
+
+
 def build_snapshot(run_dir, now=None):
     """One watch snapshot as a plain dict (``event="watch"`` — validates
     against the registered schema; importable for services and tests)."""
@@ -411,6 +436,11 @@ def _fleet_section(root, last_plan, workers, now):
         }
         if len(attempts) >= 64:
             break
+    # fleet-SLO headline (ISSUE 12, obs/slo.py): per-tenant queue-wait
+    # percentiles / deadline hit-rate / dead-letter rate from the durable
+    # lifecycle ledger, with REDCLIFF_SLO_* threshold breach flags — the
+    # service-level numbers a follow-mode operator steers by
+    slo = _fleet_slo(root)
     return {
         "counts": st["counts"],
         "by_tenant": st["by_tenant"],
@@ -420,6 +450,7 @@ def _fleet_section(root, last_plan, workers, now):
         "deadletter": {"depth": len(term["deadletter"]),
                        "requests": deadletters},
         "attempts": attempts,
+        "slo": slo,
         "worker_age_s": {w: round(now - t, 3)
                          for w, t in sorted(workers.items())},
     }
@@ -475,6 +506,31 @@ def render_text(snap):
             out.append("    attempt budgets: " + "  ".join(
                 f"{rid}={a['attempts']}f/{a['reclaims']}r"
                 for rid, a in sorted(att.items())))
+        slo = fl.get("slo")
+        if slo:
+            ov = slo["overall"]
+
+            def _slo_s(v):
+                return f"{v:.2f}s" if isinstance(v, (int, float)) else "-"
+
+            qw, tt = ov.get("queue_wait_s") or {}, ov.get("ttfa_s") or {}
+            dl = ov.get("deadline") or {}
+            dlp = ov.get("deadletter_pct")
+            att_pr = ov.get("attempts_per_request")
+            out.append(
+                f"    slo: qwait p50/p99 {_slo_s(qw.get('p50'))}/"
+                f"{_slo_s(qw.get('p99'))} | ttfa p99 "
+                f"{_slo_s(tt.get('p99'))} | deadline "
+                + (f"{dl['hit_pct']:.0f}%" if dl.get("hit_pct") is not None
+                   else "-")
+                + f" | attempts/req "
+                + (f"{att_pr:.2f}" if att_pr is not None else "-")
+                + f" | dead-letter "
+                + (f"{dlp:.1f}%" if dlp is not None else "-")
+                + f" ({ov['settled']}/{ov['requests']} settled)")
+            for br in slo.get("breaches") or []:
+                out.append(f"    SLO BREACH [{br['scope']}] {br['slo']}: "
+                           f"{br['value']:.3f} vs {br['threshold']:.3f}")
         for inf in fl["in_flight"]:
             out.append(f"    in-flight {inf['request_id']} "
                        f"[{inf['tenant']}] on {inf['worker']} "

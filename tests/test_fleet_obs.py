@@ -1,0 +1,849 @@
+"""Request-scoped distributed tracing + fleet SLO observatory (ISSUE 12).
+
+The durable lifecycle ledger (fleet/history.py — append/read roundtrip,
+torn-tail healing, best-effort writes), exact SLO math on synthetic
+timings (obs/slo.py nearest-rank percentiles, deadline hit-rate, requeue
+re-entry, REDCLIFF_SLO_* breach flags), cross-process trace-context
+propagation (obs/spans.py set_trace_ctx / REDCLIFF_TRACE_CTX — span and
+metrics stamping, zero-stamp when tracing is off), the full lifecycle
+driven through the real worker loop against a stubbed supervisor
+(submitted -> planned -> claimed -> attempt -> settled under one
+trace_id, dead-letter + bisection linkage, worker_crash flight dump),
+the fleet Perfetto export (obs/trace_export.py --fleet: per-request
+tracks, queue counter curves, structural validity), the PR-8
+rotation-boundary/SIGKILL-torn-tail pattern extended to the fleet root,
+and one real supervised end-to-end drain pinning the acceptance: every
+request's track spans submit -> settle across processes under its
+submit-minted trace_id, and the child's records carry the same join keys.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from redcliff_tpu.fleet import history as fleet_history
+from redcliff_tpu.fleet import worker as worker_mod
+from redcliff_tpu.fleet.queue import FleetQueue
+from redcliff_tpu.fleet.__main__ import TINY_SPEC
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs import slo as obs_slo
+from redcliff_tpu.obs import spans as obs_spans
+from redcliff_tpu.obs.logging import MetricLogger, read_jsonl
+from redcliff_tpu.obs.trace_export import build_fleet_trace, validate_trace
+from redcliff_tpu.runtime.supervisor import SuperviseOutcome
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _submit_tiny(q, tenant, epochs=2, points=None, **kw):
+    spec = json.loads(json.dumps(TINY_SPEC))
+    spec["epochs"] = epochs
+    return q.submit(tenant, points or [{"gen_lr": 1e-3}], spec=spec, **kw)
+
+
+def _clean_fault_env():
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# lifecycle ledger (fleet/history.py)
+# ---------------------------------------------------------------------------
+def test_history_append_read_roundtrip(tmp_path):
+    root = str(tmp_path)
+    fleet_history.append_event(root, "submitted", request_id="r1",
+                               trace_id="tr-1", tenant="a",
+                               submitted_at=10.0, now=10.0)
+    fleet_history.append_event(root, "claimed", request_id="r1",
+                               trace_id="tr-1", worker="w-1", now=12.0)
+    stats = {}
+    recs = fleet_history.read_history(root, stats=stats)
+    assert [r["kind"] for r in recs] == ["submitted", "claimed"]
+    assert all(r["event"] == "fleet_lifecycle" for r in recs)
+    assert all(r["trace_id"] == "tr-1" for r in recs)
+    assert stats["torn_lines"] == 0
+    # registered in the closed schema registry
+    assert obs_schema.validate_records(recs) == []
+    # identity triple rides every transition (the ordering contract)
+    for r in recs:
+        assert r["seq"] > 0 and r["pid"] == os.getpid() and r["host"]
+
+
+def test_history_torn_tail_healed_and_counted(tmp_path):
+    root = str(tmp_path)
+    fleet_history.append_event(root, "submitted", request_id="r1", now=1.0)
+    # a writer SIGKILLed mid-append: unterminated torn garbage on disk
+    with open(fleet_history.history_path(root), "a") as f:
+        f.write('{"event": "fleet_lifecycle", "kind": "cla')
+    # the next writer's healing newline keeps its record whole
+    fleet_history.append_event(root, "settled", request_id="r1",
+                               state="done", now=2.0)
+    stats = {}
+    recs = fleet_history.read_history(root, stats=stats)
+    assert [r["kind"] for r in recs] == ["submitted", "settled"]
+    assert stats["torn_lines"] == 1
+
+
+def test_history_unwritable_root_never_raises(tmp_path):
+    # best-effort durability: an unwritable ledger is an observability
+    # loss, never a queue-protocol failure
+    rec = fleet_history.append_event(str(tmp_path / "no" / "such" / "dir"),
+                                     "submitted", request_id="r1")
+    assert rec["kind"] == "submitted"  # returned, not raised
+    assert fleet_history.read_history(str(tmp_path / "absent")) == []
+
+
+def test_history_rotation_cap_windows_the_ledger(tmp_path, monkeypatch):
+    # REDCLIFF_HISTORY_MAX_BYTES: the head rotates like the metrics spine,
+    # the chain reads back oldest-first, and backups stay capped — a
+    # week-long fleet's ledger (and the per-tick SLO re-parse) is bounded
+    root = str(tmp_path)
+    monkeypatch.setenv(fleet_history.ENV_MAX_BYTES, "2000")
+    for i in range(100):
+        fleet_history.append_event(root, "submitted", request_id=f"r{i:03d}",
+                                   trace_id=f"tr-{i}", tenant="t",
+                                   submitted_at=1000.0 + i, now=1000.0 + i)
+    head = fleet_history.history_path(root)
+    assert os.path.exists(f"{head}.1")  # rotated at least once
+    assert os.path.getsize(head) <= 2000 + 300  # one record of slack
+    recs = fleet_history.read_history(root)
+    ids = [r["request_id"] for r in recs]
+    assert ids == sorted(ids) and ids[-1] == "r099"  # chain order intact
+    backups = [n for n in os.listdir(root)
+               if n.startswith("history.jsonl.")
+               and n.rsplit(".", 1)[-1].isdigit()]
+    assert 1 <= len(backups) <= fleet_history.MAX_BACKUPS
+    # unset (the default) never rotates
+    monkeypatch.delenv(fleet_history.ENV_MAX_BYTES)
+    other = str(tmp_path / "uncapped")
+    os.makedirs(other)
+    for i in range(50):
+        fleet_history.append_event(other, "submitted", request_id=f"r{i}",
+                                   now=2000.0 + i)
+    assert not os.path.exists(fleet_history.history_path(other) + ".1")
+
+
+def test_queue_transitions_append_lifecycle_events(tmp_path):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "alice", deadline_s=60.0)
+    [rec] = q.requests()
+    assert rec["trace_id"].startswith("tr-")
+    lease = q.claim(rid, "w-1", 30.0, batch_id="b1",
+                    batch_request_ids=[rid], tenant="alice",
+                    trace_id=rec["trace_id"])
+    assert lease is not None
+    q.complete(rid, result={"n_points": 1}, trace_id=rec["trace_id"])
+    recs = fleet_history.read_history(str(tmp_path / "fleet"))
+    by_kind = {r["kind"]: r for r in recs}
+    assert set(by_kind) == {"submitted", "claimed", "settled"}
+    assert by_kind["submitted"]["deadline_s"] == 60.0
+    assert by_kind["submitted"]["submitted_at"] == rec["submitted_at"]
+    assert by_kind["claimed"]["worker"] == "w-1"
+    assert by_kind["settled"]["state"] == "done"
+    # ONE trace identity across every transition
+    assert {r["trace_id"] for r in recs} == {rec["trace_id"]}
+
+
+def test_lease_release_appends_released_event(tmp_path):
+    # a released claim (budget-route, bisection, all-or-nothing rollback)
+    # puts the request back in the queue: the ledger must say so, or the
+    # SLO layer under-reports the wait and the trace counters stay "busy"
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "alice")
+    [rec] = q.requests()
+    lease = q.claim(rid, "w-1", 30.0, batch_id="b1",
+                    batch_request_ids=[rid], tenant="alice",
+                    trace_id=rec["trace_id"])
+    lease.release()
+    recs = fleet_history.read_history(str(tmp_path / "fleet"))
+    assert obs_schema.validate_records(recs) == []
+    assert [r["kind"] for r in recs] == ["submitted", "claimed", "released"]
+    released = recs[-1]
+    assert released["trace_id"] == rec["trace_id"]
+    assert released["tenant"] == "alice" and released["batch_id"] == "b1"
+    assert released["worker"] == "w-1"
+    # a stale handle (lease since reclaimed) releases nothing, writes
+    # nothing — the new owner's claim is the last word
+    assert q.claim(rid, "w-2", 30.0, tenant="alice",
+                   trace_id=rec["trace_id"]) is not None
+    lease.release()
+    kinds = [r["kind"] for r in
+             fleet_history.read_history(str(tmp_path / "fleet"))]
+    assert kinds == ["submitted", "claimed", "released", "claimed"]
+
+
+def test_cancel_and_requeue_ride_the_ledger(tmp_path):
+    q = FleetQueue(tmp_path / "fleet")
+    rid = _submit_tiny(q, "t")
+    [rec] = q.requests()
+    assert q.cancel(rid, reason="operator")
+    kinds = [r["kind"] for r in
+             fleet_history.read_history(str(tmp_path / "fleet"))]
+    assert kinds == ["submitted", "settled"]
+    # cancel looks the trace_id up from the spool itself
+    settled = fleet_history.read_history(str(tmp_path / "fleet"))[-1]
+    assert settled["state"] == "canceled" \
+        and settled["trace_id"] == rec["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# SLO math on synthetic timings (obs/slo.py) — exact, no interpolation
+# ---------------------------------------------------------------------------
+def test_percentile_nearest_rank_exact():
+    vals = list(range(1, 101))
+    assert obs_slo.percentile(vals, 50.0) == 50
+    assert obs_slo.percentile(vals, 99.0) == 99
+    assert obs_slo.percentile(vals, 100.0) == 100
+    assert obs_slo.percentile([7.0], 99.0) == 7.0
+    assert obs_slo.percentile([], 50.0) is None
+
+
+def _ev(kind, rid, wall, **fields):
+    rec = {"event": "fleet_lifecycle", "kind": kind, "request_id": rid,
+           "wall_time": wall, "seq": int(wall * 10), "pid": 1, "host": "h"}
+    rec.update(fields)
+    return rec
+
+
+def _synthetic_history():
+    """Known timings -> exactly predictable SLO numbers (the acceptance).
+
+    queue waits [2, 4, 8, 1] / ttfa [3, 6, 10, 0.5]; deadlines: a1 hit
+    (40 <= 50), a2 miss (20 > 10), b1 miss (failed); a3 dead-lettered."""
+    t = 1000.0
+    return [
+        _ev("submitted", "a1", t, tenant="a", submitted_at=t,
+            deadline_s=50.0, trace_id="tr-a1"),
+        _ev("submitted", "a2", t, tenant="a", submitted_at=t,
+            deadline_s=10.0, trace_id="tr-a2"),
+        _ev("submitted", "a3", t, tenant="a", submitted_at=t,
+            trace_id="tr-a3"),
+        _ev("submitted", "b1", t, tenant="b", submitted_at=t,
+            deadline_s=100.0, trace_id="tr-b1"),
+        _ev("claimed", "a1", t + 2), _ev("claimed", "a2", t + 4),
+        _ev("claimed", "a3", t + 8), _ev("claimed", "b1", t + 1),
+        _ev("attempt", "a1", t + 5, started_at=t + 3, attempts=1),
+        _ev("attempt", "a2", t + 9, started_at=t + 6, attempts=2),
+        _ev("attempt", "a3", t + 12, started_at=t + 10, attempts=1),
+        _ev("attempt", "b1", t + 2, started_at=t + 0.5, attempts=3),
+        _ev("settled", "a1", t + 40, state="done"),
+        _ev("settled", "a2", t + 20, state="done"),
+        _ev("settled", "a3", t + 30, state="deadletter"),
+        _ev("settled", "b1", t + 50, state="failed"),
+    ]
+
+
+def test_slo_exact_on_synthetic_timings():
+    slo = obs_slo.compute_slo(_synthetic_history(), thresholds={})
+    ov = slo["overall"]
+    assert slo["requests"] == 4 and slo["settled"] == 4
+    assert ov["states"] == {"done": 2, "failed": 1, "deadletter": 1,
+                            "canceled": 0}
+    # nearest-rank on [1, 2, 4, 8]: p50 = rank 2 -> 2, p99 = rank 4 -> 8
+    assert ov["queue_wait_s"]["p50"] == 2.0
+    assert ov["queue_wait_s"]["p99"] == 8.0
+    assert ov["queue_wait_s"]["max"] == 8.0
+    # ttfa [0.5, 3, 6, 10]
+    assert ov["ttfa_s"]["p50"] == 3.0 and ov["ttfa_s"]["p99"] == 10.0
+    assert ov["deadline"]["with_deadline"] == 3 and \
+        ov["deadline"]["hits"] == 1
+    assert abs(ov["deadline"]["hit_pct"] - 100.0 / 3) < 1e-9
+    assert ov["attempts_per_request"] == pytest.approx(7 / 4)
+    assert ov["deadletter_pct"] == 25.0
+    # per-tenant split: a's waits [2, 4, 8] -> p50 rank 2 -> 4
+    a = slo["tenants"]["a"]
+    assert a["queue_wait_s"]["p50"] == 4.0
+    assert a["queue_wait_s"]["p99"] == 8.0
+    assert a["deadline"]["hit_pct"] == 50.0
+    b = slo["tenants"]["b"]
+    assert b["queue_wait_s"]["p50"] == 1.0 and b["requests"] == 1
+    assert slo["breaches"] == []  # no thresholds -> nothing checked
+    json.dumps(slo, allow_nan=False)
+
+
+def test_slo_requeued_deadletter_rejoins_live_population():
+    recs = [
+        _ev("submitted", "r1", 100.0, tenant="t", submitted_at=100.0),
+        _ev("claimed", "r1", 101.0),
+        _ev("settled", "r1", 105.0, state="deadletter"),
+        _ev("requeued", "r1", 110.0),
+    ]
+    slo = obs_slo.compute_slo(recs, thresholds={})
+    assert slo["requests"] == 1 and slo["settled"] == 0
+    assert slo["overall"]["deadletter_pct"] is None  # judged afresh
+    # the eventual re-settle is judged normally
+    recs.append(_ev("settled", "r1", 120.0, state="done"))
+    slo = obs_slo.compute_slo(recs, thresholds={})
+    assert slo["settled"] == 1 and slo["overall"]["states"]["done"] == 1
+
+
+def test_slo_settle_race_converges_to_priority_winner():
+    # racing settle writers: the queue's fixed priority (done > failed >
+    # deadletter > canceled) decides what survives — mirror it
+    recs = [
+        _ev("submitted", "r1", 10.0, tenant="t", submitted_at=10.0),
+        _ev("settled", "r1", 20.0, state="deadletter"),
+        _ev("settled", "r1", 21.0, state="done"),
+    ]
+    ov = obs_slo.compute_slo(recs, thresholds={})["overall"]
+    assert ov["states"]["done"] == 1 and ov["states"]["deadletter"] == 0
+
+
+def test_slo_queue_wait_ignores_rolled_back_claim():
+    # a claim released before any attempt never did work — the tenant is
+    # still in line, so the wait ends at the claim that reached an attempt
+    t = 1000.0
+    recs = [
+        _ev("submitted", "r1", t, tenant="t", submitted_at=t),
+        _ev("claimed", "r1", t + 1),
+        _ev("released", "r1", t + 2),
+        _ev("claimed", "r1", t + 30),
+        _ev("attempt", "r1", t + 31, started_at=t + 31, attempts=1),
+        _ev("settled", "r1", t + 40, state="done"),
+    ]
+    ov = obs_slo.compute_slo(recs, thresholds={})["overall"]
+    assert ov["queue_wait_s"]["p50"] == 30.0  # NOT 1.0
+    # a claim that reached an attempt locks the wait: the release that
+    # budget-routes it afterwards doesn't reopen it
+    recs2 = [
+        _ev("submitted", "r2", t, tenant="t", submitted_at=t),
+        _ev("claimed", "r2", t + 3),
+        _ev("attempt", "r2", t + 4, started_at=t + 4, attempts=1),
+        _ev("released", "r2", t + 5),
+        _ev("claimed", "r2", t + 60),
+    ]
+    ov2 = obs_slo.compute_slo(recs2, thresholds={})["overall"]
+    assert ov2["queue_wait_s"]["p50"] == 3.0
+    # a claim still live at ledger end DID end the wait (worker mid-batch)
+    recs3 = [
+        _ev("submitted", "r3", t, tenant="t", submitted_at=t),
+        _ev("claimed", "r3", t + 5),
+    ]
+    ov3 = obs_slo.compute_slo(recs3, thresholds={})["overall"]
+    assert ov3["queue_wait_s"]["p50"] == 5.0
+
+
+def test_slo_deadline_excludes_canceled():
+    # a voluntary tenant cancel is not a service miss: it leaves the
+    # denominator entirely instead of dragging hit-rate into false breach
+    t = 1000.0
+    recs = [
+        _ev("submitted", "c1", t, tenant="t", submitted_at=t,
+            deadline_s=50.0),
+        _ev("submitted", "c2", t, tenant="t", submitted_at=t,
+            deadline_s=50.0),
+        _ev("settled", "c1", t + 10, state="canceled"),
+        _ev("claimed", "c2", t + 1),
+        _ev("attempt", "c2", t + 2, started_at=t + 2, attempts=1),
+        _ev("settled", "c2", t + 20, state="done"),
+    ]
+    ov = obs_slo.compute_slo(recs, thresholds={})["overall"]
+    assert ov["deadline"] == {"with_deadline": 1, "hits": 1,
+                              "hit_pct": 100.0}
+    assert ov["states"]["canceled"] == 1  # still counted as settled
+
+
+def test_slo_breach_flags_from_env_knobs(monkeypatch):
+    monkeypatch.setenv(obs_slo.ENV_QUEUE_P99_S, "5.0")
+    monkeypatch.setenv(obs_slo.ENV_DEADLINE_PCT, "90")
+    monkeypatch.setenv(obs_slo.ENV_DEADLETTER_PCT, "10")
+    monkeypatch.setenv(obs_slo.ENV_TTFA_P99_S, "")  # blank = unchecked
+    slo = obs_slo.compute_slo(_synthetic_history())
+    assert slo["thresholds"]["queue_p99_s"] == 5.0
+    assert slo["thresholds"]["ttfa_p99_s"] is None
+    got = {(b["scope"], b["slo"]) for b in slo["breaches"]}
+    # overall queue p99 8 > 5; hit-rate 33% < 90; dead-letter 25% > 10
+    assert ("overall", "queue_p99_s") in got
+    assert ("overall", "deadline_hit_pct") in got
+    assert ("overall", "deadletter_pct") in got
+    assert ("a", "queue_p99_s") in got          # tenant a's p99 is 8 too
+    assert ("b", "queue_p99_s") not in got      # b waited 1s: within SLO
+    assert not any(b["slo"] == "ttfa_p99_s" for b in slo["breaches"])
+
+
+def test_slo_for_root_none_without_ledger(tmp_path):
+    assert obs_slo.slo_for_root(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# trace context (obs/spans.py): in-process scoping + env propagation
+# ---------------------------------------------------------------------------
+def test_set_trace_ctx_scopes_and_restores():
+    assert obs_spans.trace_ctx() is None
+    prev = obs_spans.set_trace_ctx({"batch_id": "b1"})
+    try:
+        assert prev is None
+        assert obs_spans.trace_ctx() == {"batch_id": "b1"}
+        inner = obs_spans.set_trace_ctx({"batch_id": "b2"})
+        assert inner == {"batch_id": "b1"}
+        obs_spans.set_trace_ctx(inner)
+        assert obs_spans.trace_ctx() == {"batch_id": "b1"}
+    finally:
+        obs_spans.set_trace_ctx(None)
+    assert obs_spans.trace_ctx() is None
+    # a non-dict / empty context never sticks
+    obs_spans.set_trace_ctx("garbage")
+    assert obs_spans.trace_ctx() is None
+
+
+def test_spans_and_metrics_records_carry_trace_ctx(tmp_path):
+    ctx = {"batch_id": "b-test", "trace_ids": {"r1": "tr-1"}}
+    was = obs_spans.enabled()
+    prev = obs_spans.set_trace_ctx(ctx)
+    try:
+        obs_spans.set_enabled(True)
+        with MetricLogger(str(tmp_path)) as log:
+            with obs_spans.span("fleet.batch", logger=log, emit=True):
+                pass
+            obs_spans.record_span("fleet.plan", 1.0, logger=log, emit=True)
+            log.log("fleet", kind="plan", batches=1)
+        recs = read_jsonl(str(tmp_path))
+        assert obs_schema.validate_records(recs) == []
+        assert len(recs) == 3
+        for r in recs:
+            assert r["trace"] == ctx, r
+    finally:
+        obs_spans.set_enabled(was)
+        obs_spans.set_trace_ctx(prev)
+
+
+def test_trace_off_drops_metrics_stamping(tmp_path):
+    # the zero-cost contract: REDCLIFF_TRACE=0 -> the decision stream is
+    # bit-identical to a context-free run (no trace field anywhere)
+    ctx = {"batch_id": "b-test"}
+    was = obs_spans.enabled()
+    prev = obs_spans.set_trace_ctx(ctx)
+    try:
+        obs_spans.set_enabled(False)
+        assert obs_spans.span("fleet.batch") is obs_spans.NOOP
+        assert obs_spans.record_span("fleet.plan", 1.0) is None
+        with MetricLogger(str(tmp_path)) as log:
+            log.log("fleet", kind="plan", batches=1)
+        [rec] = [r for r in read_jsonl(str(tmp_path))
+                 if r.get("event") == "fleet"]
+        assert "trace" not in rec
+    finally:
+        obs_spans.set_enabled(was)
+        obs_spans.set_trace_ctx(prev)
+
+
+def test_trace_ctx_env_parsed_in_child_process(tmp_path):
+    ctx = {"batch_id": "b-env", "trace_ids": {"r1": "tr-env"}}
+    child = ("from redcliff_tpu.obs import spans\n"
+             "import json\n"
+             "print(json.dumps(spans.trace_ctx()))\n")
+    for raw, expect in ((json.dumps(ctx), ctx),
+                        ("not json {", None),     # garbage never crashes
+                        ("[1, 2]", None)):        # non-dict ignored
+        env = dict(os.environ, **{obs_spans.ENV_TRACE_CTX: raw})
+        r = subprocess.run([sys.executable, "-c", child], env=env,
+                           capture_output=True, text=True, cwd=REPO_ROOT,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout) == expect
+
+
+# ---------------------------------------------------------------------------
+# full lifecycle through the real worker loop (stubbed supervisor, no jax)
+# ---------------------------------------------------------------------------
+def _stub_drain(monkeypatch, classification="clean", rc=0, captured=None):
+    """Patch worker.supervise with a fake that writes every member's
+    result artifact (what a healthy run_batch child would have produced)
+    and captures the env the child would have received."""
+    def fake(cmd, ledger_path=None, policy=None, env=None, **kw):
+        if captured is not None:
+            captured.append(dict(env or {}))
+        if rc == 0:
+            with open(cmd[-1]) as f:
+                batch = json.load(f)
+            d = os.path.join(batch["run_dir"], "results")
+            os.makedirs(d, exist_ok=True)
+            for m in batch["requests"]:
+                n = len(m.get("points") or ())
+                with open(os.path.join(d, f"{m['request_id']}.json"),
+                          "w") as f:
+                    json.dump({"request_id": m["request_id"],
+                               "n_points": n, "failures": [],
+                               "best_criteria": [0.5] * n}, f)
+        return SuperviseOutcome(classification=classification,
+                                returncode=rc, attempts=[{"rc": rc}])
+
+    monkeypatch.setattr(worker_mod, "supervise", fake)
+
+
+def test_full_lifecycle_one_trace_id_per_request(tmp_path, monkeypatch):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = [_submit_tiny(q, t) for t in ("alice", "bob")]
+    traces = {r["request_id"]: r["trace_id"] for r in q.requests()}
+    captured = []
+    _stub_drain(monkeypatch, captured=captured)
+    assert worker_mod.work(str(root), drain=True, poll_s=0.1,
+                           worker_id="w-test") == 1  # merged: ONE batch
+    recs = fleet_history.read_history(str(root))
+    assert obs_schema.validate_records(recs) == []
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("submitted") == 2 and kinds.count("claimed") == 2
+    assert kinds.count("attempt") == 2 and kinds.count("settled") == 2
+    [planned] = [r for r in recs if r["kind"] == "planned"]
+    assert set(planned["requests"]) == set(rids)
+    assert planned["trace_ids"] == traces
+    for rid in rids:
+        mine = [r for r in recs if r.get("request_id") == rid]
+        # the whole lifecycle under the submit-minted identity
+        assert {r["trace_id"] for r in mine} == {traces[rid]}
+        [settled] = [r for r in mine if r["kind"] == "settled"]
+        assert settled["state"] == "done"
+        [att] = [r for r in mine if r["kind"] == "attempt"]
+        assert att["classification"] == "clean" and att["batch_id"]
+        assert att["started_at"] <= settled["wall_time"]
+    # the child env carried the same join keys (REDCLIFF_TRACE_CTX)
+    [env] = captured
+    ctx = json.loads(env[obs_spans.ENV_TRACE_CTX])
+    assert ctx["trace_ids"] == traces
+    # worker's own fleet events carry the context while the batch ran
+    stamped = [r for r in read_jsonl(str(root))
+               if r.get("event") == "fleet"
+               and r.get("kind") in ("batch_start", "batch_end")]
+    assert stamped and all(
+        r["trace"]["trace_ids"] == traces for r in stamped)
+    # ... and the context never leaks past the batch
+    assert obs_spans.trace_ctx() is None
+
+
+def test_deadletter_settle_linked_to_trace(tmp_path, monkeypatch):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rid = _submit_tiny(q, "t")
+    [rec] = q.requests()
+    _stub_drain(monkeypatch, classification="giving_up", rc=139)
+    worker_mod.work(str(root), drain=True, poll_s=0.1, max_attempts=1)
+    assert q.terminal_state(rid) == "deadletter"
+    recs = fleet_history.read_history(str(root))
+    [settled] = [r for r in recs if r["kind"] == "settled"]
+    assert settled["state"] == "deadletter"
+    assert settled["trace_id"] == rec["trace_id"]
+
+
+def test_bisected_round_links_member_traces(tmp_path, monkeypatch):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = [_submit_tiny(q, f"t{i}") for i in range(4)]
+    traces = {r["request_id"]: r["trace_id"] for r in q.requests()}
+    _stub_drain(monkeypatch, classification="giving_up", rc=137)
+    worker_mod.work(str(root), once=True, poll_s=0.1)
+    recs = fleet_history.read_history(str(root))
+    [bis] = [r for r in recs if r["kind"] == "bisected"]
+    assert set(bis["requests"]) == set(rids)
+    assert bis["trace_ids"] == traces
+    assert len(bis["halves"]) == 2
+
+
+def test_worker_crash_emits_event_and_flight_record(tmp_path, monkeypatch):
+    root = tmp_path / "fleet"
+    FleetQueue(root)
+
+    def boom(*a, **kw):
+        raise RuntimeError("induced worker-loop crash")
+
+    monkeypatch.setattr(worker_mod, "_next_batch", boom)
+    with pytest.raises(RuntimeError, match="induced"):
+        worker_mod.work(str(root), drain=True, poll_s=0.1)
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    [crash] = [r for r in recs if r.get("kind") == "worker_crash"]
+    assert "RuntimeError" in crash["error"]
+    assert crash["flight_record"] and os.path.exists(crash["flight_record"])
+    with open(crash["flight_record"]) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "worker_crash"
+
+
+# ---------------------------------------------------------------------------
+# fleet trace export (obs trace --fleet)
+# ---------------------------------------------------------------------------
+def test_fleet_trace_joins_ledger_into_request_tracks(tmp_path,
+                                                      monkeypatch):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = [_submit_tiny(q, t) for t in ("alice", "bob")]
+    traces = {r["request_id"]: r["trace_id"] for r in q.requests()}
+    _stub_drain(monkeypatch)
+    worker_mod.work(str(root), drain=True, poll_s=0.1)
+    trace = build_fleet_trace(str(root))
+    assert validate_trace(trace) == [], validate_trace(trace)[:3]
+    json.dumps(trace, allow_nan=False)
+    ev = trace["traceEvents"]
+    # one X track per request, spanning submit -> settle under its
+    # submit-minted trace_id
+    tracks = {e["args"]["request_id"]: e for e in ev
+              if e.get("cat") == "request" and e["ph"] == "X"}
+    assert set(tracks) == set(rids)
+    for rid, tr in tracks.items():
+        assert tr["args"]["trace_id"] == traces[rid]
+        assert tr["args"]["state"] == "done"
+        assert tr["dur"] > 0
+    # lifecycle instants ride each request's thread
+    insts = [e for e in ev if e.get("cat") == "fleet_lifecycle"
+             and e["ph"] == "i"]
+    assert {e["name"] for e in insts} >= {"submitted", "claimed",
+                                          "attempt", "settled", "planned"}
+    # queue counter curves replayed from the ledger
+    counters = {e["name"] for e in ev if e["ph"] == "C"}
+    assert {"queue_depth", "in_flight", "deadletter_depth"} <= counters
+    depth = [e["args"]["queued"] for e in ev
+             if e["ph"] == "C" and e["name"] == "queue_depth"]
+    assert max(depth) == 2 and depth[-1] == 0  # drained
+    od = trace["otherData"]
+    assert od["history_records"] >= 7 and od["torn_lines"] == 0
+
+
+def test_fleet_trace_counters_track_released_claims(tmp_path):
+    # a released claim returns the request to the queue: the in-flight
+    # curve must come back down and queue depth back up, or the counters
+    # read "busy" through exactly the crash-loop incidents they diagnose
+    root = tmp_path / "fleet"
+    os.makedirs(root)
+    t = 1000.0
+    recs = [
+        _ev("submitted", "r1", t, tenant="t", submitted_at=t,
+            trace_id="tr-r1"),
+        _ev("claimed", "r1", t + 1),
+        _ev("released", "r1", t + 2),
+        _ev("claimed", "r1", t + 3),
+        _ev("settled", "r1", t + 4, state="done"),
+    ]
+    with open(os.path.join(root, "history.jsonl"), "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    trace = build_fleet_trace(str(root))
+    assert validate_trace(trace) == [], validate_trace(trace)[:3]
+    ev = trace["traceEvents"]
+    queued = [e["args"]["queued"] for e in ev
+              if e.get("ph") == "C" and e["name"] == "queue_depth"]
+    inflight = [e["args"]["in_flight"] for e in ev
+                if e.get("ph") == "C" and e["name"] == "in_flight"]
+    assert queued == [1, 0, 1, 0, 0]
+    assert inflight == [0, 1, 0, 1, 0]
+    # the release rides the request's own track as an instant too
+    assert "released" in {e["name"] for e in ev if e.get("ph") == "i"}
+
+
+def test_fleet_trace_cli_flag_and_exit_codes(tmp_path, capsys):
+    from redcliff_tpu.obs.trace_export import main as trace_main
+
+    # a submit-only fleet root (no metrics chain yet) still exports
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    _submit_tiny(q, "t")
+    out_path = str(tmp_path / "trace.json")
+    assert trace_main([str(root), "--fleet", "-o", out_path]) == 0
+    with open(out_path) as f:
+        trace = json.load(f)
+    assert validate_trace(trace) == []
+    [track] = [e for e in trace["traceEvents"]
+               if e.get("cat") == "request" and e["ph"] == "X"]
+    assert track["args"]["state"] == "live"
+    capsys.readouterr()
+    # a non-fleet empty dir is refused with the exit-2 contract
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_main([str(empty), "--fleet"]) == 2
+    # the obs dispatcher passes --fleet through
+    from redcliff_tpu.obs.report import main as obs_main
+
+    assert obs_main(["trace", str(root), "--fleet", "-o", out_path]) == 0
+
+
+def test_fleet_trace_tolerates_rotated_chain_with_sigkill_torn_tail(
+        tmp_path):
+    """Satellite: the PR-8 rotation-boundary pattern at the FLEET root — a
+    worker writing fleet metrics through a small rotation cap dies by
+    SIGKILL mid-append on both the metrics chain and the history ledger;
+    watch fleet mode and the fleet trace export must see every whole
+    record and count both torn tails."""
+    root = tmp_path / "fleet"
+    spec = json.dumps(TINY_SPEC)
+    child = (
+        "import os, signal, json\n"
+        "from redcliff_tpu.obs.logging import MetricLogger\n"
+        "from redcliff_tpu.fleet.queue import FleetQueue\n"
+        "from redcliff_tpu.fleet import history\n"
+        f"root = {str(root)!r}\n"
+        "q = FleetQueue(root)\n"
+        f"spec = json.loads({spec!r})\n"
+        "for i in range(3):\n"
+        "    q.submit('rot', [{'gen_lr': 1e-3}], spec=spec)\n"
+        "log = MetricLogger(root, max_bytes=400, max_backups=20)\n"
+        "for i in range(12):\n"
+        "    log.log('fleet', kind='plan', batches=0, queue_depth=3,\n"
+        "            unschedulable=0, plan_ms=0.1)\n"
+        "log._fh.write('{\"event\": \"fleet\", \"kind\": \"plan\", \"qu')\n"
+        "log._fh.flush()\n"
+        "with open(history.history_path(root), 'a') as f:\n"
+        "    f.write('{\"event\": \"fleet_lifecycle\", \"kind\": \"cl')\n"
+        "    f.flush()\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n")
+    r = subprocess.run([sys.executable, "-c", child], cwd=REPO_ROOT,
+                       timeout=120, env=_clean_fault_env())
+    assert r.returncode == -9
+    assert "metrics.jsonl.1" in os.listdir(root), \
+        "no rotation happened: cap too big"
+    # watch fleet mode over the rotated+torn chain: every whole record
+    from redcliff_tpu.obs.watch import build_snapshot, render_text
+
+    snap = build_snapshot(str(root))
+    assert obs_schema.validate_record(snap) == []
+    assert snap["fleet"]["counts"]["queued"] == 3
+    assert snap["fleet"]["last_plan"]["queue_depth"] == 3
+    assert snap["read_audit"]["torn_lines"] == 1
+    assert len(snap["read_audit"]["files"]) > 1
+    # the SLO headline is live from the (torn) ledger: 3 submitted
+    assert snap["fleet"]["slo"]["requests"] == 3
+    assert snap["fleet"]["slo"]["settled"] == 0
+    assert "slo:" in render_text(snap)
+    # the fleet trace joins the same chain and counts BOTH torn tails
+    trace = build_fleet_trace(str(root))
+    assert validate_trace(trace) == []
+    od = trace["otherData"]
+    assert od["torn_lines"] == 2
+    assert od["history_records"] == 3
+    tracks = [e for e in trace["traceEvents"]
+              if e.get("cat") == "request" and e["ph"] == "X"]
+    assert len(tracks) == 3
+
+
+# ---------------------------------------------------------------------------
+# fleet status CLI: per-request queue/terminal ages (satellite)
+# ---------------------------------------------------------------------------
+def test_status_per_request_ages(tmp_path):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rid = _submit_tiny(q, "aged", now=time.time() - 30.0)
+    done = _submit_tiny(q, "aged")
+    q.cancel(done, now=time.time() - 5.0)
+    st = q.status(include_requests=True)
+    rows = {r["request_id"]: r for r in st["requests"]}
+    assert rows[rid]["state"] == "queued"
+    assert 29.0 <= rows[rid]["queue_age_s"] <= 120.0
+    assert rows[rid]["terminal_age_s"] is None
+    assert rows[rid]["trace_id"].startswith("tr-")
+    assert rows[done]["state"] == "canceled"
+    assert rows[done]["queue_age_s"] is None
+    assert 4.0 <= rows[done]["terminal_age_s"] <= 120.0
+    # off by default: follow-mode watchers must not pay the reads
+    assert "requests" not in q.status()
+
+
+def test_status_cli_renders_age_table(tmp_path):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rid = _submit_tiny(q, "cli")
+    out = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "status", "--root",
+         str(root)], capture_output=True, text=True,
+        env=_clean_fault_env(), cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr
+    assert "queue age" in out.stdout and rid in out.stdout
+    out = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "status", "--root",
+         str(root), "--json"], capture_output=True, text=True,
+        env=_clean_fault_env(), cwd=REPO_ROOT)
+    st = json.loads(out.stdout)
+    [row] = st["requests"]
+    assert row["request_id"] == rid and row["queue_age_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# obs report: fleet-SLO section
+# ---------------------------------------------------------------------------
+def test_report_fleet_slo_section(tmp_path, monkeypatch):
+    from redcliff_tpu.obs.report import build_report, render_text
+
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    _submit_tiny(q, "alice")
+    _submit_tiny(q, "bob")
+    _stub_drain(monkeypatch)
+    worker_mod.work(str(root), drain=True, poll_s=0.1)
+    monkeypatch.setenv(obs_slo.ENV_QUEUE_P99_S, "0.000001")
+    rep = build_report(str(root))
+    slo = rep["fleet_slo"]
+    assert slo["requests"] == 2 and slo["settled"] == 2
+    assert set(slo["tenants"]) == {"alice", "bob"}
+    assert slo["overall"]["states"]["done"] == 2
+    assert slo["overall"]["queue_wait_s"]["n"] == 2
+    # a sub-microsecond threshold must flag (real waits exceed it)
+    assert any(b["slo"] == "queue_p99_s" for b in slo["breaches"])
+    text = render_text(rep)
+    assert "fleet SLOs" in text and "SLO BREACH" in text
+    # a plain run dir has no SLO section
+    monkeypatch.delenv(obs_slo.ENV_QUEUE_P99_S)
+    plain = tmp_path / "plain"
+    with MetricLogger(str(plain)) as log:
+        log.log("fit_start", model="m", grid_size=1, grid_width=1)
+        log.log("fit_end")
+    assert build_report(str(plain))["fleet_slo"] is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one real supervised drain (jax child; warm compile cache)
+# ---------------------------------------------------------------------------
+def test_e2e_supervised_drain_trace_joins_across_processes(tmp_path):
+    """ISSUE 12 acceptance: a real multi-tenant drain (supervised jax
+    child) exports one Perfetto trace where each request's track spans
+    submit -> settle under its submit-minted trace_id, the CHILD
+    process's records carry the same join keys (the cross-process half),
+    and the SLO section computes from the surviving ledger."""
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = [_submit_tiny(q, t) for t in ("alice", "bob")]
+    traces = {r["request_id"]: r["trace_id"] for r in q.requests()}
+    policy = SupervisorPolicy(
+        max_restarts=2,
+        backoff=RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+    n = worker_mod.work(str(root), drain=True, poll_s=0.2, lease_s=20.0,
+                        supervisor_policy=policy, env=_clean_fault_env())
+    assert n == 1
+    assert q.status()["counts"]["done"] == 2
+    # the supervised CHILD's own records carry the trace join keys: the
+    # identity crossed the process boundary via REDCLIFF_TRACE_CTX
+    [batch_dir] = [os.path.join(root, "work", d)
+                   for d in os.listdir(root / "work")]
+    child_recs = read_jsonl(batch_dir)
+    own_pid = os.getpid()
+    stamped = [r for r in child_recs
+               if r.get("trace") and r.get("pid") != own_pid]
+    assert stamped, "child wrote no trace-stamped records"
+    for r in stamped:
+        assert r["trace"]["trace_ids"] == traces
+    # one joined timeline: request tracks + child process lanes together
+    trace = build_fleet_trace(str(root))
+    assert validate_trace(trace) == []
+    ev = trace["traceEvents"]
+    tracks = {e["args"]["request_id"]: e for e in ev
+              if e.get("cat") == "request" and e["ph"] == "X"}
+    assert set(tracks) == set(rids)
+    for rid in rids:
+        assert tracks[rid]["args"]["trace_id"] == traces[rid]
+        assert tracks[rid]["args"]["state"] == "done"
+    # >= 3 process lanes: worker control process, jax child, synthetic
+    # fleet-requests/queue processes
+    lanes = {e["args"]["name"] for e in ev
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(lanes) >= 4, lanes
+    # SLO view computes from the ledger the drain left behind
+    slo = obs_slo.slo_for_root(str(root))
+    assert slo["settled"] == 2
+    assert slo["overall"]["deadline"] is None       # none requested
+    assert slo["overall"]["queue_wait_s"]["p99"] >= 0
+    assert slo["overall"]["attempts_per_request"] == 1.0
