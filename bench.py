@@ -1092,6 +1092,124 @@ def _bench_trace_export(n_records=2000):
         shutil.rmtree(run, ignore_errors=True)
 
 
+def _bench_quality(jax):
+    """quality probe (ISSUE 13, obs/quality.py): a small DETERMINISTIC
+    synthetic sVAR grid fit with ground-truth graphs in hand — the live
+    model-quality observatory's graph-recovery score (final AUROC/AUPR vs
+    the true graphs), its convergence readout (plateaued-at epoch, top-k
+    edge-set stability), and the per-check-window readout cost.
+
+    ``overhead_pct`` follows the obs_overhead_pct discipline: the ISOLATED
+    per-window summary cost (jit'd readout + gather, median of warm calls)
+    against the fit's own measured steady-state epoch time, amortized at
+    the production ``check_every=50`` cadence (the probe fit itself runs
+    check_every=1 so every epoch exercises the path). Contract: <= 2 %,
+    enforced by the ``quality.overhead_pct`` regress family; the AUROC
+    floor rides ``quality.synthetic_auroc`` (contract_min)."""
+    import numpy as np
+
+    from redcliff_tpu.data import synthetic as S
+    from redcliff_tpu.data.datasets import train_val_split
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+    from redcliff_tpu.obs import quality as _q
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+
+    D, K, G = 5, 2, 4
+    p = S.reference_curation_params(D)
+    graphs, acts, _ = S.generate_lagged_adjacency_graphs_for_factor_model(
+        num_nodes=D, num_lags=2, num_factors=K,
+        make_factors_orthogonal=True,
+        make_factors_singular_components=False, rand_seed=7,
+        off_diag_edge_strengths=p["off_diag_edge_strengths"],
+        diag_receiving_node_forgetting_coeffs=p[
+            "diag_receiving_node_forgetting_coeffs"],
+        diag_sending_node_forgetting_coeffs=p[
+            "diag_sending_node_forgetting_coeffs"],
+        num_edges_per_graph=6)
+    X, Y = S.generate_synthetic_dataset(
+        jax.random.PRNGKey(9), graphs, acts, p["base_freqs"], p["noise_mu"],
+        p["noise_var"], p["innovation_amp"], num_samples=96,
+        recording_length=24, burnin_period=10, num_labeled_sys_states=K,
+        noise_type="gaussian", noise_amp=0.0)
+    train_ds, val_ds = train_val_split(X, Y, val_fraction=0.25,
+                                       rng=np.random.default_rng(0))
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=D, gen_lag=2, gen_hidden=(12,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=K, num_supervised_factors=K,
+        forecast_coeff=1.0, factor_score_coeff=10.0,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    tc = RedcliffTrainConfig(max_iter=20, batch_size=16, check_every=1,
+                             gen_lr=5e-3, embed_lr=5e-3)
+    spec = GridSpec(points=[{"gen_lr": 5e-3 * (i + 1)} for i in range(G)])
+    prev = os.environ.get(_q.ENV_ENABLE)
+    os.environ[_q.ENV_ENABLE] = "1"
+    try:
+        runner = RedcliffGridRunner(model, tc, spec)
+        runner.fit(jax.random.PRNGKey(0), train_ds, val_ds,
+                   true_gc=list(graphs))
+        qstats = (runner.dispatch_stats or {}).get("quality") or {}
+
+        # isolated per-window readout cost: the vmapped jit'd summary +
+        # its host gather on a grid-width params stack (warm; median)
+        qual_fn = jax.jit(jax.vmap(_q.make_summary_fn(model),
+                                   in_axes=(0, None)))
+        params = runner.init_grid(jax.random.PRNGKey(0))[0]
+        first = next(iter(val_ds.batches(tc.batch_size)))
+        import jax.numpy as jnp
+
+        Xw = jnp.asarray(np.asarray(first[0])[
+            : tc.max_samples_for_gc_tracking, : model.config.max_lag, :])
+        gather = lambda out: {k: np.asarray(v) for k, v in out.items()}
+        gather(qual_fn(params, Xw))  # warm the program
+        times = []
+        for _ in range(15):
+            t0 = time.perf_counter()
+            gather(qual_fn(params, Xw))
+            times.append((time.perf_counter() - t0) * 1e3)
+        per_window_ms = sorted(times)[len(times) // 2]
+
+        # steady-state epoch cost from the fit's own accounting (the
+        # width's first epoch carries compile skew — excluded)
+        ds_stats = runner.dispatch_stats
+        wkey = max(ds_stats["epoch_ms_by_width"],
+                   key=lambda w: ds_stats["epochs_by_width"][w])
+        n_w = ds_stats["epochs_by_width"][wkey]
+        tot = ds_stats["epoch_ms_by_width"][wkey]
+        first_ms = ds_stats["first_epoch_ms_by_width"].get(wkey, 0.0)
+        steady_epoch_ms = ((tot - first_ms) / (n_w - 1) if n_w > 1
+                           else tot / max(n_w, 1))
+        cadence = RedcliffTrainConfig().check_every
+        overhead_pct = (100.0 * per_window_ms
+                        / (steady_epoch_ms * cadence)
+                        if steady_epoch_ms else None)
+        return {
+            "grid_points": G,
+            "epochs": ds_stats["epochs"],
+            "windows": qstats.get("windows"),
+            "final_auroc": qstats.get("mean_auroc"),
+            "final_aupr": qstats.get("mean_aupr"),
+            "edge_stability": qstats.get("mean_edge_stability"),
+            "convergence_epoch": qstats.get("converged_at_epoch"),
+            "plateaued": qstats.get("plateaued_count"),
+            "per_window_ms": round(per_window_ms, 3),
+            "steady_epoch_ms": round(steady_epoch_ms, 3),
+            "check_every_amortized": cadence,
+            "overhead_pct": (round(overhead_pct, 3)
+                             if overhead_pct is not None else None),
+        }
+    finally:
+        if prev is None:
+            os.environ.pop(_q.ENV_ENABLE, None)
+        else:
+            os.environ[_q.ENV_ENABLE] = prev
+
+
 def _bench_fleet_trace(n_requests=50):
     """fleet_trace probe (ISSUE 12): the whole-fleet Perfetto join cost
     (obs/trace_export.py ``--fleet``) on a synthetic ``n_requests``-request
@@ -1332,6 +1450,14 @@ def _measure(platform):
     except Exception as e:  # never fail the bench over the trace probe
         fleet_trace = {"error": f"{type(e).__name__}: {e}"}
 
+    # model-quality observatory (obs/quality.py): graph recovery + readout
+    # overhead on a deterministic synthetic sVAR grid fit with ground truth
+    try:
+        quality_probe = _bench_quality(jax)
+    except Exception as e:  # never fail the bench over the quality probe
+        quality_probe = {"error": f"{type(e).__name__}: {e}",
+                         "final_auroc": None, "overhead_pct": None}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1366,6 +1492,7 @@ def _measure(platform):
         "fleet": fleet_probe,
         "fleet_containment": fleet_containment,
         "fleet_trace": fleet_trace,
+        "quality": quality_probe,
         "error": None,
     })
 

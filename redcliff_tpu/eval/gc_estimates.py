@@ -10,6 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["get_model_gc_estimates", "get_model_gc_score_estimates",
+           "get_model_gc_summary_matrices",
            "get_combined_gc_representations_across_factors"]
 
 
@@ -80,6 +81,24 @@ def get_model_gc_estimates(model, params, model_type, num_ests_required,
     else:
         raise NotImplementedError(f"unrecognized model_type: {model_type!r}")
     return _replicate(generic, num_ests_required)
+
+
+def get_model_gc_summary_matrices(model, params, model_type,
+                                  num_ests_required, X=None):
+    """Per-factor LAG-SUMMED GC matrices ``(C, C)`` on the standard eval
+    readout path — the OFFLINE counterpart of the live training-time graph
+    summary (:mod:`redcliff_tpu.obs.quality`). The quality observatory's
+    golden-parity contract (tests/test_quality.py) is that the live device
+    summary's per-factor column norms match these matrices within 1e-6 and
+    its top-k edge sets are identical, so the in-training signal can be
+    trusted as science, not merely telemetry."""
+    ests = get_model_gc_estimates(model, params, model_type,
+                                  num_ests_required, X=X)
+    out = []
+    for e in ests:
+        e = np.asarray(e, dtype=np.float32)
+        out.append(e.sum(axis=2) if e.ndim == 3 else e)
+    return out
 
 
 def get_model_gc_score_estimates(model, params, model_type,

@@ -196,6 +196,32 @@ def run_batch_file(batch_file):
     os.makedirs(results_dir, exist_ok=True)
     val_hist = np.asarray(result.val_history)
 
+    # model-quality observatory (obs/quality.py): the engine's rolling
+    # convergence snapshot, keyed by ORIGINAL merged point id — sliced per
+    # request below so results/<id>.json carries each tenant's own quality
+    # block (None when REDCLIFF_QUALITY=0 or no check window ran)
+    qstats = (getattr(runner, "dispatch_stats", None) or {}).get("quality")
+
+    def _request_quality(lo, hi):
+        if not isinstance(qstats, dict) or not qstats.get("windows"):
+            return None
+        pick = lambda key: ([(qstats.get(key) or {}).get(str(p))
+                             for p in range(lo, hi)]
+                            if qstats.get(key) is not None else None)
+        plats = pick("plateaued_at_epoch") or []
+        return {
+            "windows": qstats.get("windows"),
+            "mode": qstats.get("mode"),
+            "plateaued_at_epoch": plats,
+            "converged_at_epoch": (max(plats) if plats
+                                   and all(p is not None for p in plats)
+                                   else None),
+            "edge_stability": pick("edge_stability"),
+            "topk_hash": pick("topk_hash"),
+            "auroc": pick("auroc"),
+            "aupr": pick("aupr"),
+        }
+
     # merged-grid failures.json (train/driver.py's artifact, with per-point
     # request/tenant attribution): the worker's poison-attribution input
     # and the dead-letter dossier's quarantine evidence
@@ -231,6 +257,7 @@ def run_batch_file(batch_file):
             "active": jsonable(result.active[lo:hi]),
             "val_history": jsonable(val_hist[:, lo:hi]),
             "failures": jsonable(failures),
+            "quality": jsonable(_request_quality(lo, hi)),
         }
         tmp = os.path.join(results_dir,
                            f".{row['request_id']}.tmp.{os.getpid()}")

@@ -32,6 +32,12 @@ production-monitoring shape of large-scale ML systems, arXiv:1605.08695):
   ``python -m redcliff_tpu.obs trace <run_dir> [-o trace.json]``; with
   ``--fleet`` a whole fleet root joins into one timeline (per-request
   tracks spanning processes, queue counter tracks);
+* :mod:`.quality` — the model-quality observatory: live per-lane
+  Granger-graph summaries at check-window boundaries (column norms, edge
+  energy, top-k edge sets, factor-score entropy), convergence diagnostics
+  (edge-set Jaccard stability, edge-energy plateau detection,
+  ``plateaued_at_epoch``), and live AUROC/AUPR against ground-truth graphs
+  (``REDCLIFF_QUALITY``; numpy at import, jax lazy);
 * :mod:`.slo` — fleet service-level objectives from the request-lifecycle
   ledger (per-tenant queue-wait percentiles, time-to-first-attempt,
   deadline hit-rate, attempts-per-request, dead-letter rate;
@@ -53,7 +59,7 @@ from redcliff_tpu.obs.spans import (NOOP, Span, enabled, record_span,  # noqa: F
 __all__ = [
     "span", "record_span", "Span", "NOOP", "enabled", "set_enabled",
     "counters",
-    "flight", "schema", "spans", "memory", "profiling",
+    "flight", "schema", "spans", "memory", "profiling", "quality",
     "MetricLogger", "jsonable", "read_jsonl", "jsonl_files",
     "profiler_trace", "build_report", "render_text", "build_snapshot",
     "run_sentinel", "build_trace", "build_fleet_trace", "validate_trace",
@@ -78,10 +84,18 @@ _LAZY = {
 }
 
 
+# whole modules loaded lazily on attribute access: quality pulls numpy at
+# import time, which the stdlib-only importers above must not pay for
+_LAZY_MODULES = {"quality": "redcliff_tpu.obs.quality"}
+
+
 def __getattr__(name):
+    import importlib
+
+    mod = _LAZY_MODULES.get(name)
+    if mod is not None:
+        return importlib.import_module(mod)
     mod = _LAZY.get(name)
     if mod is None:
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-    import importlib
-
     return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,436 @@
+"""Model-quality observatory: live Granger-graph readouts during training.
+
+REDCLIFF-S's deliverable is not a loss curve — it is the per-state
+Granger-causal graphs read out of each factor's first-layer weights
+(PAPER.md §3). Everything else in the observatory watches the RUNTIME
+(spans, cost, memory, SLOs); this module watches the SCIENCE: at every
+check-window boundary the engines compute a cheap jit'd per-lane **graph
+summary** on device and this module turns the gathered numbers into
+convergence diagnostics and (when ground truth is in hand) live
+AUROC/AUPR against the true graphs.
+
+Two halves:
+
+* **Device summary** (:func:`make_summary_fn`) — a pure jit-able function
+  ``(params, X) -> dict of small arrays``: the per-factor lag-summed GC
+  matrices (the same readout :mod:`redcliff_tpu.eval.gc_estimates`
+  computes offline — the golden-parity contract below), their per-factor
+  column norms, total edge energy, the sparsity fraction of the combined
+  graph, its top-k edge indices (``lax.top_k`` magnitude order), and the
+  factor-score entropy of the embedder weightings on a fixed validation
+  window. The grid engine vmaps this over the lane axis and calls it
+  INSIDE the existing check-window device->host transfer — no new host
+  syncs, no effect on any update stream (the summary only reads params).
+
+* **Host diagnostics** (:class:`QualityMonitor`) — per-ORIGINAL-point-id
+  state across check windows (compaction-safe): top-k edge-set Jaccard
+  stability vs the previous window, edge-energy plateau detection with a
+  ``plateaued_at_epoch`` readout (ROADMAP item 3's missing input for
+  predictive scheduling), a stable hash of the top-k edge SET, and —
+  when ``true_gc`` is supplied (synthetic sVAR, DREAM4) — per-lane
+  AUROC/AUPR on the :func:`~redcliff_tpu.eval.gc_estimates
+  .get_model_gc_summary_matrices` readout convention. Each window lands
+  as one schema-registered ``quality`` event and the rolling snapshot
+  rides ``dispatch_stats["quality"]`` into every checkpoint.
+
+Golden-parity contract (tests/test_quality.py): the live summary's
+per-factor column norms match the offline
+``eval/gc_estimates.get_model_gc_summary_matrices`` readout within 1e-6
+and the top-k edge sets are identical — the live signal is trustworthy as
+science, not merely as telemetry.
+
+Readout mode: conditional ``primary_gc_est_mode`` values are forced to
+``fixed_factor_exclusive`` exactly like the system-level eval path
+(eval/gc_estimates.py get_model_gc_estimates), so the summary is a pure
+function of params and never depends on which batch happened to be in
+flight; ``raw_embedder`` (non-square map) is forced the same way.
+
+Zero-cost contract: ``REDCLIFF_QUALITY=0`` disables everything — no jit'd
+summary is built, no per-window work runs, and decision streams/params are
+bit-identical either way (pinned by test_quality.py). Knobs:
+
+* ``REDCLIFF_QUALITY`` — 1 (default) on / 0 off;
+* ``REDCLIFF_QUALITY_TOPK`` — top-k edge-set size (default 8);
+* ``REDCLIFF_QUALITY_PLATEAU_WINDOW`` — consecutive flat check windows
+  before a lane counts as plateaued (default 3);
+* ``REDCLIFF_QUALITY_PLATEAU_TOL`` — relative edge-energy change below
+  which a window counts as flat (default 0.01).
+
+Import discipline: jax only inside function bodies (the LAZY_JAX no-host-
+sync tripwire in obs/schema.py covers this module); ``block_until_ready``
+is banned — the summary must ride the existing check-window sync, never
+add one.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from redcliff_tpu.utils.metrics import roc_auc
+
+__all__ = ["enabled", "topk_k", "plateau_window", "plateau_tol",
+           "readout_mode", "make_summary_fn", "summarize_host",
+           "topk_indices_np", "topk_hash", "jaccard", "average_precision",
+           "graph_scores", "QualityMonitor",
+           "SPARSITY_REL_EPS", "ENV_ENABLE", "ENV_TOPK",
+           "ENV_PLATEAU_WINDOW", "ENV_PLATEAU_TOL"]
+
+ENV_ENABLE = "REDCLIFF_QUALITY"
+ENV_TOPK = "REDCLIFF_QUALITY_TOPK"
+ENV_PLATEAU_WINDOW = "REDCLIFF_QUALITY_PLATEAU_WINDOW"
+ENV_PLATEAU_TOL = "REDCLIFF_QUALITY_PLATEAU_TOL"
+
+# combined-graph entries at or below this fraction of the max |edge| count
+# as "off" for the sparsity fraction (a relative threshold: GC magnitudes
+# are scale-free across models/coefficients)
+SPARSITY_REL_EPS = 1e-2
+
+
+def enabled():
+    """Whether the quality observatory is on (``REDCLIFF_QUALITY``,
+    default on). Read per fit, so tests/tools can flip it per run."""
+    return os.environ.get(ENV_ENABLE, "1") != "0"
+
+
+def topk_k(default=8):
+    try:
+        return max(int(os.environ.get(ENV_TOPK, default)), 1)
+    except ValueError:
+        return default
+
+
+def plateau_window(default=3):
+    try:
+        return max(int(os.environ.get(ENV_PLATEAU_WINDOW, default)), 1)
+    except ValueError:
+        return default
+
+
+def plateau_tol(default=0.01):
+    try:
+        return float(os.environ.get(ENV_PLATEAU_TOL, default))
+    except ValueError:
+        return default
+
+
+def readout_mode(config):
+    """The GC readout mode the summary uses: the model's primary mode with
+    conditional (X-dependent) and raw-embedder (non-square) modes forced to
+    ``fixed_factor_exclusive`` — the same override the system-level eval
+    applies (eval/gc_estimates.py), so live and offline readouts agree."""
+    mode = config.primary_gc_est_mode
+    if "conditional" in mode or mode == "raw_embedder":
+        return "fixed_factor_exclusive"
+    return mode
+
+
+def make_summary_fn(model, k=None):
+    """Build the device graph-summary function for a REDCLIFF-family model.
+
+    Returns ``summary(params, X) -> dict`` of small device arrays (one
+    lane; the grid engine vmaps it over the stacked lane axis):
+
+    * ``gc`` — per-factor LAG-SUMMED GC matrices ``(K, C, C)``, float32:
+      byte-compatible with the offline
+      ``eval/gc_estimates.get_model_gc_summary_matrices`` readout;
+    * ``col_norms`` — per-factor column L2 norms ``(K, C)``;
+    * ``edge_energy`` — ``sum(gc**2)`` scalar;
+    * ``sparsity`` — fraction of combined-graph entries with magnitude
+      <= :data:`SPARSITY_REL_EPS` x max magnitude;
+    * ``topk_idx`` / ``topk_val`` — the k largest-|edge| flat indices of
+      the combined (factor-summed) graph, ``lax.top_k`` order;
+    * ``entropy`` — mean Shannon entropy (nats) of the normalized
+      first-sim factor weightings on ``X`` (the factor-score sharpness).
+
+    Pure read of ``params``: jit/vmap freely, never donates, never syncs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    cfg = model.config
+    mode = readout_mode(cfg)
+    kk = k if k is not None else topk_k()
+
+    def summary(params, X):
+        est = model.gc(params, mode, threshold=False, ignore_lag=False,
+                       combine_wavelet_representations=True,
+                       rank_wavelets=False)
+        # fixed modes: (1, K', C, C, L') — fold the singleton sample axis
+        E = jnp.sum(est.reshape((-1,) + est.shape[-3:]), axis=-1)  # (K,C,C)
+        col_norms = jnp.linalg.norm(E, axis=-2)                    # (K, C)
+        edge_energy = jnp.sum(E * E)
+        A = jnp.sum(E, axis=0)                                     # (C, C)
+        mag = jnp.abs(A)
+        m = jnp.max(mag)
+        thr = SPARSITY_REL_EPS * jnp.where(m > 0, m, 1.0)
+        sparsity = jnp.mean((mag <= thr).astype(jnp.float32))
+        k_eff = min(kk, mag.size)
+        topk_val, topk_idx = jax.lax.top_k(mag.ravel(), k_eff)
+        # factor-score entropy: the embedder's first-sim weightings on the
+        # fixed window, rows normalized to distributions by |w| mass
+        w = jnp.abs(model.forward(params, X)[2][0])                # (B, K)
+        p = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-12)
+        entropy = jnp.mean(-jnp.sum(p * jnp.log(p + 1e-12), axis=-1))
+        return {"gc": E.astype(jnp.float32), "col_norms": col_norms,
+                "edge_energy": edge_energy, "sparsity": sparsity,
+                "topk_idx": topk_idx.astype(jnp.int32),
+                "topk_val": topk_val, "entropy": entropy}
+
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# host-side twins (numpy): the generic trainer's readout path and the
+# golden-parity test both consume these
+# ---------------------------------------------------------------------------
+
+def _lagsum(mat):
+    mat = np.asarray(mat, dtype=np.float32)
+    return mat.sum(axis=2) if mat.ndim == 3 else mat
+
+
+def topk_indices_np(A, k):
+    """Flat indices of the k largest-|entry| edges, replicating
+    ``lax.top_k`` tie order (ties resolve to the smaller index)."""
+    flat = np.abs(np.asarray(A)).ravel()
+    order = np.argsort(-flat, kind="stable")
+    return order[: min(k, flat.size)].astype(np.int64)
+
+
+def summarize_host(mats, k=None):
+    """Numpy twin of :func:`make_summary_fn` for models whose GC readout is
+    host-side (the generic trainer's per-family ``model.gc`` lists).
+    ``mats``: per-factor ``(C, C[, L])`` arrays. Returns the summary dict
+    WITH a leading 1-lane axis (QualityMonitor's input convention);
+    ``entropy`` is None (no factor scores on this path)."""
+    E = np.stack([_lagsum(m) for m in mats])                     # (K, C, C)
+    col_norms = np.linalg.norm(E, axis=-2)
+    edge_energy = float(np.sum(E * E))
+    A = E.sum(axis=0)
+    mag = np.abs(A)
+    m = float(mag.max()) if mag.size else 0.0
+    thr = SPARSITY_REL_EPS * (m if m > 0 else 1.0)
+    sparsity = float(np.mean(mag <= thr))
+    idx = topk_indices_np(A, k if k is not None else topk_k())
+    return {"gc": E[None], "col_norms": col_norms[None],
+            "edge_energy": np.asarray([edge_energy], np.float32),
+            "sparsity": np.asarray([sparsity], np.float32),
+            "topk_idx": idx[None].astype(np.int32),
+            "topk_val": mag.ravel()[idx][None],
+            "entropy": None}
+
+
+def topk_hash(indices):
+    """Stable 12-hex-digit hash of a top-k edge SET (order-free: the set is
+    sorted before hashing, so hash equality == identical edge sets)."""
+    blob = ",".join(str(int(i)) for i in sorted(int(i) for i in indices))
+    return hashlib.sha1(blob.encode("ascii")).hexdigest()[:12]
+
+
+def jaccard(a, b):
+    """Jaccard similarity of two edge-index collections (1.0 for two empty
+    sets — a degenerate but stable graph is "stable")."""
+    sa, sb = set(int(i) for i in a), set(int(i) for i in b)
+    union = sa | sb
+    if not union:
+        return 1.0
+    return len(sa & sb) / len(union)
+
+
+def average_precision(labels, scores):
+    """Area under the precision-recall curve (sklearn-style step AP, ties
+    grouped). None when no positive labels exist."""
+    labels = np.asarray(labels).ravel().astype(bool)
+    scores = np.asarray(scores).ravel().astype(np.float64)
+    n_pos = int(labels.sum())
+    if n_pos == 0 or labels.size == 0:
+        return None
+    order = np.argsort(-scores, kind="mergesort")
+    lab, sc = labels[order], scores[order]
+    tp = np.cumsum(lab)
+    fp = np.cumsum(~lab)
+    prec = tp / (tp + fp)
+    rec = tp / n_pos
+    distinct = np.r_[sc[1:] != sc[:-1], True]
+    prec, rec = prec[distinct], rec[distinct]
+    return float(np.sum(np.diff(np.r_[0.0, rec]) * prec))
+
+
+def _prep_like_tracker(mat):
+    """The tracker's comparison prep (train/tracking.py _prep): lag-sum,
+    max-normalize. Self-connections kept (remove_self=False convention)."""
+    mat = np.asarray(mat, dtype=np.float64)
+    if mat.ndim == 3:
+        mat = mat.sum(axis=2)
+    m = np.max(mat)
+    return mat / m if m != 0.0 else mat
+
+
+def graph_scores(true_gc, est_mats):
+    """Mean per-factor (AUROC, AUPR) of lag-summed estimates against the
+    true graphs — the live counterpart of the oracle metrics the offline
+    eval computes on the ``eval/gc_estimates`` readout. ``est_mats``:
+    ``(K, C, C)`` (already lag-summed); ``true_gc``: list of
+    ``(C, C[, L])`` truths. Factor i scores against truth i (single-class
+    truths contribute the 0.5 / base-rate convention like the tracker)."""
+    est = np.asarray(est_mats, dtype=np.float64)
+    n = min(est.shape[0], len(true_gc))
+    if n == 0:
+        return None, None
+    aucs, aps = [], []
+    for i in range(n):
+        truth = _prep_like_tracker(true_gc[i])
+        labels = (truth.ravel() > 0).astype(int)
+        scores = _prep_like_tracker(est[i]).ravel()
+        if labels.sum() == 0 or labels.sum() == labels.size:
+            aucs.append(0.5)
+            aps.append(float(labels.sum()) / labels.size)
+            continue
+        aucs.append(roc_auc(labels, scores))
+        ap = average_precision(labels, scores)
+        aps.append(ap if ap is not None else 0.0)
+    return float(np.mean(aucs)), float(np.mean(aps))
+
+
+# ---------------------------------------------------------------------------
+# host-side convergence monitor
+# ---------------------------------------------------------------------------
+
+class QualityMonitor:
+    """Per-lane convergence diagnostics across check windows.
+
+    State is keyed by ORIGINAL point id (the ``orig_ids`` lane->point map),
+    so diagnostics survive lane compaction unchanged. One
+    :meth:`update` per check window consumes the gathered device summary
+    and returns the ``quality`` event payload; :meth:`snapshot` is the
+    rolling JSON-able view the grid engine stamps into
+    ``dispatch_stats["quality"]`` (-> every checkpoint; the
+    ``plateaued_at_epoch`` readout is ROADMAP item 3's plateau signal).
+
+    Diagnostics are per-ATTEMPT: a resumed fit restarts the Jaccard /
+    plateau history (the durable artifacts — quality events + the
+    checkpointed snapshot — carry the prior attempt's story)."""
+
+    def __init__(self, true_gc=None, window=None, tol=None, mode=None):
+        self.true_gc = ([np.asarray(g) for g in true_gc]
+                        if true_gc is not None and len(true_gc) else None)
+        self.window = window if window is not None else plateau_window()
+        self.tol = tol if tol is not None else plateau_tol()
+        self.mode = mode
+        self.windows = 0
+        self.plateaued = {}       # pid -> epoch the plateau was confirmed
+        self._energy = {}         # pid -> last edge energy
+        self._flat = {}           # pid -> consecutive flat windows
+        self._topk = {}           # pid -> previous top-k index set
+        self._last = {}           # pid -> last per-lane record
+
+    def update(self, epoch, host, orig_ids):
+        """Fold one gathered check-window summary. ``host``: numpy arrays
+        with a leading lane axis (``gc``/``col_norms``/``edge_energy``/
+        ``sparsity``/``topk_idx``/``topk_val``/``entropy``; ``entropy``
+        may be None); ``orig_ids``: lane -> original point id (< 0 =
+        bucket filler, skipped). Returns the ``quality`` event payload."""
+        ids = np.asarray(orig_ids).ravel()
+        rows = [(r, int(p)) for r, p in enumerate(ids) if p >= 0]
+        ent = host.get("entropy")
+        lanes, energy, sparsity, entropy = [], [], [], []
+        hashes, jacs, plats = [], [], []
+        aurocs, auprs = [], []
+        for r, pid in rows:
+            e = float(np.asarray(host["edge_energy"]).ravel()[r])
+            idx = np.asarray(host["topk_idx"])[r].ravel()
+            cur = frozenset(int(i) for i in idx)
+            prev = self._topk.get(pid)
+            jac = jaccard(cur, prev) if prev is not None else None
+            self._topk[pid] = cur
+            prev_e = self._energy.get(pid)
+            if prev_e is not None:
+                rel = abs(e - prev_e) / max(abs(prev_e), 1e-12)
+                self._flat[pid] = self._flat.get(pid, 0) + 1 \
+                    if rel < self.tol else 0
+                if (self._flat[pid] >= self.window
+                        and pid not in self.plateaued):
+                    self.plateaued[pid] = int(epoch)
+            self._energy[pid] = e
+            lanes.append(pid)
+            energy.append(e)
+            sparsity.append(float(np.asarray(host["sparsity"]).ravel()[r]))
+            entropy.append(float(np.asarray(ent).ravel()[r])
+                           if ent is not None else None)
+            hashes.append(topk_hash(cur))
+            jacs.append(jac)
+            plats.append(self.plateaued.get(pid))
+            if self.true_gc is not None:
+                auc, ap = graph_scores(self.true_gc,
+                                       np.asarray(host["gc"])[r])
+                aurocs.append(auc)
+                auprs.append(ap)
+            self._last[pid] = {
+                "edge_energy": e, "sparsity": sparsity[-1],
+                "entropy": entropy[-1], "topk_hash": hashes[-1],
+                "jaccard": jac,
+                "auroc": aurocs[-1] if self.true_gc is not None else None,
+                "aupr": auprs[-1] if self.true_gc is not None else None,
+            }
+        self.windows += 1
+        known_j = [j for j in jacs if j is not None]
+        known_a = [a for a in aurocs if a is not None]
+        known_p = [a for a in auprs if a is not None]
+        return {
+            "epoch": int(epoch),
+            "mode": self.mode,
+            "lanes": lanes,
+            "topk_k": int(np.asarray(host["topk_idx"]).shape[-1]),
+            "edge_energy": energy,
+            "sparsity": sparsity,
+            "entropy": entropy,
+            "topk_hash": hashes,
+            "jaccard": jacs,
+            "plateaued": plats,
+            "auroc": aurocs if self.true_gc is not None else None,
+            "aupr": auprs if self.true_gc is not None else None,
+            "mean_jaccard": (float(np.mean(known_j)) if known_j else None),
+            "mean_auroc": (float(np.mean(known_a)) if known_a else None),
+            "mean_aupr": (float(np.mean(known_p)) if known_p else None),
+            "plateaued_count": sum(p is not None for p in plats),
+        }
+
+    def snapshot(self):
+        """Rolling JSON-able view (string point-id keys — the checkpoint /
+        fit_end / fleet results consumers round-trip through JSON)."""
+        pids = sorted(self._last)
+        last = self._last
+        has_gt = self.true_gc is not None
+        jacs = [last[p]["jaccard"] for p in pids
+                if last[p]["jaccard"] is not None]
+        aucs = [last[p]["auroc"] for p in pids
+                if last[p]["auroc"] is not None]
+        aps = [last[p]["aupr"] for p in pids
+               if last[p]["aupr"] is not None]
+        return {
+            "windows": self.windows,
+            "mode": self.mode,
+            "lanes": len(pids),
+            "plateaued_count": len(self.plateaued),
+            # per-fit convergence epoch: when the SLOWEST lane plateaued;
+            # None while any lane is still moving (ROADMAP item 3 readout)
+            "converged_at_epoch": (max(self.plateaued.values())
+                                   if self.plateaued
+                                   and len(self.plateaued) == len(pids)
+                                   and pids else None),
+            "plateaued_at_epoch": {str(p): self.plateaued.get(p)
+                                   for p in pids},
+            "edge_stability": {str(p): last[p]["jaccard"] for p in pids},
+            "topk_hash": {str(p): last[p]["topk_hash"] for p in pids},
+            "edge_energy": {str(p): last[p]["edge_energy"] for p in pids},
+            "entropy": {str(p): last[p]["entropy"] for p in pids},
+            "auroc": ({str(p): last[p]["auroc"] for p in pids}
+                      if has_gt else None),
+            "aupr": ({str(p): last[p]["aupr"] for p in pids}
+                     if has_gt else None),
+            "mean_edge_stability": (float(np.mean(jacs)) if jacs else None),
+            "mean_auroc": (float(np.mean(aucs)) if aucs else None),
+            "mean_aupr": (float(np.mean(aps)) if aps else None),
+        }

@@ -64,7 +64,7 @@ class Family:
 
     def __init__(self, key, path=None, better="higher",
                  band=_BAND_THROUGHPUT, abs_floor=None, g_dependent=True,
-                 contract_max=None):
+                 contract_max=None, contract_min=None):
         self.key = key
         self.path = path or key
         self.better = better
@@ -77,6 +77,11 @@ class Family:
         # a documented contract breach is a finding even when every prior
         # round was already in breach (relative bands would hide the drift)
         self.contract_max = contract_max
+        # absolute FLOOR for higher-is-better scientific families
+        # (obs/quality.py): a model-quality metric dropping below it flags
+        # regardless of the trajectory — a perf PR that silently degrades
+        # graph recovery fails exactly like a throughput regression
+        self.contract_min = contract_min
 
     def extract(self, payload):
         cur = payload
@@ -147,6 +152,21 @@ FAMILIES = [
     # post-mortem must stay cheap enough to run on every incident
     Family("fleet_trace.export_ms", better="lower", band=_BAND_TIMING,
            abs_floor=250.0, g_dependent=False),
+    # scientific regression families (ISSUE 13, obs/quality.py): the
+    # quality probe's graph-recovery score on the deterministic synthetic
+    # sVAR grid fit, the top-k edge-set stability at the end of that fit,
+    # and the per-check-window readout cost. The AUROC floor is absolute
+    # (contract_min): a perf PR that silently degrades graph recovery
+    # fails the sentinel exactly like a throughput regression, even on a
+    # trajectory with no quality-bearing priors yet. Rounds predating the
+    # probe simply lack the fields (skipped, never noise)
+    Family("quality.synthetic_auroc", path="quality.final_auroc",
+           band=_BAND_TIMING, g_dependent=False, contract_min=0.65),
+    Family("quality.edge_stability", path="quality.edge_stability",
+           band=_BAND_TIMING, g_dependent=False),
+    Family("quality.overhead_pct", path="quality.overhead_pct",
+           better="lower", band=_BAND_TIMING, abs_floor=2.0,
+           g_dependent=False, contract_max=2.0),
 ]
 
 
@@ -333,6 +353,20 @@ def run_sentinel(current, trajectory=None, bench_dir=None, now=None):
                     "change_pct": round(
                         100.0 * (cur - fam.contract_max)
                         / fam.contract_max, 1),
+                    "band_pct": 0.0, "contract": True, "priors": {}})
+                continue
+            if fam.contract_min is not None and cur < fam.contract_min:
+                # scientific floor breach (obs/quality.py families): a
+                # quality score under the documented floor is a finding
+                # even with no prior trajectory to compare against
+                checked += 1
+                regressions.append({
+                    "metric": fam.key, "direction": fam.better,
+                    "sample": leg_name, "current": cur,
+                    "baseline_median": fam.contract_min,
+                    "change_pct": round(
+                        100.0 * (cur - fam.contract_min)
+                        / fam.contract_min, 1),
                     "band_pct": 0.0, "contract": True, "priors": {}})
                 continue
             priors = {}

@@ -243,6 +243,15 @@ def build_report(run_dir):
                             m["measured_peak_bytes"] or 0, peak)
                     if rec.get("bytes_limit") is not None:
                         m["bytes_limit"] = rec["bytes_limit"]
+        elif ev == "quality":
+            # model-quality observatory (obs/quality.py): per-check-window
+            # graph summaries; the last event + the fit_end snapshot below
+            # become the report's model-quality section
+            if cur is not None:
+                qv = cur.setdefault("_quality", {"windows": 0, "last": None,
+                                                 "snapshot": None})
+                qv["windows"] += 1
+                qv["last"] = rec
         elif ev == "fleet":
             # tenant manifest (fleet/run_batch.py): request id -> merged
             # point range; restart attempts re-log it, latest wins
@@ -277,6 +286,15 @@ def build_report(run_dir):
                 aborts += 1
         elif ev == "fit_end":
             ds = rec.get("dispatch_stats")
+            # quality snapshot: inside dispatch_stats for the grid engine,
+            # a top-level field for the trainers; missing on pre-quality
+            # runs (.get everywhere — never a KeyError)
+            q_snap = (ds.get("quality") if isinstance(ds, dict) else None) \
+                or rec.get("quality")
+            if isinstance(q_snap, dict) and cur is not None:
+                cur.setdefault("_quality", {"windows": 0, "last": None,
+                                            "snapshot": None})["snapshot"] \
+                    = q_snap
             if isinstance(ds, dict):
                 for k in _SUM_STATS:
                     v = ds.get(k)
@@ -354,6 +372,43 @@ def build_report(run_dir):
             "last_eta_s": last.get("eta_s"),
             "last_epoch": last.get("epoch"),
         })
+
+    # model-quality section (obs/quality.py): per-fit convergence readouts
+    # from the quality events + the fit_end snapshot, and — on fleet batch
+    # run dirs — the per-request quality blocks run_batch stamped into
+    # results/<id>.json (requests with no quality events render n/a)
+    quality_fits = []
+    for i, f in enumerate(fits):
+        qv = f.pop("_quality", None)
+        if qv is None:
+            continue
+        snap = qv.get("snapshot") or {}
+        last = qv.get("last") or {}
+        quality_fits.append({
+            "fit": i, "model": f.get("model"),
+            "windows": snap.get("windows") or qv["windows"],
+            "lanes": snap.get("lanes"),
+            "plateaued_count": (snap.get("plateaued_count")
+                                if snap else last.get("plateaued_count")),
+            "converged_at_epoch": snap.get("converged_at_epoch"),
+            "final_stability": (snap.get("mean_edge_stability")
+                                if snap else last.get("mean_jaccard")),
+            "final_auroc": (snap.get("mean_auroc")
+                            if snap else last.get("mean_auroc")),
+            "final_aupr": (snap.get("mean_aupr")
+                           if snap else last.get("mean_aupr")),
+        })
+    request_quality = {}
+    results_dir = os.path.join(run_dir, "results")
+    if manifest and os.path.isdir(results_dir):
+        for rid in manifest:
+            try:
+                with open(os.path.join(results_dir, f"{rid}.json")) as fh:
+                    rec_ = json.load(fh)
+                request_quality[rid] = (rec_ or {}).get("quality")
+            except (OSError, ValueError):
+                request_quality[rid] = None
+    quality_section = {"fits": quality_fits, "requests": request_quality}
 
     # device-memory section: predicted vs measured peak per fit + the
     # profile-artifact inventory (capture windows announce their artifacts
@@ -509,6 +564,7 @@ def build_report(run_dir):
         "tenants": tenants,
         "fleet_containment": containment,
         "fleet_slo": fleet_slo,
+        "quality": quality_section,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
                      "guarded_steps_skipped": int(skipped_steps),
@@ -551,6 +607,28 @@ def _fmt_bytes(b):
         if b >= div:
             return f"{b / div:.2f}{unit}"
     return f"{int(b)}B"
+
+
+def _fmt_score(v):
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "n/a"
+
+
+def _fmt_quality(q):
+    """One-line per-request quality rendering (fleet results blocks):
+    requests with no quality events show an explicit n/a."""
+    if not isinstance(q, dict) or not q.get("windows"):
+        return "quality n/a"
+    conv = q.get("converged_at_epoch")
+    stab = q.get("edge_stability") or []
+    stab = [s for s in stab if isinstance(s, (int, float))]
+    auc = q.get("auroc") or []
+    auc = [a for a in auc if isinstance(a, (int, float))]
+    mean = lambda xs: sum(xs) / len(xs) if xs else None
+    return ("quality "
+            + (f"converged@{conv}" if conv is not None else "not converged")
+            + f", stability {_fmt_score(mean(stab))}"
+            + f", auroc {_fmt_score(mean(auc))}"
+            + f" ({q.get('windows')} window(s))")
 
 
 def render_text(report):
@@ -599,6 +677,10 @@ def render_text(report):
                        f"{t['points']} point(s), {t['lane_epochs']} "
                        f"lane-epoch(s), wall {_fmt_ms((t['wall_s'] or 0) * 1e3)}, "
                        f"quarantined: {quar}")
+        rq = (r.get("quality") or {}).get("requests") or {}
+        if rq:
+            for rid in sorted(rq):
+                out.append(f"  request {rid}: {_fmt_quality(rq[rid])}")
     fc = r.get("fleet_containment")
     if fc:
         c = fc["counts"]
@@ -660,6 +742,21 @@ def render_text(report):
             out.append(f"  SLO BREACH [{br['scope']}] {br['slo']}: "
                        f"{br['value']:.3f} vs threshold "
                        f"{br['threshold']:.3f}")
+    qf = (r.get("quality") or {}).get("fits") or []
+    if qf:
+        out.append("model quality (live Granger-graph readouts, "
+                   "obs/quality.py):")
+        for q in qf:
+            conv = (f"converged@{q['converged_at_epoch']}"
+                    if q.get("converged_at_epoch") is not None
+                    else f"{q.get('plateaued_count') or 0} plateaued")
+            out.append(
+                f"  fit {q['fit']} {q.get('model')}: "
+                f"{q.get('windows') or 0} window(s), "
+                f"lanes={q.get('lanes') if q.get('lanes') is not None else '-'}, "
+                f"{conv}, stability {_fmt_score(q.get('final_stability'))}, "
+                f"auroc {_fmt_score(q.get('final_auroc'))}, "
+                f"aupr {_fmt_score(q.get('final_aupr'))}")
     mem = r.get("memory") or {}
     out.append("device memory (predicted vs measured peak, obs/memory.py):")
     for m in mem.get("fits") or []:

@@ -165,7 +165,11 @@ EVENTS = {
         "trainers + grid engine",
         optional=("best_it", "best_loss", "final_val_loss", "aborted",
                   "best_epoch", "best_criteria", "num_active", "compactions",
-                  "compile_ms", "failures", "dispatch_stats")),
+                  "compile_ms", "failures", "dispatch_stats",
+                  # model-quality snapshot (obs/quality.py): the trainers
+                  # stamp it directly; the grid engine carries it inside
+                  # dispatch_stats["quality"]
+                  "quality")),
     "compile": _ev(
         "grid engine (runtime/compileobs.py counters)",
         required=("epoch", "programs", "compile_ms"),
@@ -226,6 +230,16 @@ EVENTS = {
                   "bytes_in_use", "peak_bytes", "bytes_limit",
                   "budget_bytes", "headroom_bytes", "fits", "backend",
                   "device_kind", "n_devices", "note")),
+    "quality": _ev(
+        "grid engine + trainers (obs/quality.py: one per check window when "
+        "REDCLIFF_QUALITY=1 — per-lane Granger-graph summaries keyed by "
+        "original point id, convergence diagnostics, and live AUROC/AUPR "
+        "when the dataset carries ground-truth graphs)",
+        required=("epoch", "lanes"),
+        optional=("grid_width", "mode", "topk_k", "edge_energy", "sparsity",
+                  "entropy", "topk_hash", "jaccard", "plateaued", "auroc",
+                  "aupr", "mean_jaccard", "mean_auroc", "mean_aupr",
+                  "plateaued_count")),
     "profile": _ev(
         "obs/profiling.py capture windows (announces the jax.profiler "
         "artifact a bounded window wrote under the run dir)",
@@ -238,7 +252,7 @@ EVENTS = {
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
-                  "memory", "fleet")),
+                  "memory", "fleet", "quality")),
     "fleet": _ev(
         "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
         "worker loop, run_batch driver, containment layer; kind=submit | "
@@ -375,7 +389,7 @@ NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
                   "fleet/chaos.py", "fleet/__main__.py",
                   "fleet/history.py")
-LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py")
+LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py", "obs/quality.py")
 
 
 def _pkg_root():

@@ -202,6 +202,7 @@ def build_snapshot(run_dir, now=None):
     fleet_last_plan = None   # newest planner packing decision (fleet event)
     fleet_workers = {}       # worker id -> last fleet-event wall time
     mem_pred = mem_meas = None  # newest memory events (obs/memory.py)
+    last_quality = None      # newest quality event (obs/quality.py)
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
     last_wall = last_epoch_wall = None
@@ -242,6 +243,11 @@ def build_snapshot(run_dir, now=None):
                 mem_meas = rec
             elif rec.get("kind") == "predicted":
                 mem_pred = rec
+        elif ev == "quality":
+            # model-quality observatory (obs/quality.py): the newest
+            # check-window summary becomes the `quality:` headline; absent
+            # on pre-quality runs (section simply omitted)
+            last_quality = rec
         elif ev in ("compaction", "remesh") and cur is not None:
             if rec.get("to_width") is not None:
                 cur["grid_width"] = rec["to_width"]
@@ -337,6 +343,23 @@ def build_snapshot(run_dir, now=None):
                 if mem_meas and isinstance(mem_meas.get("wall_time"),
                                            (int, float)) else None),
         }
+    # model-quality headline (obs/quality.py): the newest check-window
+    # graph summary — lanes covered, plateau count, edge-set stability,
+    # live AUROC when ground truth is in hand. None (section omitted) on
+    # runs that never emitted a quality event, pre-quality runs included
+    quality = None
+    if last_quality is not None:
+        qwt = last_quality.get("wall_time")
+        quality = {
+            "epoch": last_quality.get("epoch"),
+            "lanes": len(last_quality.get("lanes") or []),
+            "plateaued_count": last_quality.get("plateaued_count"),
+            "stability": last_quality.get("mean_jaccard"),
+            "auroc": last_quality.get("mean_auroc"),
+            "aupr": last_quality.get("mean_aupr"),
+            "age_s": (round(now - qwt, 3)
+                      if isinstance(qwt, (int, float)) else None),
+        }
     # fleet mode (fleet/queue.py roots): queue depth + per-tenant counts
     # from the authoritative file queue, live in-flight claims from the
     # lease files, and the planner's newest packing decision from the
@@ -358,6 +381,7 @@ def build_snapshot(run_dir, now=None):
                      "aborts": aborts,
                      "guarded_steps_skipped": int(last_skipped)},
         "memory": memory,
+        "quality": quality,
         "heartbeats": heartbeats,
         "incidents": incidents,
         "attempts": {"n": len(attempts),
@@ -602,6 +626,15 @@ def render_text(snap):
     out.append(f"  numerics: {n['anomaly_events']} anomaly, "
                f"{n['rollbacks']} rollback, {n['aborts']} abort, "
                f"{n['guarded_steps_skipped']} guarded step(s) skipped")
+    q = snap.get("quality")
+    if q:
+        fs = lambda v: (f"{v:.3f}" if isinstance(v, (int, float)) else "-")
+        out.append(
+            f"  quality: epoch {q.get('epoch')} lanes={q.get('lanes')} "
+            f"plateaued={q.get('plateaued_count')} "
+            f"stability={fs(q.get('stability'))} "
+            f"auroc={fs(q.get('auroc'))} "
+            f"(age {_fmt_age(q.get('age_s'))})")
     mem = snap.get("memory")
     if mem:
         fb = lambda b: (f"{b / (1 << 20):.1f}MB"

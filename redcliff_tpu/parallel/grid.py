@@ -76,6 +76,7 @@ from redcliff_tpu.obs import MetricLogger
 from redcliff_tpu.obs import costmodel as _costmodel
 from redcliff_tpu.obs import memory as _obsmem
 from redcliff_tpu.obs import profiling as _profiling
+from redcliff_tpu.obs import quality as _quality
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.precision import matmul_precision_ctx
 
@@ -612,6 +613,10 @@ class RedcliffGridRunner:
     # — the watchdog excuses stalled siblings while it is live instead of
     # misclassifying a long first-compile window as a hang
     _seen_programs = None
+    # per-runner jit'd quality-summary program (obs/quality.py) + the
+    # top-k it was built with — rebuilt only when the knob changes
+    _qual_fn = None
+    _qual_fn_k = None
 
     def _call_cold(self, key, fn, *args):
         if self._seen_programs is None:
@@ -941,10 +946,24 @@ class RedcliffGridRunner:
 
     def fit(self, key, train_ds, val_ds, max_iter=None,
             log_dir=None, init_params=None, copy_init=True,
-            checkpoint_dir=None, checkpoint_every=None) -> GridResult:
+            checkpoint_dir=None, checkpoint_every=None,
+            true_gc=None) -> GridResult:
         """checkpoint_dir + checkpoint_every enable periodic fit-state
         checkpoints; a fit pointed at a directory holding one resumes from
         it (bit-identically) instead of starting over.
+
+        Model-quality observatory (obs/quality.py, ``REDCLIFF_QUALITY``):
+        at every check-window boundary a jit'd per-lane graph summary
+        (per-factor GC column norms, edge energy, sparsity, top-k edge
+        set, factor-score entropy) rides the window's existing
+        device->host transfer into schema-registered ``quality`` events
+        and ``dispatch_stats["quality"]`` (edge-set Jaccard stability,
+        edge-energy plateau detection with ``plateaued_at_epoch``).
+        ``true_gc`` — the dataset's ground-truth graphs (list of
+        ``(C, C[, L])`` arrays, e.g. synthetic sVAR / DREAM4) — adds live
+        per-lane AUROC/AUPR on the eval/gc_estimates readout convention.
+        Telemetry only: decision streams and params are bit-identical
+        with the observatory on, off, or supplied with truth.
 
         Fault tolerance (docs/ARCHITECTURE.md "Fault tolerance & resume
         semantics"): checkpoints are written atomically with a CRC header and
@@ -1024,7 +1043,7 @@ class RedcliffGridRunner:
                                  checkpoint_dir=checkpoint_dir,
                                  checkpoint_every=checkpoint_every,
                                  guard=guard, writer=writer, wd=live_wd,
-                                 pw=pw)
+                                 pw=pw, true_gc=true_gc)
             except (Preempted, DeadlineExceeded, remesh.HostLostError):
                 raise
             except Exception as e:
@@ -1043,7 +1062,7 @@ class RedcliffGridRunner:
              log_dir=None, init_params=None, copy_init=True,
              checkpoint_dir=None, checkpoint_every=None,
              guard=None, writer=None, wd=None,
-             pw=_profiling.NOOP) -> GridResult:
+             pw=_profiling.NOOP, true_gc=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
@@ -1279,6 +1298,41 @@ class RedcliffGridRunner:
                     : self.model.config.max_lag, :])
                 if sharding is not None:
                     cos_Xw = jax.device_put(cos_Xw, sharding)
+        # ---- model-quality observatory (obs/quality.py) ------------------
+        # per-lane Granger-graph summaries on the check-window cadence: one
+        # jit'd vmapped readout of params (pure read — no donation, no
+        # effect on any update stream) whose gather piggybacks on the
+        # window's existing device->host transfer. Zero work — no jit, no
+        # monitor, no per-window branch beyond one None check — when
+        # REDCLIFF_QUALITY=0. The entropy/conditional window is hoisted
+        # from the first val batch like cos_Xw (a once-per-fit constant)
+        qmon = qual_fn = qual_Xw = None
+        if _quality.enabled():
+            # identical slice to the cos window — share the device constant
+            # when cosine tracking already built it
+            qual_Xw = cos_Xw
+            if qual_Xw is None:
+                qfirst = next(iter(val_ds.batches(tc.batch_size)), None)
+                if qfirst is not None:
+                    qual_Xw = jnp.asarray(np.asarray(qfirst[0])[
+                        : tc.max_samples_for_gc_tracking,
+                        : self.model.config.max_lag, :])
+                    if sharding is not None:
+                        qual_Xw = jax.device_put(qual_Xw, sharding)
+            if qual_Xw is not None:
+                qmode = _quality.readout_mode(self.model.config)
+                # jit once per runner (keyed by the top-k knob): every
+                # other engine program lives on self, and a second fit on
+                # the same runner must not recompile the summary (the
+                # steady-state zero-recompile tripwire counts it)
+                qk = _quality.topk_k()
+                if self._qual_fn is None or self._qual_fn_k != qk:
+                    self._qual_fn = jax.jit(jax.vmap(
+                        _quality.make_summary_fn(self.model, k=qk),
+                        in_axes=(0, None)))
+                    self._qual_fn_k = qk
+                qual_fn = self._qual_fn
+                qmon = _quality.QualityMonitor(true_gc=true_gc, mode=qmode)
         # per-fit dispatch/stall/compile/lane accounting (bench.py's schema
         # and the tier-1 dispatch-budget + recompile tripwires read this).
         # lane_epochs counts lanes actually computed (width x epochs);
@@ -1322,7 +1376,13 @@ class RedcliffGridRunner:
             # device-memory observatory (obs/memory.py): the analytical HBM
             # prediction for this fit's (shape, G-bucket) + the measured
             # watermark where the backend reports memory_stats
-            "memory": None}
+            "memory": None,
+            # model-quality observatory (obs/quality.py): the rolling
+            # convergence snapshot — plateaued_at_epoch per original point
+            # id (ROADMAP item 3's plateau readout), edge-set stability,
+            # and AUROC/AUPR when ground truth was supplied. None when
+            # REDCLIFF_QUALITY=0 or before the first check window
+            "quality": None}
         compile_t0 = compileobs.snapshot()
         counters_t0 = obs.counters.snapshot()
         width_nominal = Gx
@@ -1772,6 +1832,25 @@ class RedcliffGridRunner:
                             num_quarantined=int((failed_host >= 0).sum()),
                             guarded_steps_skipped=int(skipped_host.sum()),
                             epoch_ms=round(epoch_ms, 3))
+                    # ---- live graph-quality summary (obs/quality.py) -----
+                    # one extra jit'd dispatch (pure read of params) whose
+                    # gather rides THIS window's existing device->host
+                    # transfer; the host-side monitor folds it into
+                    # convergence diagnostics keyed by original point id
+                    # (compaction-safe) and the event + snapshot below
+                    if qmon is not None:
+                        qdev = self._call_cold(("quality", Gx), qual_fn,
+                                               params, qual_Xw)
+                        # the (G, K, C, C) matrix stack is only consumed
+                        # host-side for ground-truth scoring — without
+                        # truth, skip its device->host transfer entirely
+                        qhost = {qk: np.asarray(gather_to_host(qv))
+                                 for qk, qv in qdev.items()
+                                 if qmon.true_gc is not None or qk != "gc"}
+                        qrec = qmon.update(it, qhost, orig_ids)
+                        stats["quality"] = qmon.snapshot()
+                        if logger.active:
+                            logger.log("quality", grid_width=Gx, **qrec)
                 # ---- learned-cost-model scoring (obs/costmodel.py) -------
                 # score the prediction that existed BEFORE this epoch ran:
                 # the persistent store's (shape, G-bucket) estimate when one
@@ -1948,6 +2027,8 @@ class RedcliffGridRunner:
                             vidx = jax.device_put(vidx, sharding)
                     if cos_Xw is not None and sharding is not None:
                         cos_Xw = jax.device_put(cos_Xw, sharding)
+                    if qual_Xw is not None and sharding is not None:
+                        qual_Xw = jax.device_put(qual_Xw, sharding)
                     eras.append(orig_ids)
                     era_cur += 1
                     stats["compactions"] += 1

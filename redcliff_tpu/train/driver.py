@@ -149,7 +149,8 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
                          key=None, mesh=None, max_iter=None,
                          init_point_params=None, checkpoint_dir=None,
                          checkpoint_every=None, run_dir=None,
-                         fit_deadline_s=None, grid_deadline_s=None):
+                         fit_deadline_s=None, grid_deadline_s=None,
+                         true_gc=None):
     """Train G coefficient/optimizer variations of one REDCLIFF model
     concurrently on the device mesh (see parallel.grid.RedcliffGridRunner).
 
@@ -204,6 +205,13 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     automatically: the grid engine re-shards the checkpointed lanes onto
     the smaller mesh (structured ``remesh`` event in metrics.jsonl) and
     results keep reporting under original point ids.
+
+    Model-quality observatory (obs/quality.py, ``REDCLIFF_QUALITY``):
+    ``true_gc`` — the dataset's ground-truth graphs (synthetic sVAR /
+    DREAM4; list of ``(C, C[, L])`` arrays) — adds live per-lane
+    AUROC/AUPR to the per-check-window ``quality`` events and the
+    ``dispatch_stats["quality"]`` convergence snapshot. Telemetry only:
+    results are bit-identical with or without it.
     """
     import jax
 
@@ -228,7 +236,8 @@ def run_coefficient_grid(model, train_config, grid_points, train_ds, val_ds,
     result = runner.fit(key, train_ds, val_ds, max_iter=max_iter,
                         init_params=init, copy_init=False,
                         checkpoint_dir=checkpoint_dir,
-                        checkpoint_every=checkpoint_every)
+                        checkpoint_every=checkpoint_every,
+                        true_gc=true_gc)
     failures_dir = run_dir if run_dir is not None else checkpoint_dir
     if result.failures and failures_dir is not None \
             and jax.process_index() == 0:

@@ -36,6 +36,7 @@ from redcliff_tpu.models.redcliff import RedcliffSCMLP, phase_schedule
 from redcliff_tpu.obs import MetricLogger
 from redcliff_tpu.obs import memory as _obsmem
 from redcliff_tpu.obs import profiling as _profiling
+from redcliff_tpu.obs import quality as _obsquality
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
@@ -399,6 +400,32 @@ class RedcliffTrainer:
                 optB_state = jax.tree.map(put(fac_sh), optB_state)
                 optA_state = jax.tree.map(put(rep), optA_state)
 
+        # ---- model-quality observatory (obs/quality.py) ------------------
+        # the single-lane analog of the grid engine's per-lane summaries:
+        # a jit'd graph readout on the check_every cadence (pure read of
+        # params — update streams untouched), folded into convergence
+        # diagnostics + schema-registered `quality` events; live AUROC/AUPR
+        # when ``true_GC`` is in hand. Nothing is built when
+        # REDCLIFF_QUALITY=0 (zero-cost contract)
+        qmon = qual_fn = qual_Xw = None
+        if _obsquality.enabled():
+            qfirst = next(iter(val_ds.batches(tc.batch_size)), None)
+            if qfirst is not None:
+                qual_Xw = jnp.asarray(np.asarray(qfirst[0])[
+                    : tc.max_samples_for_gc_tracking, : cfg.max_lag, :])
+                # jit once per trainer (keyed by the top-k knob), like the
+                # __init__-built step programs: a second fit must not
+                # recompile the summary (zero-recompile discipline)
+                qk = _obsquality.topk_k()
+                if getattr(self, "_qual_fn", None) is None \
+                        or self._qual_fn_k != qk:
+                    self._qual_fn = jax.jit(
+                        _obsquality.make_summary_fn(model, k=qk))
+                    self._qual_fn_k = qk
+                qual_fn = self._qual_fn
+                qmon = _obsquality.QualityMonitor(
+                    true_gc=true_GC, mode=_obsquality.readout_mode(cfg))
+
         last_it = iter_start - 1
         policy = tc.numerics if self._guard else None
         monitor = (numerics.DivergenceMonitor(policy)
@@ -610,6 +637,14 @@ class RedcliffTrainer:
                            epoch_ms=round(
                                (time.perf_counter() - t_epoch0) * 1e3, 3),
                            **val, **(tracker.latest_as_dict() if tracker else {}))
+                # live graph-quality summary on the check cadence
+                # (obs/quality.py): one jit'd readout of params, host-folded
+                # into convergence diagnostics; single lane id 0
+                if qmon is not None and it % tc.check_every == 0:
+                    qhost = {qk: np.asarray(qv)[None]
+                             for qk, qv in qual_fn(params, qual_Xw).items()}
+                    qrec = qmon.update(it, qhost, np.zeros(1, np.int32))
+                    logger.log("quality", **qrec)
                 pw.on_epoch_end(it, logger=logger)
                 if stop_early or aborted is not None:
                     break
@@ -638,7 +673,10 @@ class RedcliffTrainer:
             logger.log("fit_end", best_it=best_it if best_it is not None else 0,
                        best_loss=float(best_loss),
                        final_val_loss=final_val["combo_loss"],
-                       aborted=aborted)
+                       aborted=aborted,
+                       quality=(qmon.snapshot()
+                                if qmon is not None and qmon.windows
+                                else None))
         finally:
             rt_watchdog.retire("epoch_engine")
             rt_watchdog.retire("batch_loop")

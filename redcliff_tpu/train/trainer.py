@@ -40,6 +40,7 @@ from redcliff_tpu.data import pipeline
 from redcliff_tpu.obs import MetricLogger
 from redcliff_tpu.obs import memory as _obsmem
 from redcliff_tpu.obs import profiling as _profiling
+from redcliff_tpu.obs import quality as _obsquality
 from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
@@ -205,21 +206,26 @@ class Trainer:
         out["combo_loss"] = combo_sum / n
         return out
 
-    def _epoch_gc_tracking(self, params, tracker, true_GC, track_X=None):
+    def _gc_kwargs(self, track_X):
+        """Per-family ``model.gc`` keyword plumbing shared by the tracker
+        and the quality observatory: data-dependent estimates take the
+        tracking window, and wavelet-band blocks are condensed so readouts
+        compare (C, C) against the true graphs (same convention as the
+        REDCLIFF trainer; ref checkpoint tracking passes
+        combine_wavelet_representations=True). Covers both the
+        wavelet_level families (cMLP/cLSTM FM) and DGCNN's
+        num_wavelets_per_chan-expanded node axis."""
+        kw = {}
         if getattr(self.model, "gc_requires_data", False):
-            kw = {"X": track_X}
-        else:
-            kw = {}
+            kw["X"] = track_X
         mcfg = self.model.config
         if (getattr(mcfg, "wavelet_level", None) is not None
                 or getattr(mcfg, "num_wavelets_per_chan", 1) > 1):
-            # condense wavelet-band blocks so tracking compares (C, C)
-            # against the true graphs (same convention as the REDCLIFF
-            # trainer; ref checkpoint tracking passes
-            # combine_wavelet_representations=True). Covers both the
-            # wavelet_level families (cMLP/cLSTM FM) and DGCNN's
-            # num_wavelets_per_chan-expanded node axis
             kw["combine_wavelet_representations"] = True
+        return kw
+
+    def _epoch_gc_tracking(self, params, tracker, true_GC, track_X=None):
+        kw = self._gc_kwargs(track_X)
         ests = [np.asarray(g) for g in self.model.gc(params, ignore_lag=False, **kw)]
         ests_nolag = [np.asarray(g) for g in self.model.gc(params, ignore_lag=True, **kw)]
         tracker.update(true_GC, [ests], est_by_sample_lagsummed=[ests_nolag])
@@ -269,8 +275,21 @@ class Trainer:
             if tracker is not None and ck.get("tracker_state") is not None:
                 tracker.__dict__.update(ck["tracker_state"])
 
+        # ---- model-quality observatory (obs/quality.py) ------------------
+        # this trainer's GC readouts are per-family host calls (model.gc
+        # numpy lists), so the quality summary rides the HOST twin
+        # (summarize_host) on the check_every cadence — no device work
+        # beyond the readout the tracker already pays; entropy is None on
+        # this path (no factor scores). Disabled per REDCLIFF_QUALITY=0;
+        # a family whose readout throws disables itself (telemetry must
+        # never fail a fit)
+        qmon = (_obsquality.QualityMonitor(true_gc=true_GC,
+                                           mode="host_readout")
+                if _obsquality.enabled() else None)
+
         track_X = None
-        if tracker is not None and getattr(self.model, "gc_requires_data", False):
+        if ((tracker is not None or qmon is not None)
+                and getattr(self.model, "gc_requires_data", False)):
             # data-dependent GC estimates (e.g. NAVAR contribution stds) are
             # tracked on the first validation batch, like the reference's
             # per-epoch eval (ref redcliff_s_cmlp.py:1403)
@@ -376,6 +395,19 @@ class Trainer:
                                    (time.perf_counter() - t_epoch0) * 1e3, 3),
                                **val,
                                **(tracker.latest_as_dict() if tracker else {}))
+                    # live graph-quality summary on the check cadence
+                    # (obs/quality.py host twin; single lane id 0)
+                    if qmon is not None and it % cfg.check_every == 0:
+                        try:
+                            mats = [np.asarray(g) for g in self.model.gc(
+                                params, ignore_lag=False,
+                                **self._gc_kwargs(track_X))]
+                            qrec = qmon.update(
+                                it, _obsquality.summarize_host(mats),
+                                np.zeros(1, np.int32))
+                            logger.log("quality", **qrec)
+                        except Exception:  # noqa: BLE001 — telemetry must
+                            qmon = None    # never fail a fit
                     pw.on_epoch_end(it, logger=logger)
 
                     if monitor is not None:
@@ -449,7 +481,10 @@ class Trainer:
             logger.log("fit_end", best_it=best_it if best_it is not None else 0,
                        best_loss=float(best_loss),
                        final_val_loss=final_val["combo_loss"],
-                       aborted=aborted)
+                       aborted=aborted,
+                       quality=(qmon.snapshot()
+                                if qmon is not None and qmon.windows
+                                else None))
         finally:
             rt_watchdog.retire("epoch_engine")
             rt_watchdog.retire("batch_loop")

@@ -1,0 +1,505 @@
+"""Model-quality observatory tests (obs/quality.py, ISSUE 13).
+
+Pins the scientific-telemetry contracts: golden parity between the live
+device summary and the offline eval/gc_estimates readout, bit-identical
+decision streams with the observatory on vs off, schema-valid `quality`
+events with live AUROC under ground truth, the convergence diagnostics
+(Jaccard stability, plateau detection, point-id keying across filler
+lanes), the regression sentinel's scientific families (floors flag an
+injected AUROC degradation; the real BENCH trajectory stays quiet), the
+fleet per-request quality blocks, and graceful report/watch behavior on
+PR-12-era (pre-quality) run dirs.
+"""
+import json
+import os
+import pickle
+
+import jax
+import numpy as np
+import pytest
+
+from redcliff_tpu.data.datasets import ArrayDataset
+from redcliff_tpu.eval import gc_estimates as GE
+from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+from redcliff_tpu.obs import quality as Q
+from redcliff_tpu.obs import read_jsonl, schema
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.train.redcliff_trainer import (RedcliffTrainConfig,
+                                                 RedcliffTrainer)
+
+
+def _model(num_chans=4, num_factors=2):
+    return RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=num_chans, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=num_factors,
+        num_supervised_factors=2, factor_weight_l1_coeff=0.01,
+        adj_l1_reg_coeff=0.001, factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+
+
+def _data(model, n=32, seed=0):
+    cfg = model.config
+    rng = np.random.default_rng(seed)
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(n, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(
+        size=(n, cfg.num_supervised_factors + 1, 1)).astype(np.float32)
+    return ArrayDataset(X, Y)
+
+
+def _true_gc(model, seed=1):
+    rng = np.random.default_rng(seed)
+    C = model.config.num_chans
+    return [(np.abs(rng.normal(size=(C, C, 2)))
+             * (rng.random((C, C, 2)) > 0.5)).astype(np.float32)
+            for _ in range(model.config.num_factors)]
+
+
+@pytest.fixture(scope="module")
+def quality_run(tmp_path_factory):
+    """One shared grid fit with the observatory on and ground truth in
+    hand; reused by the parity / events / report / watch tests."""
+    model = _model()
+    ds = _data(model)
+    truth = _true_gc(model)
+    spec = GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3}])
+    tc = RedcliffTrainConfig(max_iter=4, batch_size=16, check_every=1)
+    runner = RedcliffGridRunner(model, tc, spec)
+    run_dir = str(tmp_path_factory.mktemp("quality_run"))
+    result = runner.fit(jax.random.PRNGKey(0), ds, ds, log_dir=run_dir,
+                        true_gc=truth)
+    return {"model": model, "ds": ds, "truth": truth, "runner": runner,
+            "result": result, "run_dir": run_dir}
+
+
+# ---------------------------------------------------------------------------
+# unit layer
+# ---------------------------------------------------------------------------
+
+def test_topk_hash_is_order_free_and_stable():
+    assert Q.topk_hash([3, 1, 2]) == Q.topk_hash([2, 3, 1])
+    assert Q.topk_hash([3, 1, 2]) != Q.topk_hash([3, 1, 4])
+    assert len(Q.topk_hash(range(8))) == 12
+
+
+def test_jaccard():
+    assert Q.jaccard([1, 2, 3], [1, 2, 3]) == 1.0
+    assert Q.jaccard([1, 2], [3, 4]) == 0.0
+    assert Q.jaccard([1, 2, 3], [2, 3, 4]) == pytest.approx(0.5)
+    assert Q.jaccard([], []) == 1.0
+
+
+def test_average_precision():
+    # perfect ranking -> 1.0; no positives -> None
+    assert Q.average_precision([1, 1, 0, 0], [4, 3, 2, 1]) == 1.0
+    assert Q.average_precision([0, 0], [1, 2]) is None
+    # known value: positives at ranks 1 and 3 -> (1/1 + 2/3) / 2
+    assert Q.average_precision([1, 0, 1, 0], [4, 3, 2, 1]) \
+        == pytest.approx((1.0 + 2.0 / 3.0) / 2.0)
+
+
+def test_topk_indices_np_matches_lax_topk_tie_order():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(5, 5)).astype(np.float32)
+    A.ravel()[3] = A.ravel()[7]  # force a tie
+    _, idx = jax.lax.top_k(jnp.abs(jnp.asarray(A)).ravel(), 6)
+    np.testing.assert_array_equal(np.asarray(idx),
+                                  Q.topk_indices_np(A, 6))
+
+
+def _host_summary(energy, topk, C=4, K=2, seed=0):
+    rng = np.random.default_rng(seed)
+    n = len(energy)
+    return {
+        "gc": rng.random((n, K, C, C)).astype(np.float32),
+        "col_norms": rng.random((n, K, C)).astype(np.float32),
+        "edge_energy": np.asarray(energy, np.float32),
+        "sparsity": np.full((n,), 0.5, np.float32),
+        "topk_idx": np.asarray(topk, np.int32),
+        "topk_val": rng.random((n, len(topk[0]))).astype(np.float32),
+        "entropy": np.full((n,), 0.3, np.float32),
+    }
+
+
+def test_plateau_detection_confirms_after_window_flat_windows():
+    mon = Q.QualityMonitor(window=2, tol=0.01)
+    topk = [[0, 1, 2]]
+    for epoch, e in enumerate([10.0, 10.0, 10.0, 10.0]):
+        rec = mon.update(epoch, _host_summary([e], topk), [0])
+    # windows 1..3 are flat comparisons; confirmed at the 2nd flat one
+    assert mon.plateaued == {0: 2}
+    assert rec["plateaued"] == [2]
+    assert mon.snapshot()["converged_at_epoch"] == 2
+
+
+def test_plateau_resets_on_energy_movement():
+    mon = Q.QualityMonitor(window=2, tol=0.01)
+    topk = [[0, 1, 2]]
+    for epoch, e in enumerate([10.0, 10.0, 20.0, 20.0, 20.0]):
+        mon.update(epoch, _host_summary([e], topk), [0])
+    # the jump at window 2 reset the flat streak; confirmed at epoch 4
+    assert mon.plateaued == {0: 4}
+
+
+def test_jaccard_tracks_topk_set_changes():
+    mon = Q.QualityMonitor()
+    r1 = mon.update(0, _host_summary([1.0], [[0, 1, 2]]), [0])
+    r2 = mon.update(1, _host_summary([1.0], [[0, 1, 2]]), [0])
+    r3 = mon.update(2, _host_summary([1.0], [[0, 1, 9]]), [0])
+    assert r1["jaccard"] == [None]
+    assert r2["jaccard"] == [1.0]
+    assert r3["jaccard"] == [pytest.approx(0.5)]
+    assert r2["topk_hash"] == r1["topk_hash"]
+    assert r3["topk_hash"] != r2["topk_hash"]
+
+
+def test_monitor_keys_by_original_point_id_and_skips_filler():
+    mon = Q.QualityMonitor(window=1, tol=0.5)
+    # execution rows [filler, point 5, point 2] — filler (-1) never appears
+    rec = mon.update(0, _host_summary([1.0, 2.0, 3.0],
+                                      [[0, 1], [2, 3], [4, 5]]),
+                     [-1, 5, 2])
+    assert rec["lanes"] == [5, 2]
+    mon.update(1, _host_summary([1.0, 2.0, 3.0],
+                                [[0, 1], [2, 3], [4, 5]]), [-1, 5, 2])
+    snap = mon.snapshot()
+    assert set(snap["plateaued_at_epoch"]) == {"2", "5"}
+    assert snap["plateaued_at_epoch"]["5"] == 1
+
+
+def test_graph_scores_recovers_known_graph():
+    truth = [np.asarray([[0.0, 1.0], [0.0, 0.0]])]
+    perfect = np.asarray([[[0.1, 5.0], [0.05, 0.2]]])
+    auc, ap = Q.graph_scores(truth, perfect)
+    assert auc == 1.0 and ap == 1.0
+    # degenerate all-positive truth -> the tracker's 0.5 convention
+    auc2, _ = Q.graph_scores([np.ones((2, 2))], perfect)
+    assert auc2 == 0.5
+
+
+def test_summarize_host_matches_field_contract():
+    mats = [np.arange(12, dtype=np.float32).reshape(2, 2, 3)]
+    s = Q.summarize_host(mats, k=3)
+    assert s["gc"].shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(s["gc"][0, 0], mats[0].sum(axis=2))
+    assert s["entropy"] is None
+    assert s["topk_idx"].shape == (1, 3)
+
+
+# ---------------------------------------------------------------------------
+# golden parity: live device summary vs the offline eval readout
+# ---------------------------------------------------------------------------
+
+def test_golden_parity_live_summary_vs_offline_readout(quality_run):
+    """The in-training device-side graph summary, evaluated on the fitted
+    params, must match the offline eval/gc_estimates readout: per-factor
+    column norms within 1e-6 and IDENTICAL top-k edge sets."""
+    model = quality_run["model"]
+    res = quality_run["result"]
+    K = model.config.num_factors
+    fn = jax.jit(Q.make_summary_fn(model, k=6))
+    first = next(iter(quality_run["ds"].batches(16)))
+    Xw = np.asarray(first[0])[:8, : model.config.max_lag, :]
+    for lane in range(2):
+        params = jax.tree.map(lambda l, _g=lane: l[_g], res.best_params)
+        live = {k: np.asarray(v)
+                for k, v in fn(params, Xw).items()}
+        offline = GE.get_model_gc_summary_matrices(model, params,
+                                                   "REDCLIFF", K)
+        # per-factor lag-summed matrices agree
+        np.testing.assert_allclose(live["gc"], np.stack(offline),
+                                   atol=1e-6)
+        # column norms within 1e-6
+        np.testing.assert_allclose(
+            live["col_norms"],
+            np.stack([np.linalg.norm(m, axis=0) for m in offline]),
+            atol=1e-6)
+        # identical top-k edge SETS on the combined graph
+        combined = np.sum(offline, axis=0)
+        assert (set(int(i) for i in live["topk_idx"])
+                == set(int(i) for i in Q.topk_indices_np(combined, 6)))
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def test_grid_quality_events_schema_valid_with_gt(quality_run):
+    recs = read_jsonl(quality_run["run_dir"])
+    assert not schema.validate_records(recs)
+    qs = [r for r in recs if r["event"] == "quality"]
+    assert len(qs) == 4  # check_every=1, 4 epochs
+    last = qs[-1]
+    assert last["lanes"] == [0, 1]
+    assert all(0.0 <= a <= 1.0 for a in last["auroc"])
+    assert all(0.0 <= a <= 1.0 for a in last["aupr"])
+    assert len(last["topk_hash"]) == 2
+    snap = quality_run["runner"].dispatch_stats["quality"]
+    assert set(snap["plateaued_at_epoch"]) == {"0", "1"}
+    assert snap["windows"] == 4
+    assert snap["mean_auroc"] is not None
+    # the snapshot is strict-JSON-able (rides checkpoints + fleet results)
+    json.dumps(snap, allow_nan=False)
+
+
+def test_grid_bit_identity_and_zero_cost_off(monkeypatch, tmp_path):
+    """REDCLIFF_QUALITY=1 vs =0: identical decision streams and params;
+    off = no quality events, no snapshot, no summary work."""
+    model = _model()
+    ds = _data(model)
+    spec_pts = [{"gen_lr": 1e-3}, {"gen_lr": 5e-3}]
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=16, check_every=1)
+
+    def run(flag, sub):
+        monkeypatch.setenv(Q.ENV_ENABLE, flag)
+        runner = RedcliffGridRunner(model, tc, GridSpec(points=spec_pts))
+        d = str(tmp_path / sub)
+        res = runner.fit(jax.random.PRNGKey(0), ds, ds, log_dir=d,
+                         true_gc=_true_gc(model))
+        return runner, res, read_jsonl(d)
+
+    r_on, res_on, recs_on = run("1", "on")
+    r_off, res_off, recs_off = run("0", "off")
+    # decision streams and trained params are bitwise identical
+    np.testing.assert_array_equal(res_on.val_history, res_off.val_history)
+    np.testing.assert_array_equal(res_on.best_criteria,
+                                  res_off.best_criteria)
+    for a, b in zip(jax.tree.leaves(res_on.best_params),
+                    jax.tree.leaves(res_off.best_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # on: events + snapshot; off: neither
+    assert any(r["event"] == "quality" for r in recs_on)
+    assert not any(r["event"] == "quality" for r in recs_off)
+    assert r_on.dispatch_stats["quality"] is not None
+    assert r_off.dispatch_stats["quality"] is None
+
+
+def test_redcliff_trainer_quality_events(tmp_path):
+    model = _model()
+    ds = _data(model)
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=16, check_every=1)
+    trainer = RedcliffTrainer(model, tc)
+    d = str(tmp_path / "run")
+    trainer.fit(model.init(jax.random.PRNGKey(0)), ds, ds,
+                true_GC=_true_gc(model), save_dir=d)
+    recs = read_jsonl(d)
+    assert not schema.validate_records(recs)
+    qs = [r for r in recs if r["event"] == "quality"]
+    assert qs and qs[-1]["lanes"] == [0]
+    assert qs[-1]["auroc"] is not None
+    fe = [r for r in recs if r["event"] == "fit_end"][-1]
+    assert fe["quality"]["windows"] == len(qs)
+
+
+def test_generic_trainer_quality_host_path(tmp_path):
+    from redcliff_tpu.models.cmlp_fm import CMLPFM, CMLPFMConfig
+    from redcliff_tpu.train.trainer import TrainConfig, Trainer
+
+    rng = np.random.default_rng(0)
+    model = CMLPFM(CMLPFMConfig(num_chans=4, gen_lag=2, gen_hidden=(8,),
+                                input_length=8))
+    ds = ArrayDataset(rng.normal(size=(32, 40, 4)).astype(np.float32))
+    trainer = Trainer(model, TrainConfig(max_iter=3, check_every=1,
+                                         batch_size=16))
+    d = str(tmp_path / "run")
+    truth = [(np.abs(rng.normal(size=(4, 4)))
+              * (rng.random((4, 4)) > 0.5))]
+    trainer.fit(model.init(jax.random.PRNGKey(1)), ds, ds, true_GC=truth,
+                save_dir=d)
+    recs = read_jsonl(d)
+    assert not schema.validate_records(recs)
+    qs = [r for r in recs if r["event"] == "quality"]
+    assert qs and qs[-1]["entropy"] == [None]  # no factor scores here
+    assert qs[-1]["auroc"] is not None
+    assert qs[-1]["mode"] == "host_readout"
+
+
+# ---------------------------------------------------------------------------
+# consumers: report / watch / regress / fleet
+# ---------------------------------------------------------------------------
+
+def test_report_renders_quality_section(quality_run):
+    from redcliff_tpu.obs.report import build_report, render_text
+
+    rep = build_report(quality_run["run_dir"])
+    fits = rep["quality"]["fits"]
+    assert len(fits) == 1
+    q = fits[0]
+    assert q["windows"] == 4
+    assert q["lanes"] == 2
+    assert q["final_auroc"] is not None
+    assert q["final_stability"] is not None
+    text = render_text(rep)
+    assert "model quality" in text
+    json.dumps(rep, allow_nan=False)
+
+
+def test_watch_quality_headline(quality_run):
+    from redcliff_tpu.obs.watch import build_snapshot, render_text
+
+    snap = build_snapshot(quality_run["run_dir"])
+    assert not schema.validate_record(snap)
+    q = snap["quality"]
+    assert q is not None and q["lanes"] == 2
+    assert q["auroc"] is not None
+    assert "quality:" in render_text(snap)
+
+
+def _pre_quality_run_dir(tmp_path):
+    """A PR-12-era run dir: metrics without quality events and a grid
+    checkpoint whose dispatch_stats has NO 'quality' key."""
+    from redcliff_tpu.obs.logging import MetricLogger
+    from redcliff_tpu.runtime import checkpoint as durable_ckpt
+
+    d = str(tmp_path / "old_run")
+    old_stats = {"mode": "epoch", "epochs": 3, "train_dispatches": 3,
+                 "val_dispatches": 3, "ckpt_stall_ms": 0.0,
+                 "grid_width": 2, "lanes_live": 2,
+                 "epoch_ms_by_width": {"2": 30.0},
+                 "epochs_by_width": {"2": 3}}
+    with MetricLogger(d) as log:
+        log.log("fit_start", model="RedcliffGridRunner", grid_size=2,
+                grid_width=2, shape={"num_chans": 4}, max_iter=3)
+        for e in range(3):
+            log.log("epoch", epoch=e, lanes_live=2, grid_width=2,
+                    epoch_ms=10.0)
+        log.log("fit_end", dispatch_stats=old_stats)
+    durable_ckpt.write_checkpoint(
+        os.path.join(d, "grid_checkpoint.pkl"),
+        {"dispatch_stats": dict(old_stats), "meta": {"batch_size": 16}})
+    return d
+
+
+def test_pre_quality_run_dir_never_keyerrors(tmp_path):
+    """Satellite fix: runs from pre-quality checkpoints (no 'quality' key
+    anywhere) render in report AND watch with the section omitted."""
+    from redcliff_tpu.obs.report import build_report, render_text
+    from redcliff_tpu.obs.watch import build_snapshot
+    from redcliff_tpu.obs.watch import render_text as watch_text
+
+    d = _pre_quality_run_dir(tmp_path)
+    rep = build_report(d)
+    assert rep["quality"]["fits"] == []
+    assert rep["quality"]["requests"] == {}
+    assert "model quality" not in render_text(rep)
+    snap = build_snapshot(d)
+    assert snap["quality"] is None
+    assert "quality:" not in watch_text(snap)
+    assert not schema.validate_record(snap)
+
+
+def test_regress_flags_injected_auroc_degradation():
+    from redcliff_tpu.obs.regress import run_sentinel
+
+    def payload(auroc, stability=0.95, overhead=0.1):
+        return {"metric": "windows_per_sec_per_chip", "value": 100.0,
+                "platform": "cpu", "grid_points": 16,
+                "quality": {"final_auroc": auroc,
+                            "edge_stability": stability,
+                            "overhead_pct": overhead}}
+
+    priors = [{"round": i, "path": f"r{i}", "payload": payload(0.72)}
+              for i in (1, 2)]
+    # healthy current: quiet on the quality families
+    cur = payload(0.71)
+    block = run_sentinel(cur, trajectory=priors
+                         + [{"round": 3, "path": "r3", "payload": cur}])
+    assert not [r for r in block["regressions"]
+                if r["metric"].startswith("quality.")]
+    # injected degradation: flags via the absolute floor (contract_min)
+    bad = payload(0.30)
+    block = run_sentinel(bad, trajectory=priors
+                         + [{"round": 3, "path": "r3", "payload": bad}])
+    hits = [r for r in block["regressions"]
+            if r["metric"] == "quality.synthetic_auroc"]
+    assert hits and hits[0].get("contract") is True
+    # an overhead contract breach flags too
+    slow = payload(0.72, overhead=3.5)
+    block = run_sentinel(slow, trajectory=priors
+                         + [{"round": 3, "path": "r3", "payload": slow}])
+    assert [r for r in block["regressions"]
+            if r["metric"] == "quality.overhead_pct"
+            and r.get("contract")]
+    # floor flags even with NO quality-bearing priors (fresh trajectory)
+    block = run_sentinel(payload(0.30), trajectory=[])
+    assert [r for r in block["regressions"]
+            if r["metric"] == "quality.synthetic_auroc"]
+
+
+def test_regress_real_trajectory_stays_quiet_on_quality_families():
+    """The committed BENCH_r*.json rounds predate the quality probe: the
+    scientific families must be skipped there, never noise."""
+    from redcliff_tpu.obs.regress import load_trajectory, run_sentinel
+
+    traj = load_trajectory()
+    usable = [r for r in traj if r["payload"] is not None]
+    if not usable:
+        pytest.skip("no usable BENCH rounds in this checkout")
+    block = run_sentinel(usable[-1]["payload"], trajectory=traj)
+    assert not [r for r in block["regressions"]
+                if r["metric"].startswith("quality.")]
+
+
+def test_fleet_results_carry_per_request_quality_block(tmp_path):
+    """run_batch stamps the final per-request quality slice into
+    results/<id>.json, keyed by each request's own point range."""
+    from redcliff_tpu.fleet.__main__ import TINY_SPEC
+    from redcliff_tpu.fleet.run_batch import run_batch_file
+
+    run_dir = str(tmp_path / "work")
+    spec = json.loads(json.dumps(TINY_SPEC))
+    batch = {
+        "batch_id": "b-quality", "run_dir": run_dir,
+        "checkpoint_every": 1,
+        "requests": [
+            {"request_id": "req-a", "tenant": "ta", "spec": spec,
+             "points": [{"gen_lr": 1e-3}]},
+            {"request_id": "req-b", "tenant": "tb", "spec": spec,
+             "points": [{"gen_lr": 3e-3}, {"gen_lr": 5e-3}]},
+        ],
+    }
+    bf = tmp_path / "batch.json"
+    bf.write_text(json.dumps(batch))
+    run_batch_file(str(bf))
+    ra = json.load(open(os.path.join(run_dir, "results", "req-a.json")))
+    rb = json.load(open(os.path.join(run_dir, "results", "req-b.json")))
+    assert ra["quality"] is not None
+    assert len(ra["quality"]["plateaued_at_epoch"]) == 1
+    assert len(rb["quality"]["plateaued_at_epoch"]) == 2
+    assert len(rb["quality"]["topk_hash"]) == 2
+    # no ground truth on the fleet synthetic spec -> explicit None scores
+    assert ra["quality"]["auroc"] is None
+
+    # obs report on the batch run dir renders the per-request lines
+    from redcliff_tpu.obs.report import build_report, render_text
+
+    rep = build_report(run_dir)
+    assert set(rep["quality"]["requests"]) == {"req-a", "req-b"}
+    text = render_text(rep)
+    assert "request req-a" in text and "quality" in text
+
+
+def test_report_renders_na_for_requests_without_quality(tmp_path):
+    """Requests whose results block has no quality events show n/a."""
+    from redcliff_tpu.obs.logging import MetricLogger
+    from redcliff_tpu.obs.report import build_report, render_text
+
+    d = str(tmp_path / "batch")
+    with MetricLogger(d) as log:
+        log.log("fleet", kind="manifest", batch_id="b0",
+                requests=[{"request_id": "req-x", "tenant": "t0",
+                           "start": 0, "stop": 1}], tenants=["t0"],
+                n_points=1)
+        log.log("fit_start", model="RedcliffGridRunner", grid_size=1,
+                shape={"num_chans": 4})
+        log.log("fit_end")
+    os.makedirs(os.path.join(d, "results"))
+    with open(os.path.join(d, "results", "req-x.json"), "w") as f:
+        json.dump({"request_id": "req-x", "quality": None}, f)
+    rep = build_report(d)
+    assert rep["quality"]["requests"]["req-x"] is None
+    assert "request req-x: quality n/a" in render_text(rep)
