@@ -29,11 +29,19 @@ Extra context fields (so "fast" is judgeable against hardware capability):
   ckpt_stall_ms   — measured main-thread checkpoint cost on the headline
                     grid state: async hand-off (what the train loop now
                     stalls) vs the synchronous gather+write it replaced
-  bf16            — smallest g_scaling point re-measured with
-                    matmul_precision="bfloat16" (params f32, MXU passes
-                    bf16) and its wps ratio vs the same point's f32 scan —
-                    measured on EVERY backend (CPU emulates bf16, slower
-                    but never null)
+  mixed_precision — smallest g_scaling point re-measured under the
+                    PRODUCTION precision_mode="mixed" path (bf16 MXU
+                    contractions, f32 master params/reductions, numerics
+                    sentinel armed): wps_ratio_vs_f32 vs the same point's
+                    f32 scan + the sentinel skip count (precision-cliff
+                    evidence) — measured on EVERY backend (CPU emulates
+                    bf16, slower but never null). `bf16` stays as the
+                    legacy alias for trajectory continuity
+  autotune        — one fresh GL-prox tiling search (ops/autotune.py):
+                    search_ms, winner tile, measured speedup vs the default
+                    tile, and the zero-re-search persistence contract
+                    (winner_persisted: the second resolve loads the store's
+                    winner with 0 search steps)
   dead_lane_flops_saved_pct / compaction — elastic grid scheduler win
                     (parallel/compaction.py): on a seeded early-stopping
                     grid, the share of lane-epochs the live-lane compaction
@@ -499,7 +507,8 @@ def _model_config():
     )
 
 
-def _make_runner(jax, model, G, B, matmul_precision=None):
+def _make_runner(jax, model, G, B, matmul_precision=None,
+                 precision_mode="f32"):
     from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
     from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
 
@@ -510,7 +519,8 @@ def _make_runner(jax, model, G, B, matmul_precision=None):
     ])
     return RedcliffGridRunner(
         model, RedcliffTrainConfig(batch_size=B,
-                                   matmul_precision=matmul_precision),
+                                   matmul_precision=matmul_precision,
+                                   precision_mode=precision_mode),
         spec, mesh=None)
 
 
@@ -522,14 +532,15 @@ def _mfu_pct(scan_flops, scan_dispatch_s, peak):
 
 
 def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
-                scan_only=False):
+                precision_mode="f32", scan_only=False):
     """Per-batch and scanned throughput (+FLOPs) of the G-point grid step.
 
     scan_only skips the per-batch compile + timing (the scanned dispatch is
     the production execution mode and the headline number) — used by the
-    bf16 variant so it costs one compile, not two."""
+    mixed-precision variant so it costs one compile, not two."""
     cfg = model.config
-    runner = _make_runner(jax, model, G, B, matmul_precision=matmul_precision)
+    runner = _make_runner(jax, model, G, B, matmul_precision=matmul_precision,
+                          precision_mode=precision_mode)
     rng = np.random.default_rng(0)
     T = cfg.max_lag + cfg.num_sims
     X = jax.device_put(rng.normal(size=(B, T, cfg.num_chans)).astype(np.float32))
@@ -624,6 +635,9 @@ def _bench_grid(jax, model, G, B, steps, scan_k, matmul_precision=None,
         "scan_compile": scan_compile,
         "compile_args": compile_args,
         "epoch_wps": epoch_wps,
+        # final sentinel counters after the timed dispatches (the
+        # mixed-precision probe reports guarded skips from these)
+        "nstate": ns,
         "runner": runner, "state": (p, a, b, coeffs, X, Y),
     }
 
@@ -767,6 +781,45 @@ def _bench_compile_cache(jax, runner, compile_args):
         "warm_cache_hits": d["cache_hits"],
         "warm_cache_misses": d["cache_misses"],
     }
+
+
+def _bench_autotune(jax):
+    """autotune probe (ISSUE 14, ops/autotune.py): one fresh iterative
+    GL-prox tiling search at the bench model's first-layer group shape —
+    search cost, the winner tile, its measured speedup over the default
+    tile — then the zero-re-search contract: the winner must load from the
+    persisted store with zero search steps on a second resolve. A throwaway
+    store dir per round keeps search_ms a *measured* family instead of a
+    cache hit."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.ops import autotune
+
+    cfg = _model_config()
+    rows = cfg.num_factors * cfg.num_chans * cfg.num_chans
+    cols = cfg.gen_hidden[0] * cfg.gen_lag
+    tmp = tempfile.mkdtemp(prefix="bench_autotune_")
+    try:
+        autotune.clear_memo()
+        br, rec = autotune.tune_gl_prox(rows, cols, base_dir=tmp, reps=3,
+                                        force=True)
+        autotune.clear_memo()  # drop the memo: reuse must come from DISK
+        br2, rec2 = autotune.tune_gl_prox(rows, cols, base_dir=tmp)
+        autotune.drain_records()
+        return {
+            "kernel": "gl_prox", "rows": rows, "cols": cols,
+            "winner_block_rows": br,
+            "candidates": rec.get("candidates"),
+            "search_ms": rec.get("search_ms"),
+            "speedup_vs_default": rec.get("speedup_vs_default"),
+            "second_run_search_steps": rec2.get("search_steps"),
+            "winner_persisted": (br2 == br
+                                 and rec2.get("search_steps") == 0),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        autotune.clear_memo()  # no throwaway-store winners outlive the probe
 
 
 def _bench_obs_overhead(jax, runner, grid_state, steps=30, calls=4000):
@@ -1330,24 +1383,41 @@ def _measure(platform):
         if G == G_HEAD:
             headline = r
 
-    # bf16 at the SMALLEST measured g_scaling point, every backend (the CPU
-    # fallback emulates bf16 matmuls, slower but measured — the field is
-    # never null): params stay f32, matmul passes run bfloat16, the standard
-    # MXU speed/accuracy trade. Scan dispatch only (one compile); the ratio
-    # vs the same point's f32 wps_scan is the comparable
+    # mixed-precision probe (the promoted bf16 field, ISSUE 14): the
+    # SMALLEST measured g_scaling point re-run under the PRODUCTION
+    # precision_mode="mixed" path (bf16 MXU contractions, f32 master
+    # params/reductions, numerics sentinel armed), every backend (the CPU
+    # fallback emulates bf16 matmuls, slower but measured — never null).
+    # Scan dispatch only (one compile); wps_ratio_vs_f32 vs the same
+    # point's f32 wps_scan is the acceptance comparable, and the sentinel
+    # skip count is the precision-cliff evidence (0 = no cliff at this
+    # shape). `bf16` stays as the legacy alias so the BENCH_r* trajectory
+    # keeps comparing
     G_small = min(int(g) for g in g_scaling)
-    print(f"bench: measuring bf16 G={G_small}", file=sys.stderr, flush=True)
+    print(f"bench: measuring mixed precision G={G_small}", file=sys.stderr,
+          flush=True)
     try:
+        from redcliff_tpu.runtime.numerics import numerics_summary
+
         rb = _bench_grid(jax, model, G_small, B, steps, scan_k,
-                         matmul_precision="bfloat16", scan_only=True)
+                         precision_mode="mixed", scan_only=True)
         f32_wps = g_scaling[str(G_small)]["wps_scan"]
-        bf16 = {"grid_points": G_small,
-                "wps_scan": round(rb["scan_wps"], 1),
-                "ratio_vs_f32": (round(rb["scan_wps"] / f32_wps, 3)
+        skips = numerics_summary(rb["nstate"])["skipped"]
+        mixed_precision = {
+            "grid_points": G_small,
+            "wps_scan": round(rb["scan_wps"], 1),
+            "wps_ratio_vs_f32": (round(rb["scan_wps"] / f32_wps, 3)
                                  if f32_wps else None),
-                "mfu_pct": (_mfu_pct(rb["scan_flops"], rb["scan_dispatch_s"],
-                                     peak) if not on_cpu else None)}
-    except Exception as e:  # never fail the bench over the bf16 probe
+            "sentinel_skips": int(np.sum(skips)),
+            "mfu_pct": (_mfu_pct(rb["scan_flops"], rb["scan_dispatch_s"],
+                                 peak) if not on_cpu else None)}
+        bf16 = {"grid_points": G_small,
+                "wps_scan": mixed_precision["wps_scan"],
+                "ratio_vs_f32": mixed_precision["wps_ratio_vs_f32"],
+                "mfu_pct": mixed_precision["mfu_pct"]}
+    except Exception as e:  # never fail the bench over the precision probe
+        mixed_precision = {"error": f"{type(e).__name__}: {e}",
+                           "wps_ratio_vs_f32": None}
         bf16 = {"error": f"{type(e).__name__}: {e}"}
 
     seq_steps = max(steps // 3, 3)
@@ -1404,6 +1474,14 @@ def _measure(platform):
     except Exception as e:
         compile_cache = {"error": f"{type(e).__name__}: {e}",
                          "dir": compile_cache_dir}
+
+    # kernel-tiling autotune (ops/autotune.py): search cost + winner vs
+    # default-tile speedup + the zero-re-search persistence contract
+    try:
+        autotune_probe = _bench_autotune(jax)
+    except Exception as e:  # never fail the bench over the autotune probe
+        autotune_probe = {"error": f"{type(e).__name__}: {e}",
+                          "speedup_vs_default": None}
 
     # telemetry-spine overhead (redcliff_tpu/obs): tracing-on vs tracing-off
     # throughput through the engine's dispatch chokepoint, every round
@@ -1479,6 +1557,8 @@ def _measure(platform):
         "dispatches_per_epoch": dispatches_per_epoch,
         "ckpt_stall_ms": ckpt_stall_ms,
         "bf16": bf16,
+        "mixed_precision": mixed_precision,
+        "autotune": autotune_probe,
         "dead_lane_flops_saved_pct": compaction_probe.get(
             "dead_lane_flops_saved_pct"),
         "compaction": compaction_probe,

@@ -78,6 +78,13 @@ def _cmd_submit(args):
             print("fleet submit: no grid points (--points / --points-file "
                   "/ spec['points'])", file=sys.stderr)
             return 2
+    if getattr(args, "precision_mode", None):
+        # tenant-facing mixed-precision knob (ISSUE 14): rides the spec's
+        # train_config, so it joins the planner's merge key (requests that
+        # disagree on numerics never share a batch) and the batch driver's
+        # RedcliffTrainConfig verbatim
+        spec.setdefault("train_config", {})["precision_mode"] = \
+            args.precision_mode
     q = FleetQueue(args.root)
     rids = []
     with MetricLogger(args.root) as log:
@@ -225,6 +232,12 @@ def main(argv=None):
     sp.add_argument("--points", default=None,
                     help="grid points as a JSON list of hparam dicts")
     sp.add_argument("--points-file", default=None)
+    sp.add_argument("--precision-mode", default=None,
+                    choices=("f32", "mixed"),
+                    help="production precision mode for the fit "
+                         "(train_config.precision_mode; 'mixed' = bf16 "
+                         "MXU contractions under the numerics sentinel's "
+                         "auto-demotion watch)")
     sp.add_argument("--per-lane-bytes", type=int, default=None,
                     help="HBM per-lane hint for the admission planner "
                          "(obs/memory.py per_lane_bytes)")

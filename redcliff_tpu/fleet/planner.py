@@ -116,10 +116,20 @@ def _batch_view(members, n_devices, cost_model=None, platform=None,
     if cost_model is not None:
         try:
             from redcliff_tpu.obs.schema import shape_key as _sk
+            # precision half of the cost bucket: a mixed-precision batch
+            # must be priced from mixed-epoch evidence, not f32's (the
+            # merge key guarantees every member shares one train_config).
+            # utils.precision is jax-free at module scope — the planner's
+            # no-jax control-plane discipline holds
+            from redcliff_tpu.utils.precision import precision_label
 
+            tcd = (members[0].get("spec") or {}).get("train_config") or {}
             eta_s = cost_model.predict_fit_eta(
                 _sk(shape), width, epochs, platform=platform,
-                cold_programs=1)
+                cold_programs=1,
+                precision=precision_label(
+                    tcd.get("precision_mode") or "f32",
+                    tcd.get("matmul_precision")))
         except Exception:  # noqa: BLE001 — predictions are advisory
             eta_s = None
     n_dev = int(n_devices or 1)

@@ -29,6 +29,7 @@ from redcliff_tpu.models import clstm as clstm_mod
 from redcliff_tpu.models import cmlp as cmlp_mod
 from redcliff_tpu.models.embedders import build_embedder, CEmbedder, DGCNNEmbedder
 from redcliff_tpu.ops import losses as L
+from redcliff_tpu.ops.factor_mix import factor_mix
 
 __all__ = ["RedcliffSCMLPConfig", "RedcliffSCMLP", "TRAINING_MODES", "GC_EST_MODES",
            "phase_schedule"]
@@ -266,7 +267,9 @@ class RedcliffSCMLP:
                 weightings = fixed_weightings
             label_preds.append(logits if logits is not None else weightings)
             preds = self._factor_step(params, window)  # (K, B, 1, C)
-            combined = jnp.einsum("bk,kbtc->btc", weightings, preds)
+            # fused factor-mix (ops/factor_mix.py): Pallas kernel on real
+            # TPU, the exact historical einsum everywhere else
+            combined = factor_mix(weightings, preds)
             sims.append(combined)
             fw_preds.append(weightings)
             factor_preds.append(preds)
@@ -293,7 +296,7 @@ class RedcliffSCMLP:
             per_factor_sims.append(preds)
             win = jnp.concatenate([win[:, :, preds.shape[2] :, :], preds], axis=2)
         factor_sims = jnp.concatenate(per_factor_sims, axis=2)  # (K, B, S, C)
-        x_sims = jnp.einsum("bk,kbsc->bsc", weightings, factor_sims)
+        x_sims = factor_mix(weightings, factor_sims)
         return x_sims, per_factor_sims, [weightings], label_preds
 
     # ---------------------------------------------------------------------- GC
@@ -582,6 +585,25 @@ class RedcliffSCMLP:
             factor_pretrain_loss=(phase in ("factor_pretrain", "post_train")),
             coeffs=coeffs, need_gc=need_gc, need_gc_lagged=need_gc_lagged,
         )
+
+    # ------------------------------------------------------------------- prox
+    def apply_prox(self, params, lam, lr, penalty="GL"):
+        """GISTA-style proximal update on the stacked factor first-layer
+        block (K, C_out, H, C_in, L) — the trainers'/grid engine's
+        ``prox_penalty`` production path. GL dispatches through the fused
+        Pallas TPU kernel (ops/pallas_prox.py; jnp reference off-TPU and
+        for GSGL/H). cMLP factors only: a cLSTM factor has no lag-
+        structured first-layer block to group."""
+        if self.config.factor_network_type != "cMLP":
+            raise ValueError(
+                "apply_prox requires cMLP factor networks (the GL group "
+                "structure lives in the lagged first-layer block)")
+        from redcliff_tpu.ops.pallas_prox import gl_prox
+
+        factors = params["factors"]
+        new_w = gl_prox(factors[0]["w"], lam, lr, penalty)
+        new_factors = [dict(factors[0], w=new_w)] + list(factors[1:])
+        return dict(params, factors=new_factors)
 
     # -------------------------------------------------------- factor alignment
     def permute_factors(self, params, order):

@@ -17,15 +17,18 @@ it ACCUMULATES across runs, restarts, and tenants exactly like the compiled
 programs it prices. One JSON object::
 
     {"version": 1, "updated_at": <wall>, "runs": <n folds>,
-     "buckets": {"<platform>|<shape_key>|g<width>": {
-         "platform", "shape", "g_bucket",
+     "buckets": {"<platform>|<shape_key>|g<width>|<precision>": {
+         "platform", "shape", "g_bucket", "precision",
          "epochs", "epoch_ms_total",           # step-cost accumulators
          "compiles", "compile_ms_total",       # compile-cost accumulators
          "cache_hits", "cache_misses", "runs", "updated_at"}}}
 
 Buckets are keyed by backend platform too — a CPU epoch and a TPU epoch of
 the same program family are different costs, and mixing them would wreck
-both predictions. Updates are read-modify-write under a best-effort
+both predictions — and by the matmul-precision label (ISSUE 14 satellite:
+bf16 and f32 epoch costs previously merged into one bucket and poisoned
+ETAs/planner ordering; legacy precision-less keys backfill to "f32" on
+read, since every pre-precision fit trained at the backend default). Updates are read-modify-write under a best-effort
 ``flock`` with an atomic replace, so concurrent fits (grid lanes under the
 supervisor, parallel test children) merge instead of clobbering. The store
 is bounded (:data:`MAX_BUCKETS`, oldest-updated evicted) and ADVISORY:
@@ -73,9 +76,17 @@ MAX_BUCKETS = 512
 _lock = threading.Lock()
 
 
-def bucket_key(platform, shape_key, g_bucket):
-    """The store's bucket id: ``<platform>|<shape_key>|g<width>``."""
-    return f"{platform}|{shape_key}|g{int(g_bucket)}"
+def bucket_key(platform, shape_key, g_bucket, precision="f32"):
+    """The store's bucket id:
+    ``<platform>|<shape_key>|g<width>|<precision>``.
+
+    ``precision`` is the matmul-precision label of the epochs being priced
+    (utils/precision.py ``precision_label``: "f32" | "mixed" | raw string).
+    Without it, bf16 and f32 epoch costs of the same program family merged
+    into one bucket and poisoned every ETA and planner ordering (ISSUE 14
+    satellite); legacy 3-segment keys are backfilled to "f32" on read —
+    every pre-precision fit trained at the backend default."""
+    return f"{platform}|{shape_key}|g{int(g_bucket)}|{precision or 'f32'}"
 
 
 def store_path(base_dir=None):
@@ -95,9 +106,29 @@ def _empty_store():
             "buckets": {}}
 
 
+def _backfill_precision(store):
+    """Normalize pre-precision buckets in place: a 3-segment legacy key
+    (``platform|shape|gN``) becomes ``platform|shape|gN|f32`` and the
+    bucket gains ``precision: "f32"`` — every fit recorded before the
+    precision axis existed trained at the backend default."""
+    buckets = store["buckets"]
+    for key in list(buckets):
+        b = buckets[key]
+        if not isinstance(b, dict):
+            continue
+        if "precision" not in b:
+            b["precision"] = "f32"
+        want = bucket_key(b.get("platform"), b.get("shape"),
+                          b.get("g_bucket") or 0, b["precision"])
+        if key != want and want not in buckets:
+            buckets[want] = buckets.pop(key)
+    return store
+
+
 def _read_store(path):
     """Parse a store file; None on missing/corrupt/wrong-version (the store
-    is advisory — a bad file means 'no model', never an exception)."""
+    is advisory — a bad file means 'no model', never an exception).
+    Legacy precision-less buckets are backfilled to "f32" on read."""
     try:
         with open(path) as f:
             store = json.load(f)
@@ -107,7 +138,7 @@ def _read_store(path):
             and store.get("version") == STORE_VERSION
             and isinstance(store.get("buckets"), dict)):
         return None
-    return store
+    return _backfill_precision(store)
 
 
 class CostModel:
@@ -138,13 +169,19 @@ class CostModel:
                    - float(self.updated_at), 0.0)
 
     # ------------------------------------------------------------------
-    def _candidates(self, shape_key, platform):
-        """Buckets matching (platform?, shape), best-sampled first."""
+    def _candidates(self, shape_key, platform, precision="f32"):
+        """Buckets matching (platform?, shape, precision), best-sampled
+        first. Precision is part of the cost identity: a bf16 epoch and an
+        f32 epoch of the same program family must never predict each
+        other."""
         out = []
         for b in self.buckets.values():
             if b.get("shape") != shape_key:
                 continue
             if platform is not None and b.get("platform") != platform:
+                continue
+            if precision is not None \
+                    and (b.get("precision") or "f32") != precision:
                 continue
             out.append(b)
         # best-sampled first; platform name breaks ties deterministically
@@ -152,25 +189,28 @@ class CostModel:
                                 str(b.get("platform"))))
         return out
 
-    def epoch_ms_mean(self, shape_key, g_bucket, platform=None):
+    def epoch_ms_mean(self, shape_key, g_bucket, platform=None,
+                      precision="f32"):
         """Mean observed epoch time for the EXACT bucket, or None."""
-        for b in self._candidates(shape_key, platform):
+        for b in self._candidates(shape_key, platform, precision):
             if int(b.get("g_bucket") or 0) == int(g_bucket) \
                     and (b.get("epochs") or 0) > 0:
                 return float(b["epoch_ms_total"]) / int(b["epochs"])
         return None
 
-    def predict_epoch_ms(self, shape_key, g_bucket, platform=None):
+    def predict_epoch_ms(self, shape_key, g_bucket, platform=None,
+                         precision="f32"):
         """Predicted wall ms for one epoch of ``shape_key`` at execution
         width ``g_bucket``: exact bucket mean, else the nearest-width
-        bucket of the same shape scaled linearly by the width ratio, else
-        None (no evidence)."""
-        exact = self.epoch_ms_mean(shape_key, g_bucket, platform=platform)
+        bucket of the same (shape, precision) scaled linearly by the width
+        ratio, else None (no evidence)."""
+        exact = self.epoch_ms_mean(shape_key, g_bucket, platform=platform,
+                                   precision=precision)
         if exact is not None:
             return exact
         want = int(g_bucket)
         best = None
-        for b in self._candidates(shape_key, platform):
+        for b in self._candidates(shape_key, platform, precision):
             w = int(b.get("g_bucket") or 0)
             n = int(b.get("epochs") or 0)
             if w <= 0 or n <= 0:
@@ -184,14 +224,15 @@ class CostModel:
         _, w, mean_ms = best
         return mean_ms * (want / w)
 
-    def predict_compile_ms(self, shape_key, g_bucket, platform=None):
+    def predict_compile_ms(self, shape_key, g_bucket, platform=None,
+                           precision="f32"):
         """Predicted wall ms of ONE cold compile of the bucket's program
         family (exact bucket, else nearest-width same-shape unscaled —
         compile cost is dominated by the program, not the lane count), or
         None."""
         want = int(g_bucket)
         best = None
-        for b in self._candidates(shape_key, platform):
+        for b in self._candidates(shape_key, platform, precision):
             n = int(b.get("compiles") or 0)
             if n <= 0:
                 continue
@@ -203,17 +244,19 @@ class CostModel:
         return best[1] if best is not None else None
 
     def predict_fit_eta(self, shape_key, g_bucket, epochs, platform=None,
-                        cold_programs=0):
+                        cold_programs=0, precision="f32"):
         """Predicted wall SECONDS for ``epochs`` epochs of ``shape_key`` at
         width ``g_bucket`` plus ``cold_programs`` cold compiles; None when
         the model has no step-cost evidence for the shape."""
-        em = self.predict_epoch_ms(shape_key, g_bucket, platform=platform)
+        em = self.predict_epoch_ms(shape_key, g_bucket, platform=platform,
+                                   precision=precision)
         if em is None:
             return None
         eta_ms = em * max(int(epochs), 0)
         if cold_programs:
             cm = self.predict_compile_ms(shape_key, g_bucket,
-                                         platform=platform)
+                                         platform=platform,
+                                         precision=precision)
             if cm is not None:
                 eta_ms += cm * int(cold_programs)
         return eta_ms / 1e3
@@ -229,6 +272,7 @@ class CostModel:
             rows.append({
                 "bucket": key, "platform": b.get("platform"),
                 "shape": b.get("shape"), "g_bucket": b.get("g_bucket"),
+                "precision": b.get("precision") or "f32",
                 "epochs": n,
                 "mean_epoch_ms": (round(b["epoch_ms_total"] / n, 3)
                                   if n else None),
@@ -256,23 +300,29 @@ def load(base_dir=None):
     return CostModel(store, path=path)
 
 
-def fit_from_report(report, platform="any"):
+def fit_from_report(report, platform="any", precision="f32"):
     """In-memory model fit from one obs-report dict's ``cost_table`` (no
-    persistence) — offline training / tests."""
+    persistence) — offline training / tests. ``precision`` labels the
+    report's epochs (a mixed-precision run's report must say so, or its
+    bf16 costs would contaminate the f32 bucket)."""
     model = CostModel(_empty_store())
-    _merge_rows(model._store, _rows_from_cost_table(report), platform,
-                now=time.time())
+    _merge_rows(model._store,
+                _rows_from_cost_table(report, precision=precision),
+                platform, now=time.time())
     return model
 
 
 # ---------------------------------------------------------------------------
 # write side
 # ---------------------------------------------------------------------------
-def _rows_from_cost_table(report):
+def _rows_from_cost_table(report, precision="f32"):
     rows = []
     for r in (report or {}).get("cost_table") or []:
         rows.append({
             "shape": r.get("shape"), "g_bucket": r.get("g_bucket"),
+            # per-row label when the report carries one (future reports),
+            # else the caller's fit-level label
+            "precision": r.get("precision") or precision,
             "epochs": r.get("epochs") or 0,
             "epoch_ms": r.get("total_epoch_ms") or 0.0,
             "compiles": r.get("compiles") or 0,
@@ -283,11 +333,13 @@ def _rows_from_cost_table(report):
     return rows
 
 
-def rows_from_dispatch_stats(shape_key, stats):
+def rows_from_dispatch_stats(shape_key, stats, precision="f32"):
     """Store-update rows from one fit's ``dispatch_stats``: one row per
     execution width from the exact per-width accumulators; the fit-level
     compile/cache totals attach to the WIDEST row (cold compiles happen at
     the fit's starting bucket, before compaction shrinks it).
+    ``precision`` stamps the rows' matmul-precision label so mixed and f32
+    epochs land in distinct buckets.
 
     Each width's FIRST epoch is excluded when more epochs exist: it
     carries the compile / cache-priming skew (measured 20x the steady
@@ -310,7 +362,8 @@ def rows_from_dispatch_stats(shape_key, stats):
             n -= 1
             total -= float(first)
         rows.append({
-            "shape": shape_key, "g_bucket": w, "epochs": n,
+            "shape": shape_key, "g_bucket": w, "precision": precision,
+            "epochs": n,
             "epoch_ms": total,
             "compiles": int(stats.get("compiles") or 0) if i == 0 else 0,
             "compile_ms": float(stats.get("compile_ms") or 0.0)
@@ -329,12 +382,14 @@ def _merge_rows(store, rows, platform, now):
         if not shape or not width or not (r.get("epochs")
                                           or r.get("compiles")):
             continue
-        key = bucket_key(platform, shape, width)
+        precision = r.get("precision") or "f32"
+        key = bucket_key(platform, shape, width, precision)
         b = store["buckets"].get(key)
         if b is None:
             b = store["buckets"][key] = {
                 "platform": platform, "shape": shape,
-                "g_bucket": int(width), "epochs": 0, "epoch_ms_total": 0.0,
+                "g_bucket": int(width), "precision": precision,
+                "epochs": 0, "epoch_ms_total": 0.0,
                 "compiles": 0, "compile_ms_total": 0.0, "cache_hits": 0,
                 "cache_misses": 0, "runs": 0}
         b["epochs"] += int(r.get("epochs") or 0)
@@ -410,8 +465,12 @@ def update_store(base_dir, rows, platform, now=None):
                 os.close(lock_fd)  # closing drops the flock
 
 
-def update_store_from_report(base_dir, report, platform, now=None):
+def update_store_from_report(base_dir, report, platform, now=None,
+                             precision="f32"):
     """Fold one obs-report's cost table into the persistent store — the
-    offline "train the model from a finished run's telemetry" path."""
-    return update_store(base_dir, _rows_from_cost_table(report), platform,
-                       now=now)
+    offline "train the model from a finished run's telemetry" path.
+    ``precision`` labels the report's epochs (read it off the run's
+    ``fit_start.precision_mode``)."""
+    return update_store(base_dir,
+                        _rows_from_cost_table(report, precision=precision),
+                        platform, now=now)

@@ -100,6 +100,21 @@ FAMILIES = [
     Family("vs_baseline"),
     Family("mfu_pct"),
     Family("bf16.ratio_vs_f32"),
+    # the promoted mixed-precision probe (ISSUE 14): the production
+    # precision_mode="mixed" wps ratio vs f32 at the same grid point —
+    # a kernel/precision regression fails the round like a throughput one.
+    # sentinel_skips is judged as lower-is-better with an absolute floor:
+    # an occasional skip is the guard working, a growing count is a cliff
+    Family("mixed_precision.wps_ratio_vs_f32"),
+    Family("mixed_precision.sentinel_skips", better="lower",
+           band=_BAND_TIMING, abs_floor=3.0, g_dependent=False),
+    # kernel-tiling autotune (ops/autotune.py): the winner's measured edge
+    # over the default tile must not erode, and the per-round fresh search
+    # must stay cheap (it runs on every fit's first encounter of a shape)
+    Family("autotune.speedup_vs_default", band=_BAND_TIMING,
+           g_dependent=False),
+    Family("autotune.search_ms", better="lower", band=_BAND_TIMING,
+           abs_floor=2000.0, g_dependent=False),
     Family("dead_lane_flops_saved_pct", band=_BAND_TIMING),
     # cost probes: lower is better, with absolute floors for timing dust
     Family("ckpt_stall_ms.async_ms", better="lower", band=_BAND_TIMING,

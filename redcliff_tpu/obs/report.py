@@ -148,6 +148,8 @@ def build_report(run_dir):
     profiles = []         # capture-window artifacts (`profile` events)
     compactions, remeshes, failures, hangs = [], [], [], []
     anomalies = rollbacks = aborts = skipped_steps = 0
+    precision_events = []  # mixed-precision demotions (ISSUE 14)
+    autotune_events = []   # kernel-tiling searches/lookups (ops/autotune.py)
     quarantined = 0
     stats_sum = {k: 0 for k in _SUM_STATS}
     t_first = t_last = None
@@ -284,6 +286,15 @@ def build_report(run_dir):
                 rollbacks += 1
             elif rec.get("kind") == "abort":
                 aborts += 1
+        elif ev == "precision":
+            precision_events.append({k: rec.get(k) for k in
+                                     ("kind", "epoch", "cause", "mode_from",
+                                      "mode_to", "lanes")})
+        elif ev == "autotune":
+            autotune_events.append({k: rec.get(k) for k in
+                                    ("kernel", "kind", "shape", "g_bucket",
+                                     "tile", "search_ms",
+                                     "speedup_vs_default")})
         elif ev == "fit_end":
             ds = rec.get("dispatch_stats")
             # quality snapshot: inside dispatch_stats for the grid engine,
@@ -571,6 +582,8 @@ def build_report(run_dir):
                      "rollbacks": rollbacks, "aborts": aborts,
                      "quarantined_lanes": quarantined,
                      "failures": failures},
+        "precision": precision_events,
+        "autotune": autotune_events,
         "hang_incidents": hangs,
         "flight_records": sorted(
             os.path.basename(p) for p in
@@ -795,6 +808,17 @@ def render_text(report):
                f"{n['guarded_steps_skipped']} guarded step(s) skipped, "
                f"{n['rollbacks']} rollback(s), {n['aborts']} abort(s), "
                f"{n['quarantined_lanes']} quarantined lane(s)")
+    for p in r.get("precision") or []:
+        out.append(f"  precision {p.get('kind')}: "
+                   f"{p.get('mode_from')}->{p.get('mode_to')} at epoch "
+                   f"{p.get('epoch')} ({p.get('cause') or 'resume'})")
+    for a in r.get("autotune") or []:
+        tile = a.get("tile") or {}
+        out.append(f"  autotune {a.get('kind') or 'search'} "
+                   f"{a.get('kernel')}[{a.get('shape')} g{a.get('g_bucket')}]"
+                   f": tile={tile}"
+                   + (f" search {a['search_ms']:.0f}ms"
+                      if a.get("search_ms") else ""))
     if r["hang_incidents"]:
         out.append(f"hang/host-loss incidents: {len(r['hang_incidents'])} "
                    f"(flight records: {r['flight_records'] or 'none'})")

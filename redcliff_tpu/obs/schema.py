@@ -137,7 +137,7 @@ EVENTS = {
         optional=("train_config", "resume_epoch", "training_mode", "shape",
                   "grid_size", "grid_width", "lanes_padded", "stream_mode",
                   "mesh", "compile_cache_dir", "resumed_from_epoch",
-                  "resumed_from", "points", "max_iter")),
+                  "resumed_from", "points", "max_iter", "precision_mode")),
     "epoch": _ev(
         "trainers + grid engine",
         required=("epoch",),
@@ -170,6 +170,24 @@ EVENTS = {
                   # stamp it directly; the grid engine carries it inside
                   # dispatch_stats["quality"]
                   "quality")),
+    "precision": _ev(
+        "trainers + grid engine (mixed-precision production path, ISSUE "
+        "14: kind=demote — the numerics sentinel caught a skip/rollback "
+        "storm under precision_mode='mixed' and the fit rebuilt every "
+        "step at f32; kind=resume_demoted — a resumed fit honored the "
+        "checkpointed demotion instead of re-promoting)",
+        required=("kind", "epoch"),
+        optional=("cause", "mode_from", "mode_to", "lanes", "grid_width",
+                  "rollbacks") + _NUMERICS_SUMMARY),
+    "autotune": _ev(
+        "trainers + grid engine (ops/autotune.py kernel-tiling search/"
+        "lookup records: kind=search — a measured candidate-ladder search "
+        "ran and persisted a winner beside the compile cache; kind=reuse "
+        "— a persisted winner was loaded with zero search steps)",
+        required=("kernel",),
+        optional=("kind", "platform", "shape", "g_bucket", "tile",
+                  "candidates", "search_ms", "search_steps",
+                  "speedup_vs_default")),
     "compile": _ev(
         "grid engine (runtime/compileobs.py counters)",
         required=("epoch", "programs", "compile_ms"),
@@ -389,7 +407,12 @@ NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
                   "fleet/chaos.py", "fleet/__main__.py",
                   "fleet/history.py")
-LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py", "obs/quality.py")
+# ops/autotune.py joins the lazy set (ISSUE 14): its store half must stay
+# importable by backend-free processes, and its measurement half must sync
+# via jax.device_get — a block_until_ready inside the tuner would be a
+# banned device sync on what is effectively an observability path
+LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py", "obs/quality.py",
+                    "ops/autotune.py")
 
 
 def _pkg_root():

@@ -1,0 +1,450 @@
+"""Iterative kernel-tiling autotuner with a persistent, fleet-shared store.
+
+The Pallas hot-path kernels (ops/pallas_prox.py GL prox, ops/factor_mix.py
+factor mix) expose one tiling knob each (``block_rows`` / ``block_b``).
+Picking it by hand is exactly the problem the iterative-search line of work
+(*AutoKernel*; *Learning to Optimize Tensor Programs* — PAPERS.md) solves by
+measuring a small candidate ladder and keeping the winner; this module is
+that loop, sized for this repo's kernels:
+
+* **Search** (:func:`tune`): measure every candidate of a ladder (median of
+  ``reps`` timed runs, synced via ``jax.device_get`` — never a
+  ``block_until_ready`` device sync, per the observability lint) and keep
+  the fastest. The ladder is evaluated in a FIXED order and ties break to
+  the first (smallest) candidate, so the same measurements always produce
+  the same winner.
+* **Store**: winners persist as ``autotune_v<VERSION>.json`` beside the
+  compile cache (``REDCLIFF_AUTOTUNE_DIR`` override, else the
+  ``REDCLIFF_COMPILE_CACHE`` base dir — the same resolution as the PR-8
+  cost model), keyed per ``(platform, kernel, shape, G-bucket)``.
+  Read-modify-write under a best-effort ``flock`` with atomic replace;
+  corrupt or wrong-version stores degrade to "no winner" (defaults), never
+  to an error on a training path. A fleet of workers tunes once and
+  inherits the winner everywhere, exactly like the persistent compile
+  cache the store lives beside.
+* **Zero re-search**: :func:`winner` / :func:`tune` consult an in-process
+  memo first and the store second — a second fit with the same
+  (platform, kernel, shape, G-bucket) performs zero search steps (the CI
+  smoke leg pins this).
+
+``REDCLIFF_AUTOTUNE=0`` disables searching (stored winners are still
+read); searching also requires a resolvable store dir so throwaway
+processes don't burn measurement time on winners nobody will reuse —
+unless the caller passes an explicit ``base_dir``.
+
+Every search/lookup appends a record to a process-level ring that engines
+drain into schema-registered ``autotune`` events (:func:`drain_records`),
+so fits show which tilings they ran and what the search cost.
+
+jax only inside function bodies (lazy-jax lint module): the store half is
+stdlib and must stay importable by backend-free processes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["STORE_VERSION", "STORE_NAME", "ENV_STORE_DIR", "ENV_ENABLE",
+           "MAX_WINNERS", "winner_key", "store_path", "search_enabled",
+           "load_store", "winner", "tuned_tile", "record_winner", "tune",
+           "drain_records", "clear_memo", "gl_prox_ladder",
+           "measure_gl_prox", "tune_gl_prox", "factor_mix_ladder",
+           "measure_factor_mix", "tune_factor_mix", "tune_for_model"]
+
+STORE_VERSION = 1
+STORE_NAME = f"autotune_v{STORE_VERSION}.json"
+ENV_STORE_DIR = "REDCLIFF_AUTOTUNE_DIR"
+ENV_CACHE_DIR = "REDCLIFF_COMPILE_CACHE"  # literal on purpose: no runtime
+#                                           import from this stdlib half
+ENV_ENABLE = "REDCLIFF_AUTOTUNE"
+MAX_WINNERS = 512
+
+_lock = threading.Lock()
+# in-process caches: key -> winner record (hot-path lookups must not re-read
+# JSON per traced kernel call), plus the drained-event ring
+_memo: dict = {}
+_records: list = []
+
+
+def winner_key(platform, kernel, shape_key, g_bucket):
+    """The store's winner id: ``<platform>|<kernel>|<shape>|g<bucket>``."""
+    return f"{platform}|{kernel}|{shape_key}|g{int(g_bucket)}"
+
+
+def store_path(base_dir=None):
+    """Resolve the store file path (``REDCLIFF_AUTOTUNE_DIR`` override, else
+    the compile-cache base dir), or None when no base dir is known."""
+    base = (base_dir or os.environ.get(ENV_STORE_DIR)
+            or os.environ.get(ENV_CACHE_DIR) or None)
+    if not base:
+        return None
+    if str(base).endswith(".json"):
+        return str(base)
+    return os.path.join(base, STORE_NAME)
+
+
+def search_enabled():
+    """True unless ``REDCLIFF_AUTOTUNE`` explicitly disables searching."""
+    return os.environ.get(ENV_ENABLE, "1") not in ("0", "off", "false")
+
+
+def _empty_store():
+    return {"version": STORE_VERSION, "updated_at": None, "runs": 0,
+            "winners": {}}
+
+
+def _read_store(path):
+    """Parse a store file; None on missing/corrupt/wrong-version — the
+    corrupt-store->defaults discipline shared with the PR-8 cost model."""
+    try:
+        with open(path) as f:
+            store = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not (isinstance(store, dict)
+            and store.get("version") == STORE_VERSION
+            and isinstance(store.get("winners"), dict)):
+        return None
+    return store
+
+
+def load_store(base_dir=None):
+    """The persisted store dict (or an empty one), plus its path."""
+    path = store_path(base_dir)
+    if path is None or not os.path.exists(path):
+        return _empty_store(), path
+    return _read_store(path) or _empty_store(), path
+
+
+def clear_memo():
+    """Drop the in-process winner memo (tests; store-dir changes)."""
+    with _lock:
+        _memo.clear()
+
+
+def drain_records():
+    """Pop every pending search/lookup record (engines log these as
+    schema-registered ``autotune`` events)."""
+    with _lock:
+        out = list(_records)
+        _records.clear()
+    return out
+
+
+def _note(record):
+    with _lock:
+        _records.append(record)
+        del _records[:-64]  # bounded ring
+
+
+def winner(kernel, shape_key, g_bucket, platform=None, base_dir=None):
+    """The persisted winner record for a bucket (memo -> store), or None.
+    Misses are memoized too — a traced kernel call must never re-read JSON
+    per trace (record_winner refreshes the memo after a search). The memo
+    is keyed by the RESOLVED store path as well: a lookup against one
+    store can never replay a winner (or a miss) cached from another."""
+    platform = platform or _platform()
+    key = winner_key(platform, kernel, shape_key, g_bucket)
+    path = store_path(base_dir)
+    with _lock:
+        if (path, key) in _memo:
+            return _memo[(path, key)]
+    store, _path = load_store(base_dir)
+    rec = store["winners"].get(key)
+    with _lock:
+        _memo[(path, key)] = rec
+    return rec
+
+
+def tuned_tile(kernel, shape_key, size, field, default):
+    """The one winner-unpack helper every kernel's hot-path lookup shares:
+    the persisted winner's ``tile[field]`` for (kernel, shape, pow2 bucket
+    of ``size``), else ``default``. Lookup only — searches run from the
+    engines/bench via the tune_* entry points, never inside a traced
+    kernel call."""
+    rec = winner(kernel, shape_key, _pow2_bucket(size))
+    if rec is not None:
+        try:
+            return int(rec["tile"][field])
+        except (KeyError, TypeError, ValueError):
+            pass
+    return default
+
+
+def record_winner(kernel, shape_key, g_bucket, tile, platform=None,
+                  base_dir=None, search_ms=None, candidates=None,
+                  speedup_vs_default=None, now=None):
+    """Persist a winner — read-modify-write under a best-effort flock with
+    an atomic replace (concurrent fits merge instead of clobbering).
+    Returns the winner record (memoized even when no store dir resolves,
+    so the current process still reuses it)."""
+    platform = platform or _platform()
+    now = time.time() if now is None else now
+    key = winner_key(platform, kernel, shape_key, g_bucket)
+    rec = {"kernel": kernel, "platform": platform, "shape": shape_key,
+           "g_bucket": int(g_bucket), "tile": dict(tile),
+           "search_ms": (round(float(search_ms), 3)
+                         if search_ms is not None else None),
+           "candidates": candidates,
+           "speedup_vs_default": (round(float(speedup_vs_default), 3)
+                                  if speedup_vs_default is not None
+                                  else None),
+           "runs": 1, "updated_at": now}
+    path = store_path(base_dir)
+    with _lock:
+        _memo[(path, key)] = rec
+    if path is None:
+        return rec
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with _lock:
+        lock_fd = None
+        try:
+            try:
+                import fcntl
+            except ImportError:
+                fcntl = None
+            if fcntl is not None:
+                try:
+                    lock_fd = os.open(path + ".lock",
+                                      os.O_CREAT | os.O_WRONLY)
+                except OSError:
+                    lock_fd = None
+                if lock_fd is not None:
+                    try:
+                        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+                    except OSError:
+                        os.close(lock_fd)
+                        lock_fd = None
+            store = _read_store(path) or _empty_store()
+            prior = store["winners"].get(key)
+            if prior is not None:
+                rec = dict(rec, runs=int(prior.get("runs") or 0) + 1)
+            store["winners"][key] = rec
+            # bound the store: evict the longest-unobserved winners
+            winners = store["winners"]
+            if len(winners) > MAX_WINNERS:
+                by_age = sorted(winners, key=lambda k:
+                                winners[k].get("updated_at") or 0.0)
+                for k in by_age[: len(winners) - MAX_WINNERS]:
+                    del winners[k]
+            store["updated_at"] = now
+            store["runs"] = int(store.get("runs") or 0) + 1
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(store, f, indent=1, allow_nan=False)
+                f.write("\n")
+            os.replace(tmp, path)
+            _memo[(path, key)] = rec
+        finally:
+            if lock_fd is not None:
+                os.close(lock_fd)  # closing drops the flock
+    return rec
+
+
+def _platform():
+    import jax
+
+    return jax.default_backend()
+
+
+def tune(kernel, shape_key, g_bucket, candidates, measure, default=None,
+         platform=None, base_dir=None, reps=3, force=False):
+    """Resolve the tile for a kernel bucket: persisted winner when one
+    exists (zero search steps), else an iterative measured search over the
+    candidate ladder.
+
+    ``candidates`` is the FIXED-ORDER ladder of tile dicts;
+    ``measure(tile)`` returns seconds for one kernel invocation at that
+    tile (the caller owns data synthesis + the ``jax.device_get`` sync);
+    ``default`` marks the no-autotune tile so the winner's
+    ``speedup_vs_default`` can be reported. Ties break deterministically
+    to the earliest candidate. Returns ``(tile, record)`` where record
+    carries ``searched``/``search_ms``/``search_steps``."""
+    platform = platform or _platform()
+    rec = winner(kernel, shape_key, g_bucket, platform=platform,
+                 base_dir=base_dir)
+    if rec is not None and not force:
+        out = dict(rec, searched=False, search_steps=0)
+        _note({"kernel": kernel, "kind": "reuse", "platform": platform,
+               "shape": shape_key, "g_bucket": int(g_bucket),
+               "tile": rec["tile"], "search_steps": 0})
+        return dict(rec["tile"]), out
+    if not force and not (search_enabled()
+                          and (base_dir or store_path() is not None)):
+        # searching disabled (or no store to persist into): default tile
+        tile = dict(default or candidates[0])
+        return tile, {"tile": tile, "searched": False, "search_steps": 0,
+                      "search_ms": None, "reason": "search_disabled"}
+    t0 = time.perf_counter()
+    timed = []
+    default_s = None
+    for tile in candidates:
+        samples = sorted(measure(dict(tile)) for _ in range(max(reps, 1)))
+        med = samples[len(samples) // 2]
+        timed.append((med, tile))
+        if default is not None and dict(tile) == dict(default):
+            default_s = med
+    if default is not None and default_s is None:
+        # default tile off the ladder (clipped by the shape): time it too so
+        # the winner's speedup-vs-default is always reportable
+        samples = sorted(measure(dict(default)) for _ in range(max(reps, 1)))
+        default_s = samples[len(samples) // 2]
+    best_s, best_tile = min(timed, key=lambda t: t[0])  # stable: first wins
+    search_ms = (time.perf_counter() - t0) * 1e3
+    speedup = (default_s / best_s if default_s and best_s else None)
+    rec = record_winner(kernel, shape_key, g_bucket, best_tile,
+                        platform=platform, base_dir=base_dir,
+                        search_ms=search_ms, candidates=len(candidates),
+                        speedup_vs_default=speedup)
+    out = dict(rec, searched=True, search_steps=len(timed))
+    _note({"kernel": kernel, "kind": "search", "platform": platform,
+           "shape": shape_key, "g_bucket": int(g_bucket),
+           "tile": rec["tile"], "candidates": len(candidates),
+           "search_ms": rec["search_ms"],
+           "speedup_vs_default": rec["speedup_vs_default"],
+           "search_steps": len(timed)})
+    return dict(best_tile), out
+
+
+# ---------------------------------------------------------------------------
+# kernel-specific ladders + measurement closures
+# ---------------------------------------------------------------------------
+def gl_prox_ladder(rows):
+    """block_rows candidates for the GL-prox kernel: a power-of-two ladder
+    clipped to the row count's pow2 bucket (so the single-block tile always
+    competes; small shapes get small ladders)."""
+    cap = _pow2_bucket(max(rows, 64))
+    ladder = [r for r in (64, 128, 256, 512, 1024) if r <= cap]
+    if not ladder:
+        ladder = [64]
+    return [{"block_rows": r} for r in ladder]
+
+
+def measure_gl_prox(rows, cols, interpret=None):
+    """A ``measure(tile)`` closure timing one fused GL-prox pass over a
+    synthetic ``(rows, cols)``-group block (``jax.device_get`` sync)."""
+    import jax
+    import numpy as np
+
+    from redcliff_tpu.ops import pallas_prox
+
+    rng = np.random.default_rng(0)
+    # gl_prox_pallas unpacks (*lead, H, C_in, L) and flattens to
+    # (prod(lead)*C_in, H*L): a (rows, cols, 1, 1) block — H=cols, C_in=1,
+    # L=1 — is exactly the (rows, cols) group problem the winner is keyed
+    # for (a (rows, 1, cols, 1) block would degenerate to rows*cols
+    # single-element groups and tune the wrong workload)
+    W = jax.numpy.asarray(
+        rng.normal(size=(rows, cols, 1, 1)).astype(np.float32))
+
+    def measure(tile):
+        run = jax.jit(lambda w: pallas_prox.gl_prox_pallas(
+            w, 0.01, 0.002, block_rows=tile["block_rows"],
+            interpret=interpret))
+        jax.device_get(run(W))  # compile + warm outside the timed call
+        t0 = time.perf_counter()
+        jax.device_get(run(W))
+        return time.perf_counter() - t0
+
+    return measure
+
+
+def tune_gl_prox(rows, cols, platform=None, base_dir=None, interpret=None,
+                 reps=3, force=False):
+    """Tune (or reuse) the GL-prox ``block_rows`` for a ``(rows, cols)``
+    group block; returns ``(block_rows, record)``."""
+    tile, rec = tune(
+        "gl_prox", f"cols{int(cols)}", _pow2_bucket(rows),
+        gl_prox_ladder(rows), measure_gl_prox(rows, cols,
+                                              interpret=interpret),
+        default={"block_rows": 512}, platform=platform, base_dir=base_dir,
+        reps=reps, force=force)
+    return int(tile["block_rows"]), rec
+
+
+def factor_mix_ladder(batch):
+    """block_b candidates for the factor-mix kernel (pow2 ladder up to the
+    batch's bucket, single-block tile included)."""
+    cap = _pow2_bucket(max(batch, 8))
+    ladder = [b for b in (8, 16, 32, 64, 128) if b <= cap]
+    if not ladder:
+        ladder = [8]
+    return [{"block_b": b} for b in ladder]
+
+
+def measure_factor_mix(batch, k, m, interpret=None):
+    """A ``measure(tile)`` closure timing one fused factor-mix pass over a
+    synthetic ``(B=batch, K=k, M=m)`` problem."""
+    import jax
+    import numpy as np
+
+    from redcliff_tpu.ops import factor_mix as fm
+
+    rng = np.random.default_rng(0)
+    w = jax.numpy.asarray(rng.random((batch, k)).astype(np.float32))
+    p = jax.numpy.asarray(
+        rng.normal(size=(k, batch, 1, m)).astype(np.float32))
+
+    def measure(tile):
+        run = jax.jit(lambda wa, pa: fm.factor_mix_pallas(
+            wa, pa, block_b=tile["block_b"], interpret=interpret))
+        jax.device_get(run(w, p))
+        t0 = time.perf_counter()
+        jax.device_get(run(w, p))
+        return time.perf_counter() - t0
+
+    return measure
+
+
+def tune_factor_mix(batch, k, m, platform=None, base_dir=None,
+                    interpret=None, reps=3, force=False):
+    """Tune (or reuse) the factor-mix ``block_b`` for a (B, K, M) problem;
+    returns ``(block_b, record)``."""
+    tile, rec = tune(
+        "factor_mix", f"k{int(k)}m{int(m)}", _pow2_bucket(batch),
+        factor_mix_ladder(batch), measure_factor_mix(batch, k, m,
+                                                     interpret=interpret),
+        default={"block_b": 32}, platform=platform, base_dir=base_dir,
+        reps=reps, force=force)
+    return int(tile["block_b"]), rec
+
+
+def _pow2_bucket(n):
+    """Bucket a size onto the power-of-two ladder (the same discipline as
+    the grid's G-bucket), so near-identical shapes share one winner."""
+    n = max(int(n), 1)
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def tune_for_model(model_config, batch_size, prox_penalty=None,
+                   base_dir=None):
+    """Tune (or reuse) every hot-path kernel tiling a REDCLIFF-S fit of
+    this shape will dispatch — the ONE shape-math site both engines call
+    from their constructors on real TPU hardware (the first fit of a
+    (platform, shape, G-bucket) searches once; later fits and fleet
+    siblings sharing the store reuse the winner with zero search steps).
+    No-op off-TPU / when searching is disabled; advisory — never fatal."""
+    if _platform() != "tpu" or not search_enabled():
+        return
+    cfg = model_config
+    try:
+        if (prox_penalty == "GL"
+                and getattr(cfg, "factor_network_type", None) == "cMLP"):
+            # the stacked first-layer block (K, C_out, H, C_in, L) flattens
+            # to K*C_out*C_in group rows of H*L columns per lane
+            rows = cfg.num_factors * cfg.num_series * cfg.num_series
+            tune_gl_prox(rows, cfg.gen_hidden[0] * cfg.gen_lag,
+                         base_dir=base_dir)
+        sims = (cfg.num_sims if cfg.forward_pass_mode
+                == "apply_factor_weights_after_sim_completion" else 1)
+        tune_factor_mix(int(batch_size), cfg.num_factors,
+                        sims * cfg.num_series, base_dir=base_dir)
+    except Exception:  # noqa: BLE001 — tuning is advisory, never fatal
+        pass

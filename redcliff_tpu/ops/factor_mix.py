@@ -1,0 +1,139 @@
+"""Fused factor-mix kernel: embedder-weighted mixing of per-factor predictions.
+
+The REDCLIFF-S forward ends every sim step with the mixture
+
+    combined[b, t, c] = sum_k weightings[b, k] * preds[k, b, t, c]
+
+(models/redcliff.py ``jnp.einsum("bk,kbtc->btc", ...)`` — the
+embedder-softmax-weighted sum of the K per-factor one-step predictions).
+Stock XLA emits a broadcast-multiply + reduce with an HBM round trip between
+them at grid scale; the Pallas kernel here keeps each batch block VMEM-
+resident and contracts K in one pass on the MXU.
+
+Contract (the same discipline as ops/pallas_prox.py):
+
+* :func:`factor_mix_reference` is the EXACT pre-existing einsum — the
+  non-TPU production path and the bit-parity anchor. ``precision_mode="f32"``
+  fits on CPU/GPU trace byte-identical graphs to a build that never heard
+  of this module.
+* :func:`factor_mix_pallas` is the fused kernel; parity vs the reference is
+  pinned BITWISE in f32 interpret mode (tests/test_parallel_grid.py).
+  It carries a ``jax.custom_vjp`` (the training step differentiates through
+  the mix): the backward pass stays jnp — two small einsums — so gradients
+  are exact while the fused forward rides the hot path.
+* :func:`factor_mix` dispatches: Pallas on real TPU hardware (killable via
+  ``REDCLIFF_FACTOR_MIX_PALLAS=0``), the reference everywhere else.
+
+``block_b`` defaults to the persisted autotune winner for this
+(platform, (K, M), B-bucket) when one exists (ops/autotune.py), else 32.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from redcliff_tpu.ops import autotune as _autotune
+
+__all__ = ["factor_mix", "factor_mix_reference", "factor_mix_pallas",
+           "DEFAULT_BLOCK_B", "ENV_DISABLE"]
+
+DEFAULT_BLOCK_B = 32
+ENV_DISABLE = "REDCLIFF_FACTOR_MIX_PALLAS"
+# f32 sublane multiple on the compiled TPU path; interpret mode keeps
+# exact batch counts so parity tests see the unpadded reduction
+_SUBLANE = 8
+
+
+def factor_mix_reference(weightings, preds):
+    """The jnp reference: ``einsum("bk,kbtc->btc")`` — byte-identical to the
+    historical in-model expression (the bit-parity anchor)."""
+    return jnp.einsum("bk,kbtc->btc", weightings, preds)
+
+
+def _factor_mix_kernel(w_ref, p_ref, out_ref):
+    # w (TB, K); p (K, TB, M); out (TB, M): batched mat-vec contracting K,
+    # f32 accumulation on the MXU
+    out_ref[:] = jax.lax.dot_general(
+        w_ref[:], p_ref[:],
+        dimension_numbers=(((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+
+
+def _tuned_block_b(batch, k, m):
+    return _autotune.tuned_tile("factor_mix", f"k{int(k)}m{int(m)}", batch,
+                                "block_b", DEFAULT_BLOCK_B)
+
+
+def _mix_fwd_impl(weightings, preds, block_b, interpret):
+    K, B, T, C = preds.shape
+    M = T * C
+    flat = jnp.reshape(preds, (K, B, M))
+    if block_b is None:
+        block_b = _tuned_block_b(B, K, M)
+    tb = max(min(int(block_b), B), 1)
+    if not interpret:
+        tb = -(-tb // _SUBLANE) * _SUBLANE
+    pad = (-B) % tb
+    w = weightings
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+        flat = jnp.pad(flat, ((0, 0), (0, pad), (0, 0)))
+    n_blocks = w.shape[0] // tb
+    out = pl.pallas_call(
+        _factor_mix_kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((tb, K), lambda i: (i, 0)),
+            pl.BlockSpec((K, tb, M), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tb, M), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((w.shape[0], M), flat.dtype),
+        interpret=interpret,
+    )(w, flat)
+    if pad:
+        out = out[:B]
+    return jnp.reshape(out, (B, T, C))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _factor_mix_vjp(weightings, preds, block_b, interpret):
+    return _mix_fwd_impl(weightings, preds, block_b, interpret)
+
+
+def _mix_fwd(weightings, preds, block_b, interpret):
+    return _mix_fwd_impl(weightings, preds, block_b, interpret), (weightings,
+                                                                  preds)
+
+
+def _mix_bwd(block_b, interpret, res, g):
+    # exact jnp backward: d w[b,k] = sum_{t,c} g[b,t,c] p[k,b,t,c];
+    # d p[k,b,t,c] = w[b,k] g[b,t,c]
+    weightings, preds = res
+    dw = jnp.einsum("btc,kbtc->bk", g, preds)
+    dp = jnp.einsum("bk,btc->kbtc", weightings, g)
+    return dw, dp
+
+
+_factor_mix_vjp.defvjp(_mix_fwd, _mix_bwd)
+
+
+def factor_mix_pallas(weightings, preds, block_b=None, interpret=None):
+    """Fused mix via Pallas: ``weightings (B, K)``, ``preds (K, B, T, C)``
+    -> ``(B, T, C)``. Differentiable (custom VJP; jnp backward)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _factor_mix_vjp(weightings, preds, block_b, bool(interpret))
+
+
+def factor_mix(weightings, preds):
+    """Production dispatch: the fused Pallas kernel on real TPU hardware
+    (``REDCLIFF_FACTOR_MIX_PALLAS=0`` kills it back to the reference), the
+    exact reference einsum everywhere else."""
+    if (jax.default_backend() == "tpu"
+            and os.environ.get(ENV_DISABLE, "1") not in ("0", "off")):
+        return factor_mix_pallas(weightings, preds)
+    return factor_mix_reference(weightings, preds)

@@ -8,6 +8,21 @@ update is one VMEM-resident pass per row-block: groups are rows of a
 row reduction on the VPU and the rescale is elementwise — no HBM round-trip
 between the reduction and the scale.
 
+Production routing (ISSUE 14): every prox path — the cmlp_fm baseline, the
+REDCLIFF-S trainers' ``prox_penalty`` knob, and the grid engine's vmapped
+per-lane prox — dispatches through :func:`gl_prox`, so real-TPU fits run
+this kernel while the jnp implementation stays the bit-parity anchor and
+the non-TPU path (real-chip parity pinned at max abs err 5e-7 on v5e, r05).
+
+Tiling: ``block_rows`` defaults to the persisted autotune winner for this
+(platform, cols, G-bucket) when one exists (ops/autotune.py — searched once
+per fleet, reused everywhere beside the compile cache), else 512. Row
+counts that do not divide the tile are zero-padded up to it (padded rows
+are sliced off after the call; real rows' math is row-independent, so
+padding never moves a real result), and on the compiled TPU path the tile
+is rounded up to the f32 sublane multiple so off-tile first-layer shapes
+compile instead of falling back.
+
 Falls back to interpret mode off-TPU (tests run on the CPU mesh) and to the
 jnp implementation for shapes where the kernel buys nothing.
 """
@@ -18,9 +33,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from redcliff_tpu.ops import autotune as _autotune
 from redcliff_tpu.ops.prox import prox_update as _jnp_prox_update
 
-__all__ = ["gl_prox_pallas", "gl_prox"]
+__all__ = ["gl_prox_pallas", "gl_prox", "DEFAULT_BLOCK_ROWS"]
+
+DEFAULT_BLOCK_ROWS = 512
+# f32 sublane multiple on the compiled TPU path (pallas_guide.md tiling
+# constraints); interpret mode keeps exact row counts
+_SUBLANE = 8
 
 
 def _gl_prox_kernel(thresh_ref, w_ref, out_ref):
@@ -30,11 +51,19 @@ def _gl_prox_kernel(thresh_ref, w_ref, out_ref):
     out_ref[:] = (w / jnp.maximum(norm, thresh)) * jnp.maximum(norm - thresh, 0.0)
 
 
-def gl_prox_pallas(W1, lam, lr, block_rows=512, interpret=None):
+def _tuned_block_rows(rows, cols):
+    """The persisted autotune winner for this (platform, cols, row-bucket),
+    else the default (lookup only; searches run from the engines/bench)."""
+    return _autotune.tuned_tile("gl_prox", f"cols{int(cols)}", rows,
+                                "block_rows", DEFAULT_BLOCK_ROWS)
+
+
+def gl_prox_pallas(W1, lam, lr, block_rows=None, interpret=None):
     """GL proximal update on a first-layer block (..., H, C_in, L) via Pallas.
 
     Groups are (out-axis..., C_in) with elements over (H, L), matching the GL
     penalty structure. Returns the updated block with the input layout.
+    ``block_rows=None`` resolves the autotuned winner (ops/autotune.py).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -45,9 +74,18 @@ def gl_prox_pallas(W1, lam, lr, block_rows=512, interpret=None):
     for d in lead:
         G *= d
     G *= C
-    flat = Wt.reshape(G, H * Lg)
-    rows = min(block_rows, G)
-    # pad rows to a multiple of the block
+    cols = H * Lg
+    flat = Wt.reshape(G, cols)
+    if block_rows is None:
+        block_rows = _tuned_block_rows(G, cols)
+    rows = max(min(int(block_rows), G), 1)
+    if not interpret:
+        # compiled TPU path: round the tile UP to the f32 sublane multiple
+        # so off-tile row counts (G < 8, odd G) compile instead of erroring;
+        # the extra rows are zero padding, masked off by the slice below
+        rows = -(-rows // _SUBLANE) * _SUBLANE
+    # pad rows to a multiple of the block (zero rows: row-independent math,
+    # sliced away after the call)
     pad = (-G) % rows
     if pad:
         flat = jnp.pad(flat, ((0, pad), (0, 0)))
@@ -59,9 +97,9 @@ def gl_prox_pallas(W1, lam, lr, block_rows=512, interpret=None):
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((rows, H * Lg), lambda i: (i, 0)),
+            pl.BlockSpec((rows, cols), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((rows, H * Lg), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((rows, cols), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype),
         interpret=interpret,
     )(thresh, flat)

@@ -77,8 +77,10 @@ from redcliff_tpu.obs import costmodel as _costmodel
 from redcliff_tpu.obs import memory as _obsmem
 from redcliff_tpu.obs import profiling as _profiling
 from redcliff_tpu.obs import quality as _quality
+from redcliff_tpu.ops import autotune as _autotune
 from redcliff_tpu.train.freeze import apply_freeze
-from redcliff_tpu.utils.precision import matmul_precision_ctx
+from redcliff_tpu.utils.precision import (matmul_precision_ctx,
+                                          resolve_matmul_precision)
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
 
@@ -131,8 +133,19 @@ class GridSpec:
     fit_deadline_s: Any = None   # scalar | per-point sequence | None
     grid_deadline_s: float | None = None
     lane_seeds: Sequence[int] | None = None
+    # production precision mode for THIS grid ("f32" | "mixed"); None
+    # inherits RedcliffTrainConfig.precision_mode. "mixed" runs bf16 MXU
+    # contractions with f32 master params/reductions under the numerics
+    # sentinel's watch (a skip storm auto-demotes the whole grid to f32 —
+    # `precision` event). Part of the resume fingerprint: the mode changes
+    # every step's update math
+    precision_mode: str | None = None
 
     def __post_init__(self):
+        if self.precision_mode is not None:
+            from redcliff_tpu.utils.precision import check_precision_mode
+
+            check_precision_mode(self.precision_mode)
         valid = set(COEFF_AXES) | set(OPT_AXES) | set(STOP_AXES)
         for i, p in enumerate(self.points):
             unknown = set(p) - valid
@@ -307,7 +320,41 @@ class RedcliffGridRunner:
         # lr/eps handled per-point; scale_by_adam is shared
         self.optA = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.embed_eps)
         self.optB = optax.scale_by_adam(b1=0.9, b2=0.999, eps=train_config.gen_eps)
+        # production precision mode (utils/precision.py): the spec override
+        # wins, else the train config. "mixed" grids are DEMOTABLE — a
+        # sentinel skip storm rebuilds every program at f32 mid-fit
+        # (`precision` event) and persists the demotion in the checkpoint
+        self._precision_mode = (spec.precision_mode
+                                or getattr(train_config, "precision_mode",
+                                           "f32"))
+        self._precision = resolve_matmul_precision(
+            self._precision_mode,
+            getattr(train_config, "matmul_precision", None))
+        self._demotable = (self._precision_mode == "mixed" and self._guard
+                           and self._precision is not None)
+        self._demoted = False
         self._build()
+        self._maybe_tune_kernels()
+
+    def _maybe_tune_kernels(self):
+        """Autotune the hot-path Pallas tilings for this grid's shapes on
+        real TPU hardware (the shared shape-math lives in
+        ops/autotune.py:tune_for_model). No-op off-TPU / when
+        REDCLIFF_AUTOTUNE=0."""
+        _autotune.tune_for_model(self.model.config, self.tc.batch_size,
+                                 prox_penalty=getattr(self.tc,
+                                                      "prox_penalty", None))
+
+    def _demote_to_f32(self):
+        """Rebuild every grid program at f32 — the sentinel-triggered
+        precision demotion. The caller logs the `precision` event and
+        resets the consecutive-skip counters."""
+        self._precision = None
+        self._demoted = True
+        self._build()
+        # the rebuilt jit wrappers are new programs: let their first
+        # dispatch run under the op-scoped compile heartbeat again
+        self._seen_programs = None
 
     # ------------------------------------------------------------------
     def _opt_states(self, params):
@@ -348,7 +395,9 @@ class RedcliffGridRunner:
         need_gc, need_gc_lagged = self._need_gc, self._need_gc_lagged
         guard = self._guard
 
-        precision = self.tc.matmul_precision
+        precision = self._precision
+        prox_pen = getattr(self.tc, "prox_penalty", None)
+        prox_lam = getattr(self.tc, "prox_lam", 0.0)
 
         def point_step(params, optA_state, optB_state, nstate, coeffs, active,
                        X, Y, phase):
@@ -393,6 +442,18 @@ class RedcliffGridRunner:
                 new["factors"], optB_state = apply_group(
                     "factors", grads["factors"], self.optB, optB_state,
                     coeffs["gen_lr"], coeffs["gen_weight_decay"])
+                if prox_pen is not None:
+                    # GISTA prox on the factor first-layer block after the
+                    # gradient step (GL rides the fused Pallas kernel on
+                    # real TPUs, ops/pallas_prox.py); the lane gate keeps
+                    # frozen/guarded lanes' params untouched — a prox of an
+                    # unchanged iterate would still shrink it
+                    proxed = model.apply_prox(new, prox_lam,
+                                              coeffs["gen_lr"],
+                                              prox_pen)["factors"]
+                    new["factors"] = jax.tree.map(
+                        lambda a, b: jnp.where(gate, a, b), proxed,
+                        new["factors"])
             return new, optA_state, optB_state, nstate, combo
 
         def point_val(params, coeffs, X, Y):
@@ -753,6 +814,14 @@ class RedcliffGridRunner:
             # break the bit-identity promise mid-stream (ADVICE r5 audit:
             # the one update-math knob the PR-3 fingerprint missed)
             "matmul_precision": tc.matmul_precision,
+            # the production precision mode is the same class of knob: a
+            # resumed fit can never silently change numerics (a mid-fit
+            # sentinel DEMOTION is state, not config — the checkpoint's
+            # precision_demoted flag carries it, the fingerprint does not)
+            "precision_mode": self._precision_mode,
+            # prox knobs change the factor update math every step
+            "prox": {"penalty": getattr(tc, "prox_penalty", None),
+                     "lam": getattr(tc, "prox_lam", 0.0)},
             # the numerics guard gates every update and decides lane
             # quarantine, so a changed/disabled policy is a different fit
             "numerics": (None if tc.numerics is None
@@ -774,7 +843,7 @@ class RedcliffGridRunner:
     # fingerprint; the obs report CLI joins it with metrics.jsonl)
     _HOST_STATE_KEYS = ("epoch", "aligned", "rng_state", "val_history",
                         "val_eras", "eras", "orig_ids", "retired", "mesh",
-                        "dispatch_stats")
+                        "dispatch_stats", "precision_demoted")
 
     @staticmethod
     def _hostify(snap, meta, to_host):
@@ -800,6 +869,9 @@ class RedcliffGridRunner:
         # mesh resumes on 4 devices (and vice versa) without rejection
         host["mesh"] = snap.get("mesh")
         host["dispatch_stats"] = snap.get("dispatch_stats")
+        # sentinel-triggered precision demotion (mixed -> f32): state, not
+        # fingerprint — a resume rebuilds its programs at f32
+        host["precision_demoted"] = bool(snap.get("precision_demoted"))
         rows = [to_host(v) for v in snap["val_history"]]
         host["val_history"] = list(compaction.expand_history(
             rows, snap["val_eras"], snap["eras"], len(meta["points"])))
@@ -919,6 +991,17 @@ class RedcliffGridRunner:
             # is what every such checkpoint trained under, so resuming under
             # the default is sound — a non-default precision still rejects
             want_meta.pop("matmul_precision")
+        if ("precision_mode" not in meta
+                and want_meta.get("precision_mode") == "f32"):
+            # pre-mixed-precision checkpoint: every such fit trained at the
+            # backend default, which is exactly what precision_mode="f32"
+            # means — resuming under the default is sound; "mixed" rejects
+            want_meta.pop("precision_mode")
+        if "prox" not in meta and want_meta.get("prox") == {
+                "penalty": None, "lam": 0.0}:
+            # pre-prox checkpoint: no fit ever applied a prox before the
+            # knob existed, so resuming with prox OFF is sound
+            want_meta.pop("prox")
         if "lane_seeds" not in meta:
             # pre-containment checkpoint: written before per-lane content
             # seeds joined the fingerprint. Lane seeds are consulted ONLY
@@ -1165,6 +1248,12 @@ class RedcliffGridRunner:
             failed_cause = self._shard(jnp.asarray(fc, jnp.int32))
             rng.bit_generator.state = ckpt["rng_state"]
             start_it = ckpt["epoch"] + 1
+            if ckpt.get("precision_demoted") and self._demotable \
+                    and not self._demoted:
+                # the checkpointed fit demoted mixed -> f32 mid-run; resume
+                # must rebuild its programs at f32 before the first dispatch
+                # (never silently re-promote)
+                self._demote_to_f32()
         else:
             # init_params: pre-stacked (G, ...) state from
             # init_grid/init_grid_from. Copy caller-supplied arrays by
@@ -1417,8 +1506,25 @@ class RedcliffGridRunner:
         cm_platform = jax.default_backend()
         cost_model = _costmodel.load(cm_base) if cm_base else None
         cm_shape_key = obs.schema.shape_key(self._shape_desc())
+        # precision half of the cost bucket (obs/costmodel.py): bf16 and
+        # f32 epochs of the same program family are different costs — a
+        # demoted fit folds/predicts under "f32" from the demotion on
+        from redcliff_tpu.utils.precision import precision_label as _plabel
+
+        cm_precision0 = _plabel(self._precision_mode,
+                                getattr(tc, "matmul_precision", None))
         cm_n = 0          # residual samples scored this fit
         cm_abs_pct = 0.0  # running sum of |residual_pct| (MAPE numerator)
+        # per-width accumulators frozen at a mid-fit demotion: epochs before
+        # it fold into the "mixed" cost bucket, epochs after into "f32".
+        # demote_compile_snap splits the compile accumulators at the same
+        # boundary (the f32 rebuild's recompiles belong to the f32 era), and
+        # demote_first_f32 records the first post-demotion epoch per width —
+        # it carries the rebuild's compile skew and must be excluded from
+        # the f32 bucket mean exactly like a width's first epoch
+        demote_snap = demote_compile_snap = None
+        demote_pending = False
+        demote_first_f32 = {}
         logger = MetricLogger(log_dir)
         if wd is not None:
             # hang incidents land in THIS fit's metrics.jsonl
@@ -1431,7 +1537,17 @@ class RedcliffGridRunner:
                    compile_cache_dir=jax.config.jax_compilation_cache_dir,
                    resumed_from_epoch=start_it - 1 if ckpt else None,
                    resumed_from=ck_src,
+                   precision_mode=self._precision_mode,
                    points=list(self.spec.points))
+        # kernel-tiling searches/lookups performed at construction
+        # (ops/autotune.py) land as schema-registered events in THIS fit's
+        # metrics chain
+        for atrec in _autotune.drain_records():
+            logger.log("autotune", **atrec)
+        if self._demoted and start_it > 0:
+            logger.log("precision", kind="resume_demoted",
+                       epoch=start_it - 1, mode_from="mixed",
+                       mode_to="f32", grid_width=Gx)
         if remesh_info is not None:
             # structured re-mesh event: which mesh the checkpoint came from,
             # which it landed on, how many lanes migrated, plan latency
@@ -1652,6 +1768,12 @@ class RedcliffGridRunner:
             stats["epochs_by_width"][wkey] = (
                 stats["epochs_by_width"].get(wkey, 0) + 1)
             stats["first_epoch_ms_by_width"].setdefault(wkey, epoch_ms)
+            if demote_pending:
+                # the first epoch after a mid-fit demotion: its wall time
+                # includes the f32 rebuild's recompiles — excluded from the
+                # f32 cost bucket like any width's first epoch
+                demote_first_f32[wkey] = epoch_ms
+                demote_pending = False
             cdelta = obs.counters.delta(counters_t0)
             stats["prefetch_stall_ms"] = cdelta.get("prefetch_stall_ms", 0.0)
             stats["prefetch_items"] = int(cdelta.get("prefetch_items", 0))
@@ -1681,6 +1803,48 @@ class RedcliffGridRunner:
                 grad_implicated = (nstate["skipped"] - epoch_skip_base) > 0
             else:
                 grad_implicated = jnp.zeros_like(active)
+            if self._demotable and not self._demoted and self._guard:
+                # precision-cliff watch (mixed mode only): a lane stuck on
+                # an in-graph SKIP STORM — max_consecutive_skips straight
+                # non-finite-gradient steps, the bf16-contraction signature
+                # — blames bf16 before blaming the lane. The whole grid
+                # demotes to f32 (rebuilt programs, `precision` event) and
+                # the stuck lanes get one f32 epoch before quarantine can
+                # re-judge them; a plain validation blow-up with finite
+                # steps (the classic bad-lr divergence) quarantines
+                # normally even in mixed mode — it carries no bf16
+                # evidence. A lane that keeps storming at f32 quarantines
+                # within max_consecutive_skips further epochs, so the
+                # worst case of misattribution is one grid recompile.
+                # Costs one small device->host transfer per epoch, paid
+                # only by mixed-mode fits
+                hit = np.asarray(gather_to_host(jnp.logical_and(
+                    jnp.logical_and(active, grad_implicated),
+                    nstate["consecutive"] >= self._numerics_k)))
+                if bool(hit.any()):
+                    nhost = numerics.numerics_summary(nstate)
+                    self._demote_to_f32()
+                    nstate = numerics.reset_consecutive(nstate)
+                    # freeze the mixed era's cost accumulators (this epoch
+                    # ran bf16 and is already folded in) so the store fold
+                    # below can split the two precision eras; the compile
+                    # counters split at the same boundary
+                    demote_snap = {
+                        k: dict(stats[k])
+                        for k in ("epochs_by_width", "epoch_ms_by_width",
+                                  "first_epoch_ms_by_width")}
+                    demote_compile_snap = compileobs.delta(compile_t0)
+                    demote_pending = True
+                    logger.log(
+                        "precision", kind="demote", epoch=it,
+                        cause="precision_cliff",
+                        mode_from="mixed", mode_to="f32", grid_width=Gx,
+                        lanes=[int(orig_ids[g])
+                               for g in np.flatnonzero(hit)],
+                        skipped=nhost["skipped"],
+                        consecutive=nhost["consecutive"])
+                    bad = jnp.zeros_like(bad)
+                    grad_implicated = jnp.zeros_like(active)
             newly_failed = jnp.logical_and(active, bad)
             failed_epoch = jnp.where(newly_failed, jnp.int32(it), failed_epoch)
             failed_cause = jnp.where(
@@ -1866,7 +2030,9 @@ class RedcliffGridRunner:
                 steady_epoch = stats["epochs_by_width"].get(wkey, 0) > 1
                 if steady_epoch and cost_model is not None:
                     pred_ms = cost_model.predict_epoch_ms(
-                        cm_shape_key, Gx, platform=cm_platform)
+                        cm_shape_key, Gx, platform=cm_platform,
+                        precision=("f32" if self._demoted
+                                   else cm_precision0))
                     if pred_ms is not None:
                         cm_src = "store"
                 if pred_ms is None:
@@ -2059,6 +2225,7 @@ class RedcliffGridRunner:
                     "val_history": val_history, "val_eras": val_eras,
                     "eras": eras, "orig_ids": orig_ids, "retired": retired,
                     "aligned": aligned, "mesh": mesh_desc,
+                    "precision_demoted": self._demoted,
                     # telemetry snapshot for the obs report CLI (deep copy:
                     # the live dict keeps mutating under the async writer)
                     "dispatch_stats": copy.deepcopy(stats),
@@ -2185,10 +2352,50 @@ class RedcliffGridRunner:
         # it lives beside. Advisory: a store failure must never fail a fit
         if cm_base and jax.process_index() == 0:
             try:
-                _costmodel.update_store(
-                    cm_base,
-                    _costmodel.rows_from_dispatch_stats(cm_shape_key, stats),
-                    platform=cm_platform)
+                if self._demoted and demote_snap is not None:
+                    # per-era fold: epochs before the demotion ran mixed,
+                    # epochs after ran f32 — each era lands in its own
+                    # precision bucket, with the compile accumulators split
+                    # at the same boundary (the f32 rebuild's recompiles
+                    # belong to the f32 era, the fit's cold compiles to the
+                    # mixed one)
+                    csnap = demote_compile_snap or {}
+                    cm_rows = _costmodel.rows_from_dispatch_stats(
+                        cm_shape_key, {**stats, **demote_snap, **csnap},
+                        precision=cm_precision0)
+                    post = {
+                        "epochs_by_width": {
+                            w: n - demote_snap["epochs_by_width"].get(w, 0)
+                            for w, n in stats["epochs_by_width"].items()},
+                        "epoch_ms_by_width": {
+                            w: ms
+                            - demote_snap["epoch_ms_by_width"].get(w, 0.0)
+                            for w, ms
+                            in stats["epoch_ms_by_width"].items()},
+                        # first-epoch (compile-skew) exclusion: widths born
+                        # after the demotion keep their own firsts, and the
+                        # first post-demotion epoch (the rebuild's
+                        # recompile cost) is excluded the same way
+                        "first_epoch_ms_by_width": {
+                            **{w: v for w, v in
+                               stats["first_epoch_ms_by_width"].items()
+                               if w not in
+                               demote_snap["first_epoch_ms_by_width"]},
+                            **demote_first_f32},
+                        **{k: stats.get(k, 0) - csnap.get(k, 0)
+                           for k in ("compiles", "compile_ms",
+                                     "cache_hits", "cache_misses")},
+                    }
+                    cm_rows += _costmodel.rows_from_dispatch_stats(
+                        cm_shape_key, post, precision="f32")
+                else:
+                    # a fit that RESUMED already-demoted ran f32 throughout
+                    cm_rows = _costmodel.rows_from_dispatch_stats(
+                        cm_shape_key, stats,
+                        precision=("f32" if self._demoted
+                                   else cm_precision0))
+                _costmodel.update_store(cm_base, cm_rows,
+                                        platform=cm_platform)
             except Exception:  # noqa: BLE001 — best-effort telemetry fold
                 pass
 

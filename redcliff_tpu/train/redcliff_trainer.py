@@ -41,10 +41,13 @@ from redcliff_tpu.runtime import checkpoint as durable_ckpt
 from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
+from redcliff_tpu.ops import autotune as _autotune
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.train.tracking import GCProgressTracker
 from redcliff_tpu.utils.misc import factor_alignment_order
-from redcliff_tpu.utils.precision import matmul_precision_ctx
+from redcliff_tpu.utils.precision import (check_precision_mode,
+                                          matmul_precision_ctx,
+                                          resolve_matmul_precision)
 
 __all__ = ["RedcliffTrainConfig", "RedcliffTrainer", "RedcliffFitResult"]
 
@@ -79,8 +82,24 @@ class RedcliffTrainConfig:
     # matmul precision for every jit'd step (train/eval/label-pred/freeze,
     # forward + backward): None = backend default; "bfloat16" runs MXU
     # passes in bf16 (params stay f32) — the standard TPU speed/accuracy
-    # trade for models whose loss tolerates it
+    # trade for models whose loss tolerates it. Expert override; production
+    # fits use precision_mode below
     matmul_precision: str | None = None
+    # production precision mode (utils/precision.py): "f32" (default —
+    # bit-identical decision streams to a build without the knob) or
+    # "mixed" (bf16 MXU contractions, f32 master params/reductions) with
+    # the numerics sentinel watching the precision cliff: a skip/rollback
+    # storm auto-demotes the fit to f32 (schema-registered `precision`
+    # event; the demotion persists in the checkpoint so a resume can never
+    # silently re-promote). Part of the resume fingerprint
+    precision_mode: str = "f32"
+    # GISTA-style proximal update on the stacked factor first-layer block
+    # after each factor optimizer step ("GL" | "GSGL" | "H"; None = off).
+    # GL routes through the fused Pallas TPU kernel in production
+    # (ops/pallas_prox.py; jnp reference off-TPU). Update-math knobs: both
+    # join the resume fingerprint
+    prox_penalty: str | None = None
+    prox_lam: float = 0.0
     # grid engine only: drive lax.scan over groups of this many pre-staged
     # device-resident batches per dispatch (amortizes per-step dispatch
     # overhead at large G); <= 1 keeps the one-dispatch-per-batch path.
@@ -127,6 +146,13 @@ class RedcliffTrainConfig:
     # causes in the grid engine); None disables the sentinel
     numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
 
+    def __post_init__(self):
+        check_precision_mode(self.precision_mode)
+        if self.prox_penalty not in (None, "GL", "GSGL", "H"):
+            raise ValueError(
+                f"prox_penalty must be one of None/'GL'/'GSGL'/'H', got "
+                f"{self.prox_penalty!r}")
+
 
 @dataclass
 class RedcliffFitResult:
@@ -170,7 +196,32 @@ class RedcliffTrainer:
         self.optB = _torch_style_adam(config.gen_lr, config.gen_eps,
                                       config.gen_weight_decay)
         self._guard = config.numerics is not None and config.numerics.enabled
+        # effective matmul precision (utils/precision.py): the legacy
+        # matmul_precision knob wins, else precision_mode resolves it.
+        # "mixed" fits are DEMOTABLE: a sentinel skip/rollback storm rebuilds
+        # every step at f32 mid-fit and persists the demotion
+        self._precision = resolve_matmul_precision(config.precision_mode,
+                                                   config.matmul_precision)
+        self._demotable = (config.precision_mode == "mixed"
+                           and self._guard and self._precision is not None)
+        self._demoted = False
         self._steps = {}
+        self._build_steps()
+        self._maybe_tune_kernels()
+
+    def _maybe_tune_kernels(self):
+        """Autotune the hot-path Pallas tilings for this model's shapes on
+        real TPU hardware (the shared shape-math lives in
+        ops/autotune.py:tune_for_model). No-op off-TPU / when searching is
+        disabled."""
+        _autotune.tune_for_model(self.model.config, self.config.batch_size,
+                                 prox_penalty=self.config.prox_penalty)
+
+    def _demote_to_f32(self):
+        """Rebuild every jit'd step at f32 (the sentinel-triggered precision
+        demotion). Idempotent; the caller logs the `precision` event."""
+        self._precision = None
+        self._demoted = True
         self._build_steps()
 
     # ------------------------------------------------------------------ phases
@@ -181,9 +232,12 @@ class RedcliffTrainer:
     def _build_steps(self):
         model = self.model
 
-        precision = self.config.matmul_precision
+        precision = self._precision
 
         guard = self._guard
+        prox_pen = self.config.prox_penalty
+        prox_lam = self.config.prox_lam
+        prox_lr = self.config.gen_lr
 
         def make_step(phase):
             def step(params, optA_state, optB_state, X, Y, nstate):
@@ -215,6 +269,12 @@ class RedcliffTrainer:
                             embedder=optax.apply_updates(params["embedder"], updA),
                             factors=optax.apply_updates(params["factors"], updB),
                         )
+                    if (prox_pen is not None
+                            and phase != "embedder_pretrain"):
+                        # GISTA prox after the factor gradient step; GL
+                        # rides the fused Pallas kernel on real TPUs
+                        params = model.apply_prox(params, prox_lam,
+                                                  prox_lr, prox_pen)
                     return params, optA_state, optB_state
 
                 tree = (params, optA_state, optB_state)
@@ -380,6 +440,12 @@ class RedcliffTrainer:
             aligned = ck.get("aligned", False)
             if tracker is not None and ck.get("tracker_state") is not None:
                 tracker.__dict__.update(ck["tracker_state"])
+            if ck.get("precision_demoted") and self._demotable \
+                    and not self._demoted:
+                # the checkpointed fit already demoted to f32 mid-run; a
+                # resume must never silently re-promote to bf16 — rebuild
+                # the steps at f32 before the first dispatch
+                self._demote_to_f32()
             if factor_mesh is not None:
                 # checkpoints hold plain numpy: re-apply the factor sharding
                 # to every resumed tree or the run would silently continue
@@ -449,6 +515,14 @@ class RedcliffTrainer:
             logger.log("fit_start", model="RedcliffSCMLP", training_mode=mode,
                        shape=obs.schema.shape_desc(cfg),
                        train_config=tc, resume_epoch=iter_start)
+            # kernel-tiling searches/lookups performed at construction
+            # (ops/autotune.py) land as schema-registered events
+            for atrec in _autotune.drain_records():
+                logger.log("autotune", **atrec)
+            if self._demoted and iter_start > 0:
+                logger.log("precision", kind="resume_demoted",
+                           epoch=iter_start - 1, mode_from="mixed",
+                           mode_to="f32")
             # analytical HBM prediction (obs/memory.py): live params + best
             # + accepted copies + Adam moments + the device-batch dataset
             # cache — shape metadata only, no device work. extra_copies=2
@@ -581,6 +655,17 @@ class RedcliffTrainer:
                             learning_rates=numerics.current_learning_rates(
                                 (optA_state, optB_state)),
                             rollbacks=monitor.rollbacks)
+                        if self._demotable and not self._demoted:
+                            # the precision cliff: a mixed-mode fit whose
+                            # sentinel just rolled back auto-demotes to f32
+                            # — the restored snapshot continues under f32
+                            # steps, and the demotion persists in every
+                            # later checkpoint
+                            self._demote_to_f32()
+                            logger.log("precision", kind="demote", epoch=it,
+                                       cause=action.cause,
+                                       mode_from="mixed", mode_to="f32",
+                                       rollbacks=monitor.rollbacks, **nhost)
                         rolled_back = True
                     elif action.kind == "abort":
                         aborted = action.cause
@@ -863,5 +948,8 @@ class RedcliffTrainer:
                 "best_it": best_it,
                 "best_loss": float(best_loss),
                 "aligned": aligned,
+                # sentinel-triggered precision demotion (mixed -> f32):
+                # resumes rebuild their steps at f32 before dispatching
+                "precision_demoted": self._demoted,
                 "tracker_state": tracker_state,
             })

@@ -46,6 +46,9 @@ from redcliff_tpu.runtime import compileobs, faultinject, numerics
 from redcliff_tpu.runtime import watchdog as rt_watchdog
 from redcliff_tpu.runtime.numerics import NumericsPolicy
 from redcliff_tpu.train.tracking import GCProgressTracker
+from redcliff_tpu.utils.precision import (check_precision_mode,
+                                          matmul_precision_ctx,
+                                          resolve_matmul_precision)
 
 __all__ = ["TrainConfig", "Trainer", "FitResult", "save_model", "load_model"]
 
@@ -80,6 +83,16 @@ class TrainConfig:
     # numerical fault policy (in-graph skip guard + divergence rollback);
     # None disables the sentinel entirely
     numerics: NumericsPolicy | None = field(default_factory=NumericsPolicy)
+    # production precision mode (utils/precision.py): "f32" (default;
+    # bit-identical to a config without the knob) or "mixed" (bf16 MXU
+    # contractions, f32 master params/reductions). The numerics sentinel
+    # watches the cliff: a rollback under "mixed" auto-demotes the fit to
+    # f32 (schema-registered `precision` event, demotion persisted in the
+    # checkpoint so a resume can never silently re-promote)
+    precision_mode: str = "f32"
+
+    def __post_init__(self):
+        check_precision_mode(self.precision_mode)
 
 
 @dataclass
@@ -135,6 +148,18 @@ class Trainer:
         self.optimizer = optax.inject_hyperparams(optax.adam)(
             learning_rate=config.learning_rate)
         self._guard = config.numerics is not None and config.numerics.enabled
+        # effective matmul precision (utils/precision.py); "mixed" fits are
+        # demotable: a sentinel rollback rebuilds the steps at f32
+        self._precision = resolve_matmul_precision(config.precision_mode)
+        self._demotable = (config.precision_mode == "mixed" and self._guard
+                           and self._precision is not None)
+        self._demoted = False
+        self._build_steps()
+
+    def _demote_to_f32(self):
+        """Rebuild the jit'd steps at f32 (sentinel-triggered demotion)."""
+        self._precision = None
+        self._demoted = True
         self._build_steps()
 
     def _build_steps(self):
@@ -150,10 +175,12 @@ class Trainer:
             return model.loss(params, X)
 
         guard = self._guard
+        precision = self._precision
 
         def train_step(params, opt_state, X, Y, rng, nstate):
-            (combo, parts), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, X, Y, rng)
+            with matmul_precision_ctx(precision):
+                (combo, parts), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, X, Y, rng)
 
             def apply(tree):
                 p, o = tree
@@ -175,7 +202,8 @@ class Trainer:
             return params, opt_state, combo, parts, nstate
 
         def eval_step(params, X, Y):
-            return loss_fn(params, X, Y, None)
+            with matmul_precision_ctx(precision):
+                return loss_fn(params, X, Y, None)
 
         self._wants_rng = wants_rng
         self._train_step = jax.jit(train_step)
@@ -274,6 +302,11 @@ class Trainer:
             iter_start = ck["epoch"] + 1
             if tracker is not None and ck.get("tracker_state") is not None:
                 tracker.__dict__.update(ck["tracker_state"])
+            if ck.get("precision_demoted") and self._demotable \
+                    and not self._demoted:
+                # the checkpointed fit demoted mixed -> f32 mid-run; resume
+                # must stay f32 (never silently re-promote)
+                self._demote_to_f32()
 
         # ---- model-quality observatory (obs/quality.py) ------------------
         # this trainer's GC readouts are per-family host calls (model.gc
@@ -433,6 +466,15 @@ class Trainer:
                                 learning_rates=numerics.current_learning_rates(
                                     opt_state),
                                 rollbacks=monitor.rollbacks)
+                            if self._demotable and not self._demoted:
+                                # precision cliff: a mixed-mode rollback
+                                # auto-demotes the fit to f32
+                                self._demote_to_f32()
+                                logger.log("precision", kind="demote",
+                                           epoch=it, cause=action.cause,
+                                           mode_from="mixed", mode_to="f32",
+                                           rollbacks=monitor.rollbacks,
+                                           **nhost)
                             continue  # re-run from the snapshot; no best/ckpt update
                         if action.kind == "abort":
                             aborted = action.cause
@@ -576,6 +618,8 @@ class Trainer:
                 "histories": histories,
                 "best_it": best_it,
                 "best_loss": float(best_loss),
+                # sentinel-triggered precision demotion (mixed -> f32)
+                "precision_demoted": self._demoted,
                 "tracker_state": tracker_state,
             },
         )

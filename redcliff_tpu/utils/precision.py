@@ -1,20 +1,85 @@
-"""Matmul precision control for jit'd steps.
+"""Matmul precision control for jit'd steps + the production precision modes.
 
 TPU MXUs run matmuls fastest in bfloat16; parameters stay f32 and only the
 contraction precision drops — the standard speed/accuracy trade. The context
 applies at trace time, so wrapping a step body inside its jit covers the
 forward and (because grad is traced inside it) the backward pass.
+
+Two knobs select it:
+
+* ``matmul_precision`` (legacy, expert): the raw
+  ``jax.default_matmul_precision`` string, forwarded verbatim;
+* ``precision_mode`` (production): ``"f32"`` — the default; bit-identical
+  to a config that never heard of precision (resolves to no context at
+  all) — or ``"mixed"`` — bf16 MXU contractions with f32 master params and
+  f32 reductions, guarded by the numerics sentinel: a skip/rollback storm
+  auto-demotes the fit to f32 mid-run (trainers + grid engine), logs a
+  schema-registered ``precision`` event, and persists the demotion in the
+  checkpoint so a resume can never silently re-promote.
+
+The mode is part of every resume fingerprint (it changes the update math of
+every step), and folds into the cost-model bucket key (obs/costmodel.py) so
+bf16 and f32 epoch costs never merge.
 """
 from __future__ import annotations
 
 import contextlib
 
-import jax
+__all__ = ["matmul_precision_ctx", "PRECISION_MODES", "MIXED_MATMUL",
+           "resolve_matmul_precision", "check_precision_mode",
+           "precision_label"]
 
-__all__ = ["matmul_precision_ctx"]
+PRECISION_MODES = ("f32", "mixed")
+# what "mixed" means on the matmul axis: bf16 MXU passes, f32 accumulation
+# (jax's "bfloat16" default_matmul_precision keeps f32 outputs/reductions)
+MIXED_MATMUL = "bfloat16"
+
+
+def check_precision_mode(mode):
+    """Validate a ``precision_mode`` value at config-construction time (fail
+    here, not deep inside the first jit'd step)."""
+    if mode not in PRECISION_MODES:
+        raise ValueError(
+            f"precision_mode must be one of {PRECISION_MODES}, got {mode!r}")
+    return mode
+
+
+def resolve_matmul_precision(precision_mode="f32", matmul_precision=None):
+    """The effective ``jax.default_matmul_precision`` string for a config.
+
+    The explicit legacy ``matmul_precision`` knob wins when set (expert
+    override — bench probes use it); otherwise ``precision_mode="mixed"``
+    resolves to :data:`MIXED_MATMUL` and ``"f32"`` resolves to ``None`` —
+    no context manager at all, so an ``"f32"`` fit traces the exact same
+    graph as a pre-precision-mode build (decision-stream bit-identity)."""
+    if matmul_precision:
+        return matmul_precision
+    if precision_mode == "mixed":
+        return MIXED_MATMUL
+    return None
+
+
+def precision_label(precision_mode="f32", matmul_precision=None):
+    """Canonical label for the cost-model bucket key (obs/costmodel.py):
+    ``"f32"`` when no precision context applies, ``"mixed"`` for bf16
+    contractions (whether selected by mode or by the legacy knob), else the
+    raw precision string — bf16 and f32 epoch costs must never merge."""
+    resolved = resolve_matmul_precision(precision_mode, matmul_precision)
+    if resolved is None:
+        return "f32"
+    if resolved == MIXED_MATMUL:
+        return "mixed"
+    return str(resolved)
 
 
 def matmul_precision_ctx(precision):
-    """``jax.default_matmul_precision`` context; ``None`` is a no-op."""
-    return (jax.default_matmul_precision(precision) if precision
-            else contextlib.nullcontext())
+    """``jax.default_matmul_precision`` context; ``None`` is a no-op.
+
+    jax is imported lazily: the mode/label helpers above are consumed by
+    backend-free processes too (the fleet planner prices mixed-precision
+    batches without ever importing jax)."""
+    if not precision:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_matmul_precision(precision)

@@ -37,10 +37,14 @@ def test_store_golden_fit_and_predictions(tmp_path):
     assert store["runs"] == 1
     [bucket] = store["buckets"].values()
     assert bucket == {
-        "platform": "cpu", "shape": SHAPE, "g_bucket": 8, "epochs": 10,
+        "platform": "cpu", "shape": SHAPE, "g_bucket": 8,
+        "precision": "f32", "epochs": 10,
         "epoch_ms_total": 1000.0, "compiles": 2, "compile_ms_total": 500.0,
         "cache_hits": 1, "cache_misses": 1, "runs": 1,
         "updated_at": bucket["updated_at"]}
+    # precisionless rows default to the f32 bucket key (ISSUE 14)
+    [key] = store["buckets"]
+    assert key == costmodel.bucket_key("cpu", SHAPE, 8, "f32")
 
     model = costmodel.load(base)
     # exact bucket: the observed mean

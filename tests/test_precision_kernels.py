@@ -1,0 +1,382 @@
+"""Mixed-precision production path + autotuned Pallas kernels (ISSUE 14).
+
+Covers the four contracts of the precision/kernel layer:
+
+* kernel parity — the fused factor-mix kernel is BITWISE equal to the jnp
+  reference in f32 interpret mode (including through the custom VJP), and
+  the GL-prox kernel matches the jnp prox on off-tile row counts;
+* precision_mode="f32" decision streams are bit-identical to a config that
+  never heard of the knob (the pre-PR behavior);
+* precision_mode="mixed" + a numerics-sentinel storm auto-demotes to f32
+  (schema-registered `precision` event), the demotion persists in the
+  checkpoint, and an f32 resume is bit-identical to an always-f32 fit from
+  the demotion point;
+* the autotune store searches once, persists beside the compile cache, and
+  a second resolve performs zero search steps (corrupt stores degrade to
+  defaults).
+"""
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from redcliff_tpu.data.datasets import ArrayDataset
+from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+from redcliff_tpu.obs import read_jsonl, schema
+from redcliff_tpu.obs import costmodel
+from redcliff_tpu.ops import autotune
+from redcliff_tpu.ops.factor_mix import (factor_mix_pallas,
+                                         factor_mix_reference)
+from redcliff_tpu.ops.pallas_prox import gl_prox_pallas
+from redcliff_tpu.ops.prox import prox_update
+from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+from redcliff_tpu.train.redcliff_trainer import (RedcliffTrainConfig,
+                                                 RedcliffTrainer)
+from redcliff_tpu.utils.precision import (precision_label,
+                                          resolve_matmul_precision)
+
+
+def _model():
+    return RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=4, gen_lag=2, gen_hidden=(8,), embed_lag=4,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+
+
+def _data(model, n=48):
+    cfg = model.config
+    rng = np.random.default_rng(0)
+    T = cfg.max_lag + cfg.num_sims
+    X = rng.normal(size=(n, T, cfg.num_chans)).astype(np.float32)
+    Y = rng.uniform(size=(n, 3, 1)).astype(np.float32)
+    return ArrayDataset(X, Y)
+
+
+_POINTS = [{"gen_lr": 1e-3}, {"gen_lr": 3e-3}]
+
+
+def _tc(**kw):
+    kw.setdefault("max_iter", 3)
+    return RedcliffTrainConfig(batch_size=16, check_every=1,
+                               stream_mode="per_batch", **kw)
+
+
+# ---------------------------------------------------------------------------
+# precision resolution
+# ---------------------------------------------------------------------------
+def test_precision_mode_resolution():
+    assert resolve_matmul_precision("f32") is None
+    assert resolve_matmul_precision("mixed") == "bfloat16"
+    # the legacy expert knob wins
+    assert resolve_matmul_precision("f32", "tensorfloat32") == "tensorfloat32"
+    assert precision_label("f32") == "f32"
+    assert precision_label("mixed") == "mixed"
+    assert precision_label("f32", "bfloat16") == "mixed"
+    with pytest.raises(ValueError, match="precision_mode"):
+        RedcliffTrainConfig(precision_mode="bf16")
+    with pytest.raises(ValueError, match="precision_mode"):
+        GridSpec(points=_POINTS, precision_mode="fp8")
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+def test_factor_mix_bitwise_parity_interpret_f32():
+    """The fused factor-mix kernel is BITWISE equal to the reference einsum
+    in f32 interpret mode — including odd batch sizes that exercise the
+    block padding/mask path."""
+    rng = np.random.default_rng(0)
+    for B, K, T, C in ((17, 5, 1, 10), (32, 2, 2, 4), (3, 4, 1, 7)):
+        w = jnp.asarray(rng.random((B, K)).astype(np.float32))
+        p = jnp.asarray(rng.normal(size=(K, B, T, C)).astype(np.float32))
+        got = factor_mix_pallas(w, p, block_b=8, interpret=True)
+        want = factor_mix_reference(w, p)
+        assert bool(jnp.all(got == want)), (B, K, T, C)
+
+
+def test_factor_mix_custom_vjp_matches_reference_grads():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.random((6, 3)).astype(np.float32))
+    p = jnp.asarray(rng.normal(size=(3, 6, 1, 4)).astype(np.float32))
+    f_pl = lambda w, p: jnp.sum(jnp.sin(
+        factor_mix_pallas(w, p, block_b=4, interpret=True)))
+    f_rf = lambda w, p: jnp.sum(jnp.sin(factor_mix_reference(w, p)))
+    g_pl = jax.grad(f_pl, argnums=(0, 1))(w, p)
+    g_rf = jax.grad(f_rf, argnums=(0, 1))(w, p)
+    for a, b in zip(g_pl, g_rf):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("shape,block_rows", [
+    ((3, 7, 5, 7, 3), 2),     # rows 21, off-tile at block 2
+    ((1, 1, 3, 1, 1), 16),    # rows 1 < block (clamp path)
+    ((5, 12, 32, 12, 4), 7),  # rows 60, odd tile
+])
+def test_pallas_gl_prox_nondivisible_shapes(shape, block_rows):
+    """Off-tile first-layer shapes ride the pad/mask path and still match
+    the jnp reference (the tiling-robustness satellite)."""
+    rng = np.random.default_rng(2)
+    W = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    got = gl_prox_pallas(W, 0.013, 0.002, block_rows=block_rows)
+    want = prox_update(W, 0.013, 0.002, "GL")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_apply_prox_routes_first_layer_only():
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0))
+    out = model.apply_prox(params, lam=0.1, lr=0.01, penalty="GL")
+    want_w = prox_update(params["factors"][0]["w"], 0.1, 0.01, "GL")
+    np.testing.assert_array_equal(np.asarray(out["factors"][0]["w"]),
+                                  np.asarray(want_w))
+    # every other leaf untouched (bias + later layers + embedder)
+    np.testing.assert_array_equal(np.asarray(out["factors"][0]["b"]),
+                                  np.asarray(params["factors"][0]["b"]))
+    for got_l, want_l in zip(jax.tree.leaves(out["factors"][1:]),
+                             jax.tree.leaves(params["factors"][1:])):
+        np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
+
+
+def test_grid_prox_enabled_fit_stays_finite_and_shrinks():
+    """A prox-enabled grid fit runs end to end, and the GL prox actually
+    shrinks first-layer group norms vs the no-prox fit."""
+    model = _model()
+    ds = _data(model)
+    res_off = RedcliffGridRunner(model, _tc(), GridSpec(points=_POINTS)).fit(
+        jax.random.PRNGKey(0), ds, ds)
+    res_on = RedcliffGridRunner(
+        model, _tc(prox_penalty="GL", prox_lam=0.05),
+        GridSpec(points=_POINTS)).fit(jax.random.PRNGKey(0), ds, ds)
+    assert np.all(np.isfinite(res_on.val_history))
+    w_off = np.asarray(res_off.best_params["factors"][0]["w"])
+    w_on = np.asarray(res_on.best_params["factors"][0]["w"])
+    norm = lambda w: np.sqrt((w ** 2).sum(axis=(-3, -1)))
+    assert norm(w_on).sum() < norm(w_off).sum()
+
+
+# ---------------------------------------------------------------------------
+# precision_mode="f32" bit-identity (the pre-PR decision streams)
+# ---------------------------------------------------------------------------
+def test_f32_mode_decision_stream_bit_identity():
+    model = _model()
+    ds = _data(model)
+    res_default = RedcliffGridRunner(
+        model, _tc(), GridSpec(points=_POINTS)).fit(
+        jax.random.PRNGKey(0), ds, ds)
+    res_f32 = RedcliffGridRunner(
+        model, _tc(precision_mode="f32"), GridSpec(points=_POINTS)).fit(
+        jax.random.PRNGKey(0), ds, ds)
+    np.testing.assert_array_equal(np.asarray(res_default.val_history),
+                                  np.asarray(res_f32.val_history))
+    for a, b in zip(jax.tree.leaves(res_default.best_params),
+                    jax.tree.leaves(res_f32.best_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# mixed-mode auto-demotion (grid + trainer) + resume semantics
+# ---------------------------------------------------------------------------
+def test_grid_mixed_demotes_on_skip_storm_and_resume_stays_f32(tmp_path):
+    model = _model()
+    ds = _data(model)
+    ck = str(tmp_path / "ck")
+    log1 = str(tmp_path / "log1")
+    os.environ["REDCLIFF_FAULT_INJECT"] = "nan_batch:0-2"
+    try:
+        runner = RedcliffGridRunner(model, _tc(max_iter=5,
+                                               precision_mode="mixed"),
+                                    GridSpec(points=_POINTS))
+        res = runner.fit(jax.random.PRNGKey(0), ds, ds, max_iter=3,
+                         log_dir=log1, checkpoint_dir=ck,
+                         checkpoint_every=1)
+    finally:
+        del os.environ["REDCLIFF_FAULT_INJECT"]
+    recs = read_jsonl(log1)
+    assert not schema.validate_records(recs)
+    pev = [r for r in recs if r["event"] == "precision"]
+    assert pev and pev[0]["kind"] == "demote" \
+        and pev[0]["cause"] == "precision_cliff"
+    assert runner._demoted
+    # demotion gave the lanes an f32 epoch instead of quarantining them
+    assert res.failures == []
+
+    # resume under the SAME mixed config honors the checkpointed demotion
+    log2 = str(tmp_path / "log2")
+    runner2 = RedcliffGridRunner(model, _tc(max_iter=5,
+                                            precision_mode="mixed"),
+                                 GridSpec(points=_POINTS))
+    runner2.fit(jax.random.PRNGKey(0), ds, ds, log_dir=log2,
+                checkpoint_dir=ck, checkpoint_every=1)
+    assert runner2._demoted
+    recs2 = read_jsonl(log2)
+    assert any(r["event"] == "precision" and r["kind"] == "resume_demoted"
+               for r in recs2)
+
+    # a DIFFERENT precision_mode is a different fit: resume rejects
+    runner3 = RedcliffGridRunner(model, _tc(max_iter=5),
+                                 GridSpec(points=_POINTS))
+    with pytest.raises(ValueError, match="precision_mode"):
+        runner3.fit(jax.random.PRNGKey(0), ds, ds, checkpoint_dir=ck)
+
+
+def test_trainer_mixed_demotes_and_f32_resume_bit_identical(tmp_path):
+    """Faultinject a bf16-cliff-shaped storm (non-finite grads -> sentinel
+    skips -> rollback): the mixed trainer demotes, logs the `precision`
+    event, and continuing the fit from the demotion point is BIT-IDENTICAL
+    whether the resuming config says "mixed" (honoring the persisted
+    demotion) or "f32" outright."""
+    model = _model()
+    ds = _data(model)
+    d = str(tmp_path / "run")
+    params = model.init(jax.random.PRNGKey(1))
+    os.environ["REDCLIFF_FAULT_INJECT"] = "nan_batch:3-5"  # epoch 1's batches
+    try:
+        tr = RedcliffTrainer(model, _tc(precision_mode="mixed"))
+        tr.fit(params, ds, ds, save_dir=d)
+    finally:
+        del os.environ["REDCLIFF_FAULT_INJECT"]
+    assert tr._demoted
+    recs = read_jsonl(d)
+    assert not schema.validate_records(recs)
+    pev = [r for r in recs if r["event"] == "precision"]
+    assert pev and pev[0]["kind"] == "demote"
+    # the anomaly trail shows the sentinel skipped (the storm evidence)
+    assert any(r["event"] == "anomaly" for r in recs)
+
+    d_mixed = str(tmp_path / "resume_mixed")
+    d_f32 = str(tmp_path / "resume_f32")
+    shutil.copytree(d, d_mixed)
+    shutil.copytree(d, d_f32)
+    res_a = RedcliffTrainer(model, _tc(max_iter=6, precision_mode="mixed")
+                            ).fit(params, ds, ds, save_dir=d_mixed)
+    res_b = RedcliffTrainer(model, _tc(max_iter=6, precision_mode="f32")
+                            ).fit(params, ds, ds, save_dir=d_f32)
+    for a, b in zip(jax.tree.leaves(res_a.params),
+                    jax.tree.leaves(res_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# autotune store
+# ---------------------------------------------------------------------------
+def test_autotune_searches_once_then_zero_search_steps(tmp_path):
+    d = str(tmp_path / "store")
+    autotune.clear_memo()
+    br, rec = autotune.tune_gl_prox(64, 16, base_dir=d, interpret=True,
+                                    reps=1)
+    assert rec["searched"] and rec["search_steps"] > 0
+    assert rec["search_ms"] is not None
+    assert rec["speedup_vs_default"] is not None
+    assert os.path.exists(os.path.join(d, autotune.STORE_NAME))
+    # drop the in-process memo: the second resolve must come from DISK
+    autotune.clear_memo()
+    br2, rec2 = autotune.tune_gl_prox(64, 16, base_dir=d, interpret=True)
+    assert br2 == br
+    assert rec2["search_steps"] == 0 and not rec2["searched"]
+    # the drained records feed schema-registered `autotune` events
+    kinds = [r["kind"] for r in autotune.drain_records()]
+    assert kinds == ["search", "reuse"]
+    autotune.clear_memo()
+
+
+def test_autotune_corrupt_store_degrades_to_defaults(tmp_path):
+    d = str(tmp_path / "store")
+    os.makedirs(d)
+    with open(os.path.join(d, autotune.STORE_NAME), "w") as f:
+        f.write("{not json")
+    autotune.clear_memo()
+    assert autotune.winner("gl_prox", "cols16", 64, base_dir=d) is None
+    # a search over a corrupt store restarts it fresh
+    br, rec = autotune.tune_gl_prox(64, 16, base_dir=d, interpret=True,
+                                    reps=1)
+    assert rec["searched"]
+    autotune.clear_memo()
+    assert autotune.winner("gl_prox", "cols16", 64,
+                           base_dir=d)["tile"]["block_rows"] == br
+    autotune.clear_memo()
+
+
+def test_autotuned_block_rows_reaches_gl_prox(tmp_path, monkeypatch):
+    """gl_prox_pallas(block_rows=None) resolves the persisted winner from
+    the configured store — and still matches the jnp reference at that
+    tile. The store is pointed at tmp via REDCLIFF_AUTOTUNE_DIR because
+    the hot-path lookup resolves the SAME store the winner was recorded
+    to (memo keys include the resolved path)."""
+    monkeypatch.setenv(autotune.ENV_STORE_DIR, str(tmp_path / "store"))
+    autotune.clear_memo()
+    rng = np.random.default_rng(3)
+    W = jnp.asarray(rng.normal(size=(4, 8, 8, 8, 2)).astype(np.float32))
+    # rows = 4*8*8 = 256 -> bucket 256; record the winner at the right key
+    autotune.record_winner("gl_prox", "cols16", 256, {"block_rows": 2})
+    got = gl_prox_pallas(W, 0.01, 0.002)  # winner lookup path
+    want = prox_update(W, 0.01, 0.002, "GL")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    autotune.clear_memo()
+
+
+# ---------------------------------------------------------------------------
+# cost-model precision axis
+# ---------------------------------------------------------------------------
+def test_costmodel_precision_splits_buckets(tmp_path):
+    base = str(tmp_path)
+    shape = "num_chans=4"
+    rows_f32 = [{"shape": shape, "g_bucket": 8, "epochs": 4,
+                 "epoch_ms": 400.0, "precision": "f32"}]
+    rows_mixed = [{"shape": shape, "g_bucket": 8, "epochs": 4,
+                   "epoch_ms": 100.0, "precision": "mixed"}]
+    costmodel.update_store(base, rows_f32, platform="cpu")
+    costmodel.update_store(base, rows_mixed, platform="cpu")
+    model = costmodel.load(base)
+    assert model.predict_epoch_ms(shape, 8, platform="cpu",
+                                  precision="f32") == 100.0
+    assert model.predict_epoch_ms(shape, 8, platform="cpu",
+                                  precision="mixed") == 25.0
+    # the two buckets never predict each other
+    assert model.predict_epoch_ms(shape, 8, platform="cpu",
+                                  precision="tf32") is None
+    keys = set(model.buckets)
+    assert costmodel.bucket_key("cpu", shape, 8, "f32") in keys
+    assert costmodel.bucket_key("cpu", shape, 8, "mixed") in keys
+
+
+def test_costmodel_legacy_store_backfills_f32(tmp_path):
+    """A pre-precision store (3-segment keys, no precision field) reads as
+    f32 buckets — existing evidence keeps predicting f32 fits."""
+    import json
+
+    base = str(tmp_path)
+    path = costmodel.store_path(base)
+    legacy = {
+        "version": costmodel.STORE_VERSION, "updated_at": 1.0, "runs": 1,
+        "buckets": {"cpu|num_chans=4|g8": {
+            "platform": "cpu", "shape": "num_chans=4", "g_bucket": 8,
+            "epochs": 2, "epoch_ms_total": 50.0, "compiles": 0,
+            "compile_ms_total": 0.0, "cache_hits": 0, "cache_misses": 0,
+            "runs": 1}}}
+    with open(path, "w") as f:
+        json.dump(legacy, f)
+    model = costmodel.load(base)
+    assert model.predict_epoch_ms("num_chans=4", 8, platform="cpu",
+                                  precision="f32") == 25.0
+    assert model.predict_epoch_ms("num_chans=4", 8, platform="cpu",
+                                  precision="mixed") is None
+    rows = model.accuracy_rows()
+    assert rows[0]["precision"] == "f32"
+    # a write-back normalizes the key
+    costmodel.update_store(base, [{"shape": "num_chans=4", "g_bucket": 8,
+                                   "epochs": 2, "epoch_ms": 50.0}],
+                          platform="cpu")
+    model2 = costmodel.load(base)
+    assert costmodel.bucket_key("cpu", "num_chans=4", 8, "f32") \
+        in model2.buckets
+    assert model2.predict_epoch_ms("num_chans=4", 8, platform="cpu",
+                                   precision="f32") == 25.0

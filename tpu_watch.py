@@ -49,6 +49,7 @@ import json
 import numpy as np
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform != "cpu", "tunnel fell back to cpu"
+from redcliff_tpu.ops.factor_mix import factor_mix_pallas, factor_mix_reference
 from redcliff_tpu.ops.pallas_prox import gl_prox_pallas
 from redcliff_tpu.ops.prox import prox_update
 rng = np.random.default_rng(0)
@@ -57,7 +58,14 @@ lam, lr = 0.013, 0.002
 got = gl_prox_pallas(W, lam, lr, interpret=False)
 want = prox_update(W, lam, lr, "GL")
 err = float(jnp.max(jnp.abs(got - want)))
-print(json.dumps({"ok": err < 5e-6, "max_abs_err": err,
+# fused factor-mix kernel (ISSUE 14), compiled on the real chip
+fw = jnp.asarray(rng.random((64, 5)).astype(np.float32))
+fp = jnp.asarray(rng.normal(size=(5, 64, 1, 10)).astype(np.float32))
+fm_got = factor_mix_pallas(fw, fp, interpret=False)
+fm_want = factor_mix_reference(fw, fp)
+fm_err = float(jnp.max(jnp.abs(fm_got - fm_want)))
+print(json.dumps({"ok": err < 5e-6 and fm_err < 5e-6, "max_abs_err": err,
+                  "factor_mix_max_abs_err": fm_err,
                   "device": jax.devices()[0].device_kind}))
 """
 
