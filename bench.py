@@ -1105,6 +1105,167 @@ def _bench_fleet_containment():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_predictive_policy(n_devices=8, check_every=5, gather_ms=250.0):
+    """predictive_policy probe (ISSUE 15, parallel/policy.py): heuristic
+    bucket ladder vs the predictive scheduling policy on a SIMULATED
+    mixed-shape early-stopping sweep.
+
+    Ground truth is a synthetic per-(shape, width) cost table (epoch cost =
+    per-lane ms x width + fixed; one compile cost per program family) from
+    which a cost-model store is trained — the policy sees exactly what a
+    converged store would hold, so the probe isolates the DECISIONS, not
+    prediction error (MAPE health is the cost_model events' job). Both legs
+    replay the same deterministic lane-retirement schedules through the
+    same simulator: epochs are charged at the width the policy chose, and
+    each FIRST-TOUCH (shape, width) program pays its compile once — the
+    persistent-cache discipline, with the same warm-start set (the rungs
+    the store has compile evidence for) on both legs.
+
+    ``makespan_ratio`` < 1.0 is the acceptance claim (registered as an
+    ``obs regress`` family with contract_max=1.0): the predictive policy
+    wins by HOLDING compactions whose recompile costs more than the
+    surviving epochs save, and by starting grids at WARM adjacent rungs
+    instead of cold heuristic ones. ``empty_store_identical`` pins the
+    fallback contract: with no store, both policies must produce
+    bit-identical decision streams and makespans."""
+    import numpy as np
+
+    from redcliff_tpu.obs import costmodel
+    from redcliff_tpu.parallel import compaction
+    from redcliff_tpu.parallel.policy import (GridSchedulingPolicy,
+                                              PredictiveSchedulingPolicy)
+
+    shapes = {
+        "A": {"per_lane_ms": 6.0, "fixed_ms": 30.0, "compile_ms": 9000.0},
+        "B": {"per_lane_ms": 12.0, "fixed_ms": 50.0, "compile_ms": 15000.0},
+        "C": {"per_lane_ms": 3.0, "fixed_ms": 20.0, "compile_ms": 6000.0},
+    }
+    # program families with compile evidence in the store == the warm
+    # persistent-cache start set (both legs)
+    warm_history = {"A": (32,), "B": (16,), "C": (8,)}
+    # the mixed-shape queue: (shape, G_real, epochs)
+    sweep = [("A", 24, 60), ("B", 12, 40), ("A", 9, 50), ("C", 30, 80),
+             ("B", 20, 30), ("C", 7, 40), ("A", 18, 30), ("B", 5, 50)]
+
+    def epoch_ms(sk, width):
+        t = shapes[sk]
+        return t["per_lane_ms"] * width + t["fixed_ms"]
+
+    def live_at(g, epochs, e):
+        # deterministic early stopping: ~linear decay to one survivor by
+        # 60% of the horizon (the shape of a real criteria sweep)
+        return max(1, int(round(g * (1.0 - 0.9 * min(
+            e / max(epochs * 0.6, 1.0), 1.0)))))
+
+    def trained_model():
+        store = costmodel._empty_store()
+        rows = []
+        for sk in shapes:
+            widths = {1, 2, 4}
+            w = compaction.bucket_width(1, n_devices)
+            while w <= 64:
+                widths.add(w)
+                w = compaction.bucket_width(w + 1, n_devices)
+            for w in sorted(widths):
+                rows.append({"shape": sk, "g_bucket": w, "epochs": 50,
+                             "epoch_ms": epoch_ms(sk, w) * 50,
+                             "compiles": (1 if w in warm_history[sk]
+                                          else 0),
+                             "compile_ms": (shapes[sk]["compile_ms"]
+                                            if w in warm_history[sk]
+                                            else 0.0)})
+        costmodel._merge_rows(store, rows, "sim", now=1.0)
+        return costmodel.CostModel(store)
+
+    def simulate(make_policy):
+        warm = {(sk, w) for sk, ws in warm_history.items() for w in ws}
+        total_ms = 0.0
+        compiles = holds = widens = 0
+        decisions = []
+        for sk, g, epochs in sweep:
+            pol = make_policy(sk, epochs)
+            w = pol.initial_width(g, n_devices)
+            if hasattr(pol, "take_decision"):
+                d = pol.take_decision()
+                widens += bool(d and d.get("action") == "widen")
+            decisions.append(("init", sk, g, w))
+            orig = np.concatenate(
+                [np.arange(g, dtype=np.int32),
+                 np.full((w - g,), -1, np.int32)])
+            active = np.zeros((w,), bool)
+            active[:g] = True
+            retired = set()
+            if (sk, w) not in warm:
+                total_ms += shapes[sk]["compile_ms"]
+                compiles += 1
+                warm.add((sk, w))
+            for e in range(epochs):
+                lanes = np.flatnonzero(active)
+                live = live_at(g, epochs, e)
+                if live < lanes.size:
+                    active[lanes[live:]] = False
+                total_ms += epoch_ms(sk, active.size)
+                if e % check_every == 0:
+                    plan = pol.compaction_plan(
+                        active, orig, retired, n_devices,
+                        epochs_remaining=epochs - e - 1)
+                    if hasattr(pol, "take_decision"):
+                        d = pol.take_decision()
+                        holds += bool(d and d.get("action") == "hold")
+                    if plan is not None:
+                        decisions.append(("compact", sk, int(orig.size),
+                                          plan.new_width, e))
+                        retired.update(int(i) for i in plan.retire_ids)
+                        orig = plan.orig_ids
+                        active = plan.active.copy()
+                        if (sk, plan.new_width) not in warm:
+                            total_ms += shapes[sk]["compile_ms"]
+                            compiles += 1
+                            warm.add((sk, plan.new_width))
+                        total_ms += gather_ms
+        return total_ms, decisions, compiles, holds, widens
+
+    def heuristic(sk, epochs):
+        return GridSchedulingPolicy()
+
+    model = trained_model()
+
+    def predictive(sk, epochs):
+        return PredictiveSchedulingPolicy(cost_model=model, shape_key=sk,
+                                          platform="sim", epochs=epochs,
+                                          gather_ms=gather_ms)
+
+    heur_ms, heur_dec, heur_compiles, _, _ = simulate(heuristic)
+    t0 = time.perf_counter()
+    pred_ms, pred_dec, pred_compiles, holds, widens = simulate(predictive)
+    decide_ms = (time.perf_counter() - t0) * 1e3
+
+    def empty_predictive(sk, epochs):
+        return PredictiveSchedulingPolicy(
+            cost_model=costmodel.CostModel(costmodel._empty_store()),
+            shape_key=sk, platform="sim", epochs=epochs,
+            gather_ms=gather_ms)
+
+    empty_ms, empty_dec, _, _, _ = simulate(empty_predictive)
+    return {
+        "fits": len(sweep),
+        "n_devices": n_devices,
+        "heuristic_makespan_s": round(heur_ms / 1e3, 3),
+        "predictive_makespan_s": round(pred_ms / 1e3, 3),
+        "makespan_ratio": (round(pred_ms / heur_ms, 4) if heur_ms
+                           else None),
+        "heuristic_compiles": heur_compiles,
+        "predictive_compiles": pred_compiles,
+        "holds": holds,
+        "widens": widens,
+        # fallback contract: an empty store must reproduce the heuristic
+        # decision stream bit-for-bit (and therefore its makespan)
+        "empty_store_identical": (empty_dec == heur_dec
+                                  and empty_ms == heur_ms),
+        "decide_ms": round(decide_ms, 3),
+    }
+
+
 def _bench_trace_export(n_records=2000):
     """trace_export probe: span -> Perfetto round-trip cost
     (obs/trace_export.py) on a synthetic but schema-shaped run dir —
@@ -1528,6 +1689,15 @@ def _measure(platform):
     except Exception as e:  # never fail the bench over the trace probe
         fleet_trace = {"error": f"{type(e).__name__}: {e}"}
 
+    # predictive scheduling policy (ISSUE 15): simulated mixed-shape sweep
+    # makespan — predictive vs heuristic ladder, with the empty-store
+    # bit-identity contract
+    try:
+        predictive_policy = _bench_predictive_policy()
+    except Exception as e:  # never fail the bench over the policy probe
+        predictive_policy = {"error": f"{type(e).__name__}: {e}",
+                             "makespan_ratio": None}
+
     # model-quality observatory (obs/quality.py): graph recovery + readout
     # overhead on a deterministic synthetic sVAR grid fit with ground truth
     try:
@@ -1572,6 +1742,7 @@ def _measure(platform):
         "fleet": fleet_probe,
         "fleet_containment": fleet_containment,
         "fleet_trace": fleet_trace,
+        "predictive_policy": predictive_policy,
         "quality": quality_probe,
         "error": None,
     })

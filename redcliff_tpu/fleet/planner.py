@@ -26,9 +26,14 @@ ordered list of BATCHES, each one grid fit:
   estimate exceeds headroom (pinned by tests/test_fleet.py);
 * **ordering is cost-aware**: batches sort by priority (desc), then
   earliest tenant deadline, then predicted wall-clock
-  (obs/costmodel.py ``predict_fit_eta`` — shortest first, unknown last),
-  then deterministic tie-breaks, so urgent and cheap work drains ahead of
-  long sweeps.
+  (obs/costmodel.py ``predict_fit_eta`` — shortest first; unknown-ETA
+  batches after, in submission order rather than hash order so planners
+  with different cost-model stores agree — ISSUE 15 satellite), then
+  deterministic tie-breaks, so urgent and cheap work drains ahead of long
+  sweeps. Batch views also carry ``cold_compile_ms`` (the predicted
+  first-touch compile when the program family is cold, 0 when the shared
+  persistent cache holds it) — the fleet worker's cold-compile claim
+  ordering input (parallel/policy.py ``compile_order``).
 
 :func:`fifo_plan` is the naive one-request-per-fit baseline bench.py's
 ``fleet`` probe compares against (mesh-slot utilization,
@@ -112,26 +117,45 @@ def _batch_view(members, n_devices, cost_model=None, platform=None,
     ids = [r["request_id"] for r in members]
     shape = members[0].get("shape") or {}
     epochs = max((r.get("epochs") or 0) for r in members)
-    eta_s = None
+    # precision half of the cost bucket: a mixed-precision batch must be
+    # priced from mixed-epoch evidence, not f32's (the merge key guarantees
+    # every member shares one train_config). utils.precision is jax-free at
+    # module scope — the planner's no-jax control-plane discipline holds.
+    # Defensive: pricing is ADVISORY, so a malformed tenant-supplied spec
+    # (non-dict train_config) degrades to the default label instead of
+    # crashing the whole worker's plan cycle
+    try:
+        from redcliff_tpu.utils.precision import precision_label
+
+        tcd = (members[0].get("spec") or {}).get("train_config") or {}
+        precision = precision_label(tcd.get("precision_mode") or "f32",
+                                    tcd.get("matmul_precision"))
+    except Exception:  # noqa: BLE001 — tenant input, advisory output
+        precision = "f32"
+    eta_s = cold_compile_ms = None
     if cost_model is not None:
         try:
             from redcliff_tpu.obs.schema import shape_key as _sk
-            # precision half of the cost bucket: a mixed-precision batch
-            # must be priced from mixed-epoch evidence, not f32's (the
-            # merge key guarantees every member shares one train_config).
-            # utils.precision is jax-free at module scope — the planner's
-            # no-jax control-plane discipline holds
-            from redcliff_tpu.utils.precision import precision_label
 
-            tcd = (members[0].get("spec") or {}).get("train_config") or {}
+            sk = _sk(shape)
             eta_s = cost_model.predict_fit_eta(
-                _sk(shape), width, epochs, platform=platform,
-                cold_programs=1,
-                precision=precision_label(
-                    tcd.get("precision_mode") or "f32",
-                    tcd.get("matmul_precision")))
+                sk, width, epochs, platform=platform,
+                cold_programs=1, precision=precision)
+            # cold-compile ordering input (ISSUE 15): the predicted cost of
+            # this batch's FIRST-TOUCH compile — 0 when the program family
+            # has compile evidence (the shared persistent XLA cache holds
+            # it), the predicted cold compile otherwise, None unpriceable
+            if cost_model.compile_warm(sk, width, platform=platform,
+                                       precision=precision):
+                cold_compile_ms = 0.0
+            else:
+                cm = cost_model.predict_compile_ms(sk, width,
+                                                   platform=platform,
+                                                   precision=precision)
+                cold_compile_ms = (round(float(cm), 3)
+                                   if cm is not None else None)
         except Exception:  # noqa: BLE001 — predictions are advisory
-            eta_s = None
+            eta_s = cold_compile_ms = None
     n_dev = int(n_devices or 1)
     return {
         "batch_id": batch_id_for(ids),
@@ -161,6 +185,12 @@ def _batch_view(members, n_devices, cost_model=None, platform=None,
         "predicted_bytes": predicted_batch_bytes(members, width),
         "eta_s": (round(eta_s, 3) if isinstance(eta_s, (int, float))
                   else None),
+        # earliest member submission: the deterministic tie-break for
+        # unknown-ETA ordering (see _batch_order_key)
+        "submitted_at": min((float(r.get("submitted_at") or 0.0)
+                             for r in members), default=0.0),
+        "precision": precision,
+        "cold_compile_ms": cold_compile_ms,
         # containment circuit breaker: this batch was planned SOLO because
         # its request has prior failed attempts (never merged with healthy
         # tenants until it proves clean)
@@ -169,11 +199,24 @@ def _batch_view(members, n_devices, cost_model=None, platform=None,
 
 
 def _batch_order_key(batch):
+    """Priority desc, earliest deadline, then predicted wall-clock
+    shortest-first for KNOWN ETAs — with unknown-ETA batches after them,
+    ordered among themselves by earliest member SUBMISSION time (then id).
+
+    The unknown group's internal order deliberately rides submission time,
+    not the content-hash batch id (the pre-ISSUE-15 "unknown last" key):
+    on a mixed-store fleet — some hosts' cost models price a shape others
+    have never seen — the hash order made two planners disagree about
+    which unpriced tenant drains first, i.e. queue position depended on
+    which worker happened to scan. Submission order is store-independent
+    FIFO fairness for every pair of batches unknown to both planners
+    (pinned by the two-store planner test)."""
     dl = batch.get("deadline_s")
     eta = batch.get("eta_s")
     return (-batch["priority"],
             dl if dl is not None else float("inf"),
-            eta if eta is not None else float("inf"),
+            ((0, float(eta)) if eta is not None
+             else (1, float(batch.get("submitted_at") or 0.0))),
             batch["batch_id"])
 
 

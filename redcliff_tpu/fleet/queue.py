@@ -660,14 +660,22 @@ class FleetQueue:
     # pinned batches (bisection halves: exact compositions, planner-bypass)
     # ------------------------------------------------------------------
     def pin_batch(self, batch_id, request_ids, parent_batch_id=None,
-                  now=None):
+                  after_request=None, now=None):
         """Durably pin an exact batch composition for the next claiming
         worker (the bisection requeue path: halves must run AS HALVES, not
-        be re-merged by the admission planner)."""
+        be re-merged by the admission planner).
+
+        ``after_request`` (deadline-aware preemption, ISSUE 15): the
+        beneficiary request this composition yielded the mesh to — workers
+        defer claiming the pin while that request is still pending (no
+        terminal record, no live lease), so the preempted batch resumes
+        only once the tenant it was preempted FOR has been served (or has
+        settled some other way)."""
         now = time.time() if now is None else now
         _write_json_atomic(self._pin_path(batch_id), {
             "batch_id": batch_id, "requests": list(request_ids),
-            "parent_batch_id": parent_batch_id, "pinned_at": now})
+            "parent_batch_id": parent_batch_id,
+            "after_request": after_request, "pinned_at": now})
 
     def unpin_batch(self, batch_id):
         try:

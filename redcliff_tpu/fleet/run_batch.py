@@ -169,6 +169,14 @@ def run_batch_file(batch_file):
 
         mesh = _remesh.visible_mesh(n_lanes=len(merged))
 
+    # predictive-policy widening ceiling (ISSUE 15, parallel/policy.py
+    # ENV_POLICY_MAX_WIDTH): the admission planner's HBM gate and
+    # max_bucket cap were priced at the ADMITTED width recorded in the
+    # batch file — a warm-rung initial-width widening inside this child
+    # must never exceed it (per-lane footprint scales with width)
+    if batch.get("g_bucket"):
+        os.environ["REDCLIFF_POLICY_MAX_WIDTH"] = str(int(batch["g_bucket"]))
+
     # tenant manifest into the run dir's metrics chain BEFORE the fit, so
     # even a crashed attempt's telemetry is tenant-attributable; the grid
     # engine appends its own events to the same chain next

@@ -43,12 +43,26 @@ request's own: deterministic classes fail it outright, a crash/hang loop
 with a failure dossier. Anything non-terminal releases the leases so
 another worker retries.
 
-stdlib-only imports at module scope, and NEVER jax (obs/schema.py
-``--check`` enforces it): the worker is a control process — the jax backend
+Predictive scheduling (ISSUE 15, ``REDCLIFF_PREDICTIVE``,
+docs/ARCHITECTURE.md "Predictive scheduling & preemption"): the worker
+closes the learning loop on two decisions — fresh admission plans are
+claimed COLD-COMPILE-FIRST within an urgency class (parallel/policy.py
+``compile_order``: the longest predicted missing executable starts
+compiling earliest, so the shared persistent cache warms on the critical
+path), and a running batch is CHECKPOINT-AND-PREEMPTED when
+``predict_fit_eta`` shows a queued higher-priority tenant's deadline would
+otherwise be missed (:class:`_PreemptMonitor`). A preemption is a reclaim,
+never a charged failure attempt: leases release cleanly, the composition is
+pinned with its beneficiary (``after_request``) and resumes bit-identically
+from its checkpoint after the deadline tenant is served.
+
+No jax anywhere in this module's import chain (obs/schema.py ``--check``
+enforces it): the worker is a control process — the jax backend
 initializes only inside the supervised ``run_batch`` child.
 """
 from __future__ import annotations
 
+import contextlib
 import glob
 import json
 import os
@@ -62,14 +76,21 @@ from redcliff_tpu.obs import record_span
 from redcliff_tpu.obs import costmodel as _costmodel
 from redcliff_tpu.obs import flight as _flight
 from redcliff_tpu.obs import spans as _spans
-from redcliff_tpu.runtime.supervisor import SupervisorPolicy, supervise
+from redcliff_tpu.runtime.supervisor import (SupervisorPolicy,
+                                             latest_cost_model_eta,
+                                             supervise)
 from redcliff_tpu.fleet import history as _history
 from redcliff_tpu.fleet import planner as _planner
 from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
+# parallel/policy.py is jax-free by contract (schema --check pins it via
+# this import chain): the predictive-scheduling gate + the cold-compile
+# claim-ordering decision live there, beside the width/compaction pricing
+from redcliff_tpu.parallel.policy import (PredictiveSchedulingPolicy,
+                                          predictive_enabled)
 
 __all__ = ["work", "run_one_batch", "default_worker_id",
            "TERMINAL_FAIL_CLASSES", "DETERMINISTIC_FAIL_CLASSES",
-           "DEFAULT_MAX_ATTEMPTS"]
+           "DEFAULT_MAX_ATTEMPTS", "DEFAULT_PREEMPT_GRACE_S"]
 
 # supervised outcomes a restart cannot fix: the batch will not be re-run
 # as-is (solo requests are failed or budget-routed; merged batches bisect)
@@ -90,6 +111,16 @@ DETERMINISTIC_FAIL_CLASSES = ("numerics_abort", "deadline", "mesh_exhausted")
 # dead-letter healthy requests (the exact blast radius this layer exists
 # to contain)
 DEFAULT_MAX_ATTEMPTS = 3
+
+# deadline-aware preemption knobs (ISSUE 15; armed by REDCLIFF_PREDICTIVE,
+# parallel/policy.py): the grace term is the charged checkpoint-and-yield
+# overhead — the in-flight epoch the child drains plus its final checkpoint
+# and the beneficiary's supervised-child spawn — and the poll is how often
+# the monitor re-prices the queue against the running batch
+ENV_PREEMPT_GRACE = "REDCLIFF_PREEMPT_GRACE_S"
+ENV_PREEMPT_POLL = "REDCLIFF_PREEMPT_POLL_S"
+DEFAULT_PREEMPT_GRACE_S = 5.0
+DEFAULT_PREEMPT_POLL_S = 0.5
 
 
 def default_worker_id():
@@ -163,9 +194,12 @@ def _claim_batch(q, worker_id, lease_s, batch_id, request_ids, by_id,
 
 
 def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
-                logger):
-    """Reclaim-first, then plan-and-claim. Returns (batch_view, leases,
-    member_requests) or None when nothing is claimable right now."""
+                logger, predictive=False):
+    """Reclaim-first, then pinned compositions, then plan-and-claim.
+    Returns (batch_view, leases, member_requests) or None when nothing is
+    claimable right now. ``predictive`` arms the cold-compile claim
+    ordering over fresh admission plans (ISSUE 15)."""
+    now = time.time()
     by_id = {r["request_id"]: r for r in q.requests()}
 
     # 1) reclaim: an expired lease records the batch it was claimed under —
@@ -208,6 +242,19 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
     pinned_ids = {rid for p in pinned for rid in (p.get("requests") or ())}
     for pin in pinned:
         batch_id = pin["batch_id"]
+        # deadline-aware preemption (ISSUE 15): a preempted composition is
+        # pinned WITH the beneficiary it yielded the mesh to — defer
+        # claiming it while that request is still waiting (no terminal
+        # record, no live lease), so this cycle falls through to fresh
+        # planning and serves the beneficiary first. Once it is being
+        # served (live lease elsewhere) or settled, the pin resumes the
+        # preempted fit from its checkpoint in the same run dir
+        after = pin.get("after_request")
+        if after and after in by_id and not q.is_terminal(after):
+            lease = q.lease_of(after)
+            if lease is None \
+                    or float(lease.get("expires_at") or 0.0) <= now:
+                continue
         rids_all = [r for r in pin["requests"] if r in by_id]
         claimable = [r for r in rids_all if not q.is_terminal(r)]
         if not claimable:
@@ -221,8 +268,12 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
             # composition (same content-derived lane seeds, so any prior
             # run of this exact composition still resumes cleanly)
             new_id = _planner.batch_id_for(claimable)
+            # a re-keyed pin keeps its preemption-beneficiary deferral:
+            # dropping after_request here would let the preempted batch
+            # jump ahead of the tenant it yielded the mesh to
             q.pin_batch(new_id, claimable,
-                        parent_batch_id=pin.get("parent_batch_id"))
+                        parent_batch_id=pin.get("parent_batch_id"),
+                        after_request=pin.get("after_request"))
             q.unpin_batch(batch_id)
             batch_id, rids_all = new_id, claimable
         leases = _claim_batch(q, worker_id, lease_s, batch_id, claimable,
@@ -255,9 +306,10 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
     if not pending:
         return None
     t0 = time.perf_counter()
+    cost_model = _costmodel.load()
     pl = _planner.plan(pending, n_devices=n_devices,
                        budget_bytes=budget_bytes,
-                       cost_model=_costmodel.load(), max_bucket=max_bucket,
+                       cost_model=cost_model, max_bucket=max_bucket,
                        suspects=suspects)
     record_span("fleet.plan", (time.perf_counter() - t0) * 1e3,
                 component="fleet", logger=logger, emit=True,
@@ -274,7 +326,10 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
                             "priority", "suspect")}
                           for b in pl["batches"][:8]],
                worker=worker_id)
-    for b in pl["batches"]:
+    batches = pl["batches"]
+    if predictive and cost_model is not None and len(batches) > 1:
+        batches = _cold_compile_order(batches, logger, worker_id)
+    for b in batches:
         rids = [r for r in b["requests"]
                 if r in by_id and not q.is_terminal(r)]
         if not rids:
@@ -300,6 +355,265 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
             members = [by_id[r] for r in b["requests"] if r in by_id]
             return b, leases, members
     return None
+
+
+def _cold_compile_order(batches, logger, worker_id):
+    """Cold-compile claim ordering (ISSUE 15 tentpole, the worker's half of
+    warming the compile cache on the critical path): within the plan's
+    LEADING urgency class — the prefix of batches sharing the head's
+    (priority, deadline) — claim the batch whose first-touch program is the
+    LONGEST predicted cold compile first. Whoever claims it starts XLA on
+    the fleet's most expensive missing executable immediately (overlapped
+    with that fit's own prefetch/warmup, under the engine's op-scoped
+    ``compile`` heartbeat excuse), so sibling workers and every later batch
+    of the same family hit the shared persistent cache warm. Warm and
+    unpriceable batches keep their urgency order after the cold group —
+    ordering is pure decision math in parallel/policy.py ``compile_order``
+    over the batch views' ``cold_compile_ms`` (priced ONCE at plan time,
+    the single source of truth); urgency classes are never crossed."""
+    head = batches[0]
+    hkey = (head.get("priority"), head.get("deadline_s"))
+    n = 0
+    for b in batches:
+        if (b.get("priority"), b.get("deadline_s")) != hkey:
+            break
+        n += 1
+    if n <= 1:
+        return batches
+    order = PredictiveSchedulingPolicy.compile_order(batches[:n])
+    if order == list(range(n)):
+        return batches
+    logger.log("policy", kind="compile_order",
+               order=[batches[i]["batch_id"] for i in order],
+               worker=worker_id)
+    return [batches[i] for i in order] + batches[n:]
+
+
+class _PreemptMonitor:
+    """Deadline-aware preemption (ISSUE 15 tentpole): while a supervised
+    batch runs, periodically price every queued HIGHER-priority tenant
+    with a deadline against the running batch — would its deadline be
+    missed if we wait, and met if we checkpoint-and-yield now? Preempt only
+    when BOTH predictions exist and both answers are yes: a preemption is
+    never triggered on a guess (no usable cost-model prior on either side
+    means hold, mirroring the policy's bit-identical fallback contract).
+
+    Mechanics ride machinery that already exists end to end: the SIGTERM
+    lands on the supervised ``run_batch`` child, whose PreemptionGuard
+    (PR 1) drains the in-flight epoch, writes a final checkpoint, and exits
+    ``EXIT_PREEMPTED``; ``supervise``'s ``should_stop`` hook turns that
+    into a stop instead of a restart; the settle path releases the leases
+    as ZERO-CHARGE reclaims (PR 11 attempt budgets untouched — a preemption
+    is a reclaim, never a failure) and pins the exact composition with
+    ``after_request`` so the beneficiary claims the mesh first and the
+    preempted fit then resumes bit-identically from its checkpoint in the
+    same run dir (PR 10 lease/pin paths). The signal is gated on the
+    batch's first durable grid checkpoint: before it exists the child's
+    guard may not be installed and there is nothing to resume from.
+
+    Remaining-work estimate for the running batch: the fit's own newest
+    ``cost_model`` ETA (metrics tail beside the batch ledger — the PR 8
+    scoring events), else the store-level ``predict_fit_eta`` minus elapsed
+    wall; the queued tenant's cost is the planner's own batch-view pricing,
+    cold compile included. Every pricing lands as a ``policy`` event
+    (kind=preempt_price, action=hold|preempt) and the signal as a
+    ``preempt`` event — the ``obs watch`` fleet headline's source."""
+
+    def __init__(self, q, batch, members, run_dir, logger, worker_id,
+                 n_devices=1, grace_s=None, poll_s=None, now=None):
+        self._q = q
+        self._batch = batch
+        self._members = members
+        self._member_ids = {m["request_id"] for m in members}
+        self._run_dir = run_dir
+        self._logger = logger
+        self._worker = worker_id
+        self._n_devices = int(n_devices or 1)
+        self._grace = float(grace_s if grace_s is not None else
+                            os.environ.get(ENV_PREEMPT_GRACE,
+                                           DEFAULT_PREEMPT_GRACE_S))
+        self._poll = float(poll_s if poll_s is not None else
+                           os.environ.get(ENV_PREEMPT_POLL,
+                                          DEFAULT_PREEMPT_POLL_S))
+        self._started = time.time() if now is None else now
+        self._proc = None
+        self._held = set()    # candidates already priced+logged as hold
+        # poll-tick caches (the monitor runs for the whole batch lifetime):
+        # the cost model re-parses only when the store file changes (the
+        # watch.py (mtime, size)-signature pattern), and the queue rescan
+        # is skipped while the spool is unchanged AND the last scan found
+        # no candidate — the steady no-urgent-work state costs two stats.
+        # The skip is bounded by _RESCAN_S: a candidate can also become
+        # pending WITHOUT a spool write (another worker's lease on it
+        # expires/releases), so a periodic full rescan backstops the
+        # signature gate
+        self._cm_sig = None
+        self._cm = None
+        self._spool_sig = ()
+        self._had_candidates = False
+        self._last_scan = 0.0
+        self._errored = False
+        self.requested = False
+        self.decision = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-preempt-monitor")
+
+    # full-queue rescan backstop cadence (see __init__): pending-set changes
+    # that bypass the spool signature are picked up within this bound
+    _RESCAN_S = 2.0
+
+    # supervise() hooks -------------------------------------------------
+    def on_spawn(self, proc):
+        self._proc = proc
+
+    def should_stop(self):
+        return self.requested
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    # -------------------------------------------------------------------
+    def _log(self, event, **kw):
+        try:
+            self._logger.log(event, **kw)
+        except Exception:  # noqa: BLE001 — telemetry trouble must never
+            pass           # take down the batch loop
+
+    def _run(self):
+        while not self._stop.wait(self._poll):
+            if self.requested:
+                return
+            try:
+                self._check(time.time())
+            except Exception as e:  # noqa: BLE001 — pricing is advisory;
+                if not self._errored:  # a bug here must not kill the batch
+                    self._errored = True
+                    self._log("policy", kind="preempt_price",
+                              action="error", worker=self._worker,
+                              reason=f"{type(e).__name__}: {e}")
+
+    def _running_remaining_s(self, now, cost_model):
+        """Predicted seconds until the RUNNING batch finishes: the fit's
+        own newest cost_model ETA when THIS batch's telemetry has one
+        (since_wall pins it to this batch — a stale dir never leaks an
+        old attempt's eta), discounted by the event's age so a sparse
+        check-window cadence cannot overstate remaining work by a whole
+        window; else the store-level whole-fit prediction minus elapsed
+        wall; None = no usable prior (never preempt on a guess)."""
+        eta = latest_cost_model_eta(
+            os.path.join(self._run_dir, "run_ledger.jsonl"),
+            since_wall=self._started)
+        if eta is not None and isinstance(eta.get("eta_s"), (int, float)):
+            age = (max(now - eta["wall_time"], 0.0)
+                   if isinstance(eta.get("wall_time"), (int, float))
+                   else 0.0)
+            return max(float(eta["eta_s"]) - age, 0.0)
+        view = _planner._batch_view(self._members, self._n_devices,
+                                    cost_model=cost_model)
+        if view.get("eta_s") is None:
+            return None
+        return max(float(view["eta_s"]) - (now - self._started), 0.0)
+
+    def _load_cost_model(self):
+        path = _costmodel.store_path()
+        if path is None:
+            return None
+        try:
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            sig = None
+        if sig != self._cm_sig:
+            self._cm_sig = sig
+            self._cm = _costmodel.load() if sig is not None else None
+        return self._cm
+
+    def _check(self, now):
+        spool_sig = None
+        try:
+            st = os.stat(self._q.spool_path)
+            spool_sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+        if spool_sig == self._spool_sig and not self._had_candidates \
+                and now - self._last_scan < self._RESCAN_S:
+            return  # nothing new submitted, nobody was waiting last scan
+        cost_model = self._load_cost_model()
+        if cost_model is None:
+            return
+        self._spool_sig = spool_sig
+        self._last_scan = now
+        batch_pri = int(self._batch.get("priority") or 0)
+        cands = [r for r in self._q.pending(now=now)
+                 if r["request_id"] not in self._member_ids
+                 and r.get("deadline_s") is not None
+                 and int(r.get("priority") or 0) > batch_pri]
+        self._had_candidates = bool(cands)
+        if not cands:
+            return
+        run_rem = self._running_remaining_s(now, cost_model)
+        if run_rem is None:
+            return
+        for r in sorted(cands, key=_planner._order_key):
+            rid = r["request_id"]
+            view = _planner._batch_view([r], self._n_devices,
+                                        cost_model=cost_model)
+            eta_r = view.get("eta_s")
+            if eta_r is None:
+                continue  # no prior for the tenant's shape: hold
+            deadline_at = (float(r.get("submitted_at") or 0.0)
+                           + float(r["deadline_s"]))
+            miss_if_wait = now + run_rem + eta_r > deadline_at
+            meets_if_preempt = now + self._grace + eta_r <= deadline_at
+            fields = {
+                "batch_id": self._batch["batch_id"],
+                "queued_eta_s": round(float(eta_r), 3),
+                "running_rem_s": round(run_rem, 3),
+                "deadline_at": round(deadline_at, 3),
+                "slack_s": round(deadline_at - now - eta_r, 3),
+                "grace_s": self._grace,
+                "priority": int(r.get("priority") or 0),
+                "worker": self._worker,
+            }
+            if miss_if_wait and meets_if_preempt:
+                # durable-state gate: without a checkpoint there is nothing
+                # to resume and the child's guard may not be up yet — hold
+                # this poll, the decision re-prices next tick
+                if not os.path.exists(os.path.join(self._run_dir,
+                                                   "grid_checkpoint.pkl")):
+                    return
+                proc = self._proc
+                if proc is None or proc.poll() is not None:
+                    return  # no live child to yield (racing an exit)
+                self.decision = dict(fields, beneficiary=rid,
+                                     tenant=str(r.get("tenant")))
+                self.requested = True
+                self._log("policy", kind="preempt_price", action="preempt",
+                          request_id=rid, **fields)
+                self._log("preempt", kind="signal", beneficiary=rid,
+                          tenant=str(r.get("tenant")),
+                          requests=sorted(self._member_ids),
+                          run_dir=self._run_dir, **fields)
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+                return
+            if rid not in self._held:
+                # first hold pricing per candidate (not every poll): the
+                # audit trail that the monitor SAW the tenant and why it
+                # stayed its hand
+                self._held.add(rid)
+                self._log("policy", kind="preempt_price", action="hold",
+                          request_id=rid,
+                          reason=("meets_deadline" if not miss_if_wait
+                                  else "missed_even_preempting"), **fields)
 
 
 class _LeaseHeartbeat:
@@ -371,10 +685,15 @@ class _LeaseHeartbeat:
 def run_one_batch(q, batch, leases, members, logger, worker_id,
                   lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
                   env=None, python=None,
-                  max_attempts=DEFAULT_MAX_ATTEMPTS):
+                  max_attempts=DEFAULT_MAX_ATTEMPTS, n_devices=1,
+                  predictive=None, preempt_monitor=None):
     """Run one claimed batch under the crash-loop supervisor and settle its
     requests (containment discipline — see the module docstring); returns
     the :class:`~redcliff_tpu.runtime.supervisor.SuperviseOutcome`.
+
+    ``predictive`` (None = the ``REDCLIFF_PREDICTIVE`` env gate) arms the
+    deadline-aware preemption monitor; ``preempt_monitor`` injects a
+    pre-built monitor (tests).
 
     The batch runs under its TRACE CONTEXT (batch id + each member's
     submit-minted trace id): set process-wide for the worker's own spans
@@ -388,7 +707,9 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                               ctx, lease_s=lease_s,
                               checkpoint_every=checkpoint_every,
                               supervisor_policy=supervisor_policy, env=env,
-                              python=python, max_attempts=max_attempts)
+                              python=python, max_attempts=max_attempts,
+                              n_devices=n_devices, predictive=predictive,
+                              preempt_monitor=preempt_monitor)
     finally:
         _spans.set_trace_ctx(prev_ctx)
 
@@ -396,7 +717,8 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
 def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
                    lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
                    env=None, python=None,
-                   max_attempts=DEFAULT_MAX_ATTEMPTS):
+                   max_attempts=DEFAULT_MAX_ATTEMPTS, n_devices=1,
+                   predictive=None, preempt_monitor=None):
     batch_id = batch["batch_id"]
     run_dir = q.batch_dir(batch_id)
     os.makedirs(run_dir, exist_ok=True)
@@ -407,8 +729,13 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
         # the identical content from the lease-recorded member order
         tmp = f"{batch_file}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
+            # g_bucket: the planner-ADMITTED width (deterministic from the
+            # composition, so a reclaiming worker rebuilds it identically);
+            # run_batch exports it as the predictive policy's widening
+            # ceiling — the HBM admission gate priced THIS width
             json.dump({"batch_id": batch_id, "run_dir": run_dir,
                        "checkpoint_every": int(checkpoint_every),
+                       "g_bucket": batch.get("g_bucket"),
                        "requests": members}, f, allow_nan=False)
             f.flush()
             os.fsync(f.fileno())
@@ -436,11 +763,24 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
     child_env[_spans.ENV_TRACE_CTX] = json.dumps(trace_ctx)
     started_at = time.time()
     t0 = time.perf_counter()
-    with _LeaseHeartbeat(leases, lease_s, logger) as hb:
+    # deadline-aware preemption monitor (ISSUE 15): armed by the
+    # REDCLIFF_PREDICTIVE gate (or injected by tests); inert when off —
+    # supervise runs exactly as before
+    monitor = preempt_monitor
+    if monitor is None and (predictive if predictive is not None
+                            else predictive_enabled()):
+        monitor = _PreemptMonitor(q, batch, members, run_dir, logger,
+                                  worker_id, n_devices=n_devices,
+                                  now=started_at)
+    with _LeaseHeartbeat(leases, lease_s, logger) as hb, \
+            (monitor if monitor is not None else contextlib.nullcontext()):
         outcome = supervise(
             cmd, ledger_path=ledger_path,
             policy=supervisor_policy or SupervisorPolicy(max_restarts=2),
-            env=child_env)
+            env=child_env,
+            on_spawn=monitor.on_spawn if monitor is not None else None,
+            should_stop=monitor.should_stop if monitor is not None
+            else None)
     dur_ms = (time.perf_counter() - t0) * 1e3
     record_span("fleet.batch", dur_ms, component="fleet", logger=logger,
                 emit=True, batch_id=batch_id,
@@ -448,7 +788,7 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
 
     lost = set(hb.lost)
     settled = {"done": [], "failed": [], "released": [], "deadletter": [],
-               "bisected": [], "lost": sorted(lost)}
+               "bisected": [], "preempted": [], "lost": sorted(lost)}
     cls = outcome.classification
     live = [(rid, leases[rid]) for rid in leases if rid not in lost]
 
@@ -512,6 +852,33 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
             logger.log("fleet", kind="complete", batch_id=batch_id,
                        requests=[rid], tenants=[str(rec.get("tenant"))],
                        worker=worker_id)
+    elif monitor is not None and monitor.requested:
+        # deadline-aware preemption settle (ISSUE 15): the batch stopped
+        # because THIS worker asked it to yield — whatever the exact exit
+        # class (normally `preempted`; `signal` if the SIGTERM landed in a
+        # pre-guard window), it is a RECLAIM, never a charged failure:
+        # attempts record kind="reclaim" (dossier evidence, budget
+        # untouched — PR 11), the leases release cleanly, and the exact
+        # composition is pinned with the beneficiary so the mesh serves the
+        # deadline tenant first and this fit then resumes bit-identically
+        # from its checkpoint in the same run dir
+        rids_all = [m["request_id"] for m in members]
+        beneficiary = (monitor.decision or {}).get("beneficiary")
+        for rid, lease in live:
+            q.record_attempt(rid, "preempted", batch_id=batch_id,
+                             run_dir=run_dir, kind="reclaim")
+            lease.release()
+            settled["preempted"].append(rid)
+        q.pin_batch(batch_id, rids_all, after_request=beneficiary)
+        logger.log("preempt", kind="preempted", batch_id=batch_id,
+                   requests=rids_all, tenants=batch.get("tenants"),
+                   beneficiary=beneficiary, run_dir=run_dir,
+                   worker=worker_id)
+        _history.append_event(
+            q.root, "preempted", batch_id=batch_id, requests=rids_all,
+            trace_ids={rid: trace_of(rid) for rid in rids_all
+                       if trace_of(rid)},
+            beneficiary=beneficiary, worker=worker_id)
     elif cls in TERMINAL_FAIL_CLASSES and len(live) > 1:
         # terminal failure of a MERGED batch with no per-lane attribution:
         # never blame every member — bisect, so halving corners the poison
@@ -564,7 +931,8 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
                done=len(settled["done"]), failed=len(settled["failed"]),
                released=len(settled["released"]),
                deadlettered=len(settled["deadletter"]),
-               bisected=len(settled["bisected"]), worker=worker_id)
+               bisected=len(settled["bisected"]),
+               preempted=len(settled["preempted"]), worker=worker_id)
     return outcome
 
 
@@ -671,7 +1039,7 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
          drain=False, once=False, n_devices=1, budget_bytes=None,
          max_bucket=_planner.DEFAULT_MAX_BUCKET, checkpoint_every=1,
          supervisor_policy=None, env=None, python=None,
-         max_attempts=DEFAULT_MAX_ATTEMPTS):
+         max_attempts=DEFAULT_MAX_ATTEMPTS, predictive=None):
     """The worker loop; returns the number of batches run.
 
     ``drain``: exit once the queue holds no claimable or running work.
@@ -679,9 +1047,13 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
     ``budget_bytes``: the admission HBM budget (``check_headroom``'s
     ``budget_bytes`` on the serving mesh; None = ungated, e.g. this CPU
     container). ``max_attempts``: the per-request retry budget (failure
-    attempts before a request is dead-lettered)."""
+    attempts before a request is dead-lettered). ``predictive`` (None =
+    the ``REDCLIFF_PREDICTIVE`` env gate) arms the cold-compile claim
+    ordering and the deadline-aware preemption monitor (ISSUE 15)."""
     q = FleetQueue(root)
     worker_id = worker_id or default_worker_id()
+    predictive = (predictive_enabled() if predictive is None
+                  else bool(predictive))
     batches_run = 0
     with _logger(root) as logger:
         logger.log("fleet", kind="worker_start", worker=worker_id,
@@ -690,7 +1062,8 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
         try:
             while True:
                 got = _next_batch(q, worker_id, lease_s, n_devices,
-                                  budget_bytes, max_bucket, logger)
+                                  budget_bytes, max_bucket, logger,
+                                  predictive=predictive)
                 if got is not None:
                     batch, leases, members = got
                     run_one_batch(q, batch, leases, members, logger,
@@ -698,7 +1071,9 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
                                   checkpoint_every=checkpoint_every,
                                   supervisor_policy=supervisor_policy,
                                   env=env, python=python,
-                                  max_attempts=max_attempts)
+                                  max_attempts=max_attempts,
+                                  n_devices=n_devices,
+                                  predictive=predictive)
                     batches_run += 1
                     if max_batches is not None \
                             and batches_run >= max_batches:

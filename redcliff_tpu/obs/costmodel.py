@@ -39,15 +39,21 @@ training path.
 shape, width) bucket -> nearest-width bucket of the same (platform, shape)
 scaled linearly by the width ratio (lane math is width-independent in the
 vmapped engine, so per-lane cost is ~flat across buckets; the XLA
-width-rounding caveat is a ~1 ulp numerics effect, not a cost effect) ->
-no prediction (``None``). ``predict_fit_eta`` prices ``epochs`` epochs plus
+width-rounding caveat is a ~1 ulp numerics effect, not a cost effect),
+CLAMPED to the adjacent rung (width ratio <= 2 — the log-spaced ladder
+makes any longer reach extrapolation, which previously answered
+confidently-wrong ETAs at the ladder extremes) -> no prediction
+(``None``). ``predict_fit_eta`` prices ``epochs`` epochs plus
 ``cold_programs`` cold compiles.
 
-**Scoring**: predictions are logged and scored, not yet acted on — the grid
-engine emits a schema-registered ``cost_model`` event each check window
-(prediction vs actual epoch time, residual pct, running MAPE, remaining-fit
-ETA) and ``obs report`` aggregates them into the per-bucket accuracy table.
-Wiring predictions into scheduling decisions is ROADMAP item 4's follow-up.
+**Scoring & steering**: the grid engine emits a schema-registered
+``cost_model`` event each check window (prediction vs actual epoch time,
+residual pct, running MAPE, remaining-fit ETA) and ``obs report``
+aggregates them into the per-bucket accuracy table. As of ISSUE 15 the
+predictions also STEER: the predictive scheduling policy
+(parallel/policy.py, ``REDCLIFF_PREDICTIVE``) prices bucket widths and
+compaction points from this store, and the fleet worker's deadline-aware
+preemption prices queued tenants' fit ETAs against running batches.
 
 stdlib only — the supervisor (which must never initialize a jax backend)
 and the watch/report CLIs all import this path.
@@ -198,12 +204,22 @@ class CostModel:
                 return float(b["epoch_ms_total"]) / int(b["epochs"])
         return None
 
+    # how far from an observed rung the linear width-scaling fallback may
+    # reach: the ladder is log-spaced (powers of two, mesh-adjusted), so one
+    # rung away is a 2x width ratio — the largest step where "per-lane cost
+    # is ~flat" is still evidence rather than extrapolation. Scaling bucket
+    # 4's mean out to 256 (a 64x ratio) answered confidently-wrong ETAs at
+    # the ladder extremes (ISSUE 15 satellite); past the clamp the answer
+    # is None — no evidence, never a wild guess.
+    ADJACENT_RUNG_RATIO = 2.0
+
     def predict_epoch_ms(self, shape_key, g_bucket, platform=None,
                          precision="f32"):
         """Predicted wall ms for one epoch of ``shape_key`` at execution
         width ``g_bucket``: exact bucket mean, else the nearest-width
         bucket of the same (shape, precision) scaled linearly by the width
-        ratio, else None (no evidence)."""
+        ratio — CLAMPED to adjacent-rung scaling
+        (:data:`ADJACENT_RUNG_RATIO`) — else None (no evidence)."""
         exact = self.epoch_ms_mean(shape_key, g_bucket, platform=platform,
                                    precision=precision)
         if exact is not None:
@@ -215,6 +231,9 @@ class CostModel:
             n = int(b.get("epochs") or 0)
             if w <= 0 or n <= 0:
                 continue
+            if max(w, want) / min(w, want) > self.ADJACENT_RUNG_RATIO:
+                continue  # beyond the adjacent rung: extrapolation, not
+                #           evidence (None beats a 64x-scaled guess)
             # nearest width on the (log-spaced) bucket ladder
             d = abs(w - want) / max(w, want)
             if best is None or d < best[0]:
@@ -223,6 +242,21 @@ class CostModel:
             return None
         _, w, mean_ms = best
         return mean_ms * (want / w)
+
+    def compile_warm(self, shape_key, g_bucket, platform=None,
+                     precision="f32"):
+        """Whether the EXACT (platform?, shape, width, precision) bucket has
+        compile evidence: the program family was compiled before on this
+        store's lifetime, so — the persistent XLA cache riding the same base
+        dir — a first touch is a warm retrieval, not a cold compile. The
+        predictive scheduling policy (parallel/policy.py) treats warm rungs
+        as free to move to and prices cold ones by
+        :meth:`predict_compile_ms`."""
+        for b in self._candidates(shape_key, platform, precision):
+            if int(b.get("g_bucket") or 0) == int(g_bucket) \
+                    and int(b.get("compiles") or 0) > 0:
+                return True
+        return False
 
     def predict_compile_ms(self, shape_key, g_bucket, platform=None,
                            precision="f32"):

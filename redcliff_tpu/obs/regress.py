@@ -167,6 +167,17 @@ FAMILIES = [
     # post-mortem must stay cheap enough to run on every incident
     Family("fleet_trace.export_ms", better="lower", band=_BAND_TIMING,
            abs_floor=250.0, g_dependent=False),
+    # predictive scheduling policy (ISSUE 15, parallel/policy.py): the
+    # simulated mixed-shape sweep makespan under the predictive policy over
+    # the heuristic ladder — < 1.0 is the win the policy exists for, and
+    # the absolute ceiling (contract_max) pins the acceptance bound even on
+    # a trajectory whose priors were already in breach. decide_ms keeps the
+    # pure-host decision pricing queue-scan cheap (it runs at every check
+    # window and every worker claim cycle)
+    Family("predictive_policy.makespan_ratio", better="lower",
+           band=_BAND_TIMING, g_dependent=False, contract_max=1.0),
+    Family("predictive_policy.decide_ms", better="lower", band=_BAND_TIMING,
+           abs_floor=50.0, g_dependent=False),
     # scientific regression families (ISSUE 13, obs/quality.py): the
     # quality probe's graph-recovery score on the deterministic synthetic
     # sVAR grid fit, the top-k edge-set stability at the end of that fit,

@@ -150,6 +150,8 @@ def build_report(run_dir):
     anomalies = rollbacks = aborts = skipped_steps = 0
     precision_events = []  # mixed-precision demotions (ISSUE 14)
     autotune_events = []   # kernel-tiling searches/lookups (ops/autotune.py)
+    policy_events = []     # predictive-policy decisions (ISSUE 15)
+    preempt_events = []    # deadline-aware preemption events (ISSUE 15)
     quarantined = 0
     stats_sum = {k: 0 for k in _SUM_STATS}
     t_first = t_last = None
@@ -295,6 +297,15 @@ def build_report(run_dir):
                                     ("kernel", "kind", "shape", "g_bucket",
                                      "tile", "search_ms",
                                      "speedup_vs_default")})
+        elif ev == "policy":
+            # predictive scheduling decisions (ISSUE 15, parallel/policy.py
+            # via the grid engine / fleet worker): kept with the emitting
+            # fit's shape key so compaction decisions can be joined against
+            # the observed per-width epoch costs (predicted vs REALIZED)
+            policy_events.append(dict(
+                rec, _shape_key=(cur or {}).get("shape_key", "unknown")))
+        elif ev == "preempt":
+            preempt_events.append(rec)
         elif ev == "fit_end":
             ds = rec.get("dispatch_stats")
             # quality snapshot: inside dispatch_stats for the grid engine,
@@ -383,6 +394,73 @@ def build_report(run_dir):
             "last_eta_s": last.get("eta_s"),
             "last_epoch": last.get("epoch"),
         })
+
+    # predictive policy decision table (ISSUE 15): what the policy decided
+    # (compact / hold / widen / fallback), the saving it PREDICTED, and —
+    # for executed compactions, joined against the observed per-width epoch
+    # means above — the saving it REALIZED. The fallback count is the
+    # "how often did the store have no usable prior" health signal
+    def _observed_mean_ms(sk, width):
+        acc = cost.get((sk, int(width or 0)))
+        if not acc:
+            return None
+        exact = acc["epochs_exact"] > 0
+        n = acc["epochs_exact"] if exact else acc["epochs_sampled"]
+        ms = acc["epoch_ms_exact"] if exact else acc["epoch_ms_sampled"]
+        return ms / n if n else None
+
+    policy_decisions = None
+    if policy_events or preempt_events:
+        by_action = {}
+        fallbacks = 0
+        pred_sum = real_sum = 0.0
+        joined = 0
+        rows = []
+        for p in policy_events:
+            key = f"{p.get('kind')}:{p.get('action') or '?'}"
+            by_action[key] = by_action.get(key, 0) + 1
+            if p.get("fallback"):
+                fallbacks += 1
+            realized = None
+            if p.get("kind") == "compaction" and p.get("action") == "compact" \
+                    and isinstance(p.get("saving_ms"), (int, float)) \
+                    and isinstance(p.get("epochs_remaining"), (int, float)):
+                mf = _observed_mean_ms(p.get("_shape_key"),
+                                       p.get("from_width"))
+                mt = _observed_mean_ms(p.get("_shape_key"),
+                                       p.get("to_width"))
+                if mf is not None and mt is not None:
+                    realized = (mf - mt) * p["epochs_remaining"]
+                    pred_sum += p["saving_ms"]
+                    real_sum += realized
+                    joined += 1
+            rows.append({
+                "kind": p.get("kind"), "action": p.get("action"),
+                "epoch": p.get("epoch"),
+                "fallback": bool(p.get("fallback")),
+                "from_width": p.get("from_width"),
+                "to_width": p.get("to_width"),
+                "chosen_width": p.get("chosen_width"),
+                "heuristic_width": p.get("heuristic_width"),
+                "predicted_saving_ms": p.get("saving_ms"),
+                "realized_saving_ms": (round(realized, 3)
+                                       if realized is not None else None),
+                "compile_ms": p.get("compile_ms"),
+                "epochs_remaining": p.get("epochs_remaining"),
+                "beneficiary": p.get("beneficiary"),
+                "reason": p.get("reason")})
+        policy_decisions = {
+            "decisions": len(policy_events),
+            "by_action": dict(sorted(by_action.items())),
+            "fallbacks": fallbacks,
+            "predicted_saving_ms": (round(pred_sum, 3) if joined else None),
+            "realized_saving_ms": (round(real_sum, 3) if joined else None),
+            "preempts": sum(1 for p in preempt_events
+                            if p.get("kind") == "preempted"),
+            "preempt_signals": sum(1 for p in preempt_events
+                                   if p.get("kind") == "signal"),
+            "rows": rows[-16:],
+        }
 
     # model-quality section (obs/quality.py): per-fit convergence readouts
     # from the quality events + the fit_end snapshot, and — on fleet batch
@@ -572,6 +650,7 @@ def build_report(run_dir):
                         "by_bucket": by_bucket},
         "compactions": compactions,
         "remeshes": remeshes,
+        "policy_decisions": policy_decisions,
         "tenants": tenants,
         "fleet_containment": containment,
         "fleet_slo": fleet_slo,
@@ -865,6 +944,38 @@ def render_text(report):
     else:
         out.append("  store: no compile-cache dir configured "
                    "(REDCLIFF_COMPILE_CACHE / compile_cache_dir)")
+    pd = r.get("policy_decisions")
+    if pd:
+        out.append(
+            f"predictive policy decisions (parallel/policy.py, "
+            f"REDCLIFF_PREDICTIVE): {pd['decisions']} decision(s), "
+            f"{pd['fallbacks']} heuristic fallback(s), "
+            f"{pd['preempt_signals']} preempt signal(s), "
+            f"{pd['preempts']} preemption(s)")
+        if pd.get("by_action"):
+            out.append("  by action: " + "  ".join(
+                f"{k}={v}" for k, v in pd["by_action"].items()))
+        if pd.get("predicted_saving_ms") is not None:
+            out.append(
+                f"  executed compactions: predicted saving "
+                f"{_fmt_ms(pd['predicted_saving_ms'])} vs realized "
+                f"{_fmt_ms(pd['realized_saving_ms'])}")
+        for row in pd.get("rows") or []:
+            if row["kind"] == "compaction":
+                body = (f"{row['from_width']}->{row['to_width']} "
+                        f"pred {_fmt_ms(row['predicted_saving_ms'])}"
+                        f" real {_fmt_ms(row['realized_saving_ms'])}"
+                        f" ({row['epochs_remaining']} epochs left)")
+            elif row["kind"] == "initial_width":
+                body = (f"rung {row['chosen_width']} "
+                        f"(heuristic {row['heuristic_width']})")
+            else:
+                body = row.get("beneficiary") or row.get("reason") or ""
+            out.append(f"  {row['kind']}:{row['action']}"
+                       + (" [fallback]" if row["fallback"] else "")
+                       + (f" @e{row['epoch']}"
+                          if row.get("epoch") is not None else "")
+                       + f" {body}")
     tc = r.get("tpu_bench_cache")
     if tc:
         out.append(f"cached real-TPU evidence: {tc.get('value')} w/s on "

@@ -236,6 +236,37 @@ EVENTS = {
         optional=("residual_pct", "grid_width", "source", "eta_s",
                   "epochs_remaining", "samples", "mape_pct",
                   "predicted_compile_ms")),
+    "policy": _ev(
+        "predictive scheduling policy (ISSUE 15, parallel/policy.py "
+        "decisions consulted from the learned cost model, logged by the "
+        "grid engine and the fleet worker; kind=initial_width — the priced "
+        "starting-rung choice at fit start; kind=compaction — the "
+        "compact/hold/fallback pricing of one check window's ladder move; "
+        "kind=compile_order — the worker's cold-compile claim ordering "
+        "over one admission plan; kind=preempt_price — the worker's "
+        "deadline-aware hold/preempt pricing of a queued tenant against "
+        "the running batch)",
+        required=("kind",),
+        optional=("epoch", "grid_width", "action", "fallback",
+                  "from_width", "to_width", "chosen_width",
+                  "heuristic_width", "saving_ms", "compile_ms", "gather_ms",
+                  "total_ms", "heuristic_ms", "epochs", "epochs_remaining",
+                  "order", "batch_id", "request_id", "beneficiary",
+                  "deadline_at", "eta_s", "queued_eta_s", "running_rem_s",
+                  "grace_s", "slack_s", "priority", "worker", "reason")),
+    "preempt": _ev(
+        "fleet worker deadline-aware preemption (ISSUE 15: "
+        "kind=signal — the worker decided a queued higher-priority "
+        "tenant's deadline would be missed and SIGTERMed the supervised "
+        "batch child after its checkpoint landed; kind=preempted — the "
+        "batch settled as a zero-charge reclaim: leases released, "
+        "composition pinned to resume bit-identically after the "
+        "beneficiary runs)",
+        required=("kind",),
+        optional=("batch_id", "requests", "tenants", "beneficiary",
+                  "tenant", "priority", "deadline_at", "eta_s",
+                  "queued_eta_s", "running_rem_s", "slack_s", "grace_s",
+                  "worker", "run_dir", "reason", "epoch")),
     "memory": _ev(
         "grid engine + trainers (obs/memory.py: kind=predicted — the "
         "analytical HBM footprint at fit start; kind=measured — a "
@@ -270,7 +301,7 @@ EVENTS = {
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
-                  "memory", "fleet", "quality")),
+                  "memory", "fleet", "quality", "policy", "preempt")),
     "fleet": _ev(
         "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
         "worker loop, run_batch driver, containment layer; kind=submit | "
@@ -288,7 +319,7 @@ EVENTS = {
                   # dead-letter routing, heartbeat renewal escalation,
                   # suspect-solo planning
                   "reason", "halves", "error", "consecutive", "suspects",
-                  "deadlettered", "bisected", "max_attempts",
+                  "deadlettered", "bisected", "max_attempts", "preempted",
                   # worker_crash (ISSUE 12): the uncaught-exception record
                   # + the flight-record artifact dumped before exit
                   "flight_record")),
@@ -296,13 +327,16 @@ EVENTS = {
         "fleet history ledger (fleet/history.py — the durable per-request "
         "lifecycle transitions obs/slo.py and the fleet trace export join; "
         "kind=submitted | planned | claimed | attempt | released | "
-        "bisected | settled | requeued)",
+        "bisected | settled | requeued | preempted — the zero-charge "
+        "checkpoint-and-yield transition ISSUE 15's deadline-aware "
+        "preemption records)",
         required=("kind",),
         optional=("request_id", "trace_id", "batch_id", "tenant", "worker",
                   "state", "classification", "attempt", "attempts",
                   "started_at", "requests", "trace_ids", "halves", "reason",
                   "priority", "deadline_s", "n_points", "submitted_at",
-                  "g_bucket", "reclaim", "run_dir", "parent_batch_id")),
+                  "g_bucket", "reclaim", "run_dir", "parent_batch_id",
+                  "beneficiary")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),

@@ -203,6 +203,8 @@ def build_snapshot(run_dir, now=None):
     fleet_workers = {}       # worker id -> last fleet-event wall time
     mem_pred = mem_meas = None  # newest memory events (obs/memory.py)
     last_quality = None      # newest quality event (obs/quality.py)
+    last_policy = None       # newest predictive-policy decision (ISSUE 15)
+    last_preempt = None      # newest deadline-aware preemption event
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
     last_wall = last_epoch_wall = None
@@ -248,6 +250,14 @@ def build_snapshot(run_dir, now=None):
             # check-window summary becomes the `quality:` headline; absent
             # on pre-quality runs (section simply omitted)
             last_quality = rec
+        elif ev == "policy":
+            # predictive scheduling (ISSUE 15, parallel/policy.py): the
+            # newest decision — chosen rung / compact-vs-hold pricing in a
+            # run dir, compile ordering / preemption pricing in a fleet
+            # root — becomes the `policy:` headline
+            last_policy = rec
+        elif ev == "preempt":
+            last_preempt = rec
         elif ev in ("compaction", "remesh") and cur is not None:
             if rec.get("to_width") is not None:
                 cur["grid_width"] = rec["to_width"]
@@ -360,6 +370,29 @@ def build_snapshot(run_dir, now=None):
             "age_s": (round(now - qwt, 3)
                       if isinstance(qwt, (int, float)) else None),
         }
+    # predictive-scheduling headlines (ISSUE 15): the newest policy
+    # decision and preemption event, age-stamped — None (sections omitted)
+    # on runs/roots that never decided predictively
+    policy = None
+    if last_policy is not None:
+        pwt = last_policy.get("wall_time")
+        policy = {k: last_policy.get(k) for k in
+                  ("kind", "action", "fallback", "epoch", "from_width",
+                   "to_width", "chosen_width", "heuristic_width",
+                   "saving_ms", "compile_ms", "heuristic_ms", "total_ms",
+                   "epochs_remaining", "beneficiary", "request_id",
+                   "batch_id", "reason")}
+        policy["age_s"] = (round(now - pwt, 3)
+                          if isinstance(pwt, (int, float)) else None)
+    preempt = None
+    if last_preempt is not None:
+        pwt = last_preempt.get("wall_time")
+        preempt = {k: last_preempt.get(k) for k in
+                   ("kind", "batch_id", "requests", "beneficiary", "tenant",
+                    "queued_eta_s", "running_rem_s", "deadline_at",
+                    "grace_s")}
+        preempt["age_s"] = (round(now - pwt, 3)
+                            if isinstance(pwt, (int, float)) else None)
     # fleet mode (fleet/queue.py roots): queue depth + per-tenant counts
     # from the authoritative file queue, live in-flight claims from the
     # lease files, and the planner's newest packing decision from the
@@ -382,6 +415,8 @@ def build_snapshot(run_dir, now=None):
                      "guarded_steps_skipped": int(last_skipped)},
         "memory": memory,
         "quality": quality,
+        "policy": policy,
+        "preempt": preempt,
         "heartbeats": heartbeats,
         "incidents": incidents,
         "attempts": {"n": len(attempts),
@@ -635,6 +670,40 @@ def render_text(snap):
             f"stability={fs(q.get('stability'))} "
             f"auroc={fs(q.get('auroc'))} "
             f"(age {_fmt_age(q.get('age_s'))})")
+    pol = snap.get("policy")
+    if pol:
+        fms = lambda v: (f"{v:.0f}ms" if isinstance(v, (int, float))
+                         else "-")
+        kind = pol.get("kind")
+        if kind == "compaction":
+            body = (f"{pol.get('action')} {pol.get('from_width')}->"
+                    f"{pol.get('to_width')} saving {fms(pol.get('saving_ms'))}"
+                    f" vs compile {fms(pol.get('compile_ms'))} "
+                    f"({pol.get('epochs_remaining')} epochs left)")
+        elif kind == "initial_width":
+            body = (f"{pol.get('action')} rung {pol.get('chosen_width')} "
+                    f"(heuristic {pol.get('heuristic_width')}, "
+                    f"saving {fms(pol.get('saving_ms'))})")
+        elif kind == "preempt_price":
+            body = (f"{pol.get('action')} "
+                    f"{pol.get('request_id') or pol.get('beneficiary') or ''}"
+                    + (f" ({pol['reason']})" if pol.get("reason") else ""))
+        else:
+            body = f"{kind} {pol.get('action') or ''}".strip()
+        out.append(f"  policy: {body}"
+                   + (" [fallback]" if pol.get("fallback") else "")
+                   + f" (age {_fmt_age(pol.get('age_s'))})")
+    pre = snap.get("preempt")
+    if pre:
+        out.append(
+            f"  preempt: {pre.get('kind')} batch {pre.get('batch_id')} -> "
+            f"{pre.get('beneficiary')}"
+            + (f" [{pre['tenant']}]" if pre.get("tenant") else "")
+            + (f" queued eta {_fmt_age(pre['queued_eta_s'])}"
+               if pre.get("queued_eta_s") is not None else "")
+            + (f", running rem {_fmt_age(pre['running_rem_s'])}"
+               if pre.get("running_rem_s") is not None else "")
+            + f" (age {_fmt_age(pre.get('age_s'))})")
     mem = snap.get("memory")
     if mem:
         fb = lambda b: (f"{b / (1 << 20):.1f}MB"

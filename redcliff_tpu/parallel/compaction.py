@@ -88,9 +88,11 @@ def ladder_widths(n_lanes, n_devices=1, max_width=None):
     """The bucket-ladder rungs from the width ``n_lanes`` requires up to
     ``max_width`` (default: 8x the base rung), ascending. The enumeration
     input for the device-memory observatory's per-rung HBM footprints
-    (obs/memory.py ``footprint_by_bucket``) and ROADMAP item 1's admission
-    planner: which widths COULD this shape run at, before asking what each
-    one costs in bytes and milliseconds."""
+    (obs/memory.py ``footprint_by_bucket``), the fleet admission planner,
+    and the predictive scheduling policy's initial-width pricing
+    (parallel/policy.py ``PredictiveSchedulingPolicy``, ISSUE 15): which
+    widths COULD this shape run at, before asking what each one costs in
+    bytes and milliseconds."""
     base = bucket_width(n_lanes, n_devices)
     if max_width is None:
         max_width = base * 8

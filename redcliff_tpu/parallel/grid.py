@@ -62,6 +62,7 @@ import optax
 from redcliff_tpu.data import pipeline
 from redcliff_tpu.models.redcliff import phase_schedule
 from redcliff_tpu.parallel import compaction, remesh
+from redcliff_tpu.parallel import policy as gridpolicy
 from redcliff_tpu.parallel.policy import GridSchedulingPolicy
 from redcliff_tpu.parallel.distributed import gather_to_host, put_along_mesh
 from redcliff_tpu.parallel.mesh import (Mesh, grid_mesh, replicated,
@@ -80,6 +81,7 @@ from redcliff_tpu.obs import quality as _quality
 from redcliff_tpu.ops import autotune as _autotune
 from redcliff_tpu.train.freeze import apply_freeze
 from redcliff_tpu.utils.precision import (matmul_precision_ctx,
+                                          precision_label,
                                           resolve_matmul_precision)
 
 __all__ = ["GridSpec", "GridResult", "RedcliffGridRunner", "group_configs_by_shape"]
@@ -285,6 +287,38 @@ class RedcliffGridRunner:
         self.policy = (policy if policy is not None
                        else GridSchedulingPolicy.from_train_config(
                            train_config))
+        # predictive scheduling (ISSUE 15, parallel/policy.py): when armed
+        # (REDCLIFF_PREDICTIVE) and a persistent cost-model store is
+        # readable, swap the default heuristic for the predictive policy
+        # BEFORE the initial-width decision below. Safe to arm anywhere:
+        # every decision falls back bit-identically to the heuristic when
+        # the store holds no usable prior, and the resume fingerprint is
+        # width-agnostic (the checkpoint carries its own era). A
+        # caller-supplied policy always wins — services inject their own
+        if policy is None and gridpolicy.predictive_enabled():
+            cm_base = (os.environ.get(_costmodel.ENV_STORE_DIR)
+                       or getattr(train_config, "compile_cache_dir", None)
+                       or os.environ.get(compileobs.ENV_CACHE_DIR) or None)
+            cm = _costmodel.load(cm_base) if cm_base else None
+            if cm is not None:
+                # REDCLIFF_POLICY_MAX_WIDTH: the admission ceiling a
+                # service priced its HBM/max_bucket gate at (the fleet
+                # batch driver exports the planner-admitted G-bucket) —
+                # warm-rung widening must never outgrow it
+                max_w = os.environ.get(gridpolicy.ENV_POLICY_MAX_WIDTH)
+                self.policy = gridpolicy.PredictiveSchedulingPolicy(
+                    g_bucket=self.policy.g_bucket,
+                    compaction=self.policy.compaction,
+                    cost_model=cm,
+                    shape_key=obs.schema.shape_key(self._shape_desc()),
+                    platform=jax.default_backend(),
+                    precision=precision_label(
+                        spec.precision_mode
+                        or getattr(train_config, "precision_mode", "f32"),
+                        getattr(train_config, "matmul_precision", None)),
+                    epochs=getattr(train_config, "max_iter", None),
+                    max_width=(int(max_w) if max_w
+                               and max_w.isdigit() else None))
         self._g_bucket = self.policy.g_bucket
         self._compaction_on = self.policy.compaction
         compileobs.enable_cache(
@@ -292,6 +326,11 @@ class RedcliffGridRunner:
         compileobs.install()
         n_dev = mesh.devices.size if mesh is not None else 1
         g_exec = self.policy.initial_width(G_real, n_dev)
+        # the initial-width decision record (predictive policy only): logged
+        # as a `policy` event once _fit has a logger in hand
+        self._policy_init_decision = (
+            self.policy.take_decision()
+            if hasattr(self.policy, "take_decision") else None)
         if mesh is not None and self._g_bucket:
             self.mesh = self._mesh_for(g_exec)
         self._g_exec0 = g_exec
@@ -351,6 +390,11 @@ class RedcliffGridRunner:
         resets the consecutive-skip counters."""
         self._precision = None
         self._demoted = True
+        # the predictive policy's cost buckets follow the demotion: pricing
+        # the rebuilt f32 programs from mixed-epoch evidence would mispredict
+        # every post-demotion decision
+        if hasattr(self.policy, "precision"):
+            self.policy.precision = "f32"
         self._build()
         # the rebuilt jit wrappers are new programs: let their first
         # dispatch run under the op-scoped compile heartbeat again
@@ -1509,10 +1553,9 @@ class RedcliffGridRunner:
         # precision half of the cost bucket (obs/costmodel.py): bf16 and
         # f32 epochs of the same program family are different costs — a
         # demoted fit folds/predicts under "f32" from the demotion on
-        from redcliff_tpu.utils.precision import precision_label as _plabel
-
-        cm_precision0 = _plabel(self._precision_mode,
-                                getattr(tc, "matmul_precision", None))
+        cm_precision0 = precision_label(self._precision_mode,
+                                        getattr(tc, "matmul_precision",
+                                                None))
         cm_n = 0          # residual samples scored this fit
         cm_abs_pct = 0.0  # running sum of |residual_pct| (MAPE numerator)
         # per-width accumulators frozen at a mid-fit demotion: epochs before
@@ -1544,6 +1587,13 @@ class RedcliffGridRunner:
         # metrics chain
         for atrec in _autotune.drain_records():
             logger.log("autotune", **atrec)
+        # the predictive policy's initial-width decision (ISSUE 15): priced
+        # at construction, logged here where the metrics chain exists —
+        # chosen rung, heuristic rung, predicted saving, fallback flag
+        if getattr(self, "_policy_init_decision", None):
+            logger.log("policy", epoch=start_it - 1, grid_width=Gx,
+                       **self._policy_init_decision)
+            self._policy_init_decision = None
         if self._demoted and start_it > 0:
             logger.log("precision", kind="resume_demoted",
                        epoch=start_it - 1, mode_from="mixed",
@@ -2129,7 +2179,18 @@ class RedcliffGridRunner:
                     act_host, orig_ids, retired.keys(),
                     self._mesh_full.devices.size
                     if self._mesh_full is not None else 1,
-                    n_processes=jax.process_count())
+                    n_processes=jax.process_count(),
+                    epochs_remaining=max(max_iter - it - 1, 0))
+                # predictive compaction pricing (ISSUE 15): the policy's
+                # decision record — compact / hold / heuristic fallback with
+                # the predicted saving vs compile+gather cost — lands as a
+                # schema-registered `policy` event (obs watch/report render
+                # these; the heuristic base policy records nothing)
+                pol_dec = (self.policy.take_decision()
+                           if hasattr(self.policy, "take_decision")
+                           else None)
+                if pol_dec is not None:
+                    logger.log("policy", epoch=it, grid_width=Gx, **pol_dec)
                 if plan is not None:
                     t_comp = time.perf_counter()
                     # retire frozen lanes' results to host before their

@@ -19,8 +19,7 @@ Every method is pure host arithmetic on numbers the engine already holds —
 no device work, no sync, no jax import. That is the point of the split: the
 fleet sweep service (redcliff_tpu/fleet) and its admission planner consult
 the SAME ladder/width logic when packing multi-tenant requests into
-G-buckets, without instantiating an engine, and a future cost-model-driven
-policy (ROADMAP item 4) swaps in here without touching dispatch mechanics.
+G-buckets, without instantiating an engine.
 
 Decision parity: the engine delegating here is a pure code movement — every
 decision is computed from the same inputs by the same expressions as before
@@ -28,15 +27,76 @@ the split, so grid decision streams (and therefore per-lane update streams)
 are bit-identical to the pre-split engine. Pinned by the existing
 compaction/remesh bit-identity tests, which run unmodified.
 
-numpy-only at module scope (like parallel/compaction.py).
+**Predictive scheduling** (ISSUE 15, ROADMAP item 3 — the first place the
+learned cost model's predictions steer a decision instead of only being
+scored): :class:`PredictiveSchedulingPolicy` consults an
+:class:`~redcliff_tpu.obs.costmodel.CostModel` view to choose by predicted
+wall-clock —
+
+* **initial width**: price every candidate ladder rung as ``predicted
+  epoch cost x planned epochs + predicted cold-compile cost when the rung's
+  program family is unseen`` and start at the cheapest rung. A rung with a
+  WARM program family (compile evidence in the store, so the persistent XLA
+  cache holds the executable) can beat the heuristic base rung when the
+  recompile it avoids outweighs the padded lanes it adds — this is the grid
+  engine's half of cold-compile ordering: the first-touch compile is
+  steered onto the cache's critical path;
+* **compaction point**: the PR-5 heuristic compacts at the first check
+  window where the live-lane count drops below the next rung; the
+  predictive policy compacts only when ``(epoch cost at the current width -
+  at the target width) x surviving epochs`` exceeds the predicted
+  compile + gather cost of moving — a near-finished fit stops paying a
+  fresh XLA compile to save a handful of cheap epochs;
+* **fallback contract** (pinned by tests and the bench
+  ``predictive_policy`` probe): whenever the store lacks a usable prior for
+  ANY input of a pricing — either width's epoch cost, the target's compile
+  cost — the decision falls back BIT-IDENTICALLY to the heuristic, so an
+  empty or cold store produces exactly the PR-5 decision stream. Every
+  decision (including fallbacks) is recorded via :meth:`take_decision` and
+  logged by the engine as a schema-registered ``policy`` event.
+
+The gate is ``REDCLIFF_PREDICTIVE`` (:func:`predictive_enabled`, default
+off): flipping it on is safe anywhere — with no store the policy IS the
+heuristic — but stays opt-in so accumulated stores cannot silently move
+decision streams under tests or reproductions that pin them.
+
+numpy-only at module scope (like parallel/compaction.py) and no jax
+anywhere: the fleet worker (a no-jax control process) imports this module
+for :func:`predictive_enabled` and the preemption pricing helpers.
 """
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
 from redcliff_tpu.parallel import compaction
 
-__all__ = ["GridSchedulingPolicy"]
+__all__ = ["GridSchedulingPolicy", "PredictiveSchedulingPolicy",
+           "ENV_PREDICTIVE", "predictive_enabled"]
+
+# the predictive-scheduling master switch (README "Elastic scheduling"
+# knobs): "1" lets PredictiveSchedulingPolicy price widths/compactions from
+# the learned cost model and arms the fleet worker's deadline-aware
+# preemption; default off — empty-store runs are bit-identical either way,
+# but accumulated stores must never move pinned decision streams uninvited
+ENV_PREDICTIVE = "REDCLIFF_PREDICTIVE"
+
+# hard ceiling on the predictive initial-width choice, exported by callers
+# whose ADMISSION decision was priced at a specific width: the fleet batch
+# driver (fleet/run_batch.py) sets it to the planner-admitted G-bucket so a
+# warm-rung widening can never exceed the footprint the HBM admission gate
+# budgeted (predicted_batch_bytes scales per-lane with width) or the
+# planner's max_bucket cap. Unset = standalone fits, bounded by the
+# policy's own 4x-base candidate ladder
+ENV_POLICY_MAX_WIDTH = "REDCLIFF_POLICY_MAX_WIDTH"
+
+
+def predictive_enabled(env=None):
+    """Whether predictive scheduling is armed (``REDCLIFF_PREDICTIVE``)."""
+    val = (env if env is not None
+           else os.environ.get(ENV_PREDICTIVE, "0"))
+    return str(val).strip().lower() not in ("", "0", "false", "off")
 
 
 class GridSchedulingPolicy:
@@ -87,10 +147,11 @@ class GridSchedulingPolicy:
     # check-window compaction decision
     # ------------------------------------------------------------------
     def compaction_plan(self, active_host, orig_ids, retired_ids, n_devices,
-                        n_processes=1):
+                        n_processes=1, epochs_remaining=None):
         """Plan a live-lane compaction for this check window, or None (the
         current width is already the right bucket, compaction is disabled,
-        or the run spans multiple processes)."""
+        or the run spans multiple processes). ``epochs_remaining`` is the
+        predictive subclass's pricing input; the heuristic ignores it."""
         if not self.compaction or n_processes != 1:
             return None
         return compaction.plan_compaction(active_host, orig_ids, retired_ids,
@@ -115,3 +176,206 @@ class GridSchedulingPolicy:
         """Whether the whole-grid budget is spent as of ``elapsed``."""
         return bool(grid_deadline_s and elapsed is not None
                     and elapsed > grid_deadline_s)
+
+
+class PredictiveSchedulingPolicy(GridSchedulingPolicy):
+    """Cost-model-steered scheduling: choose widths and compaction points by
+    predicted wall-clock (module docstring for the decision rules and the
+    bit-identical fallback contract).
+
+    ``cost_model`` is a read-side :class:`~redcliff_tpu.obs.costmodel
+    .CostModel` view (or None — pure heuristic); ``shape_key`` /
+    ``platform`` / ``precision`` identify this fit's cost buckets;
+    ``epochs`` is the planned epoch budget (initial-width pricing);
+    ``gather_ms`` is the charged host cost of applying one compaction (the
+    state gather + re-shard — small next to a cold compile, but priced so a
+    zero-compile move still needs a real saving to go).
+
+    Every consulted decision is stashed for the engine to log as a
+    ``policy`` event; :meth:`take_decision` hands it over exactly once.
+    """
+
+    def __init__(self, g_bucket=True, compaction=True, cost_model=None,
+                 shape_key=None, platform=None, precision="f32",
+                 epochs=None, gather_ms=250.0, max_width=None):
+        super().__init__(g_bucket=g_bucket, compaction=compaction)
+        self.cost_model = cost_model
+        self.shape_key = shape_key
+        self.platform = platform
+        self.precision = precision
+        self.epochs = int(epochs) if epochs else None
+        self.gather_ms = float(gather_ms)
+        # admission ceiling (ENV_POLICY_MAX_WIDTH): widening must never
+        # outgrow the width an HBM admission gate / max_bucket cap priced
+        self.max_width = int(max_width) if max_width else None
+        self._last_decision = None
+
+    # ------------------------------------------------------------------
+    # decision record hand-off (engine logs it as a `policy` event)
+    # ------------------------------------------------------------------
+    def take_decision(self):
+        """The decision record of the LAST consulted width/compaction call,
+        exactly once (None when nothing was decided since the last take)."""
+        dec, self._last_decision = self._last_decision, None
+        return dec
+
+    # ------------------------------------------------------------------
+    # pricing primitives (None = no usable prior -> heuristic fallback)
+    # ------------------------------------------------------------------
+    def _epoch_ms(self, width):
+        if self.cost_model is None or not self.shape_key:
+            return None
+        return self.cost_model.predict_epoch_ms(
+            self.shape_key, width, platform=self.platform,
+            precision=self.precision)
+
+    def _compile_ms(self, width):
+        if self.cost_model is None or not self.shape_key:
+            return None
+        return self.cost_model.predict_compile_ms(
+            self.shape_key, width, platform=self.platform,
+            precision=self.precision)
+
+    def _warm(self, width):
+        """Whether the program family at ``width`` has compile evidence —
+        its executable rides the persistent XLA cache, so moving there pays
+        a warm retrieval, not a cold compile."""
+        return bool(self.cost_model is not None and self.shape_key
+                    and self.cost_model.compile_warm(
+                        self.shape_key, width, platform=self.platform,
+                        precision=self.precision))
+
+    def _move_cost_ms(self, width):
+        """Predicted cost of first-touching ``width``'s program family plus
+        the compaction gather, or None (cold with no compile prior)."""
+        if self._warm(width):
+            return self.gather_ms
+        cm = self._compile_ms(width)
+        return None if cm is None else cm + self.gather_ms
+
+    # ------------------------------------------------------------------
+    # width decisions
+    # ------------------------------------------------------------------
+    def initial_width(self, g_real, n_devices):
+        """Cheapest-priced ladder rung for a fresh grid; the heuristic base
+        rung whenever the base rung itself cannot be priced (fallback
+        contract) or no rung beats it strictly."""
+        base = super().initial_width(g_real, n_devices)
+        self._last_decision = None
+        if not self.g_bucket or self.cost_model is None \
+                or not self.epochs or not self.shape_key:
+            return base
+        n_dev = int(n_devices or 1)
+        cap = base * 4 if self.max_width is None \
+            else min(base * 4, self.max_width)
+        priced = {}
+        for w in compaction.ladder_widths(g_real, n_dev, max_width=cap):
+            em = self._epoch_ms(w)
+            if em is None:
+                continue
+            # a rung's total: every planned epoch at that width, plus the
+            # cold compile when its program family is unseen (warm rungs
+            # retrieve from the persistent cache — this is the engine half
+            # of cold-compile ordering: first touch lands on the cache)
+            compile_ms = 0.0 if self._warm(w) else self._compile_ms(w)
+            if compile_ms is None:
+                continue  # cold with no compile prior: unpriceable rung
+            priced[w] = em * self.epochs + compile_ms
+        dec = {"kind": "initial_width", "heuristic_width": base,
+               "epochs": self.epochs}
+        if base not in priced:
+            # no usable prior at the heuristic rung: nothing to compare
+            # against — fall back bit-identically
+            self._last_decision = dict(dec, action="fallback",
+                                       chosen_width=base, fallback=True)
+            return base
+        chosen = min(priced, key=lambda w: (priced[w], w))
+        if not priced[chosen] < priced[base]:
+            chosen = base  # strict improvement only: ties keep the ladder
+        self._last_decision = dict(
+            dec, action=("widen" if chosen != base else "keep"),
+            chosen_width=chosen, fallback=False,
+            total_ms=round(priced[chosen], 3),
+            heuristic_ms=round(priced[base], 3),
+            saving_ms=round(priced[base] - priced[chosen], 3))
+        return chosen
+
+    # ------------------------------------------------------------------
+    # check-window compaction decision
+    # ------------------------------------------------------------------
+    def compaction_plan(self, active_host, orig_ids, retired_ids, n_devices,
+                        n_processes=1, epochs_remaining=None):
+        """The heuristic plan, priced: compact only when the predicted
+        saving over the surviving epochs exceeds the predicted
+        compile + gather cost of moving; hold (return None) otherwise.
+        Unpriceable inputs fall back bit-identically to the heuristic
+        (compact whenever the ladder says so)."""
+        plan = super().compaction_plan(active_host, orig_ids, retired_ids,
+                                       n_devices, n_processes=n_processes)
+        self._last_decision = None
+        if plan is None:
+            return None
+        from_w = int(np.asarray(orig_ids).size)
+        to_w = plan.new_width
+        dec = {"kind": "compaction", "from_width": from_w, "to_width": to_w,
+               "epochs_remaining": epochs_remaining}
+        if self.cost_model is None:
+            return plan  # pure heuristic policy instance: nothing to record
+        cur = self._epoch_ms(from_w)
+        new = self._epoch_ms(to_w)
+        cost = self._move_cost_ms(to_w)
+        if cur is None or new is None or cost is None \
+                or epochs_remaining is None:
+            self._last_decision = dict(dec, action="compact", fallback=True)
+            return plan
+        saving = (cur - new) * max(int(epochs_remaining), 0)
+        dec.update(fallback=False, saving_ms=round(saving, 3),
+                   compile_ms=round(cost - self.gather_ms, 3),
+                   gather_ms=self.gather_ms)
+        if saving > cost:
+            self._last_decision = dict(dec, action="compact")
+            return plan
+        self._last_decision = dict(dec, action="hold")
+        return None
+
+    # ------------------------------------------------------------------
+    # cold-compile ordering (the fleet worker's half)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def compile_order(programs, cost_model=None, platform=None):
+        """Order first-touch program descriptors so the longest predicted
+        COLD compile starts first and warm/unpriceable families keep their
+        given (urgency) order after — the fleet worker applies this within
+        one urgency class of an admission plan, so the shared persistent
+        compile cache warms on the critical path while the first claimer's
+        prefetch overlaps the compile.
+
+        ``programs``: sequence of dicts. A descriptor carrying a
+        ``cold_compile_ms`` field (the fleet planner's batch views price it
+        once at plan time: 0 = warm, >0 = predicted cold compile, None =
+        unpriceable) is used as-is — one source of truth; otherwise the
+        cost is derived here from ``shape_key``/``g_bucket``/``precision``
+        against ``cost_model``. Returns indices into ``programs``."""
+        cold = []
+        rest = []
+        for i, p in enumerate(programs):
+            if "cold_compile_ms" in p:
+                ms = p["cold_compile_ms"]
+                ms = (float(ms) if isinstance(ms, (int, float)) and ms > 0
+                      else None)
+            elif cost_model is not None and p.get("shape_key"):
+                ms = None
+                prec = p.get("precision") or "f32"
+                if not cost_model.compile_warm(
+                        p["shape_key"], p.get("g_bucket") or 0,
+                        platform=platform, precision=prec):
+                    ms = cost_model.predict_compile_ms(
+                        p["shape_key"], p.get("g_bucket") or 0,
+                        platform=platform, precision=prec)
+            else:
+                ms = None
+            if ms is not None:
+                cold.append((-float(ms), i))
+            else:
+                rest.append(i)
+        return [i for _, i in sorted(cold)] + rest

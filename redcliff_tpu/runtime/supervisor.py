@@ -166,9 +166,13 @@ def latest_cost_model_eta(ledger_path, since_wall=None,
                 isinstance(rec.get("wall_time"), (int, float))
                 and rec["wall_time"] >= since_wall):
             return None  # newest event predates this attempt: no eta
+        # wall_time: when the ETA was computed — consumers that treat
+        # eta_s as "remaining from NOW" (the fleet preemption monitor)
+        # must discount by its age or a sparse check-window cadence
+        # overstates remaining work by up to one window
         return {k: rec.get(k) for k in
                 ("eta_s", "predicted_epoch_ms", "epochs_remaining",
-                 "epoch", "source")}
+                 "epoch", "source", "wall_time")}
     return None
 
 

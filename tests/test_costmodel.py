@@ -65,6 +65,50 @@ def test_store_golden_fit_and_predictions(tmp_path):
     assert model.staleness_s() is not None and model.staleness_s() >= 0
 
 
+def test_nearest_width_fallback_clamped_to_adjacent_rung(tmp_path):
+    """ISSUE 15 satellite: linear width scaling is evidence one rung away
+    and extrapolation beyond — bucket 4 evidence must never price bucket
+    256 (previously a confident 64x-scaled guess), and vice versa at the
+    other extreme of the ladder."""
+    base = str(tmp_path / "cache")
+    costmodel.update_store(base, _rows(width=4), platform="cpu")
+    model = costmodel.load(base)
+    # exact + adjacent rungs still predict
+    assert model.predict_epoch_ms(SHAPE, 4) == 100.0
+    assert model.predict_epoch_ms(SHAPE, 8) == 200.0
+    assert model.predict_epoch_ms(SHAPE, 2) == 50.0
+    # beyond the adjacent rung: None, never a wild guess
+    assert model.predict_epoch_ms(SHAPE, 16) is None
+    assert model.predict_epoch_ms(SHAPE, 256) is None
+    assert model.predict_fit_eta(SHAPE, 256, 10) is None
+    # the other boundary: a widest-rung store never prices the bottom
+    base2 = str(tmp_path / "cache2")
+    costmodel.update_store(base2, _rows(width=256), platform="cpu")
+    model2 = costmodel.load(base2)
+    assert model2.predict_epoch_ms(SHAPE, 128) == 50.0
+    assert model2.predict_epoch_ms(SHAPE, 4) is None
+    assert model2.predict_epoch_ms(SHAPE, 64) is None
+    # the clamp prefers the nearer rung when two are adjacent
+    costmodel.update_store(base, _rows(width=8, epoch_ms_mean=300.0),
+                           platform="cpu")
+    model3 = costmodel.load(base)
+    assert model3.predict_epoch_ms(SHAPE, 16) == 600.0  # from 8, not 4
+
+
+def test_compile_warm_is_exact_bucket_evidence(tmp_path):
+    base = str(tmp_path / "cache")
+    costmodel.update_store(base, _rows(width=8), platform="cpu")
+    model = costmodel.load(base)
+    assert model.compile_warm(SHAPE, 8)
+    assert model.compile_warm(SHAPE, 8, platform="cpu")
+    # warmth never transfers across widths, platforms, or precisions: a
+    # different bucket is a different executable
+    assert not model.compile_warm(SHAPE, 4)
+    assert not model.compile_warm(SHAPE, 8, platform="tpu")
+    assert not model.compile_warm(SHAPE, 8, precision="mixed")
+    assert not model.compile_warm("other=1", 8)
+
+
 def test_store_accumulates_across_updates_and_platforms(tmp_path):
     base = str(tmp_path)
     costmodel.update_store(base, _rows(100.0, epochs=10), platform="cpu")
@@ -179,7 +223,8 @@ def test_latest_cost_model_eta_reads_newest_event(tmp_path):
         f.write('{"event": "cost_model", "epoch": 3, "torn mid-app')
     eta = latest_cost_model_eta(ledger)
     assert eta == {"eta_s": 20.0, "predicted_epoch_ms": 10.0,
-                   "epochs_remaining": 0, "epoch": 2, "source": "store"}
+                   "epochs_remaining": 0, "epoch": 2, "source": "store",
+                   "wall_time": 2.0}
     # since_wall bounds the scan to THIS attempt's telemetry: an event
     # stamped before the attempt started is not inherited
     assert latest_cost_model_eta(ledger, since_wall=1.5) == eta

@@ -1,0 +1,635 @@
+"""Predictive scheduling + deadline-aware preemption (ISSUE 15).
+
+Policy units (parallel/policy.py PredictiveSchedulingPolicy): the
+bit-identical empty-store fallback contract, compact-vs-hold pricing,
+warm-rung initial-width reuse, cold-compile ordering. Planner satellite:
+the deterministic unknown-ETA tie-break under two different cost-model
+stores. Worker preemption: the monitor's pricing decision, the settle
+path's zero-charge reclaim accounting (PR 11 budgets untouched), the
+``after_request`` pin deferral, and — slow-marked — the end-to-end
+acceptance: checkpoint-and-preempt mid-fit, the higher-priority tenant
+meets its deadline, and the preempted batch resumes with bit-identical
+decision streams. Engine wiring: a REDCLIFF_PREDICTIVE fit emits
+schema-valid ``policy`` events and stays bit-identical to the heuristic
+when the store holds no steering prior.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.fleet import planner
+from redcliff_tpu.fleet import worker as fleet_worker
+from redcliff_tpu.fleet.queue import FleetQueue
+from redcliff_tpu.fleet.__main__ import TINY_POINTS, TINY_SPEC
+from redcliff_tpu.obs import costmodel
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs.logging import MetricLogger, read_jsonl
+from redcliff_tpu.parallel.policy import (GridSchedulingPolicy,
+                                          PredictiveSchedulingPolicy,
+                                          predictive_enabled)
+
+SHAPE = "num_chans=4"
+
+
+def _model_with(rows, platform="cpu"):
+    store = costmodel._empty_store()
+    costmodel._merge_rows(store, rows, platform, now=1.0)
+    return costmodel.CostModel(store)
+
+
+def _row(shape, width, epoch_ms=None, epochs=10, compiles=0,
+         compile_ms=0.0):
+    return {"shape": shape, "g_bucket": width, "epochs": epochs,
+            "epoch_ms": (epoch_ms or 0.0) * epochs, "compiles": compiles,
+            "compile_ms": compile_ms}
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+def test_predictive_enabled_gate(monkeypatch):
+    monkeypatch.delenv("REDCLIFF_PREDICTIVE", raising=False)
+    assert not predictive_enabled()
+    for off in ("0", "", "false", "off"):
+        assert not predictive_enabled(env=off)
+    assert predictive_enabled(env="1")
+    monkeypatch.setenv("REDCLIFF_PREDICTIVE", "1")
+    assert predictive_enabled()
+
+
+def test_empty_store_decisions_bit_identical_to_heuristic():
+    """The fallback contract: no usable prior -> exactly the PR-5 ladder,
+    across widths, meshes, and compaction scenarios."""
+    h = GridSchedulingPolicy()
+    for cm in (None, costmodel.CostModel(costmodel._empty_store())):
+        p = PredictiveSchedulingPolicy(cost_model=cm, shape_key=SHAPE,
+                                       platform="cpu", epochs=50)
+        for g, n_dev in ((1, 1), (3, 1), (5, 8), (9, 6), (2, 8)):
+            assert p.initial_width(g, n_dev) == h.initial_width(g, n_dev)
+        for live, width, n_dev in ((1, 8, 1), (3, 8, 1), (5, 16, 8),
+                                   (2, 4, 1)):
+            act = np.zeros((width,), bool)
+            act[:live] = True
+            ids = np.arange(width, dtype=np.int32)
+            ph = h.compaction_plan(act, ids, (), n_dev)
+            pp = p.compaction_plan(act, ids, (), n_dev,
+                                   epochs_remaining=100)
+            assert (ph is None) == (pp is None)
+            if ph is not None:
+                np.testing.assert_array_equal(ph.sel, pp.sel)
+                np.testing.assert_array_equal(ph.orig_ids, pp.orig_ids)
+
+
+def test_compaction_priced_hold_vs_compact():
+    cm = _model_with([
+        _row(SHAPE, 8, epoch_ms=100.0, compiles=1, compile_ms=5000.0),
+        _row(SHAPE, 4, epoch_ms=60.0),
+    ])
+    pol = PredictiveSchedulingPolicy(cost_model=cm, shape_key=SHAPE,
+                                     platform="cpu", epochs=50)
+    act = np.zeros((8,), bool)
+    act[:3] = True
+    ids = np.arange(8, dtype=np.int32)
+    # target rung 4 is COLD (no compile evidence): saving (100-60)*rem must
+    # beat predicted compile 5000 + gather 250
+    plan = pol.compaction_plan(act, ids, (), 1, epochs_remaining=10)
+    dec = pol.take_decision()
+    assert plan is None and dec["action"] == "hold" and not dec["fallback"]
+    assert dec["saving_ms"] == pytest.approx(400.0)
+    plan = pol.compaction_plan(act, ids, (), 1, epochs_remaining=500)
+    dec = pol.take_decision()
+    assert plan is not None and plan.new_width == 4
+    assert dec["action"] == "compact" and not dec["fallback"]
+    # a WARM target rung only needs to beat the gather cost
+    cm2 = _model_with([
+        _row(SHAPE, 8, epoch_ms=100.0, compiles=1, compile_ms=5000.0),
+        _row(SHAPE, 4, epoch_ms=60.0, compiles=1, compile_ms=5000.0),
+    ])
+    pol2 = PredictiveSchedulingPolicy(cost_model=cm2, shape_key=SHAPE,
+                                      platform="cpu", epochs=50)
+    plan = pol2.compaction_plan(act, ids, (), 1, epochs_remaining=10)
+    dec = pol2.take_decision()
+    assert plan is not None and dec["action"] == "compact"
+    assert dec["compile_ms"] == pytest.approx(0.0)
+    # unpriceable target width epoch cost -> bit-identical heuristic
+    # fallback, recorded as such
+    cm3 = _model_with([_row(SHAPE, 8, epoch_ms=100.0)])
+    pol3 = PredictiveSchedulingPolicy(cost_model=cm3, shape_key=SHAPE,
+                                      platform="cpu", epochs=50)
+    act2 = np.zeros((32,), bool)
+    act2[:2] = True  # 32 -> 2 is beyond the adjacent-rung clamp
+    ids2 = np.arange(32, dtype=np.int32)
+    plan = pol3.compaction_plan(act2, ids2, (), 1, epochs_remaining=10)
+    dec = pol3.take_decision()
+    assert plan is not None and dec["fallback"] and dec["action"] == "compact"
+
+
+def test_initial_width_warm_rung_reuse():
+    # base rung 8 is cold; rung 16 is warm with evidence: short fits widen
+    # to reuse the cached program, long fits keep the ladder
+    cm = _model_with([
+        _row(SHAPE, 8, epoch_ms=100.0),
+        _row(SHAPE, 16, epoch_ms=180.0, compiles=1, compile_ms=60000.0),
+    ])
+    short = PredictiveSchedulingPolicy(cost_model=cm, shape_key=SHAPE,
+                                       platform="cpu", epochs=10)
+    w = short.initial_width(5, 1)
+    dec = short.take_decision()
+    # 10 epochs: 10*100 + 60000 cold = 61000 at rung 8 vs 10*180 warm =
+    # 1800 at rung 16
+    assert w == 16 and dec["action"] == "widen"
+    assert dec["heuristic_width"] == 8 and dec["saving_ms"] > 0
+    long = PredictiveSchedulingPolicy(cost_model=cm, shape_key=SHAPE,
+                                      platform="cpu", epochs=5000)
+    assert long.initial_width(5, 1) == 8
+    assert long.take_decision()["action"] == "keep"
+    # base rung unpriceable -> heuristic fallback recorded
+    cm2 = _model_with([_row(SHAPE, 256, epoch_ms=1000.0)])
+    pol = PredictiveSchedulingPolicy(cost_model=cm2, shape_key=SHAPE,
+                                     platform="cpu", epochs=10)
+    assert pol.initial_width(5, 1) == 8
+    assert pol.take_decision()["action"] == "fallback"
+    # admission ceiling (REDCLIFF_POLICY_MAX_WIDTH): a warm-rung widening
+    # must never outgrow the width the fleet's HBM gate/max_bucket priced
+    capped = PredictiveSchedulingPolicy(cost_model=cm, shape_key=SHAPE,
+                                        platform="cpu", epochs=10,
+                                        max_width=8)
+    assert capped.initial_width(5, 1) == 8  # 16 would win, but is capped
+    assert capped.take_decision()["action"] == "keep"
+
+
+def test_compile_order_longest_cold_first():
+    cm = _model_with([
+        _row("a=1", 8, epoch_ms=1.0, compiles=1, compile_ms=1000.0),
+        _row("b=1", 8, epoch_ms=1.0, compiles=1, compile_ms=9000.0),
+        _row("c=1", 8, epoch_ms=1.0, compiles=1, compile_ms=4000.0),
+    ])
+    progs = [{"shape_key": "a=1", "g_bucket": 16},   # cold, pred 1000
+             {"shape_key": "b=1", "g_bucket": 16},   # cold, pred 9000
+             {"shape_key": "b=1", "g_bucket": 8},    # warm (exact evidence)
+             {"shape_key": "d=1", "g_bucket": 8},    # unpriceable
+             {"shape_key": "c=1", "g_bucket": 16}]   # cold, pred 4000
+    order = PredictiveSchedulingPolicy.compile_order(progs, cm)
+    # longest predicted cold compile first; warm/unpriceable keep position
+    assert order == [1, 4, 0, 2, 3]
+    # no cost model: given order untouched
+    assert PredictiveSchedulingPolicy.compile_order(progs, None) \
+        == [0, 1, 2, 3, 4]
+    # pre-priced descriptors (the planner's batch-view cold_compile_ms —
+    # one source of truth) are used as-is: 0.0 means warm, None unpriceable
+    priced = [{"cold_compile_ms": 100.0}, {"cold_compile_ms": 7000.0},
+              {"cold_compile_ms": 0.0}, {"cold_compile_ms": None},
+              {"cold_compile_ms": 900.0}]
+    assert PredictiveSchedulingPolicy.compile_order(priced) \
+        == [1, 4, 0, 2, 3]
+
+
+def test_worker_cold_compile_order_respects_urgency_classes(tmp_path):
+    """The worker's claim reordering moves the longest predicted COLD
+    compile first — consuming the batch views' plan-time
+    ``cold_compile_ms`` — but only WITHIN the leading urgency class; a
+    higher-priority head batch is never displaced."""
+    def view(bid, cold_ms, priority=0):
+        return {"batch_id": bid, "priority": priority, "deadline_s": None,
+                "cold_compile_ms": cold_ms, "requests": [bid]}
+
+    a = view("b-a", 2000.0)
+    b = view("b-b", 9000.0)
+    hi = view("b-hi", 9000.0, priority=9)
+    with MetricLogger(str(tmp_path)) as logger:
+        out = fleet_worker._cold_compile_order([a, b], logger, "w")
+        assert [x["batch_id"] for x in out] == ["b-b", "b-a"]
+        # a higher-priority head forms its own class: untouched
+        out = fleet_worker._cold_compile_order([hi, a, b], logger, "w")
+        assert [x["batch_id"] for x in out] == ["b-hi", "b-a", "b-b"]
+    recs = read_jsonl(str(tmp_path))
+    assert not obs_schema.validate_records(recs)
+    assert any(r["event"] == "policy" and r["kind"] == "compile_order"
+               for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# planner satellite: deterministic unknown-ETA tie-break (two-store test)
+# ---------------------------------------------------------------------------
+def _plan_req(rid, shape, submitted_at):
+    return {"request_id": rid, "tenant": "t", "submitted_at": submitted_at,
+            "priority": 0, "deadline_s": None, "shape": shape,
+            "points": [{"gen_lr": 1e-3}, {"gen_lr": 2e-3}], "epochs": 50,
+            "spec": {"model_config": shape, "epochs": 50}}
+
+
+def test_planner_unknown_eta_order_is_submission_order_across_stores():
+    """Two planners with DIFFERENT cost-model stores (each prices a shape
+    the other has never seen) must agree on the relative order of batches
+    neither can price: submission order, not content-hash order."""
+    sa, sb, sc = ({"num_chans": 4}, {"num_chans": 8}, {"num_chans": 16})
+    reqs = [_plan_req("req-zz", sc, 0.0), _plan_req("req-aa", sb, 1.0),
+            _plan_req("req-mm", sa, 2.0)]
+    ka, kb = obs_schema.shape_key(sa), obs_schema.shape_key(sb)
+    store_a = _model_with([_row(ka, 2, epoch_ms=10.0)], platform="any")
+    store_b = _model_with([_row(kb, 2, epoch_ms=10.0)], platform="any")
+
+    def order(cm):
+        pl = planner.plan(reqs, n_devices=1, cost_model=cm)
+        return [b["requests"][0] for b in pl["batches"]]
+
+    o_a = order(store_a)
+    o_b = order(store_b)
+    # the priced shape drains first; the unknown pair rides submission
+    # order in BOTH plans (zz submitted before aa/mm)
+    assert o_a == ["req-mm", "req-zz", "req-aa"]
+    assert o_b == ["req-aa", "req-zz", "req-mm"]
+    # and with no store at all, pure submission order
+    assert order(None) == ["req-zz", "req-aa", "req-mm"]
+    # batch views carry the tie-break + ordering provenance fields
+    b = planner.plan(reqs, n_devices=1, cost_model=store_a)["batches"][0]
+    assert b["submitted_at"] == 2.0 and "cold_compile_ms" in b
+
+
+# ---------------------------------------------------------------------------
+# worker preemption: monitor decision + settle accounting
+# ---------------------------------------------------------------------------
+def _submit_tiny(q, tenant, epochs=2, points=None, **kw):
+    spec = json.loads(json.dumps(TINY_SPEC))
+    spec["epochs"] = epochs
+    return q.submit(tenant, points or list(TINY_POINTS), spec=spec, **kw)
+
+
+def _prime_store(path, shape, width, epoch_ms, compile_ms=500.0,
+                 platform="cpu"):
+    costmodel.update_store(str(path), [
+        _row(obs_schema.shape_key(shape), width, epoch_ms=epoch_ms,
+             epochs=50, compiles=1, compile_ms=compile_ms)], platform)
+
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+
+def test_preempt_monitor_prices_and_signals(tmp_path, monkeypatch):
+    root = tmp_path / "fleet"
+    store = tmp_path / "store"
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR", str(store))
+    q = FleetQueue(root)
+    low = _submit_tiny(q, "long", epochs=300)
+    low_rec = next(r for r in q.requests() if r["request_id"] == low)
+    _prime_store(store, low_rec["shape"], 2, epoch_ms=2000.0,
+                 platform="any")
+
+    members = [low_rec]
+    batch = planner._batch_view(members, 1,
+                                cost_model=costmodel.load(str(store)))
+    run_dir = q.batch_dir(batch["batch_id"])
+    os.makedirs(run_dir, exist_ok=True)
+    lease = q.claim(low, "w1", 60.0, batch_id=batch["batch_id"])
+    assert lease is not None
+
+    with MetricLogger(str(root)) as logger:
+        mon = fleet_worker._PreemptMonitor(q, batch, members, run_dir,
+                                           logger, "w1", n_devices=1,
+                                           grace_s=2.0, poll_s=0.05)
+        proc = _FakeProc()
+        mon.on_spawn(proc)
+        # no higher-priority deadline tenant queued: hold
+        mon._check(time.time())
+        assert not mon.requested and not proc.terminated
+
+        urgent = _submit_tiny(q, "urgent", epochs=2, priority=5,
+                              deadline_s=30.0)
+        # decision gated on the first durable checkpoint
+        mon._check(time.time())
+        assert not mon.requested
+        open(os.path.join(run_dir, "grid_checkpoint.pkl"), "wb").close()
+        mon._check(time.time())
+        assert mon.requested and proc.terminated
+        assert mon.decision["beneficiary"] == urgent
+    recs = read_jsonl(str(root))
+    assert not obs_schema.validate_records(recs)
+    kinds = [(r["event"], r.get("kind"), r.get("action")) for r in recs
+             if r["event"] in ("policy", "preempt")]
+    assert ("policy", "preempt_price", "preempt") in kinds
+    assert ("preempt", "signal", None) in kinds
+
+
+def test_preempt_monitor_never_fires_without_predictions(tmp_path,
+                                                         monkeypatch):
+    """No usable cost-model prior -> hold, never a preemption on a guess."""
+    root = tmp_path / "fleet"
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR",
+                       str(tmp_path / "empty_store"))
+    q = FleetQueue(root)
+    low = _submit_tiny(q, "long", epochs=300)
+    low_rec = next(iter(q.requests()))
+    batch = planner._batch_view([low_rec], 1)
+    run_dir = q.batch_dir(batch["batch_id"])
+    os.makedirs(run_dir, exist_ok=True)
+    open(os.path.join(run_dir, "grid_checkpoint.pkl"), "wb").close()
+    q.claim(low, "w1", 60.0, batch_id=batch["batch_id"])
+    _submit_tiny(q, "urgent", epochs=2, priority=5, deadline_s=30.0)
+    with MetricLogger(str(root)) as logger:
+        mon = fleet_worker._PreemptMonitor(q, batch, [low_rec], run_dir,
+                                           logger, "w1")
+        proc = _FakeProc()
+        mon.on_spawn(proc)
+        mon._check(time.time())
+    assert not mon.requested and not proc.terminated
+
+
+class _FakeMonitor:
+    """A pre-decided monitor for exercising the settle path without a
+    supervised child."""
+
+    def __init__(self, beneficiary):
+        self.requested = True
+        self.decision = {"beneficiary": beneficiary}
+
+    def on_spawn(self, proc):
+        pass
+
+    def should_stop(self):
+        return True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+def test_preemption_settle_is_zero_charge_reclaim(tmp_path, monkeypatch):
+    """Settle of a preempted batch: requests charged ZERO failure attempts
+    (PR 11 budget untouched), leases released cleanly (re-claimable), the
+    exact composition pinned with the beneficiary, preempt events +
+    lifecycle transition recorded."""
+    from redcliff_tpu.runtime.supervisor import SuperviseOutcome
+
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    low = _submit_tiny(q, "long", epochs=4)
+    urgent = _submit_tiny(q, "urgent", epochs=2, priority=5,
+                          deadline_s=60.0)
+    by_id = {r["request_id"]: r for r in q.requests()}
+    members = [by_id[low]]
+    batch = planner._batch_view(members, 1)
+    leases = {low: q.claim(low, "w1", 60.0, batch_id=batch["batch_id"])}
+
+    def fake_supervise(cmd, ledger_path=None, policy=None, env=None,
+                       on_spawn=None, should_stop=None, **kw):
+        return SuperviseOutcome(classification="preempted", returncode=17,
+                                attempts=[{"classification": "preempted"}])
+
+    monkeypatch.setattr(fleet_worker, "supervise", fake_supervise)
+    with MetricLogger(str(root)) as logger:
+        out = fleet_worker.run_one_batch(
+            q, batch, leases, members, logger, "w1",
+            preempt_monitor=_FakeMonitor(urgent))
+    assert out.classification == "preempted"
+
+    # zero-charge: reclaims counted, failure attempts NOT
+    att = q.attempt_record(low)
+    assert att["attempts"] == 0 and att["reclaims"] == 1
+    assert att["last"]["classification"] == "preempted"
+    # lease released cleanly — the request is claimable again (by the pin)
+    assert q.lease_of(low) is None
+    [pin] = q.pinned_batches()
+    assert pin["batch_id"] == batch["batch_id"]
+    assert pin["requests"] == [low]
+    assert pin["after_request"] == urgent
+
+    recs = read_jsonl(str(root))
+    assert not obs_schema.validate_records(recs)
+    pre = [r for r in recs if r["event"] == "preempt"]
+    assert pre and pre[-1]["kind"] == "preempted" \
+        and pre[-1]["beneficiary"] == urgent
+    hist = [json.loads(l) for l in
+            open(os.path.join(root, "history.jsonl"))]
+    assert any(h.get("kind") == "preempted" and h.get("requests") == [low]
+               for h in hist)
+
+    # the pin defers to the beneficiary: the next claim cycle serves the
+    # urgent tenant FIRST, then the preempted composition becomes claimable
+    with MetricLogger(str(root)) as logger:
+        got = fleet_worker._next_batch(q, "w2", 60.0, 1, None, 256, logger)
+        assert got is not None
+        b2, leases2, _ = got
+        assert b2["requests"] == [urgent]
+        q.complete(urgent, result={"ok": True})
+        for l in leases2.values():
+            l.release()
+        got = fleet_worker._next_batch(q, "w2", 60.0, 1, None, 256, logger)
+        assert got is not None
+        b3, leases3, _ = got
+        assert b3["batch_id"] == batch["batch_id"]  # same run dir: resume
+        assert b3["requests"] == [low]
+        for l in leases3.values():
+            l.release()
+    assert q.pinned_batches() == []  # the pin was consumed at claim
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: REDCLIFF_PREDICTIVE fit emits policy events, stays
+# bit-identical without steering priors
+# ---------------------------------------------------------------------------
+def test_grid_engine_predictive_policy_events(tmp_path, monkeypatch):
+    import jax
+
+    from redcliff_tpu.parallel.grid import GridSpec, RedcliffGridRunner
+    from redcliff_tpu.train.redcliff_trainer import RedcliffTrainConfig
+    from test_parallel_grid import _data, _model
+
+    model = _model()
+    ds = _data(model)
+    spec = lambda: GridSpec(points=[{"gen_lr": 1e-3}, {"gen_lr": 5e-3},
+                                    {"gen_lr": 2e-3}])
+    tc = RedcliffTrainConfig(max_iter=3, batch_size=32, check_every=1)
+
+    # heuristic reference leg (gate off)
+    monkeypatch.delenv("REDCLIFF_PREDICTIVE", raising=False)
+    ref = RedcliffGridRunner(model, tc, spec()).fit(
+        jax.random.PRNGKey(0), ds, ds)
+
+    # predictive leg: store primed with epoch evidence at the base rung
+    # only — every pricing keeps the heuristic choice, so the decision
+    # stream (and the results) must be bit-identical, with the decisions
+    # RECORDED as schema-valid `policy` events
+    store = tmp_path / "store"
+    shape_key = obs_schema.shape_key(obs_schema.shape_desc(model.config))
+    costmodel.update_store(str(store), [
+        _row(shape_key, 4, epoch_ms=50.0, epochs=10)],
+        jax.default_backend())
+    monkeypatch.setenv("REDCLIFF_PREDICTIVE", "1")
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR", str(store))
+    log_dir = str(tmp_path / "run")
+    runner = RedcliffGridRunner(model, tc, spec())
+    assert isinstance(runner.policy, PredictiveSchedulingPolicy)
+    res = runner.fit(jax.random.PRNGKey(0), ds, ds, log_dir=log_dir)
+    np.testing.assert_array_equal(res.val_history, ref.val_history)
+
+    recs = read_jsonl(log_dir)
+    assert not obs_schema.validate_records(recs)
+    pols = [r for r in recs if r["event"] == "policy"]
+    assert pols and pols[0]["kind"] == "initial_width"
+    assert pols[0]["chosen_width"] == 4
+
+
+# ---------------------------------------------------------------------------
+# obs surfaces: watch headlines + report decision table
+# ---------------------------------------------------------------------------
+def test_watch_and_report_surface_policy_decisions(tmp_path):
+    from redcliff_tpu.obs import report as obs_report
+    from redcliff_tpu.obs import watch as obs_watch
+
+    run = str(tmp_path / "run")
+    with MetricLogger(run) as log:
+        log.log("fit_start", model="probe", grid_size=8, grid_width=8,
+                shape={"num_chans": 4})
+        log.log("policy", kind="initial_width", epoch=-1, action="keep",
+                fallback=False, chosen_width=8, heuristic_width=8,
+                total_ms=100.0, heuristic_ms=100.0, saving_ms=0.0)
+        for e in (2, 4):
+            log.log("epoch", epoch=e, grid_width=8, epoch_ms=100.0)
+        log.log("policy", kind="compaction", epoch=4, action="hold",
+                fallback=False, from_width=8, to_width=4,
+                saving_ms=120.0, compile_ms=5000.0, gather_ms=250.0,
+                epochs_remaining=3)
+        log.log("preempt", kind="preempted", batch_id="batch-x",
+                requests=["req-1"], beneficiary="req-9", worker="w1")
+    snap = obs_watch.build_snapshot(run)
+    assert not obs_schema.validate_record(snap)
+    assert snap["policy"]["kind"] == "compaction"
+    assert snap["policy"]["action"] == "hold"
+    assert snap["preempt"]["beneficiary"] == "req-9"
+    text = obs_watch.render_text(snap)
+    assert "policy: hold 8->4" in text
+    assert "preempt: preempted batch batch-x -> req-9" in text
+
+    rep = obs_report.build_report(run)
+    pd = rep["policy_decisions"]
+    assert pd["decisions"] == 2 and pd["fallbacks"] == 0
+    assert pd["by_action"] == {"compaction:hold": 1,
+                               "initial_width:keep": 1}
+    assert pd["preempts"] == 1
+    rtext = obs_report.render_text(rep)
+    assert "predictive policy decisions" in rtext
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance (slow): preempt mid-fit, beneficiary meets its
+# deadline, preempted batch resumes bit-identically
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_preemption_acceptance_end_to_end(tmp_path, monkeypatch):
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    monkeypatch.setenv("REDCLIFF_PREDICTIVE", "1")
+    # fast re-pricing so the preemption lands right after the first
+    # durable checkpoint instead of half a second later
+    monkeypatch.setenv("REDCLIFF_PREEMPT_POLL_S", "0.05")
+    store = tmp_path / "store"
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR", str(store))
+    for var in ("REDCLIFF_FAULT_INJECT", "REDCLIFF_FAULT_MARKER"):
+        monkeypatch.delenv(var, raising=False)
+    sup = SupervisorPolicy(
+        max_restarts=2,
+        backoff=RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+
+    def _submit_long(q_, tenant):
+        # 400 epochs with a LATE scoring cadence (check_every=100): until
+        # epoch 100 the running fit emits no cost_model events, so the
+        # monitor prices its remaining work from the primed store
+        # (~2 s/epoch — a predicted miss for any 45 s deadline) while the
+        # real fit stays short enough to keep the test fast
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = 400
+        spec["train_config"]["check_every"] = 100
+        return q_.submit(tenant, [{"gen_lr": 1e-3}], spec=spec)
+
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    long_rid = _submit_long(q, "long")
+    long_rec = next(iter(q.requests()))
+    # prime the store: the long fit predicts ~2s/epoch (so its remaining
+    # ETA dwarfs the deadline), the urgent 2-epoch fit predicts seconds
+    _prime_store(store, long_rec["shape"], 1, epoch_ms=2000.0,
+                 platform="any")
+
+    worker_err = []
+
+    def run_worker():
+        try:
+            fleet_worker.work(str(root), drain=True, poll_s=0.1,
+                              lease_s=60.0, supervisor_policy=sup,
+                              max_attempts=2, predictive=True)
+        except Exception as e:  # pragma: no cover - surfaced below
+            worker_err.append(e)
+
+    t = threading.Thread(target=run_worker)
+    t.start()
+    try:
+        # wait for the long batch to be claimed, then submit the urgent
+        # deadline tenant
+        deadline = time.time() + 120
+        while not q.live_leases():
+            assert time.time() < deadline, "long batch never claimed"
+            assert t.is_alive(), worker_err
+            time.sleep(0.05)
+        urgent_rid = _submit_tiny(q, "urgent", epochs=2, priority=5,
+                                  deadline_s=45.0)
+        urgent_submitted = next(
+            r for r in q.requests()
+            if r["request_id"] == urgent_rid)["submitted_at"]
+        t.join(timeout=420)
+        assert not t.is_alive(), "worker never drained"
+    finally:
+        if t.is_alive():  # pragma: no cover - diagnostics only
+            q.cancel(long_rid)
+            q.cancel(urgent_rid)
+            t.join(timeout=60)
+    assert not worker_err, worker_err
+
+    # both settled done; the preemption was recorded
+    counts = q.status()["counts"]
+    assert counts["done"] == 2 and counts["failed"] == 0 \
+        and counts["deadletter"] == 0, counts
+    recs = read_jsonl(str(root))
+    assert not obs_schema.validate_records(recs)
+    pre_kinds = {r.get("kind") for r in recs if r["event"] == "preempt"}
+    assert {"signal", "preempted"} <= pre_kinds, pre_kinds
+
+    # the beneficiary met its deadline and finished BEFORE the long fit
+    urgent_done = q.result(urgent_rid)
+    long_done = q.result(long_rid)
+    assert urgent_done["completed_at"] - urgent_submitted <= 45.0
+    assert urgent_done["completed_at"] < long_done["completed_at"]
+
+    # zero-charge accounting: the preempted request burned no failure
+    # attempts (PR 11 budget intact), only reclaims
+    att = q.attempt_record(long_rid)
+    assert att["attempts"] == 0 and att["reclaims"] >= 1, att
+
+    # bit-identical resumed streams: an uninterrupted control run of the
+    # identical request (content-derived lane seeds) matches field-for-field
+    ref_root = tmp_path / "fleet_ref"
+    qr = FleetQueue(ref_root)
+    ref_rid = _submit_long(qr, "long")
+    fleet_worker.work(str(ref_root), drain=True, poll_s=0.1, lease_s=60.0,
+                      supervisor_policy=sup, max_attempts=2,
+                      predictive=True)
+    res = long_done["result"]
+    ref = qr.result(ref_rid)["result"]
+    for key in ("best_criteria", "best_epoch", "val_history", "active",
+                "failures"):
+        assert res[key] == ref[key], f"{key} diverged after preemption"
