@@ -1105,6 +1105,112 @@ def _bench_fleet_containment():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_autoscale(n_requests=4, max_workers=2):
+    """autoscale probe (ISSUE 16, fleet/autoscale.py): a seeded submit
+    storm drained by the SLO-driven control loop, end-to-end through real
+    worker processes.
+
+    ``breach_to_recovery_s`` is the wall time from the FIRST windowed
+    queue-wait breach the autoscaler detects to the queue fully drained —
+    the breach-absorption latency the subsystem exists to bound.
+    ``reject_eta_err_pct`` is the backpressure gate's reject-with-ETA
+    accuracy: once the autoscaler has published its pool state, one extra
+    submit is attempted under a deliberately tiny queue-wait SLO; the
+    structured reject's predicted wait is compared against the OBSERVED
+    remaining drain wall. The ``recovered`` flag is the correctness
+    contract: every stormed request done, zero dead-letters, pool actually
+    grew past one worker."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.fleet import autoscale as _autoscale
+    from redcliff_tpu.fleet.__main__ import TINY_SPEC
+    from redcliff_tpu.fleet.chaos import submit_storm
+    from redcliff_tpu.fleet.queue import BackpressureReject, FleetQueue
+
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    env.pop("REDCLIFF_SLO_QUEUE_P99_S", None)
+
+    tmp = tempfile.mkdtemp(prefix="bench_autoscale_")
+    root = os.path.join(tmp, "fleet")
+    try:
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = 1
+        storm = submit_storm(root, n_requests, tenant="bench-storm",
+                             seed=0, spec=spec)
+        q = FleetQueue(root)
+        policy = _autoscale.AutoscalePolicy(
+            max_workers=max_workers, min_workers=0,
+            # a target far below one tiny fit's wall forces immediate
+            # growth to the cap — the storm IS the breach scenario
+            target_drain_s=1.0, hysteresis_s=0.5, window_s=600.0,
+            default_eta_s=30.0)
+        scaler = _autoscale.Autoscaler(
+            root, policy=policy, lease_s=60.0, poll_s=0.1, max_attempts=2,
+            max_restarts=1, env=env,
+            worker_args=["--max-restarts", "1",
+                         "--base-delay-s", "0.05", "--max-delay-s", "0.05"],
+            thresholds={"queue_p99_s": 0.05})
+        max_seen = 0
+        reject = None
+        t_reject = None
+        try:
+            deadline = time.time() + 600.0
+            while time.time() < deadline:
+                scaler.tick()
+                max_seen = max(max_seen, len(scaler.workers))
+                if reject is None and q.pending():
+                    # pool state is published: probe the admission gate
+                    # under a deliberately tiny queue-wait SLO
+                    os.environ["REDCLIFF_SLO_QUEUE_P99_S"] = "0.05"
+                    try:
+                        q.submit("bench-reject", [{"gen_lr": 1e-3}],
+                                 spec=spec)
+                    except BackpressureReject as rej:
+                        reject = {"eta_s": rej.eta_s,
+                                  "workers": rej.workers}
+                        t_reject = time.perf_counter()
+                    finally:
+                        os.environ.pop("REDCLIFF_SLO_QUEUE_P99_S", None)
+                if scaler.settled() and not any(
+                        w["proc"].poll() is None
+                        for w in scaler.workers.values()):
+                    break
+                time.sleep(0.2)
+        finally:
+            scaler.close()
+        t_drained = time.perf_counter()
+        t_drained_wall = time.time()
+        counts = q.status()["counts"]
+        breach_to_recovery = None
+        if scaler.first_breach_wall is not None:
+            breach_to_recovery = round(
+                t_drained_wall - scaler.first_breach_wall, 3)
+        eta_err_pct = None
+        if reject is not None and t_reject is not None \
+                and t_drained > t_reject:
+            observed = t_drained - t_reject
+            eta_err_pct = round(
+                100.0 * abs(reject["eta_s"] - observed) / observed, 1)
+        return {
+            "stormed": len(storm["submitted"]),
+            "max_workers_seen": max_seen,
+            "done": counts["done"],
+            "deadlettered": counts["deadletter"],
+            "failed": counts["failed"],
+            "breach_to_recovery_s": breach_to_recovery,
+            "reject_eta_s": (reject or {}).get("eta_s"),
+            "reject_eta_err_pct": eta_err_pct,
+            "recovered": (counts["done"] == len(storm["submitted"])
+                          and counts["deadletter"] == 0
+                          and counts["failed"] == 0 and max_seen > 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_predictive_policy(n_devices=8, check_every=5, gather_ms=250.0):
     """predictive_policy probe (ISSUE 15, parallel/policy.py): heuristic
     bucket ladder vs the predictive scheduling policy on a SIMULATED
@@ -1698,6 +1804,16 @@ def _measure(platform):
         predictive_policy = {"error": f"{type(e).__name__}: {e}",
                              "makespan_ratio": None}
 
+    # SLO-driven autoscaling (ISSUE 16): seeded submit storm drained by the
+    # control loop through real workers — breach-absorption latency + the
+    # backpressure gate's reject-with-ETA accuracy
+    try:
+        autoscale_probe = _bench_autoscale()
+    except Exception as e:  # never fail the bench over the autoscale probe
+        autoscale_probe = {"error": f"{type(e).__name__}: {e}",
+                           "breach_to_recovery_s": None,
+                           "reject_eta_err_pct": None}
+
     # model-quality observatory (obs/quality.py): graph recovery + readout
     # overhead on a deterministic synthetic sVAR grid fit with ground truth
     try:
@@ -1743,6 +1859,7 @@ def _measure(platform):
         "fleet_containment": fleet_containment,
         "fleet_trace": fleet_trace,
         "predictive_policy": predictive_policy,
+        "autoscale": autoscale_probe,
         "quality": quality_probe,
         "error": None,
     })

@@ -1,16 +1,27 @@
-"""``python -m redcliff_tpu.fleet {submit,work,status,cancel,requeue}``.
+"""``python -m redcliff_tpu.fleet {submit,work,autoscale,status,cancel,
+requeue}``.
 
 submit — append fit requests to a fleet root's durable queue
     (fleet/queue.py). ``--tiny`` uses the built-in canonical tiny spec
     (the fault-injection harness's small deterministic fit) — the smoke /
-    CI path; real sweeps pass ``--spec-file`` + ``--points``.
+    CI path; real sweeps pass ``--spec-file`` + ``--points``. Rides the
+    admission backpressure gate: with ``REDCLIFF_SLO_QUEUE_P99_S`` armed,
+    a submit whose predicted queue wait would breach it is REJECTED with
+    the ETA (exit 3; ``REDCLIFF_BACKPRESSURE=0`` opts out).
 work — run the worker loop (fleet/worker.py): reclaim expired claims,
     run pinned bisection halves, plan admission (fleet/planner.py),
     supervise batches, settle results under the containment discipline
     (``--max-attempts`` is the per-request retry budget).
+autoscale — run the SLO-driven fleet control loop (fleet/autoscale.py):
+    spawn/retire ``work --drain`` workers against the queue's predicted
+    drain time (``REDCLIFF_AUTOSCALE_*`` knobs), demote breaching tenants
+    down the degraded-QoS ladder at the pool cap, publish
+    ``<root>/autoscale.json``.
 status — queue-wide and per-tenant counts plus a per-request age table:
     queue age (now − ``submitted_at``) for live requests, terminal-state
-    age for settled ones (``--json`` for scripts).
+    age for settled ones (``--json`` for scripts); plus the autoscaler's
+    last published decision and per-tenant QoS/backpressure state when an
+    autoscaler has run against the root.
 cancel — first-writer-wins ``canceled`` terminal record: the request is
     never re-planned, a running worker's settle stands down, and no lease
     is orphaned (tombstone-reclaim path, docs/ARCHITECTURE.md "Fleet
@@ -85,22 +96,32 @@ def _cmd_submit(args):
         # RedcliffTrainConfig verbatim
         spec.setdefault("train_config", {})["precision_mode"] = \
             args.precision_mode
+    from redcliff_tpu.fleet.queue import BackpressureReject
+
     q = FleetQueue(args.root)
     rids = []
+    rc = 0
     with MetricLogger(args.root) as log:
         for _ in range(args.n):
-            rid = q.submit(args.tenant, points, spec=spec,
-                           priority=args.priority,
-                           deadline_s=args.deadline_s,
-                           per_lane_bytes=args.per_lane_bytes,
-                           fixed_bytes=args.fixed_bytes)
+            try:
+                rid = q.submit(args.tenant, points, spec=spec,
+                               priority=args.priority,
+                               deadline_s=args.deadline_s,
+                               per_lane_bytes=args.per_lane_bytes,
+                               fixed_bytes=args.fixed_bytes)
+            except BackpressureReject as rej:
+                # the structured reject-with-ETA, not a crash: nothing was
+                # spooled; retry after ~eta_s or opt out
+                print(f"fleet submit: {rej}", file=sys.stderr)
+                rc = 3
+                break
             log.log("fleet", kind="submit", requests=[rid],
                     tenants=[args.tenant], n_points=len(points),
                     priority=args.priority)
             rids.append(rid)
     for rid in rids:
         print(rid)
-    return 0
+    return rc
 
 
 def _cmd_work(args):
@@ -120,6 +141,29 @@ def _cmd_work(args):
              checkpoint_every=args.checkpoint_every,
              supervisor_policy=policy, max_attempts=args.max_attempts)
     print(f"fleet work: ran {n} batch(es)", file=sys.stderr)
+    return 0
+
+
+def _cmd_autoscale(args):
+    from redcliff_tpu.fleet import autoscale as _autoscale
+
+    policy = _autoscale.AutoscalePolicy.from_env()
+    for name in ("max_workers", "min_workers", "target_drain_s",
+                 "hysteresis_s", "window_s"):
+        val = getattr(args, name)
+        if val is not None:
+            setattr(policy, name, val)
+    scaler = _autoscale.Autoscaler(
+        args.root, policy=policy, n_devices=args.n_devices,
+        lease_s=args.lease_s, poll_s=args.poll_s,
+        max_attempts=args.max_attempts, max_restarts=args.max_restarts)
+    summary = scaler.run(interval_s=args.interval_s,
+                         max_ticks=args.max_ticks, drain=args.drain)
+    last = summary.get("last_decision") or {}
+    print(f"fleet autoscale: {summary['ticks']} tick(s) over "
+          f"{summary['wall_s']:.1f}s, {summary['workers']} worker(s) "
+          f"live, last decision {last.get('kind')} "
+          f"({last.get('reason')})", file=sys.stderr)
     return 0
 
 
@@ -169,7 +213,17 @@ def _cmd_status(args):
     # archived/read-only roots still report. include_requests: the
     # per-request age view (queue age = now - submitted_at for live
     # requests, terminal-state age for settled ones)
+    from redcliff_tpu.fleet import autoscale as _autoscale
+
     st = FleetQueue(args.root, create=False).status(include_requests=True)
+    auto = _autoscale.load_state(args.root)
+    qos = _autoscale.active_qos(args.root)
+    if auto is not None or qos:
+        st["autoscale"] = {
+            "state": auto,
+            "qos": {t: {"rung": r.get("rung"), "reason": r.get("reason")}
+                    for t, r in sorted(qos.items())},
+        }
     if args.json:
         json.dump(st, sys.stdout, indent=2, allow_nan=False)
         sys.stdout.write("\n")
@@ -189,6 +243,20 @@ def _cmd_status(args):
               f"{t['queued']} queued, {t['running']} running, "
               f"{t['done']} done, {t['failed']} failed, "
               f"{t['deadletter']} dead-lettered, {t['canceled']} canceled")
+    auto_st = (st.get("autoscale") or {}).get("state")
+    if auto_st:
+        last = auto_st.get("last_decision") or {}
+        print(f"  autoscale: {auto_st.get('workers')}/"
+              f"{auto_st.get('max_workers')} worker(s), target "
+              f"{auto_st.get('target')}, {auto_st.get('pending')} pending, "
+              f"drain eta {auto_st.get('drain_eta_s')}s")
+        if last:
+            print(f"    last decision: {last.get('kind')} "
+                  f"({last.get('reason')})")
+    for tenant, rec in sorted(((st.get("autoscale") or {}).get("qos")
+                               or {}).items()):
+        print(f"    qos tenant {tenant}: rung {rec.get('rung')} "
+              f"({rec.get('reason')})")
 
     def _age(s):
         if s is None:
@@ -270,6 +338,38 @@ def main(argv=None):
                     help="per-request retry budget: failure attempts before "
                          "a request is dead-lettered (fleet/worker.py)")
     wp.set_defaults(fn=_cmd_work)
+
+    asp = sub.add_parser(
+        "autoscale",
+        help="run the SLO-driven fleet control loop (fleet/autoscale.py): "
+             "scale drain-workers against predicted drain time, demote "
+             "breaching tenants down the degraded-QoS ladder")
+    asp.add_argument("--root", required=True)
+    asp.add_argument("--interval-s", type=float, default=2.0,
+                     help="control-loop tick interval")
+    asp.add_argument("--max-ticks", type=int, default=None,
+                     help="stop after N ticks (smoke/CI)")
+    asp.add_argument("--drain", action="store_true",
+                     help="exit once the queue settles and every spawned "
+                          "worker has retired")
+    asp.add_argument("--max-workers", type=int, default=None,
+                     help="pool cap (default REDCLIFF_AUTOSCALE_MAX_WORKERS "
+                          "or 4)")
+    asp.add_argument("--min-workers", type=int, default=None)
+    asp.add_argument("--target-drain-s", type=float, default=None,
+                     help="queue drain-time target the pool is sized for")
+    asp.add_argument("--hysteresis-s", type=float, default=None,
+                     help="cooldown between pool/QoS changes")
+    asp.add_argument("--window-s", type=float, default=None,
+                     help="rolling SLO window the loop reacts to")
+    asp.add_argument("--n-devices", type=int, default=1)
+    asp.add_argument("--lease-s", type=float, default=60.0)
+    asp.add_argument("--poll-s", type=float, default=2.0)
+    asp.add_argument("--max-attempts", type=int, default=3)
+    asp.add_argument("--max-restarts", type=int, default=2,
+                     help="respawn budget per worker slot (crashed workers "
+                          "respawn under the supervised-exit taxonomy)")
+    asp.set_defaults(fn=_cmd_autoscale)
 
     st = sub.add_parser("status", help="queue + per-tenant counts")
     st.add_argument("--root", required=True)

@@ -52,7 +52,7 @@ import time
 __all__ = ["CHAOS_KEY", "poison_point", "strip_chaos", "detonate",
            "tear_spool_tail", "corrupt_random_lease", "expire_random_lease",
            "WorkerFleet", "FLEET_FAULT_KINDS", "random_fleet_fault_schedule",
-           "apply_fault"]
+           "apply_fault", "submit_storm"]
 
 # the sentinel key a poison request spec rides in on; the batch driver
 # strips it from every point before the fit and acts on it only when the
@@ -172,6 +172,61 @@ def expire_random_lease(root, rng, now=None):
         os.fsync(f.fileno())
     os.replace(tmp, path)
     return lease.get("request_id")
+
+
+# ---------------------------------------------------------------------------
+# load faults
+# ---------------------------------------------------------------------------
+def submit_storm(root, n_requests, tenant="storm", seed=0, spec=None,
+                 points_per_request=1, epochs=None, priority=0,
+                 deadline_s=None, distinct=True, now=None):
+    """A seeded burst of N requests against a fleet root — the LOAD fault:
+    more work than the current pool can drain inside its SLO. Used by the
+    autoscale acceptance soak and bench probe (ISSUE 16): at fixed worker
+    count the storm breaches queue-wait p99; with the autoscaler +
+    backpressure armed it must settle with SLOs restored and zero
+    dead-letters.
+
+    ``spec`` is the per-request fit spec (defaults to the CLI's tiny
+    synthetic spec). ``distinct=True`` (the default) varies each request's
+    data seed deterministically in ``seed`` — CRITICAL for a storm: N
+    byte-identical specs share one ``planner.batch_key`` and merge into a
+    single batch, which is a merge benchmark, not a load storm.
+
+    Submissions ride the normal admission gate: a
+    :class:`~redcliff_tpu.fleet.queue.BackpressureReject` is counted, not
+    raised. Returns ``{"submitted": [rids...], "rejected": [
+    {"eta_s", "threshold_s"}...], "tenant", "seed"}``."""
+    from redcliff_tpu.fleet.queue import BackpressureReject, FleetQueue
+
+    rng = random.Random(seed)
+    q = FleetQueue(str(root))
+    if spec is None:
+        from redcliff_tpu.fleet.__main__ import TINY_SPEC
+
+        spec = TINY_SPEC
+    submitted, rejected = [], []
+    for i in range(int(n_requests)):
+        s = json.loads(json.dumps(spec))
+        if distinct:
+            data = s.setdefault("data", {})
+            data["seed"] = int(data.get("seed") or 0) + 1 + rng.randrange(
+                1 << 20)
+        points = [{"gen_lr": round(1e-3 * (1 + rng.random()), 8)}
+                  for _ in range(int(points_per_request))]
+        try:
+            rid = q.submit(tenant, points, spec=s, epochs=epochs,
+                           priority=priority, deadline_s=deadline_s,
+                           now=now)
+        except BackpressureReject as rej:
+            rejected.append({"eta_s": rej.eta_s,
+                             "threshold_s": rej.threshold_s,
+                             "queue_depth": rej.queue_depth,
+                             "workers": rej.workers})
+            continue
+        submitted.append(rid)
+    return {"submitted": submitted, "rejected": rejected,
+            "tenant": str(tenant), "seed": int(seed)}
 
 
 # ---------------------------------------------------------------------------

@@ -78,8 +78,8 @@ import uuid
 
 from redcliff_tpu.fleet import history as _history
 
-__all__ = ["FleetQueue", "Lease", "LeaseLost", "SPOOL_NAME",
-           "TERMINAL_STATES"]
+__all__ = ["FleetQueue", "Lease", "LeaseLost", "BackpressureReject",
+           "SPOOL_NAME", "TERMINAL_STATES"]
 
 SPOOL_NAME = "requests.jsonl"
 _LEASES = "leases"
@@ -103,6 +103,27 @@ _MAX_HISTORY = 20
 class LeaseLost(RuntimeError):
     """The lease file no longer belongs to this claimant (it expired and
     another worker reclaimed the request)."""
+
+
+class BackpressureReject(RuntimeError):
+    """``submit`` refused admission: the predicted queue wait would breach
+    the tenant's queue-wait SLO (``REDCLIFF_SLO_QUEUE_P99_S``). The
+    structured reject-with-ETA: ``eta_s`` is the predicted wait, so the
+    caller can resubmit after roughly that long (or with
+    ``REDCLIFF_BACKPRESSURE=0``). Rejection beats silent lateness."""
+
+    def __init__(self, tenant, eta_s, threshold_s, queue_depth, workers):
+        self.tenant = str(tenant)
+        self.eta_s = float(eta_s)
+        self.threshold_s = float(threshold_s)
+        self.queue_depth = int(queue_depth)
+        self.workers = int(workers)
+        super().__init__(
+            f"backpressure: predicted queue wait {self.eta_s:.1f}s exceeds "
+            f"SLO {self.threshold_s:g}s for tenant {self.tenant!r} "
+            f"(queue depth {self.queue_depth}, {self.workers} worker(s)); "
+            f"retry in ~{self.eta_s:.0f}s or set "
+            f"REDCLIFF_BACKPRESSURE=0")
 
 
 def _read_json(path):
@@ -239,6 +260,33 @@ class FleetQueue:
     # ------------------------------------------------------------------
     # submit / read the spool
     # ------------------------------------------------------------------
+    def _backpressure_gate(self, tenant, now):
+        """Raise :class:`BackpressureReject` when the predicted queue wait
+        for a request submitted now would breach the armed queue-wait SLO.
+        Inert unless ``REDCLIFF_SLO_QUEUE_P99_S`` is set (and not opted
+        out via ``REDCLIFF_BACKPRESSURE=0``) — prediction costs a planner
+        pass, so the gate only runs when a tenant actually bought an SLO."""
+        from redcliff_tpu.fleet import autoscale as _autoscale
+        from redcliff_tpu.obs import slo as _slo
+
+        if not _autoscale.backpressure_enabled():
+            return
+        threshold = _slo.thresholds_from_env().get("queue_p99_s")
+        if threshold is None:
+            return
+        pred = _autoscale.predict_queue_wait_s(self.root, q=self, now=now)
+        if pred["eta_s"] <= threshold:
+            return
+        from redcliff_tpu.obs.logging import MetricLogger
+
+        with MetricLogger(self.root) as log:
+            log.log("backpressure", kind="reject", tenant=str(tenant),
+                    eta_s=pred["eta_s"], threshold_s=float(threshold),
+                    queue_depth=pred["queue_depth"],
+                    workers=pred["workers"], reason="predicted queue wait")
+        raise BackpressureReject(tenant, pred["eta_s"], threshold,
+                                 pred["queue_depth"], pred["workers"])
+
     def submit(self, tenant, points, spec=None, shape=None, priority=0,
                deadline_s=None, epochs=None, per_lane_bytes=None,
                fixed_bytes=None, request_id=None, now=None):
@@ -257,8 +305,19 @@ class FleetQueue:
         Mints the request's durable ``trace_id`` — the identity every
         lifecycle event, span, and metrics record downstream joins on —
         and appends the ``submitted`` lifecycle transition to the history
-        ledger."""
+        ledger.
+
+        **Admission backpressure (ISSUE 16).** When the tenant queue-wait
+        SLO is armed (``REDCLIFF_SLO_QUEUE_P99_S`` set) and
+        ``REDCLIFF_BACKPRESSURE`` is not ``0``, submission first consults
+        the autoscaler's queue-wait prediction
+        (fleet/autoscale.py:predict_queue_wait_s — cost-model-priced drain
+        estimate over the live worker count) and raises
+        :class:`BackpressureReject` — structured, with the predicted ETA —
+        instead of spooling work that is predicted to breach. With no SLO
+        armed the gate is inert and submit behaves exactly as before."""
         now = time.time() if now is None else now
+        self._backpressure_gate(tenant, now)
         spec = dict(spec or {})
         if epochs is None:
             epochs = spec.get("epochs")

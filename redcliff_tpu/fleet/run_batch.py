@@ -152,10 +152,17 @@ def run_batch_file(batch_file):
         pts = [_chaos.strip_chaos(p, chaos_specs) for p in
                (r.get("points") or ())]
         merged.extend(pts)
-        manifest.append({"request_id": r["request_id"],
-                         "tenant": str(r.get("tenant")),
-                         "trace_id": r.get("trace_id"),
-                         "start": start, "stop": start + len(pts)})
+        row = {"request_id": r["request_id"],
+               "tenant": str(r.get("tenant")),
+               "trace_id": r.get("trace_id"),
+               "start": start, "stop": start + len(pts)}
+        if r.get("qos"):
+            # degraded-QoS stamp (fleet/autoscale.py apply_qos): the rung
+            # this request was admitted under rides into the manifest and
+            # its results record — the durable "completed at degraded
+            # settings" evidence the ISSUE-16 acceptance requires
+            row["qos"] = r["qos"]
+        manifest.append(row)
         start += len(pts)
     if chaos_specs and _fi.fleet_poison_armed():
         # a poison request spec (fleet chaos harness): die the way the
@@ -267,6 +274,8 @@ def run_batch_file(batch_file):
             "failures": jsonable(failures),
             "quality": jsonable(_request_quality(lo, hi)),
         }
+        if row.get("qos"):
+            rec["qos"] = row["qos"]
         tmp = os.path.join(results_dir,
                            f".{row['request_id']}.tmp.{os.getpid()}")
         with open(tmp, "w") as f:

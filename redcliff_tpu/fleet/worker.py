@@ -79,6 +79,7 @@ from redcliff_tpu.obs import spans as _spans
 from redcliff_tpu.runtime.supervisor import (SupervisorPolicy,
                                              latest_cost_model_eta,
                                              supervise)
+from redcliff_tpu.fleet import autoscale as _autoscale
 from redcliff_tpu.fleet import history as _history
 from redcliff_tpu.fleet import planner as _planner
 from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
@@ -305,6 +306,17 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
             suspects.add(rid)
     if not pending:
         return None
+    # degraded-QoS ladder (ISSUE 16): apply any durable per-tenant demotion
+    # rung (fleet/autoscale.py, <root>/qos/<tenant>.json) to the FRESH
+    # admission population only. A demoted spec no longer shares a
+    # planner.batch_key with undemoted work, so un-breached co-tenants'
+    # batches are bit-identical with the ladder active or not. The reclaim
+    # and pinned paths above deliberately bypass this: their compositions
+    # must resume the exact spec their grid checkpoint was fitted under
+    qos_rungs = _autoscale.active_qos(q.root)
+    if qos_rungs:
+        pending = [_autoscale.apply_qos(rec, qos_rungs) for rec in pending]
+    pend_map = {r["request_id"]: r for r in pending}
     t0 = time.perf_counter()
     cost_model = _costmodel.load()
     pl = _planner.plan(pending, n_devices=n_devices,
@@ -339,7 +351,8 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
             # claim: its points must not ride into the fit — rebuild the
             # batch from the survivors (fresh id, fresh run dir; same
             # content-derived lane seeds, so results are unchanged)
-            b = _planner._batch_view([by_id[r] for r in rids], n_devices)
+            b = _planner._batch_view([pend_map[r] for r in rids
+                                      if r in pend_map], n_devices)
         leases = _claim_batch(q, worker_id, lease_s, b["batch_id"],
                               b["requests"], by_id, logger)
         if leases:
@@ -352,7 +365,11 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
                 requests=b["requests"], trace_ids=b.get("trace_ids"),
                 n_points=b["n_points"], g_bucket=b["g_bucket"],
                 worker=worker_id)
-            members = [by_id[r] for r in b["requests"] if r in by_id]
+            # members come from the QoS-transformed map: the demoted spec
+            # (and its "qos" stamp) is what rides into batch.json and the
+            # supervised fit
+            members = [pend_map.get(r) or by_id[r]
+                       for r in b["requests"] if r in by_id]
             return b, leases, members
     return None
 

@@ -178,6 +178,18 @@ FAMILIES = [
            band=_BAND_TIMING, g_dependent=False, contract_max=1.0),
     Family("predictive_policy.decide_ms", better="lower", band=_BAND_TIMING,
            abs_floor=50.0, g_dependent=False),
+    # SLO-driven autoscaling (ISSUE 16, fleet/autoscale.py): wall time
+    # from the first windowed breach detection of a seeded submit storm to
+    # the queue fully drained with the pool grown — the breach-absorption
+    # latency the subsystem exists to bound. Storms drain real tiny fits,
+    # so the floor forgives scheduler/compile jitter on small absolutes.
+    # reject_eta_err_pct tracks the backpressure gate's reject-with-ETA
+    # accuracy (|predicted wait - observed drain| as % of observed): a
+    # creeping error means tenants are told wrong retry times
+    Family("autoscale.breach_to_recovery_s", better="lower",
+           band=_BAND_TIMING, abs_floor=30.0, g_dependent=False),
+    Family("autoscale.reject_eta_err_pct", better="lower",
+           band=_BAND_TIMING, abs_floor=50.0, g_dependent=False),
     # scientific regression families (ISSUE 13, obs/quality.py): the
     # quality probe's graph-recovery score on the deterministic synthetic
     # sVAR grid fit, the top-k edge-set stability at the end of that fit,

@@ -142,6 +142,12 @@ def build_report(run_dir):
     cur = None            # current fit context: {"shape_key", "shape", ...}
     manifest = {}         # request_id -> {tenant, start, stop} (fleet runs)
     fleet_kind_counts = {}  # fleet-event lifecycle counts (fleet roots)
+    autoscale_counts = {}   # autoscale decision-kind counts (ISSUE 16)
+    last_autoscale = None
+    qos_last = {}           # tenant -> newest qos demote/restore event
+    qos_demotes = 0
+    bp_rejects = 0
+    bp_last = None
     cost = {}             # (shape_key, g_bucket) -> accumulators
     cm_acc = {}           # (shape_key, g_bucket) -> residual-event accuracy
     run_cache_dir = None  # the versioned compile-cache dir fit_start logs
@@ -266,6 +272,21 @@ def build_report(run_dir):
                 for row in rec.get("requests") or []:
                     if isinstance(row, dict) and row.get("request_id"):
                         manifest[row["request_id"]] = row
+        elif ev == "autoscale":
+            # the SLO-driven control loop's decision stream (ISSUE 16)
+            kind = str(rec.get("kind"))
+            autoscale_counts[kind] = autoscale_counts.get(kind, 0) + 1
+            # headline = the newest POOL decision; start/stop are loop
+            # lifecycle markers, holds are steady-state noise
+            if kind not in ("hold", "start", "stop"):
+                last_autoscale = rec
+        elif ev == "qos":
+            if rec.get("tenant") is not None:
+                qos_last[str(rec["tenant"])] = rec
+            qos_demotes += rec.get("kind") == "demote"
+        elif ev == "backpressure":
+            bp_rejects += rec.get("kind") == "reject"
+            bp_last = rec
         elif ev == "profile":
             profiles.append({k: rec.get(k) for k in
                              ("path", "spec", "first_epoch", "last_epoch",
@@ -581,8 +602,10 @@ def build_report(run_dir):
     # deadletter / cancel / requeue / renew_error)
     containment = None
     fleet_slo = None
+    fleet_autoscale = None
     if os.path.exists(os.path.join(run_dir, "requests.jsonl")) \
             or os.path.isdir(os.path.join(run_dir, "leases")):
+        from redcliff_tpu.fleet import autoscale as _as
         from redcliff_tpu.fleet.queue import FleetQueue
         from redcliff_tpu.obs import slo as _slo
 
@@ -606,6 +629,37 @@ def build_report(run_dir):
                 if k in ("deadletter", "bisect", "cancel", "requeue",
                          "renew_error", "lease_lost", "reclaim")},
         }
+        # autoscale section (ISSUE 16): decision-kind tallies from the
+        # metrics chain, the last non-hold decision, the durable published
+        # control state, active QoS rungs, and admission-gate rejects
+        auto_state = _as.load_state(run_dir)
+        qos_rungs = _as.active_qos(run_dir)
+        if autoscale_counts or auto_state is not None or qos_rungs \
+                or bp_rejects or qos_last:
+            fleet_autoscale = {
+                "decisions": {k: autoscale_counts[k]
+                              for k in sorted(autoscale_counts)},
+                "last_decision": ({k: last_autoscale.get(k) for k in
+                                   ("kind", "reason", "workers", "target",
+                                    "queue_depth", "drain_eta_s",
+                                    "breaches", "wall_time")}
+                                  if last_autoscale else None),
+                "state": auto_state,
+                "qos": {t: {"rung": r.get("rung"), "reason": r.get("reason")}
+                        for t, r in sorted(qos_rungs.items())},
+                "qos_demotes": int(qos_demotes),
+                "qos_last_events": {t: {k: e.get(k) for k in
+                                        ("kind", "rung", "from_rung",
+                                         "reason")}
+                                    for t, e in sorted(qos_last.items())},
+                "backpressure": {
+                    "rejects": int(bp_rejects),
+                    "last": ({k: bp_last.get(k) for k in
+                              ("tenant", "eta_s", "threshold_s",
+                               "queue_depth", "workers")}
+                             if bp_last else None),
+                },
+            }
 
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
@@ -654,6 +708,7 @@ def build_report(run_dir):
         "tenants": tenants,
         "fleet_containment": containment,
         "fleet_slo": fleet_slo,
+        "fleet_autoscale": fleet_autoscale,
         "quality": quality_section,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
@@ -834,6 +889,35 @@ def render_text(report):
             out.append(f"  SLO BREACH [{br['scope']}] {br['slo']}: "
                        f"{br['value']:.3f} vs threshold "
                        f"{br['threshold']:.3f}")
+    fa = r.get("fleet_autoscale")
+    if fa:
+        out.append("fleet autoscale (SLO-driven control loop, "
+                   "fleet/autoscale.py; docs/ARCHITECTURE.md 'SLO-driven "
+                   "autoscaling & degraded QoS'):")
+        if fa.get("decisions"):
+            out.append("  decisions: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(fa["decisions"].items())))
+        ld = fa.get("last_decision")
+        if ld:
+            out.append(f"  last decision: {ld.get('kind')} "
+                       f"({ld.get('reason')}), workers={ld.get('workers')} "
+                       f"target={ld.get('target')}")
+        st_ = fa.get("state") or {}
+        if st_:
+            out.append(f"  published state: {st_.get('workers')}/"
+                       f"{st_.get('max_workers')} worker(s), pending "
+                       f"{st_.get('pending')}, drain eta "
+                       f"{st_.get('drain_eta_s')}s")
+        for tenant, q_ in sorted((fa.get("qos") or {}).items()):
+            out.append(f"  qos tenant {tenant}: rung {q_.get('rung')} "
+                       f"({q_.get('reason')})")
+        bp = fa.get("backpressure") or {}
+        if bp.get("rejects"):
+            last = bp.get("last") or {}
+            out.append(f"  backpressure: {bp['rejects']} reject(s)"
+                       + (f", last [{last.get('tenant')}] eta "
+                          f"{last.get('eta_s')}s vs slo "
+                          f"{last.get('threshold_s')}s" if last else ""))
     qf = (r.get("quality") or {}).get("fits") or []
     if qf:
         out.append("model quality (live Granger-graph readouts, "

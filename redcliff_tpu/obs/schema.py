@@ -329,14 +329,39 @@ EVENTS = {
         "kind=submitted | planned | claimed | attempt | released | "
         "bisected | settled | requeued | preempted — the zero-charge "
         "checkpoint-and-yield transition ISSUE 15's deadline-aware "
-        "preemption records)",
+        "preemption records — | autoscale | qos — ISSUE 16's durable "
+        "pool-scaling and QoS-rung transitions, what `obs trace --fleet` "
+        "joins scaling decisions from)",
         required=("kind",),
         optional=("request_id", "trace_id", "batch_id", "tenant", "worker",
                   "state", "classification", "attempt", "attempts",
                   "started_at", "requests", "trace_ids", "halves", "reason",
                   "priority", "deadline_s", "n_points", "submitted_at",
                   "g_bucket", "reclaim", "run_dir", "parent_batch_id",
-                  "beneficiary")),
+                  "beneficiary", "workers", "target", "rung")),
+    "autoscale": _ev(
+        "fleet autoscaler (fleet/autoscale.py — the SLO-driven control "
+        "loop's decision stream in the fleet root's metrics chain; "
+        "kind=start | scale_up | respawn | scale_down | hold | stop)",
+        required=("kind",),
+        optional=("workers", "target", "max_workers", "min_workers",
+                  "reason", "queue_depth", "drain_eta_s", "target_drain_s",
+                  "window_s", "breaches", "spawned", "retired", "worker",
+                  "classification", "restarts", "pending", "ticks")),
+    "qos": _ev(
+        "fleet autoscaler degraded-QoS ladder (fleet/autoscale.py — a "
+        "breaching tenant demoted to cheaper settings instead of "
+        "dead-lining; kind=demote | restore)",
+        required=("kind", "tenant"),
+        optional=("rung", "from_rung", "reason", "precision_mode",
+                  "check_every_factor", "window_s", "worker")),
+    "backpressure": _ev(
+        "fleet queue admission gate (fleet/queue.py submit — the "
+        "structured reject-with-ETA when predicted queue wait would "
+        "breach the tenant's armed queue-wait SLO; kind=reject)",
+        required=("kind", "tenant"),
+        optional=("eta_s", "threshold_s", "queue_depth", "workers",
+                  "n_points", "priority", "reason")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -440,7 +465,7 @@ NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "obs/slo.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
                   "fleet/chaos.py", "fleet/__main__.py",
-                  "fleet/history.py")
+                  "fleet/history.py", "fleet/autoscale.py")
 # ops/autotune.py joins the lazy set (ISSUE 14): its store half must stay
 # importable by backend-free processes, and its measurement half must sync
 # via jax.device_get — a block_until_ready inside the tuner would be a

@@ -32,6 +32,15 @@ Definitions (docs/ARCHITECTURE.md "Request lifecycle tracing & SLOs"):
   over requests with at least one recorded ``attempt`` transition;
 * **deadletter rate** — settled ``deadletter`` over all settled, percent.
 
+The default view is ALL-TIME (every request the ledger ever saw — what
+``obs report`` archives). ``compute_slo(records, window_s=...)`` instead
+restricts the population to requests with lifecycle activity inside the
+trailing window (last event wall time within ``window_s`` of the ledger's
+newest wall) — the view the fleet autoscaler (fleet/autoscale.py) reacts
+to, so a breach absorbed an hour ago cannot keep the pool inflated. The
+windowed output carries ``window.window_s``/``window.cutoff_wall``; the
+all-time output is bit-identical to what it was before windowing existed.
+
 Percentiles are **nearest-rank** (p-th percentile of n sorted values =
 value at rank ``ceil(p/100 * n)``): exact on small populations — a ledger
 with known synthetic timings yields exactly predictable p50/p99 (pinned by
@@ -129,7 +138,11 @@ def _requests_from_history(records):
             "submitted_at": None, "deadline_s": None,
             "first_claimed": None, "first_attempt_start": None,
             "attempts": 0, "settled_state": None, "settled_at": None,
-            "_pending_claim": None})
+            "last_wall": None, "_pending_claim": None})
+        wt_any = _wall(rec)
+        if wt_any is not None and (r["last_wall"] is None
+                                   or wt_any > r["last_wall"]):
+            r["last_wall"] = wt_any
         if rec.get("tenant") is not None:
             r["tenant"] = str(rec["tenant"])
         if rec.get("trace_id") is not None and r["trace_id"] is None:
@@ -257,14 +270,25 @@ def _breaches_of(scope, block, thr):
     return out
 
 
-def compute_slo(records, thresholds=None):
+def compute_slo(records, thresholds=None, window_s=None):
     """Compute the fleet SLO view from lifecycle-ledger records
     (fleet/history.py). Returns ``{"requests", "settled", "overall",
     "tenants": {tenant: block}, "thresholds", "breaches", "window"}`` —
     strict-JSON-able; ``None`` sub-blocks mean no evidence yet, never
-    zero. ``thresholds`` defaults to :func:`thresholds_from_env`."""
+    zero. ``thresholds`` defaults to :func:`thresholds_from_env`.
+
+    ``window_s`` restricts the population to requests with lifecycle
+    activity in the trailing window (see module docstring); ``None`` — the
+    default — is the all-time view, whose output is bit-identical to the
+    pre-windowing era."""
     thr = dict(thresholds_from_env(), **(thresholds or {}))
     reqs = list(_requests_from_history(records).values())
+    walls = [w for rec in records for w in (_wall(rec),) if w is not None]
+    cutoff_wall = None
+    if window_s is not None and walls:
+        cutoff_wall = max(walls) - float(window_s)
+        reqs = [r for r in reqs if r["last_wall"] is not None
+                and r["last_wall"] >= cutoff_wall]
     by_tenant = {}
     for r in reqs:
         by_tenant.setdefault(r["tenant"] or "?", []).append(r)
@@ -273,7 +297,11 @@ def compute_slo(records, thresholds=None):
     breaches = _breaches_of("overall", overall, thr)
     for t, block in tenants.items():
         breaches.extend(_breaches_of(t, block, thr))
-    walls = [w for rec in records for w in (_wall(rec),) if w is not None]
+    window = {"first_wall": min(walls) if walls else None,
+              "last_wall": max(walls) if walls else None}
+    if window_s is not None:
+        window["window_s"] = float(window_s)
+        window["cutoff_wall"] = cutoff_wall
     return {
         "requests": overall["requests"],
         "settled": overall["settled"],
@@ -281,12 +309,11 @@ def compute_slo(records, thresholds=None):
         "tenants": tenants,
         "thresholds": thr,
         "breaches": breaches,
-        "window": {"first_wall": min(walls) if walls else None,
-                   "last_wall": max(walls) if walls else None},
+        "window": window,
     }
 
 
-def slo_for_root(root, thresholds=None, stats=None):
+def slo_for_root(root, thresholds=None, stats=None, window_s=None):
     """The SLO view for a fleet root (reads ``<root>/history.jsonl``), or
     None when the root holds no lifecycle ledger yet."""
     from redcliff_tpu.fleet.history import read_history
@@ -294,4 +321,4 @@ def slo_for_root(root, thresholds=None, stats=None):
     records = read_history(root, stats=stats)
     if not records:
         return None
-    return compute_slo(records, thresholds=thresholds)
+    return compute_slo(records, thresholds=thresholds, window_s=window_s)

@@ -201,6 +201,10 @@ def build_snapshot(run_dir, now=None):
     cur = None
     fleet_last_plan = None   # newest planner packing decision (fleet event)
     fleet_workers = {}       # worker id -> last fleet-event wall time
+    last_autoscale = None    # newest autoscaler decision (ISSUE 16)
+    last_qos = {}            # tenant -> newest qos demote/restore event
+    last_backpressure = None  # newest admission-gate reject
+    backpressure_rejects = 0
     mem_pred = mem_meas = None  # newest memory events (obs/memory.py)
     last_quality = None      # newest quality event (obs/quality.py)
     last_policy = None       # newest predictive-policy decision (ISSUE 15)
@@ -267,6 +271,16 @@ def build_snapshot(run_dir, now=None):
             w = rec.get("worker")
             if w and isinstance(wt, (int, float)):
                 fleet_workers[str(w)] = wt
+        elif ev == "autoscale":
+            # the SLO-driven control loop's decision stream (ISSUE 16):
+            # the newest decision becomes the fleet section's headline
+            last_autoscale = rec
+        elif ev == "qos":
+            if rec.get("tenant") is not None:
+                last_qos[str(rec["tenant"])] = rec
+        elif ev == "backpressure":
+            last_backpressure = rec
+            backpressure_rejects += rec.get("kind") == "reject"
         elif ev == "anomaly":
             anomalies += 1
         elif ev == "numerics":
@@ -399,7 +413,11 @@ def build_snapshot(run_dir, now=None):
     # rotation-chain-tailed `fleet` events above
     fleet = None
     if is_fleet_root(run_dir):
-        fleet = _fleet_section(run_dir, fleet_last_plan, fleet_workers, now)
+        fleet = _fleet_section(
+            run_dir, fleet_last_plan, fleet_workers, now,
+            last_autoscale=last_autoscale, last_qos=last_qos,
+            last_backpressure=last_backpressure,
+            backpressure_rejects=backpressure_rejects)
     return {
         "event": "watch",
         "wall_time": now,
@@ -432,10 +450,16 @@ def build_snapshot(run_dir, now=None):
     }
 
 
-def _fleet_section(root, last_plan, workers, now):
+def _fleet_section(root, last_plan, workers, now, last_autoscale=None,
+                   last_qos=None, last_backpressure=None,
+                   backpressure_rejects=0):
     """The fleet-mode snapshot body: queue/tenant counts (file queue =
     authoritative), live in-flight claims (lease files), the planner's
-    newest packing decision, and worker liveness ages."""
+    newest packing decision, worker liveness ages, and the autoscaler's
+    control state (published ``autoscale.json`` = authoritative pool view;
+    the tailed ``autoscale``/``qos``/``backpressure`` events supply the
+    newest decisions)."""
+    from redcliff_tpu.fleet import autoscale as _as
     from redcliff_tpu.fleet.queue import FleetQueue
 
     # create=False: a watcher is a pure reader — it must neither mkdir
@@ -500,6 +524,41 @@ def _fleet_section(root, last_plan, workers, now):
     # lifecycle ledger, with REDCLIFF_SLO_* threshold breach flags — the
     # service-level numbers a follow-mode operator steers by
     slo = _fleet_slo(root)
+    # autoscale view (ISSUE 16): durable state file + qos rung files are
+    # authoritative (they outlive the metrics tail); the tailed events
+    # carry the newest decision/reject headline
+    auto_state = _as.load_state(root)
+    qos_rungs = _as.active_qos(root)
+    autoscale = None
+    if auto_state is not None or qos_rungs or last_autoscale is not None \
+            or last_backpressure is not None or last_qos:
+        last_dec = (auto_state or {}).get("last_decision") or last_autoscale
+        awt = (auto_state or {}).get("wall_time")
+        autoscale = {
+            "workers": (auto_state or {}).get("workers"),
+            "target": (auto_state or {}).get("target"),
+            "max_workers": (auto_state or {}).get("max_workers"),
+            "pending": (auto_state or {}).get("pending"),
+            "drain_eta_s": (auto_state or {}).get("drain_eta_s"),
+            "state_age_s": (round(now - awt, 3)
+                            if isinstance(awt, (int, float)) else None),
+            "last_decision": ({k: last_dec.get(k) for k in
+                               ("kind", "reason", "workers", "target",
+                                "queue_depth", "drain_eta_s", "breaches")}
+                              if last_dec else None),
+            "qos": {t: {"rung": r.get("rung"), "reason": r.get("reason")}
+                    for t, r in sorted(qos_rungs.items())},
+            "last_qos_events": {t: {k: e.get(k) for k in
+                                    ("kind", "rung", "from_rung", "reason")}
+                                for t, e in sorted((last_qos or {}).items())},
+            "backpressure": {
+                "rejects": int(backpressure_rejects),
+                "last": ({k: last_backpressure.get(k) for k in
+                          ("tenant", "eta_s", "threshold_s", "queue_depth",
+                           "workers")}
+                         if last_backpressure else None),
+            },
+        }
     return {
         "counts": st["counts"],
         "by_tenant": st["by_tenant"],
@@ -510,6 +569,7 @@ def _fleet_section(root, last_plan, workers, now):
                        "requests": deadletters},
         "attempts": attempts,
         "slo": slo,
+        "autoscale": autoscale,
         "worker_age_s": {w: round(now - t, 3)
                          for w, t in sorted(workers.items())},
     }
@@ -614,6 +674,31 @@ def render_text(snap):
             out.append("    workers: " + "  ".join(
                 f"{w}={_fmt_age(a)}"
                 for w, a in fl["worker_age_s"].items()))
+        auto = fl.get("autoscale")
+        if auto:
+            out.append(
+                f"    autoscale: {auto.get('workers')}/"
+                f"{auto.get('max_workers')} worker(s), target "
+                f"{auto.get('target')}, pending {auto.get('pending')}, "
+                f"drain eta {_fmt_age(auto.get('drain_eta_s'))}"
+                + (f" (state {_fmt_age(auto['state_age_s'])} old)"
+                   if auto.get("state_age_s") is not None else ""))
+            ld = auto.get("last_decision")
+            if ld:
+                out.append(f"      last decision: {ld.get('kind')} "
+                           f"({ld.get('reason')})")
+            for tenant, r in sorted((auto.get("qos") or {}).items()):
+                out.append(f"      qos tenant {tenant}: rung "
+                           f"{r.get('rung')} ({r.get('reason')})")
+            bp = auto.get("backpressure") or {}
+            if bp.get("rejects"):
+                last = bp.get("last") or {}
+                out.append(
+                    f"      backpressure: {bp['rejects']} reject(s)"
+                    + (f", last [{last.get('tenant')}] eta "
+                       f"{_fmt_age(last.get('eta_s'))} vs slo "
+                       f"{_fmt_age(last.get('threshold_s'))}"
+                       if last else ""))
     hb = snap["heartbeats"]
     out.append(f"  ages: metrics file {_fmt_age(hb['metrics_file_age_s'])} |"
                f" last record {_fmt_age(hb['last_record_age_s'])} | last "

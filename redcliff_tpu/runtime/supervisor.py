@@ -60,7 +60,7 @@ from redcliff_tpu.runtime.retry import RetryPolicy
 from redcliff_tpu.runtime.watchdog import classify_exit
 
 __all__ = ["SupervisorPolicy", "SuperviseOutcome", "supervise", "main",
-           "LEDGER_NAME", "latest_cost_model_eta"]
+           "LEDGER_NAME", "latest_cost_model_eta", "worker_exit_action"]
 
 LEDGER_NAME = "run_ledger.jsonl"
 
@@ -124,6 +124,27 @@ class SuperviseOutcome:
 def _restartable(classification):
     return any(classification == c or classification.startswith(c + ":")
                for c in RESTART_CLASSES)
+
+
+def worker_exit_action(returncode, restarts_used, max_restarts=None,
+                       policy=None):
+    """Judge one WORKER-process exit under the supervised-exit taxonomy:
+    returns ``(classification, action)`` where action is ``"retire"`` (a
+    clean drain — the fleet autoscaler's passive scale-down), ``"respawn"``
+    (a restartable infra class with restart budget left), or ``"stop"``
+    (terminal, or budget exhausted). The fleet autoscaler
+    (fleet/autoscale.py) applies the same exit-code taxonomy to its worker
+    POOL that :func:`supervise` applies to one child — one classification
+    vocabulary across both supervision layers."""
+    if max_restarts is None:
+        max_restarts = (policy or SupervisorPolicy()).max_restarts
+    if returncode == 0:
+        return "drained", "retire"
+    classification = classify_exit(returncode)
+    if _restartable(classification) and int(restarts_used) < int(
+            max_restarts):
+        return classification, "respawn"
+    return classification, "stop"
 
 
 # how much of the metrics file tail to scan for the newest cost_model

@@ -1,0 +1,542 @@
+"""SLO-driven fleet autoscaling tests (redcliff_tpu/fleet/autoscale, ISSUE
+16).
+
+Windowed-SLO units (trailing-window population filter, all-time
+bit-identity), QoS-ladder units (rung knobs, apply_qos identity for clean
+tenants vs deep-copy demotion, batch-key divergence so demoted work never
+merges with undemoted siblings), queue-wait prediction and the submit-side
+backpressure gate (inert unarmed, structured reject-with-ETA armed,
+REDCLIFF_BACKPRESSURE opt-out), the control loop against an injected fake
+worker pool (scale-up to cap, hysteresis cooldown, respawn/retire reaping,
+state publication, QoS demote-at-cap/restore), and real-worker legs: an
+autoscaled drain of a seeded submit storm (zero dead-letters, pool grows
+then empties) and a demoted tenant completing with the QoS stamp in its
+results manifest. The full breach->recovery storm soak is slow-marked.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from redcliff_tpu.fleet import autoscale, chaos, history, planner
+from redcliff_tpu.fleet.queue import BackpressureReject, FleetQueue
+from redcliff_tpu.fleet.__main__ import TINY_SPEC
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs import slo as obs_slo
+from redcliff_tpu.obs.logging import read_jsonl
+from redcliff_tpu.runtime.supervisor import worker_exit_action
+from redcliff_tpu.runtime.watchdog import EXIT_NUMERICS_ABORT
+
+# every REDCLIFF_SLO_* unchecked: tick decisions in units below must not
+# depend on thresholds leaking from the invoking environment
+_NO_SLOS = {"queue_p99_s": None, "ttfa_p99_s": None,
+            "deadline_hit_pct": None, "deadletter_pct": None}
+
+
+def _tiny_spec(epochs=1):
+    spec = json.loads(json.dumps(TINY_SPEC))
+    spec["epochs"] = epochs
+    return spec
+
+
+def _clean_env(monkeypatch):
+    for name in ("REDCLIFF_FAULT_INJECT", "REDCLIFF_FAULT_MARKER",
+                 "REDCLIFF_SLO_QUEUE_P99_S", "REDCLIFF_SLO_TTFA_P99_S",
+                 "REDCLIFF_SLO_DEADLINE_PCT", "REDCLIFF_SLO_DEADLETTER_PCT",
+                 "REDCLIFF_BACKPRESSURE", "REDCLIFF_COST_MODEL_DIR",
+                 "REDCLIFF_COMPILE_CACHE"):
+        monkeypatch.delenv(name, raising=False)
+
+
+# ---------------------------------------------------------------------------
+# windowed SLO view (obs/slo.py window_s)
+# ---------------------------------------------------------------------------
+def _lifecycle(rid, tenant, t_submit, t_claim=None, t_attempt=None,
+               t_settle=None, state="done"):
+    recs = [{"event": "fleet_lifecycle", "kind": "submitted",
+             "request_id": rid, "tenant": tenant, "wall_time": t_submit,
+             "submitted_at": t_submit, "seq": 0}]
+    if t_claim is not None:
+        recs.append({"event": "fleet_lifecycle", "kind": "claimed",
+                     "request_id": rid, "wall_time": t_claim, "seq": 1})
+    if t_attempt is not None:
+        recs.append({"event": "fleet_lifecycle", "kind": "attempt",
+                     "request_id": rid, "wall_time": t_attempt,
+                     "started_at": t_attempt, "seq": 2})
+    if t_settle is not None:
+        recs.append({"event": "fleet_lifecycle", "kind": "settled",
+                     "request_id": rid, "wall_time": t_settle,
+                     "state": state, "seq": 3})
+    return recs
+
+
+def test_windowed_slo_restricts_population_to_recent_requests():
+    old = _lifecycle("req-old", "a", 0.0, t_claim=5.0, t_attempt=6.0,
+                     t_settle=10.0)
+    new = _lifecycle("req-new", "a", 1000.0, t_claim=1001.0,
+                     t_attempt=1002.0, t_settle=1005.0)
+    records = old + new
+
+    full = obs_slo.compute_slo(records)
+    assert full["requests"] == 2
+    assert full["overall"]["queue_wait_s"]["p99"] == 5.0  # the old wait
+
+    win = obs_slo.compute_slo(records, window_s=100.0)
+    assert win["requests"] == 1  # req-old's last activity is at wall 10
+    assert win["overall"]["queue_wait_s"]["p99"] == 1.0
+    assert win["window"]["window_s"] == 100.0
+    assert win["window"]["cutoff_wall"] == 1005.0 - 100.0
+    # a breach absorbed long ago cannot keep the pool inflated
+    thr = {"queue_p99_s": 2.0}
+    assert obs_slo.compute_slo(records, thresholds=thr)["breaches"]
+    assert obs_slo.compute_slo(records, thresholds=thr,
+                               window_s=100.0)["breaches"] == []
+
+
+def test_all_time_slo_bit_identical_without_window():
+    records = (_lifecycle("r1", "a", 0.0, t_claim=2.0, t_settle=3.0)
+               + _lifecycle("r2", "b", 1.0, t_claim=5.0))
+    full = obs_slo.compute_slo(records)
+    # the all-time view never grows window keys (the pre-windowing shape)
+    assert set(full["window"]) == {"first_wall", "last_wall"}
+    # a window covering everything computes the identical view
+    win = obs_slo.compute_slo(records, window_s=1e9)
+    win["window"].pop("window_s")
+    win["window"].pop("cutoff_wall")
+    assert win == full
+
+
+# ---------------------------------------------------------------------------
+# the QoS ladder
+# ---------------------------------------------------------------------------
+def test_qos_knobs_ladder_rungs_and_clamp():
+    assert autoscale.qos_knobs(0) == {"rung": 0}
+    assert autoscale.qos_knobs(1) == {"rung": 1, "precision_mode": "mixed"}
+    r2 = autoscale.qos_knobs(2)
+    assert r2["precision_mode"] == "mixed"
+    assert r2["check_every_factor"] == autoscale.QOS_CHECK_EVERY_FACTOR
+    assert autoscale.qos_knobs(99)["rung"] == autoscale.QOS_MAX_RUNG
+    assert autoscale.qos_knobs(-3) == {"rung": 0}
+
+
+def test_set_qos_active_qos_roundtrip(tmp_path):
+    root = str(tmp_path)
+    assert autoscale.active_qos(root) == {}
+    rec = autoscale.set_qos(root, "hot", 2, reason="test", now=123.0)
+    assert rec["rung"] == 2 and rec["set_at"] == 123.0
+    active = autoscale.active_qos(root)
+    assert set(active) == {"hot"}
+    assert active["hot"]["precision_mode"] == "mixed"
+    # clearing (rung 0) removes the durable rung file
+    assert autoscale.set_qos(root, "hot", 0) is None
+    assert autoscale.active_qos(root) == {}
+
+
+def test_apply_qos_identity_for_clean_tenant_mutation_for_demoted(tmp_path):
+    root = str(tmp_path)
+    req = {"request_id": "r", "tenant": "hot",
+           "spec": {"train_config": {"check_every": 2, "seed": 0}}}
+    # no rung anywhere: the SAME object comes back (bit-identity guarantee)
+    assert autoscale.apply_qos(req, {}) is req
+    assert autoscale.apply_qos(req, autoscale.active_qos(root)) is req
+
+    autoscale.set_qos(root, "hot", 2, reason="breach")
+    rungs = autoscale.active_qos(root)
+    out = autoscale.apply_qos(req, rungs)
+    assert out is not req
+    tc = out["spec"]["train_config"]
+    assert tc["precision_mode"] == "mixed"
+    assert tc["check_every"] == 2 * autoscale.QOS_CHECK_EVERY_FACTOR
+    assert out["qos"]["rung"] == 2 and out["qos"]["reason"] == "breach"
+    # the original record is untouched (deep copy, not mutation)
+    assert "precision_mode" not in req["spec"]["train_config"]
+    # a co-tenant's record still passes through unchanged
+    other = {"request_id": "o", "tenant": "cool", "spec": {}}
+    assert autoscale.apply_qos(other, rungs) is other
+
+
+def test_demoted_spec_never_merges_with_undemoted_sibling(tmp_path):
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    spec = _tiny_spec()
+    q.submit("hot", [{"gen_lr": 1e-3}], spec=spec)
+    q.submit("cool", [{"gen_lr": 2e-3}], spec=spec)
+    pending = q.pending()
+    assert len({planner.batch_key(r) for r in pending}) == 1
+    assert len(planner.plan(pending, n_devices=1)["batches"]) == 1
+
+    autoscale.set_qos(str(root), "hot", 1)
+    rungs = autoscale.active_qos(str(root))
+    demoted = [autoscale.apply_qos(r, rungs) for r in pending]
+    # the demoted spec changes batch_key: two batches now, and the clean
+    # tenant's record (and therefore its batch) is the identical object
+    assert len({planner.batch_key(r) for r in demoted}) == 2
+    assert len(planner.plan(demoted, n_devices=1)["batches"]) == 2
+    cool = next(r for r in pending if r["tenant"] == "cool")
+    assert any(r is cool for r in demoted)
+
+
+# ---------------------------------------------------------------------------
+# drain / queue-wait prediction + the submit-side backpressure gate
+# ---------------------------------------------------------------------------
+def test_predicted_drain_empty_then_unpriced_backlog(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    empty = autoscale.predicted_drain(q, default_eta_s=10.0)
+    assert empty == {"pending": 0, "batches": 0, "priced": 0,
+                     "unpriced": 0, "total_eta_s": 0.0}
+    chaos.submit_storm(root, 2, tenant="t", seed=3, spec=_tiny_spec())
+    drain = autoscale.predicted_drain(q, cost_model=None,
+                                      default_eta_s=10.0)
+    # distinct data seeds -> two batches, both unpriced at the default ETA
+    assert drain["pending"] == 2 and drain["batches"] == 2
+    assert drain["unpriced"] == 2 and drain["priced"] == 0
+    assert drain["total_eta_s"] == 20.0
+
+
+def test_predict_queue_wait_uses_fresh_published_worker_count(
+        tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    monkeypatch.setenv(autoscale.ENV_DEFAULT_ETA_S, "10")
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    chaos.submit_storm(root, 2, tenant="t", seed=3, spec=_tiny_spec())
+    pred = autoscale.predict_queue_wait_s(str(root), q=q, cost_model=None)
+    assert pred["workers"] == 1 and pred["workers_source"] == "default"
+    base_eta = pred["eta_s"]
+    assert base_eta > 0 and pred["queue_depth"] == 2
+
+    # a fresh autoscale.json divides the serial drain by the live pool
+    autoscale._write_json_atomic(
+        os.path.join(str(root), autoscale.STATE_NAME),
+        {"wall_time": time.time(), "workers": 4, "n_devices": 1})
+    pred4 = autoscale.predict_queue_wait_s(str(root), q=q, cost_model=None)
+    assert pred4["workers"] == 4 and pred4["workers_source"] == "autoscaler"
+    assert pred4["eta_s"] == pytest.approx(base_eta / 4.0, rel=1e-6)
+
+    # a stale state file is distrusted: back to the conservative floor
+    autoscale._write_json_atomic(
+        os.path.join(str(root), autoscale.STATE_NAME),
+        {"wall_time": time.time() - 10 * autoscale.STATE_FRESH_S,
+         "workers": 4, "n_devices": 1})
+    stale = autoscale.predict_queue_wait_s(str(root), q=q, cost_model=None)
+    assert stale["workers_source"] == "default"
+
+
+def test_backpressure_gate_inert_reject_and_opt_out(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    # unarmed (no queue-wait SLO): the gate must be invisible
+    chaos.submit_storm(root, 2, tenant="t", seed=5, spec=_tiny_spec())
+    assert len(q.pending()) == 2
+
+    # armed with an unmeetable threshold: structured reject-with-ETA
+    monkeypatch.setenv(obs_slo.ENV_QUEUE_P99_S, "0.01")
+    monkeypatch.setenv(autoscale.ENV_DEFAULT_ETA_S, "30")
+    with pytest.raises(BackpressureReject) as err:
+        q.submit("t", [{"gen_lr": 1e-3}], spec=_tiny_spec())
+    rej = err.value
+    assert rej.tenant == "t" and rej.threshold_s == 0.01
+    assert rej.eta_s > rej.threshold_s and rej.queue_depth == 2
+    assert "backpressure" in str(rej) and "REDCLIFF_BACKPRESSURE" in str(rej)
+    assert len(q.pending()) == 2  # nothing spooled
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    bp = [r for r in recs if r.get("event") == "backpressure"]
+    assert bp and bp[-1]["kind"] == "reject" and bp[-1]["tenant"] == "t"
+
+    # submit_storm counts rejections instead of raising
+    storm = chaos.submit_storm(root, 2, tenant="t", seed=6,
+                               spec=_tiny_spec())
+    assert storm["submitted"] == [] and len(storm["rejected"]) == 2
+
+    # the documented opt-out knob restores unconditional admission
+    monkeypatch.setenv(autoscale.ENV_BACKPRESSURE, "0")
+    q.submit("t", [{"gen_lr": 1e-3}], spec=_tiny_spec())
+    assert len(q.pending()) == 3
+
+
+# ---------------------------------------------------------------------------
+# the control loop (injected fake worker pool — no subprocesses)
+# ---------------------------------------------------------------------------
+class FakeProc:
+    def __init__(self, cmd=None):
+        self.cmd = cmd
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+
+def _scaler(root, procs, monkeypatch=None, thresholds=None, **policy_kw):
+    kw = dict(max_workers=3, min_workers=0, target_drain_s=1.0,
+              hysteresis_s=10.0, window_s=600.0, default_eta_s=30.0)
+    kw.update(policy_kw)
+
+    def spawn(cmd):
+        procs.append(FakeProc(cmd))
+        return procs[-1]
+
+    return autoscale.Autoscaler(
+        str(root), autoscale.AutoscalePolicy(**kw), spawn=spawn,
+        thresholds=dict(_NO_SLOS, **(thresholds or {})))
+
+
+def test_tick_scales_up_to_cap_and_publishes_state(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    chaos.submit_storm(root, 4, tenant="a", seed=1, spec=_tiny_spec())
+    procs = []
+    scaler = _scaler(root, procs)
+    t0 = time.time()
+    d = scaler.tick(now=t0)
+    # 4 unpriced batches x 30s over a 1s drain target: capped at the max
+    assert d["kind"] == "scale_up" and d["workers"] == 3
+    assert len(d["spawned"]) == 3 and len(procs) == 3
+    # the spawned argv is the drain-mode worker CLI (passive scale-down)
+    assert "--drain" in procs[0].cmd and "work" in procs[0].cmd
+    st = autoscale.load_state(str(root))
+    assert st["workers"] == 3 and st["pending"] == 4
+    assert st["target"] == 3 and st["max_workers"] == 3
+    assert len(st["worker_ids"]) == 3
+
+    # steady second tick: target == live, no pool change, still published
+    d2 = scaler.tick(now=t0 + 0.1)
+    assert d2["kind"] == "hold" and d2["reason"] == "steady"
+    assert len(procs) == 3
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    kinds = [r["kind"] for r in recs if r.get("event") == "autoscale"]
+    assert kinds.count("scale_up") == 1
+    # pool changes land in the durable lifecycle ledger too (obs trace)
+    hist = history.read_history(str(root))
+    assert any(h.get("kind") == "autoscale" for h in hist)
+    scaler.close()
+
+
+def test_tick_hysteresis_gates_breach_driven_scale_up(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    storm = chaos.submit_storm(root, 2, tenant="hot", seed=2,
+                               spec=_tiny_spec())
+    # synthesize an observed queue-wait breach: a claim 50s after submit
+    history.append_event(str(root), "claimed",
+                         request_id=storm["submitted"][0], tenant="hot",
+                         now=time.time() + 50.0)
+    procs = []
+    scaler = _scaler(root, procs, thresholds={"queue_p99_s": 0.05},
+                     max_workers=4, target_drain_s=1000.0)
+    t0 = time.time() + 60.0
+    d = scaler.tick(now=t0)
+    # eta/target rounds to 1; the standing breach nudges to live+1 = 1
+    assert d["kind"] == "scale_up" and d["workers"] == 1
+    assert d["breaches"] >= 1 and "breach" in d["reason"]
+    assert scaler.first_breach_wall == t0
+
+    # inside the cooldown the breach still wants live+1: held, not spawned
+    d2 = scaler.tick(now=t0 + 1.0)
+    assert d2["kind"] == "hold" and d2["reason"] == "hysteresis cooldown"
+    assert len(procs) == 1
+    # cooled: the breach-driven escalation proceeds
+    d3 = scaler.tick(now=t0 + 11.0)
+    assert d3["kind"] == "scale_up" and d3["workers"] == 2
+    scaler.close()
+
+
+def test_reap_respawns_crashes_and_retires_drains(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    procs = []
+    scaler = _scaler(root, procs, max_workers=4)
+    scaler.max_restarts = 1
+    logger = scaler._ensure_logger()
+    w1 = scaler._spawn_worker()
+    w2 = scaler._spawn_worker()
+
+    # restartable crash with budget left: respawned, restarts incremented
+    procs[0].rc = 137
+    scaler._reap(logger, time.time(), pending=True)
+    assert w1 not in scaler.workers and len(scaler.workers) == 2
+    crashed = next(wid for wid in scaler.workers if wid != w2)
+    assert scaler.workers[crashed]["restarts"] == 1
+
+    # the respawn crashes again: budget spent -> scale_down, not respawn
+    scaler.workers[crashed]["proc"].rc = 137
+    scaler._reap(logger, time.time(), pending=True)
+    assert len(scaler.workers) == 1
+
+    # clean drain retires (the passive scale-down) even with budget left
+    scaler.workers[w2]["proc"].rc = 0
+    scaler._reap(logger, time.time(), pending=False)
+    assert scaler.workers == {}
+    recs = [r for r in read_jsonl(str(root))
+            if r.get("event") == "autoscale"]
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("respawn") == 1 and kinds.count("scale_down") == 2
+    drained = [r for r in recs if r.get("classification") == "drained"]
+    assert drained and drained[0]["worker"] == w2
+    scaler.close()
+
+
+def test_worker_exit_action_taxonomy():
+    assert worker_exit_action(0, 0) == ("drained", "retire")
+    assert worker_exit_action(137, 0, max_restarts=2) == ("crash", "respawn")
+    assert worker_exit_action(137, 2, max_restarts=2) == ("crash", "stop")
+    # terminal classes never respawn regardless of budget
+    cls, action = worker_exit_action(EXIT_NUMERICS_ABORT, 0, max_restarts=9)
+    assert cls == "numerics_abort" and action == "stop"
+    assert worker_exit_action(-9, 0, max_restarts=2) \
+        == ("signal:SIGKILL", "respawn")
+
+
+def test_qos_demotes_at_cap_and_restores_when_clean(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    storm = chaos.submit_storm(root, 2, tenant="hot", seed=7,
+                               spec=_tiny_spec())
+    history.append_event(str(root), "claimed",
+                         request_id=storm["submitted"][0], tenant="hot",
+                         now=time.time() + 50.0)
+    procs = []
+    scaler = _scaler(root, procs, thresholds={"queue_p99_s": 0.05},
+                     max_workers=1, hysteresis_s=0.0)
+    t0 = time.time() + 60.0
+    scaler.tick(now=t0)  # live 0 < cap: scaling is tried first, no demote
+    assert autoscale.active_qos(str(root)) == {}
+    scaler.tick(now=t0 + 1.0)  # at cap + breached: one rung per tick
+    assert autoscale.active_qos(str(root))["hot"]["rung"] == 1
+    scaler.tick(now=t0 + 2.0)
+    assert autoscale.active_qos(str(root))["hot"]["rung"] == 2
+    scaler.tick(now=t0 + 3.0)  # the ladder tops out
+    assert autoscale.active_qos(str(root))["hot"]["rung"] == 2
+    st = autoscale.load_state(str(root))
+    assert st["qos"] == {"hot": 2}
+
+    # window clean again: the rung is restored, the file removed
+    scaler.thresholds = dict(_NO_SLOS)
+    scaler.tick(now=t0 + 4.0)
+    assert autoscale.active_qos(str(root)) == {}
+    recs = [r for r in read_jsonl(str(root)) if r.get("event") == "qos"]
+    assert [r["kind"] for r in recs] == ["demote", "demote", "restore"]
+    assert recs[0]["precision_mode"] == "mixed"
+    assert obs_schema.validate_records(read_jsonl(str(root))) == []
+    # rung changes are in the lifecycle ledger (obs trace --fleet)
+    assert any(h.get("kind") == "qos"
+               for h in history.read_history(str(root)))
+    scaler.close()
+
+
+# ---------------------------------------------------------------------------
+# real workers: autoscaled drain + the QoS manifest stamp
+# ---------------------------------------------------------------------------
+def test_autoscaler_drains_storm_with_real_workers(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    storm = chaos.submit_storm(root, 3, tenant="storm", seed=0,
+                               spec=_tiny_spec())
+    assert len(storm["submitted"]) == 3
+    policy = autoscale.AutoscalePolicy(
+        max_workers=2, min_workers=0, target_drain_s=1.0,
+        hysteresis_s=0.5, window_s=600.0, default_eta_s=30.0)
+    scaler = autoscale.Autoscaler(
+        str(root), policy, lease_s=60.0, poll_s=0.5, max_attempts=2,
+        max_restarts=1,
+        worker_args=["--max-restarts", "1", "--base-delay-s", "0.05",
+                     "--max-delay-s", "0.05"],
+        thresholds=dict(_NO_SLOS, queue_p99_s=0.05))
+    summary = scaler.run(interval_s=0.5, drain=True)
+    st = FleetQueue(root).status()
+    assert st["counts"]["done"] == 3
+    assert st["counts"]["failed"] == 0 and st["counts"]["deadletter"] == 0
+    # the pool grew past one worker, then emptied via self-drain retires
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    events = [r for r in recs if r.get("event") == "autoscale"]
+    kinds = {r["kind"] for r in events}
+    assert {"start", "scale_up", "scale_down", "stop"} <= kinds
+    assert max(r.get("workers") or 0 for r in events) == 2
+    state = autoscale.load_state(str(root))
+    assert state["workers"] == 0 and state["pending"] == 0
+    assert summary["workers"] == 0 and summary["first_breach_wall"]
+
+    # fleet status / obs watch surface the autoscale section, schema-valid
+    from redcliff_tpu.obs.watch import build_snapshot
+
+    snap = build_snapshot(str(root))
+    assert obs_schema.validate_record(snap) == []
+    auto = snap["fleet"]["autoscale"]
+    assert auto["workers"] == 0
+    assert auto["last_decision"]["kind"] in ("hold", "scale_up")
+
+
+def test_demoted_tenant_completes_with_qos_in_results(tmp_path, monkeypatch):
+    _clean_env(monkeypatch)
+    from redcliff_tpu.fleet.worker import work
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    autoscale.set_qos(str(root), "degraded", 2, reason="test demotion")
+    rid = q.submit("degraded", [{"gen_lr": 1e-3}], spec=_tiny_spec())
+    policy = SupervisorPolicy(
+        max_restarts=2,
+        backoff=RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    n = work(str(root), drain=True, poll_s=0.2, lease_s=20.0,
+             supervisor_policy=policy, env=env)
+    assert n == 1
+    res = q.result(rid)["result"]
+    # the durable evidence: the fit ran at the demoted settings and the
+    # results manifest says so
+    assert res["qos"]["rung"] == 2
+    assert res["qos"]["precision_mode"] == "mixed"
+    assert res["qos"]["check_every"] == autoscale.QOS_CHECK_EVERY_FACTOR
+    assert len(res["best_criteria"]) == 1
+
+
+@pytest.mark.slow
+def test_storm_breach_to_recovery_acceptance(tmp_path, monkeypatch):
+    """The ISSUE 16 chaos acceptance: a seeded submit storm that breaches
+    queue-wait p99 at a fixed 1-worker pool settles — SLOs restored going
+    forward, zero dead-letters — once the autoscaler (+ armed
+    backpressure) manages the pool, with every decision traceable."""
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    storm = chaos.submit_storm(root, 6, tenant="storm", seed=0,
+                               spec=_tiny_spec())
+    assert len(storm["submitted"]) == 6
+    # the storm's predicted serial drain breaches the armed queue-wait SLO
+    pred = autoscale.predict_queue_wait_s(str(root), cost_model=None)
+    assert pred["eta_s"] > 5.0
+
+    policy = autoscale.AutoscalePolicy(
+        max_workers=3, min_workers=0, target_drain_s=1.0,
+        hysteresis_s=0.5, window_s=600.0, default_eta_s=30.0)
+    scaler = autoscale.Autoscaler(
+        str(root), policy, lease_s=60.0, poll_s=0.5, max_attempts=2,
+        max_restarts=1,
+        worker_args=["--max-restarts", "1", "--base-delay-s", "0.05",
+                     "--max-delay-s", "0.05"],
+        thresholds=dict(_NO_SLOS, queue_p99_s=5.0))
+    summary = scaler.run(interval_s=0.5, drain=True)
+    st = FleetQueue(root).status()
+    assert st["counts"]["done"] == 6
+    assert st["counts"]["deadletter"] == 0 and st["counts"]["failed"] == 0
+    assert summary["first_breach_wall"] is not None
+    # recovery: the drained fleet's forward-looking wait is inside the SLO
+    after = autoscale.predict_queue_wait_s(str(root), cost_model=None)
+    assert after["eta_s"] == 0.0
+    # decisions traceable end to end: metrics chain AND lifecycle ledger
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    kinds = {r["kind"] for r in recs if r.get("event") == "autoscale"}
+    assert {"scale_up", "scale_down"} <= kinds
+    hist = history.read_history(str(root))
+    assert any(h.get("kind") == "autoscale" for h in hist)
