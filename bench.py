@@ -1530,6 +1530,85 @@ def _bench_quality(jax):
             os.environ[_q.ENV_ENABLE] = prev
 
 
+def _bench_serve(jax, capacity=8, ticks=96):
+    """serve probe (ISSUE 17, redcliff_tpu/serve): the streaming inference
+    service on a fully leased slot table — per-sample ingest->answer p99
+    through the shared vmapped dispatch, sustained samples/s at that
+    stream count, and the churn-isolation pin (co-resident lanes
+    byte-identical with vs without a chaos storm of connect/disconnect/
+    NaN/abandoned neighbors; 1.0 means the pin holds).
+
+    The latency run uses the real clock (that IS the metric); the
+    isolation check rides :func:`redcliff_tpu.serve.chaos
+    .churn_isolation_report`'s virtual clock so its verdict is pure math.
+    Warmup (ring fill + jit compile of the dispatch) is excluded from the
+    timed window."""
+    from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
+                                              RedcliffSCMLPConfig)
+    from redcliff_tpu.obs import slo as _slo
+    from redcliff_tpu.serve import chaos as _chaos
+    from redcliff_tpu.serve.service import ServeService
+
+    D, K = 6, 2
+    model = RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=D, gen_lag=2, gen_hidden=(12,), embed_lag=4,
+        embed_hidden_sizes=(12,), num_factors=K, num_supervised_factors=K,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+    params = model.init(jax.random.PRNGKey(0))
+
+    svc = ServeService(model, params, root=None, capacity=capacity,
+                       resume=False)
+    try:
+        feeds = {f"s{i}": _chaos.stream_samples(i, ticks, D)
+                 for i in range(capacity)}
+        for sid in feeds:
+            svc.connect(sid=sid, now=time.perf_counter())
+        warm_ticks = model.config.embed_lag + 2
+        lats, answered = [], 0
+        t0 = time.perf_counter()
+        for t in range(ticks):
+            if t == warm_ticks:
+                lats, answered = [], 0
+                t0 = time.perf_counter()
+            t_ing = {}
+            for sid, arr in feeds.items():
+                t_ing[sid] = time.perf_counter()
+                svc.ingest(sid, arr[t], now=t_ing[sid])
+            svc.pump(now=time.perf_counter())
+            for sid in feeds:
+                t_done = time.perf_counter()
+                for _rec in svc.poll(sid, now=t_done):
+                    answered += 1
+                    # end-to-end ingest->poll (the service's own
+                    # latency_ms shares a clock base with time.time(),
+                    # not perf_counter — measure externally)
+                    lats.append((t_done - t_ing[sid]) * 1e3)
+        wall_s = time.perf_counter() - t0
+    finally:
+        svc.stop()
+
+    iso = _chaos.churn_isolation_report(
+        lambda: ServeService(model, params, root=None, capacity=capacity,
+                             resume=False),
+        chans=D, n_victims=2, n_samples=24, seed=0)
+    return {
+        "streams_per_chip": capacity,
+        "ticks_timed": ticks - warm_ticks,
+        "answered": answered,
+        "p50_ms": (round(_slo.percentile(lats, 50.0), 3) if lats else None),
+        "p99_ms": (round(_slo.percentile(lats, 99.0), 3) if lats else None),
+        "samples_per_s": (round(answered / wall_s, 1) if wall_s > 0
+                          else None),
+        "isolation_ok": 1.0 if iso["identical"] else 0.0,
+        "isolation_compared": iso["compared"],
+        "isolation_rejects": iso["rejects"],
+    }
+
+
 def _bench_fleet_trace(n_requests=50):
     """fleet_trace probe (ISSUE 12): the whole-fleet Perfetto join cost
     (obs/trace_export.py ``--fleet``) on a synthetic ``n_requests``-request
@@ -1822,6 +1901,14 @@ def _measure(platform):
         quality_probe = {"error": f"{type(e).__name__}: {e}",
                          "final_auroc": None, "overhead_pct": None}
 
+    # streaming inference service (ISSUE 17, redcliff_tpu/serve): saturated
+    # slot-table dispatch latency + the churn-isolation contract
+    try:
+        serve_probe = _bench_serve(jax)
+    except Exception as e:  # never fail the bench over the serve probe
+        serve_probe = {"error": f"{type(e).__name__}: {e}",
+                       "p99_ms": None, "isolation_ok": None}
+
     mfu_head = (_mfu_pct(headline["scan_flops"], headline["scan_dispatch_s"],
                          peak) if not on_cpu else None)
     _emit({
@@ -1861,6 +1948,7 @@ def _measure(platform):
         "predictive_policy": predictive_policy,
         "autoscale": autoscale_probe,
         "quality": quality_probe,
+        "serve": serve_probe,
         "error": None,
     })
 

@@ -7,6 +7,7 @@ from .pipeline import (
     dispatch_budget,
     epoch_batch_plan,
     prefetch_batches,
+    PrefetchIterator,
 )
 from .dream4 import (
     D4IC_SNR_TIERS,
@@ -35,7 +36,7 @@ from .shards import (
 __all__ = [
     "ArrayDataset", "train_val_split",
     "choose_stream_mode", "dispatch_budget", "epoch_batch_plan",
-    "prefetch_batches", "ShardedBatchDataset",
+    "prefetch_batches", "PrefetchIterator", "ShardedBatchDataset",
     "D4IC_SNR_TIERS", "make_d4ic_fold", "make_dream4_combo_dataset",
     "make_dream4_individual_dataset",
     "make_dream4_single_dominant_superpositional_dataset",

@@ -51,6 +51,7 @@ __all__ = [
     "choose_stream_mode",
     "dataset_device_bytes",
     "prefetch_batches",
+    "PrefetchIterator",
     "dispatch_budget",
     "DEFAULT_MAX_DEVICE_DATASET_BYTES",
     "STREAM_MODES",
@@ -168,13 +169,9 @@ def dispatch_budget(num_full_batches, num_remainder_batches=0,
     return num_full_batches + num_remainder_batches
 
 
-class _PrefetchCancelled(Exception):
-    pass
-
-
-def prefetch_batches(iterator, depth=2, put=None):
-    """Double-buffered background prefetch: a daemon thread drains
-    ``iterator`` up to ``depth`` items ahead, applying ``put`` (e.g.
+class PrefetchIterator:
+    """Double-buffered background prefetch: a daemon thread drains the
+    source ``iterator`` up to ``depth`` items ahead, applying ``put`` (e.g.
     ``jax.device_put``) in the thread, so host batch assembly + H2D transfer
     of item t+1 overlap the consumer's compute on item t. ``depth=2`` is
     classic double buffering; ``put=None`` keeps items host-side (multi-host
@@ -182,43 +179,63 @@ def prefetch_batches(iterator, depth=2, put=None):
     host-side slicing.
 
     Order-preserving and exception-transparent: an error raised by the
-    source (or ``put``) re-raises at the consumer's ``next()``. Abandoning
-    the generator (consumer exception / early ``close``) cancels the thread
-    promptly instead of leaking it blocked on a full queue.
+    source (or ``put``) re-raises at the consumer's ``next()``.
+
+    An iterator object rather than a generator so teardown is an explicit,
+    callable contract: :meth:`close` unblocks a producer waiting on a full
+    queue, joins the thread (bounded), and retires the heartbeat — exactly
+    what a consumer abandoning the stream mid-epoch needs (serve session
+    teardown does this on every disconnect). ``close`` is idempotent and
+    also runs via ``with`` (context manager), at normal end-of-stream, and
+    as a ``__del__`` backstop, so a for-loop consumer that just drains the
+    stream needs no code change from the old generator form.
 
     Liveness: the worker stamps the ``"prefetch"`` heartbeat per produced
     item AND while waiting on a full queue (a blocked-on-slow-consumer
     worker is healthy; a worker wedged in the source or in ``put`` stops
     stamping and the watchdog escalates). The heartbeat retires when the
-    stream ends, so inter-epoch idle never reads as a hang.
+    stream ends or closes, so inter-epoch idle never reads as a hang.
     """
-    if depth < 1:
-        yield from iterator
-        return
-    q = queue.Queue(maxsize=depth)
-    cancel = threading.Event()
-    END, ERR = object(), object()
 
-    def put_blocking(item):
+    _END, _ERR = object(), object()
+
+    def __init__(self, iterator, depth=2, put=None):
+        self._closed = False
+        if depth < 1:
+            # passthrough mode: no thread, no queue — next() defers to the
+            # source directly and close() has nothing to join
+            self._source = iter(iterator)
+            self._thread = None
+            return
+        self._source = None
+        self._q = queue.Queue(maxsize=depth)
+        self._cancel = threading.Event()
+        self._put = put
+        self._iterator = iterator
+        self._thread = threading.Thread(
+            target=self._worker, name="batch-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put_blocking(self, item):
         """Enqueue, waiting out a full queue unless cancelled. EVERY
         enqueue — items, END, and ERR alike — must use this: dropping the
         END/ERR sentinel when the queue happens to be full would leave the
         consumer blocked on q.get() forever with the real error lost."""
-        while not cancel.is_set():
+        while not self._cancel.is_set():
             # a full queue means the CONSUMER is slow (e.g. compiling), not
             # that this thread is hung — keep the heartbeat alive
             _watchdog.stamp("prefetch")
             try:
-                q.put(item, timeout=0.1)
+                self._q.put(item, timeout=0.1)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def worker():
+    def _worker(self):
         try:
-            for item in iterator:
-                if cancel.is_set():
+            for item in self._iterator:
+                if self._cancel.is_set():
                     return
                 _watchdog.stamp("prefetch")
                 _faultinject.hang_point("prefetch")
@@ -228,54 +245,96 @@ def prefetch_batches(iterator, depth=2, put=None):
                 # Enqueue-waiting on a full queue is deliberately outside
                 # the span (a blocked-on-slow-consumer worker is healthy)
                 with _obs.span("prefetch.fill", component="prefetch"):
-                    if put is not None:
-                        item = tuple(None if x is None else put(x)
+                    if self._put is not None:
+                        item = tuple(None if x is None else self._put(x)
                                      for x in item)
                 _obs.counters.add("prefetch_items", 1)
-                if not put_blocking(item):
+                if not self._put_blocking(item):
                     return
-            put_blocking(END)
+            self._put_blocking(self._END)
         except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-            put_blocking((ERR, e))
+            self._put_blocking((self._ERR, e))
         finally:
             # a cancelled worker retires its own heartbeat: its stamps
             # happen-before this (same thread), so an abandoning consumer
             # can never be overtaken by a late stamp re-registering the
             # beat after the consumer retired it (false-hang orphan)
-            if cancel.is_set():
+            if self._cancel.is_set():
                 _watchdog.retire("prefetch")
 
-    t = threading.Thread(target=worker, name="batch-prefetch", daemon=True)
-    t.start()
-    try:
-        while True:
-            # consumer-side stall accounting: time blocked on an empty
-            # queue IS the pipeline's un-overlapped fill cost. Counted into
-            # obs.counters (the grid folds it into dispatch_stats.
-            # prefetch_stall_ms); stalls > 1 ms also land in the prefetch
-            # flight ring
-            t_get0 = time.perf_counter()
-            item = q.get()
-            wait_ms = (time.perf_counter() - t_get0) * 1e3
-            _obs.counters.add("prefetch_stall_ms", wait_ms)
-            if wait_ms > 1.0:
-                _obs.record_span("prefetch.stall", wait_ms,
-                                 component="prefetch")
-            if item is END:
-                return
-            if isinstance(item, tuple) and len(item) == 2 and item[0] is ERR:
-                raise item[1]
-            yield item
-    finally:
-        cancel.set()
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._thread is None:
+            if self._closed:
+                raise StopIteration
+            return next(self._source)
+        if self._closed:
+            raise StopIteration
+        # consumer-side stall accounting: time blocked on an empty
+        # queue IS the pipeline's un-overlapped fill cost. Counted into
+        # obs.counters (the grid folds it into dispatch_stats.
+        # prefetch_stall_ms); stalls > 1 ms also land in the prefetch
+        # flight ring
+        t_get0 = time.perf_counter()
+        item = self._q.get()
+        wait_ms = (time.perf_counter() - t_get0) * 1e3
+        _obs.counters.add("prefetch_stall_ms", wait_ms)
+        if wait_ms > 1.0:
+            _obs.record_span("prefetch.stall", wait_ms,
+                             component="prefetch")
+        if item is self._END:
+            self.close()
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 \
+                and item[0] is self._ERR:
+            self.close()
+            raise item[1]
+        return item
+
+    def close(self):
+        """Unblock and join the producer thread, retire the heartbeat.
+        Idempotent; safe mid-stream (the abandonment path) and after
+        end-of-stream alike. Buffered-but-undelivered items are dropped."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is None:
+            return
+        self._cancel.set()
         # unblock a producer waiting on a full queue, then let it exit
         try:
             while True:
-                q.get_nowait()
+                self._q.get_nowait()
         except queue.Empty:
             pass
         # bounded join, then retire: covers the normal end-of-stream case
         # (worker already gone, never saw the cancel) while the worker's
         # own cancelled-path retire above closes the abandonment race
-        t.join(timeout=5.0)
+        self._thread.join(timeout=5.0)
         _watchdog.retire("prefetch")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        # backstop only — explicit close() (or exhaustion) is the contract;
+        # GC timing must not be load-bearing for thread teardown
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+
+def prefetch_batches(iterator, depth=2, put=None):
+    """Construct a :class:`PrefetchIterator` over ``iterator`` — see its
+    docstring for the full contract. Kept as the call-site spelling (every
+    engine loop reads ``for batch in prefetch_batches(...)``); consumers
+    that may abandon the stream early should hold the returned object and
+    call ``close()`` (or use it as a context manager)."""
+    return PrefetchIterator(iterator, depth=depth, put=put)

@@ -77,6 +77,11 @@ import time
 import uuid
 
 from redcliff_tpu.fleet import history as _history
+# shared admission taxonomy (ISSUE 17): BackpressureReject moved to
+# runtime/admission.py so the serve plane raises the same family; this
+# re-export keeps every existing `from fleet.queue import BackpressureReject`
+# call site and except-clause working unchanged
+from redcliff_tpu.runtime.admission import BackpressureReject
 
 __all__ = ["FleetQueue", "Lease", "LeaseLost", "BackpressureReject",
            "SPOOL_NAME", "TERMINAL_STATES"]
@@ -103,27 +108,6 @@ _MAX_HISTORY = 20
 class LeaseLost(RuntimeError):
     """The lease file no longer belongs to this claimant (it expired and
     another worker reclaimed the request)."""
-
-
-class BackpressureReject(RuntimeError):
-    """``submit`` refused admission: the predicted queue wait would breach
-    the tenant's queue-wait SLO (``REDCLIFF_SLO_QUEUE_P99_S``). The
-    structured reject-with-ETA: ``eta_s`` is the predicted wait, so the
-    caller can resubmit after roughly that long (or with
-    ``REDCLIFF_BACKPRESSURE=0``). Rejection beats silent lateness."""
-
-    def __init__(self, tenant, eta_s, threshold_s, queue_depth, workers):
-        self.tenant = str(tenant)
-        self.eta_s = float(eta_s)
-        self.threshold_s = float(threshold_s)
-        self.queue_depth = int(queue_depth)
-        self.workers = int(workers)
-        super().__init__(
-            f"backpressure: predicted queue wait {self.eta_s:.1f}s exceeds "
-            f"SLO {self.threshold_s:g}s for tenant {self.tenant!r} "
-            f"(queue depth {self.queue_depth}, {self.workers} worker(s)); "
-            f"retry in ~{self.eta_s:.0f}s or set "
-            f"REDCLIFF_BACKPRESSURE=0")
 
 
 def _read_json(path):

@@ -205,6 +205,19 @@ FAMILIES = [
     Family("quality.overhead_pct", path="quality.overhead_pct",
            better="lower", band=_BAND_TIMING, abs_floor=2.0,
            g_dependent=False, contract_max=2.0),
+    # streaming inference service (ISSUE 17, redcliff_tpu/serve): the
+    # saturated-slot-table per-sample dispatch p99 (ingest->answer wall
+    # clock; the abs_floor forgives sub-5ms scheduler dust), sustained
+    # samples/s at full stream occupancy, and the churn-isolation pin —
+    # isolation_ok is 1.0 iff co-resident lanes are byte-identical with
+    # vs without a chaos storm; contract_min pins it as an acceptance
+    # bound even on trajectories whose priors were already green
+    Family("serve.p99_ms", path="serve.p99_ms", better="lower",
+           band=_BAND_TIMING, abs_floor=5.0, g_dependent=False),
+    Family("serve.samples_per_s", path="serve.samples_per_s",
+           band=_BAND_TIMING, g_dependent=False),
+    Family("serve.isolation_ok", path="serve.isolation_ok",
+           band=_BAND_TIMING, g_dependent=False, contract_min=1.0),
 ]
 
 

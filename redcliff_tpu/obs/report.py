@@ -661,6 +661,31 @@ def build_report(run_dir):
                 },
             }
 
+    # streaming-inference section (ISSUE 17): the serve plane's cumulative
+    # counters + latency SLO view (obs/slo.py compute_serve_slo over the
+    # run's `serve` events, REDCLIFF_SLO_SERVE_* breach flags) and the
+    # session-lifecycle tallies. None on run dirs that never served.
+    serve_section = None
+    serve_events = [r for r in records if r.get("event") == "serve"]
+    if serve_events:
+        from redcliff_tpu.obs import slo as _slo_mod
+
+        session_kinds = {}
+        qos_demotes_serve = 0
+        for r in records:
+            if r.get("event") == "session":
+                k = str(r.get("kind"))
+                session_kinds[k] = session_kinds.get(k, 0) + 1
+            elif r.get("event") == "serve" and r.get("kind") == "qos" \
+                    and (r.get("rung") or 0) > (r.get("from_rung") or 0):
+                qos_demotes_serve += 1
+        serve_section = {
+            "slo": _slo_mod.compute_serve_slo(records),
+            "sessions": {k: session_kinds[k]
+                         for k in sorted(session_kinds)},
+            "qos_demotes": qos_demotes_serve,
+        }
+
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
 
@@ -709,6 +734,7 @@ def build_report(run_dir):
         "fleet_containment": containment,
         "fleet_slo": fleet_slo,
         "fleet_autoscale": fleet_autoscale,
+        "serve": serve_section,
         "quality": quality_section,
         "memory": memory_section,
         "numerics": {"anomaly_events": anomalies,
@@ -918,6 +944,35 @@ def render_text(report):
                        + (f", last [{last.get('tenant')}] eta "
                           f"{last.get('eta_s')}s vs slo "
                           f"{last.get('threshold_s')}s" if last else ""))
+    sv = r.get("serve")
+    if sv:
+        out.append("serve (streaming inference service, "
+                   "redcliff_tpu/serve; docs/ARCHITECTURE.md 'Streaming "
+                   "inference service'):")
+        ss = sv.get("slo") or {}
+        lat = ss.get("latency") or {}
+
+        def _ms(v):
+            return f"{v:.2f}ms" if isinstance(v, (int, float)) else "-"
+
+        out.append(
+            f"  {ss.get('samples_out') or 0}/{ss.get('samples_in') or 0} "
+            f"samples answered over {ss.get('streams') or 0} stream(s); "
+            f"lat p50/p99 {_ms(lat.get('p50_ms'))}/{_ms(lat.get('p99_ms'))}"
+            f" (n={lat.get('n') or 0})"
+            + (f"; {ss['rejects']} admission reject(s)"
+               if ss.get("rejects") else "")
+            + (f"; {ss['dropped']} slow-consumer drop(s)"
+               if ss.get("dropped") else ""))
+        if sv.get("sessions"):
+            out.append("  sessions: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(sv["sessions"].items())))
+        if sv.get("qos_demotes"):
+            out.append(f"  qos: {sv['qos_demotes']} cadence demotion(s)")
+        for br in ss.get("breaches") or []:
+            out.append(f"  SLO BREACH [{br['scope']}] {br['slo']}: "
+                       f"{br['value']:.3f} vs threshold "
+                       f"{br['threshold']:.3f}")
     qf = (r.get("quality") or {}).get("fits") or []
     if qf:
         out.append("model quality (live Granger-graph readouts, "

@@ -78,6 +78,7 @@ SPAN_NAMES = frozenset({
     "ckpt.write", "ckpt.async_write", "ckpt.submit_barrier",
     "prefetch.fill", "prefetch.stall", "shard.load",
     "fleet.plan", "fleet.batch",
+    "serve.dispatch",
 })
 
 # identity fields the MetricLogger stamps on every record (schema v1);
@@ -301,7 +302,8 @@ EVENTS = {
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
-                  "memory", "fleet", "quality", "policy", "preempt")),
+                  "memory", "fleet", "quality", "policy", "preempt",
+                  "serve")),
     "fleet": _ev(
         "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
         "worker loop, run_batch driver, containment layer; kind=submit | "
@@ -362,6 +364,28 @@ EVENTS = {
         required=("kind", "tenant"),
         optional=("eta_s", "threshold_s", "queue_depth", "workers",
                   "n_points", "priority", "reason")),
+    "serve": _ev(
+        "streaming inference service (redcliff_tpu/serve/service.py — the "
+        "slot-table serving loop's operational stream; kind=start | resume "
+        "| tick | qos | reject | overflow | drain | stop. qos is the "
+        "per-STREAM degraded graph-readout cadence ladder — the serve twin "
+        "of the fleet's per-tenant qos event; reject is the SlotsExhausted "
+        "admission refusal with lease-expiry ETA)",
+        required=("kind",),
+        optional=("capacity", "streams", "free_slots", "ticks",
+                  "samples_in", "samples_out", "rejects", "dropped",
+                  "p50_ms", "p99_ms", "n", "eta_s", "reason", "sid",
+                  "trace_id", "rung", "from_rung", "cadence", "backlog",
+                  "checkpoint", "resumed", "undelivered", "model_class")),
+    "session": _ev(
+        "serve session lifecycle (redcliff_tpu/serve/service.py over "
+        "serve/session.py's lease/heartbeat registry; kind=connect | "
+        "disconnect | expire | quarantine | recycle | resume — expire is "
+        "the lease reaper, quarantine the per-stream input-contract "
+        "verdict, recycle the lane reset that returns a slot to the pool)",
+        required=("kind", "sid"),
+        optional=("slot", "trace_id", "reason", "samples_in", "samples_out",
+                  "lease_s", "state", "undelivered")),
     "regression": _ev(
         "obs.regress (bench-artifact sentinel block, not a jsonl line)",
         required=("regressions",),
@@ -465,13 +489,25 @@ NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "obs/slo.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
                   "fleet/chaos.py", "fleet/__main__.py",
-                  "fleet/history.py", "fleet/autoscale.py")
+                  "fleet/history.py", "fleet/autoscale.py",
+                  # serve control plane (ISSUE 17): admission, session
+                  # supervision, and the chaos harness drive a service
+                  # object without ever touching the backend themselves
+                  "runtime/admission.py", "serve/session.py",
+                  "serve/chaos.py")
 # ops/autotune.py joins the lazy set (ISSUE 14): its store half must stay
 # importable by backend-free processes, and its measurement half must sync
 # via jax.device_get — a block_until_ready inside the tuner would be a
 # banned device sync on what is effectively an observability path
 LAZY_JAX_MODULES = ("obs/memory.py", "obs/profiling.py", "obs/quality.py",
-                    "ops/autotune.py")
+                    "ops/autotune.py",
+                    # serve data plane (ISSUE 17): jax only once an engine
+                    # actually spins up — tests construct/inspect services
+                    # and the session layer without a backend, and a
+                    # device sync inside the serving loop outside the
+                    # engine's own output read would serialize the
+                    # double-buffered dispatch
+                    "serve/engine.py", "serve/service.py")
 
 
 def _pkg_root():

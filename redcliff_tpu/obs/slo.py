@@ -60,6 +60,16 @@ Thresholds (each unset by default = no breach checking for that SLO)::
     REDCLIFF_SLO_DEADLINE_PCT     min acceptable deadline hit-rate, percent
     REDCLIFF_SLO_DEADLETTER_PCT   max acceptable dead-letter rate, percent
 
+**Serve SLOs (ISSUE 17).** The streaming inference service has its own
+latency objective: per-sample ingest->answer latency, judged on the same
+nearest-rank percentiles from the cumulative reservoir the service's
+``serve`` kind=tick/drain events carry (``p50_ms``/``p99_ms``/``n``).
+:func:`compute_serve_slo` folds a run dir's serve events into one block and
+flags breaches of::
+
+    REDCLIFF_SLO_SERVE_P50_MS     max acceptable per-sample p50, milliseconds
+    REDCLIFF_SLO_SERVE_P99_MS     max acceptable per-sample p99, milliseconds
+
 stdlib only, no jax (obs/schema.py ``--check`` enforces it): SLO math runs
 in observer processes that must never initialize a backend.
 """
@@ -70,12 +80,16 @@ import os
 
 __all__ = ["percentile", "compute_slo", "slo_for_root",
            "thresholds_from_env", "ENV_QUEUE_P99_S", "ENV_TTFA_P99_S",
-           "ENV_DEADLINE_PCT", "ENV_DEADLETTER_PCT"]
+           "ENV_DEADLINE_PCT", "ENV_DEADLETTER_PCT",
+           "compute_serve_slo", "serve_thresholds_from_env",
+           "ENV_SERVE_P50_MS", "ENV_SERVE_P99_MS"]
 
 ENV_QUEUE_P99_S = "REDCLIFF_SLO_QUEUE_P99_S"
 ENV_TTFA_P99_S = "REDCLIFF_SLO_TTFA_P99_S"
 ENV_DEADLINE_PCT = "REDCLIFF_SLO_DEADLINE_PCT"
 ENV_DEADLETTER_PCT = "REDCLIFF_SLO_DEADLETTER_PCT"
+ENV_SERVE_P50_MS = "REDCLIFF_SLO_SERVE_P50_MS"
+ENV_SERVE_P99_MS = "REDCLIFF_SLO_SERVE_P99_MS"
 
 # the queue's converging-settle priority (fleet/queue.py TERMINAL_STATES):
 # when racing writers recorded two settles, this is the one that survived
@@ -311,6 +325,60 @@ def compute_slo(records, thresholds=None, window_s=None):
         "breaches": breaches,
         "window": window,
     }
+
+
+def serve_thresholds_from_env():
+    """Serve latency thresholds from ``REDCLIFF_SLO_SERVE_*`` (None = that
+    SLO is not checked)."""
+    return {
+        "serve_p50_ms": _env_float(ENV_SERVE_P50_MS),
+        "serve_p99_ms": _env_float(ENV_SERVE_P99_MS),
+    }
+
+
+def compute_serve_slo(records, thresholds=None):
+    """Fold a metrics chain's ``serve`` events into the serve SLO block.
+
+    The service emits CUMULATIVE latency percentiles (nearest-rank over its
+    bounded reservoir) on every kind=tick/drain record, so the newest such
+    record IS the run's current view — no re-derivation, byte-agreement
+    with what the service itself computed. Returns ``{"latency":
+    {"p50_ms", "p99_ms", "n"}, "streams", "rejects", "dropped",
+    "samples_in", "samples_out", "thresholds", "breaches"}``, or None when
+    the records carry no serve events at all.
+    """
+    thr = dict(serve_thresholds_from_env(), **(thresholds or {}))
+    last_lat = None
+    # counters are cumulative but scattered across kinds (drain carries no
+    # rejects, stop no streams): keep the newest non-None value per field
+    counts = {k: None for k in ("streams", "rejects", "dropped",
+                                "samples_in", "samples_out")}
+    seen = False
+    for rec in records:
+        if rec.get("event") != "serve":
+            continue
+        seen = True
+        for k in counts:
+            if rec.get(k) is not None:
+                counts[k] = rec[k]
+        if rec.get("n") and rec.get("p99_ms") is not None:
+            last_lat = rec
+    if not seen:
+        return None
+    latency = None
+    if last_lat is not None:
+        latency = {"p50_ms": last_lat.get("p50_ms"),
+                   "p99_ms": last_lat.get("p99_ms"),
+                   "n": last_lat.get("n")}
+    breaches = []
+    if latency is not None:
+        for slo, key in (("serve_p50_ms", "p50_ms"),
+                         ("serve_p99_ms", "p99_ms")):
+            value, limit = latency.get(key), thr.get(slo)
+            if value is not None and limit is not None and value > limit:
+                breaches.append({"scope": "serve", "slo": slo,
+                                 "value": value, "threshold": limit})
+    return dict(counts, latency=latency, thresholds=thr, breaches=breaches)
 
 
 def slo_for_root(root, thresholds=None, stats=None, window_s=None):

@@ -209,6 +209,9 @@ def build_snapshot(run_dir, now=None):
     last_quality = None      # newest quality event (obs/quality.py)
     last_policy = None       # newest predictive-policy decision (ISSUE 15)
     last_preempt = None      # newest deadline-aware preemption event
+    last_serve = None        # newest serve-plane event (ISSUE 17)
+    serve_counts = {}        # newest non-None value per serve counter
+    serve_quarantines = 0    # session quarantine verdicts seen
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
     last_wall = last_epoch_wall = None
@@ -262,6 +265,18 @@ def build_snapshot(run_dir, now=None):
             last_policy = rec
         elif ev == "preempt":
             last_preempt = rec
+        elif ev == "serve":
+            # serving-plane headline (ISSUE 17): counters are cumulative
+            # but scattered across kinds (drain has no capacity, stop no
+            # streams) — fold the newest non-None value per field
+            last_serve = rec
+            for k in ("capacity", "streams", "free_slots", "ticks",
+                      "samples_in", "samples_out", "rejects", "dropped",
+                      "p50_ms", "p99_ms", "n"):
+                if rec.get(k) is not None:
+                    serve_counts[k] = rec[k]
+        elif ev == "session":
+            serve_quarantines += rec.get("kind") == "quarantine"
         elif ev in ("compaction", "remesh") and cur is not None:
             if rec.get("to_width") is not None:
                 cur["grid_width"] = rec["to_width"]
@@ -407,6 +422,17 @@ def build_snapshot(run_dir, now=None):
                     "grace_s")}
         preempt["age_s"] = (round(now - pwt, 3)
                             if isinstance(pwt, (int, float)) else None)
+    # streaming-inference section (ISSUE 17): the serve plane's live
+    # counters + the newest latency view — None (section omitted) on run
+    # dirs that never served
+    serve = None
+    if last_serve is not None:
+        swt = last_serve.get("wall_time")
+        serve = dict(serve_counts)
+        serve["last_kind"] = last_serve.get("kind")
+        serve["quarantines"] = serve_quarantines
+        serve["age_s"] = (round(now - swt, 3)
+                          if isinstance(swt, (int, float)) else None)
     # fleet mode (fleet/queue.py roots): queue depth + per-tenant counts
     # from the authoritative file queue, live in-flight claims from the
     # lease files, and the planner's newest packing decision from the
@@ -435,6 +461,7 @@ def build_snapshot(run_dir, now=None):
         "quality": quality,
         "policy": policy,
         "preempt": preempt,
+        "serve": serve,
         "heartbeats": heartbeats,
         "incidents": incidents,
         "attempts": {"n": len(attempts),
@@ -699,6 +726,24 @@ def render_text(snap):
                        f"{_fmt_age(last.get('eta_s'))} vs slo "
                        f"{_fmt_age(last.get('threshold_s'))}"
                        if last else ""))
+    sv = snap.get("serve")
+    if sv:
+        def _ms(v):
+            return f"{v:.2f}ms" if isinstance(v, (int, float)) else "-"
+
+        out.append(
+            f"  serve [{sv.get('last_kind')}]: "
+            f"{sv.get('streams', 0)} stream(s) / "
+            f"{sv.get('capacity', '?')} slot(s), "
+            f"{sv.get('samples_out', 0)}/{sv.get('samples_in', 0)} "
+            f"answered, lat p50/p99 {_ms(sv.get('p50_ms'))}/"
+            f"{_ms(sv.get('p99_ms'))}"
+            + (f", {sv['rejects']} reject(s)" if sv.get("rejects") else "")
+            + (f", {sv['dropped']} dropped" if sv.get("dropped") else "")
+            + (f", {sv['quarantines']} quarantine(s)"
+               if sv.get("quarantines") else "")
+            + (f" ({_fmt_age(sv['age_s'])} old)"
+               if sv.get("age_s") is not None else ""))
     hb = snap["heartbeats"]
     out.append(f"  ages: metrics file {_fmt_age(hb['metrics_file_age_s'])} |"
                f" last record {_fmt_age(hb['last_record_age_s'])} | last "

@@ -8,6 +8,10 @@ writes (ADVICE.md). Large-system practice (TensorFlow, arXiv:1605.08695)
 treats checkpoint durability and worker failure as first-class design inputs;
 this package does the same:
 
+- :mod:`~redcliff_tpu.runtime.admission` — the shared structured
+  admission-reject taxonomy (``AdmissionReject`` / ``BackpressureReject`` /
+  ``SlotsExhausted``) both capacity-bounded planes — the fleet queue and the
+  streaming inference service — raise instead of drifting copies;
 - :mod:`~redcliff_tpu.runtime.checkpoint` — durable checkpoint files: atomic
   tmp+``os.replace`` writes, a trailing ``.prev`` generation, CRC/format
   version header, quarantine of corrupt files to ``*.bad``, and dataset
@@ -38,6 +42,11 @@ None of these modules import jax at module scope: bench.py's parent process
 must stay backend-free (a hung TPU tunnel would wedge it in a C call), so it
 can import the retry primitives safely.
 """
+from redcliff_tpu.runtime.admission import (  # noqa: F401
+    AdmissionReject,
+    BackpressureReject,
+    SlotsExhausted,
+)
 from redcliff_tpu.runtime.checkpoint import (  # noqa: F401
     CheckpointCorruptError,
     CheckpointWriteError,

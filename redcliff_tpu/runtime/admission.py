@@ -1,0 +1,76 @@
+"""Shared structured-admission-reject taxonomy.
+
+Two production planes admit work against finite capacity: the fleet queue
+(``fleet/queue.py submit`` — queue-wait SLO backpressure, ISSUE 16) and the
+streaming inference service (``serve/service.py connect`` — fixed slot-table
+capacity, ISSUE 17). Both refuse admission the same way: a TYPED exception
+carrying a predicted ETA, so the caller can distinguish "come back in ~N
+seconds" from a crash and machine-handle the retry. This module owns the
+taxonomy so the two planes raise the same types instead of drifting copies.
+
+* :class:`AdmissionReject` — the base: every structured refusal carries
+  ``eta_s`` (predicted seconds until admission would likely succeed; the
+  contract is best-effort, never a promise) and ``reason``;
+* :class:`BackpressureReject` — the fleet queue's reject-with-ETA (predicted
+  queue wait would breach the tenant's armed queue-wait SLO). Signature and
+  message are byte-compatible with its original home in fleet/queue.py,
+  which still re-exports it;
+* :class:`SlotsExhausted` — the serve plane's reject: every stream slot is
+  leased; ``eta_s`` is the soonest lease expiry (the earliest moment a slot
+  could recycle if its subscriber goes silent).
+
+stdlib only, no jax (obs/schema.py ``--check`` enforces it): admission
+decisions run in control processes that must never initialize a backend.
+"""
+from __future__ import annotations
+
+__all__ = ["AdmissionReject", "BackpressureReject", "SlotsExhausted"]
+
+
+class AdmissionReject(RuntimeError):
+    """Base of every structured admission refusal: the service is refusing
+    work it predicts it cannot serve acceptably, with an ETA the caller can
+    retry against. ``eta_s`` may be None when no prediction exists."""
+
+    def __init__(self, message, eta_s=None, reason=None):
+        self.eta_s = float(eta_s) if eta_s is not None else None
+        self.reason = reason
+        super().__init__(message)
+
+
+class BackpressureReject(AdmissionReject):
+    """``fleet submit`` refused admission: the predicted queue wait would
+    breach the tenant's queue-wait SLO (``REDCLIFF_SLO_QUEUE_P99_S``). The
+    structured reject-with-ETA: ``eta_s`` is the predicted wait, so the
+    caller can resubmit after roughly that long (or with
+    ``REDCLIFF_BACKPRESSURE=0``). Rejection beats silent lateness."""
+
+    def __init__(self, tenant, eta_s, threshold_s, queue_depth, workers):
+        self.tenant = str(tenant)
+        self.threshold_s = float(threshold_s)
+        self.queue_depth = int(queue_depth)
+        self.workers = int(workers)
+        super().__init__(
+            f"backpressure: predicted queue wait {float(eta_s):.1f}s exceeds "
+            f"SLO {self.threshold_s:g}s for tenant {self.tenant!r} "
+            f"(queue depth {self.queue_depth}, {self.workers} worker(s)); "
+            f"retry in ~{float(eta_s):.0f}s or set "
+            f"REDCLIFF_BACKPRESSURE=0",
+            eta_s=eta_s, reason="predicted queue wait")
+
+
+class SlotsExhausted(AdmissionReject):
+    """``serve connect`` refused admission: every slot in the fixed-capacity
+    stream table is leased to a live session. ``eta_s`` is the soonest
+    lease expiry among live sessions — the earliest moment a slot could be
+    reaped and recycled if its subscriber stops heartbeating — or None when
+    every lease was just renewed."""
+
+    def __init__(self, capacity, eta_s=None):
+        self.capacity = int(capacity)
+        eta = (f"soonest lease expiry in ~{float(eta_s):.1f}s"
+               if eta_s is not None else "no lease near expiry")
+        super().__init__(
+            f"serve admission: all {self.capacity} stream slot(s) leased; "
+            f"{eta} — retry then, or raise REDCLIFF_SERVE_SLOTS",
+            eta_s=eta_s, reason="slots exhausted")

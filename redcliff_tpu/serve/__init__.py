@@ -1,0 +1,39 @@
+"""Streaming inference service: the platform's second production workload.
+
+The paper's point is reading out *dynamic* causal graphs from live
+multivariate signal; this package serves a fitted REDCLIFF-S checkpoint to
+many concurrent subscriber streams per chip — per-sample factor scores plus
+per-state Granger-graph readouts — with robustness designed in at every
+layer (ISSUE 17):
+
+- :mod:`~redcliff_tpu.serve.engine` — the fixed-capacity vmapped **slot
+  table**: each stream owns one lane of cached embedder state (a
+  device-resident ring buffer of its last ``embed_lag`` samples), a new
+  sample advances that state in O(1), and every tick batches all ragged
+  arrivals through ONE dispatch. Lane math is row-independent, so a poison
+  neighbor can never perturb a co-resident stream (bit-identity pinned);
+- :mod:`~redcliff_tpu.serve.session` — the lease/heartbeat session
+  registry: connect/disconnect/quarantine/expire lifecycle, dead
+  subscribers reaped and slots recycled without touching live lanes,
+  admission via the shared :class:`~redcliff_tpu.runtime.admission`
+  taxonomy (``SlotsExhausted`` reject-with-ETA);
+- :mod:`~redcliff_tpu.serve.service` — the serving loop: per-sample input
+  contracts (NaN / shape violations quarantine the offending stream into a
+  structured error state), a per-stream degraded-QoS ladder (graph-readout
+  cadence sheds before any latency SLO breach), SIGTERM drain (in-flight
+  samples answered, sessions checkpointed, a restarted server resumes
+  them), and per-stream ``trace_id`` end to end;
+- :mod:`~redcliff_tpu.serve.chaos` — the seeded chaos harness:
+  connect/disconnect storms, NaN streams, slow-consumer backpressure, and
+  the churn-isolation comparison that pins co-resident outputs bit-identical
+  to an interference-free run.
+
+``python -m redcliff_tpu.serve smoke`` runs the self-contained smoke
+(3 streams incl. a NaN poisoner -> quarantine + siblings answer + drain).
+"""
+from redcliff_tpu.serve.session import (  # noqa: F401
+    Session,
+    SessionRegistry,
+)
+
+__all__ = ["Session", "SessionRegistry"]

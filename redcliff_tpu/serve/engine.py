@@ -1,0 +1,172 @@
+"""Vmapped slot-table inference engine: O(1) cached-state advance per sample.
+
+The serving compute core. A fixed-capacity table of ``capacity`` lanes, each
+owning the cached embedder state of one subscriber stream: a device-resident
+ring buffer of that stream's last ``embed_lag`` samples. Advancing a stream
+by one sample is O(1) state work — one ``(S, C)`` host->device transfer for
+the whole tick's arrivals, one scatter into the ring, one ring-ordered
+gather — instead of re-assembling and re-transferring each stream's full
+sliding window every sample (the naive O(window) host path). All lanes step
+through ONE jit'd dispatch per tick, so a chip serves ``capacity`` streams
+at one dispatch of overhead (the gang-scheduled batching idea;
+ISSUE 17 / PAPERS.md O(1) autoregressive caching).
+
+Isolation is a property of the math, not of scheduling: every per-lane
+computation (ring scatter, ordered gather, embedder matmuls, graph einsum)
+is row-independent along the slot axis, so lane i's outputs are a function
+of lane i's ring alone — a NaN-spewing neighbor, a mid-tick connect, or a
+reaped lane changes NOTHING in co-resident lanes' bytes (the churn-isolation
+pin, tests/test_serve.py). Non-finite samples are detected in-graph and
+NEVER written into ring state: the offending lane latches ``poisoned`` and
+its sample is discarded; co-resident lanes cannot even observe the event.
+
+Graph readouts reuse the jit'd :func:`obs.quality.make_summary_fn` summary:
+for the fixed (non-conditional) readout modes the per-factor GC matrices are
+params-only, so they are computed ONCE at load and each sample's per-state
+graph is just ``einsum('sk,kij->sij', weightings, static_gc)`` — per-lane
+independent by construction.
+
+jax imports are lazy (obs/schema.py LAZY_JAX_MODULES): the session/admission
+control plane imports this package's siblings without a backend.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StreamEngine"]
+
+
+class StreamEngine:
+    """Fixed-capacity slot table over a fitted REDCLIFF-family model.
+
+    ``step`` is the only hot path: one call per tick, all slots at once.
+    State lives on device between ticks; ``export_state``/``import_state``
+    round-trip it through numpy for the drain checkpoint.
+    """
+
+    def __init__(self, model, params, capacity):
+        import jax
+        import jax.numpy as jnp
+
+        from redcliff_tpu.obs import quality as _quality
+
+        cfg = model.config
+        self.model = model
+        self.capacity = int(capacity)
+        self.num_chans = int(cfg.num_chans)
+        self.num_factors = int(cfg.num_factors)
+        self.window_len = int(cfg.embed_lag)
+        self._jnp = jnp
+        self.params = params
+
+        # static per-factor GC graphs: params-only for the fixed readout
+        # modes quality.readout_mode forces, so ONE offline summary call at
+        # load covers every future sample; the probe window only feeds the
+        # entropy field, which we discard
+        probe = jnp.zeros((1, int(cfg.max_lag), self.num_chans),
+                          dtype=jnp.float32)
+        summ = _quality.make_summary_fn(model)(params, probe)
+        self.static_gc = jnp.asarray(summ["gc"], dtype=jnp.float32)
+
+        S, L, C = self.capacity, self.window_len, self.num_chans
+        self.state = {
+            "window": jnp.zeros((S, L, C), dtype=jnp.float32),
+            "pos": jnp.zeros((S,), dtype=jnp.int32),
+            "filled": jnp.zeros((S,), dtype=jnp.int32),
+            "poisoned": jnp.zeros((S,), dtype=bool),
+        }
+
+        static_gc = self.static_gc
+
+        def _step(params, state, samples, arrive):
+            window, pos = state["window"], state["pos"]
+            filled, poisoned = state["filled"], state["poisoned"]
+            lanes = jnp.arange(S)
+
+            finite = jnp.all(jnp.isfinite(samples), axis=-1)
+            poison_hit = arrive & ~finite & ~poisoned
+            accept = arrive & finite & ~poisoned
+            poisoned_n = poisoned | poison_hit
+
+            # ring scatter: ONLY accepted lanes write — a poison sample
+            # never touches device state, so quarantine+recycle is the only
+            # cleanup a poisoned lane ever needs
+            cur = window[lanes, pos]
+            window_n = window.at[lanes, pos].set(
+                jnp.where(accept[:, None], samples, cur))
+            pos_n = jnp.where(accept, (pos + 1) % L, pos)
+            filled_n = jnp.where(accept, jnp.minimum(filled + 1, L), filled)
+            ready = accept & (filled_n >= L)
+
+            # ring-ordered gather (oldest -> newest): after writing at pos
+            # and advancing, the oldest live sample sits at the new pos
+            order = (pos_n[:, None] + jnp.arange(L)[None, :]) % L
+            win = jnp.take_along_axis(window_n, order[:, :, None], axis=1)
+
+            weightings, _ = model._embed(params, win)        # (S, K)
+            scores = jnp.where(ready[:, None], weightings, 0.0)
+            graph = jnp.where(ready[:, None, None],
+                              jnp.einsum("sk,kij->sij", scores, static_gc),
+                              0.0)
+
+            new_state = {"window": window_n, "pos": pos_n,
+                         "filled": filled_n, "poisoned": poisoned_n}
+            out = {"scores": scores.astype(jnp.float32),
+                   "graph": graph.astype(jnp.float32),
+                   "ready": ready, "poison_hit": poison_hit,
+                   "poisoned": poisoned_n}
+            return new_state, out
+
+        self._step = jax.jit(_step)
+
+    def step(self, samples, arrive):
+        """Advance every arriving lane one sample; one dispatch.
+
+        ``samples``: ``(S, C)`` float32 (rows of non-arriving lanes are
+        ignored); ``arrive``: ``(S,)`` bool. Returns a dict of HOST numpy
+        arrays: ``scores (S, K)``, ``graph (S, C, C)``, ``ready (S,)``
+        (lane produced an output this tick: sample accepted AND ring full),
+        ``poison_hit (S,)`` (lane newly poisoned by a non-finite sample this
+        tick), ``poisoned (S,)`` (latched state).
+        """
+        jnp = self._jnp
+        samples = jnp.asarray(np.asarray(samples, dtype=np.float32))
+        arrive = jnp.asarray(np.asarray(arrive, dtype=bool))
+        self.state, out = self._step(self.params, self.state, samples,
+                                     arrive)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def reset_slot(self, slot):
+        """Zero one lane's ring + flags (slot recycle / quarantine release).
+        A single-lane ``.at[slot].set`` — co-resident lanes' state bytes are
+        untouched by construction."""
+        jnp = self._jnp
+        s = int(slot)
+        self.state = {
+            "window": self.state["window"].at[s].set(0.0),
+            "pos": self.state["pos"].at[s].set(0),
+            "filled": self.state["filled"].at[s].set(0),
+            "poisoned": self.state["poisoned"].at[s].set(False),
+        }
+
+    def export_state(self):
+        """Slot-table state as plain numpy (drain checkpoint payload)."""
+        return {k: np.asarray(v) for k, v in self.state.items()}
+
+    def import_state(self, snap):
+        """Restore slot-table state from :meth:`export_state` output.
+        Shape-checked: a checkpoint from a different capacity/model geometry
+        is refused rather than silently misapplied."""
+        jnp = self._jnp
+        want = {k: tuple(v.shape) for k, v in self.state.items()}
+        got = {k: tuple(np.asarray(snap[k]).shape) for k in want}
+        if want != got:
+            raise ValueError(
+                f"serve state geometry mismatch: checkpoint {got} vs "
+                f"engine {want} (capacity/model changed across restart?)")
+        self.state = {
+            "window": jnp.asarray(snap["window"], dtype=jnp.float32),
+            "pos": jnp.asarray(snap["pos"], dtype=jnp.int32),
+            "filled": jnp.asarray(snap["filled"], dtype=jnp.int32),
+            "poisoned": jnp.asarray(snap["poisoned"], dtype=bool),
+        }

@@ -1,0 +1,562 @@
+"""Streaming inference service: supervision + dispatch over the slot table.
+
+The serving loop that ties the layers together: the
+:class:`~redcliff_tpu.serve.engine.StreamEngine` slot table (device math),
+the :class:`~redcliff_tpu.serve.session.SessionRegistry` (lease/heartbeat
+supervision), the shared admission taxonomy (``SlotsExhausted``
+reject-with-ETA), and the telemetry spine (schema-registered ``serve`` /
+``session`` events, ``serve.dispatch`` spans, per-stream ``trace_id``).
+
+**Tick discipline.** ``pump()`` is one tick: reap lapsed leases (recycled
+lanes reset one-by-one, co-residents untouched), assemble at most one
+pending sample per ACTIVE stream into the ``(S, C)`` arrival batch, ONE
+engine dispatch, distribute outputs. ``run_loop`` rides the same tick
+through :func:`data.pipeline.prefetch_batches` (depth=2), so host assembly
+of tick t+1 overlaps device compute of tick t — the same double-buffered
+discipline the training engines use.
+
+**Input contracts (per stream, never per table).** A shape-violating sample
+quarantines its stream HOST-side (it never reaches the device); a
+non-finite sample is detected in-graph and quarantines the stream with its
+lane's ring untouched (the poison sample is discarded, the ``poisoned``
+flag latches). Either way the stream degrades to a structured error state —
+its subscriber polls the verdict — while co-resident lanes' outputs stay
+bit-identical to a run where the poisoner never existed (pinned,
+tests/test_serve.py).
+
+**Overload ladder.** Admission rejects with ETA when slots are exhausted
+(``SlotsExhausted``); a stream whose backlog climbs sheds graph-readout
+cadence through :data:`QOS_CADENCE` rungs (factor scores keep flowing at
+full rate — the cheap output — while the ``C x C`` graph emission thins)
+BEFORE any latency SLO breach; per-sample ingest past the backlog cap gets
+a structured non-accept; a slow consumer's out-queue drops ITS oldest
+results past :data:`ENV_OUT_CAP` (counted) instead of growing without
+bound or stalling siblings. Demotion is per-stream: one greedy subscriber
+degrades alone.
+
+**Drain.** ``drain()`` (or SIGTERM via :meth:`ServeService.
+install_signal_handlers`) answers every in-flight sample, converts nothing
+to loss, checkpoints sessions + slot-table rings + undelivered outputs
+through runtime/checkpoint.py (atomic, CRC, ``.prev``), and a restarted
+server resumes every session — same ``trace_id``, same ring state, same
+undelivered outputs — with a fresh lease so subscribers can re-attach.
+
+jax stays out of module scope (LAZY_JAX_MODULES): constructing/driving a
+service in tests pulls jax only when the engine spins up.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from redcliff_tpu import obs as _obs
+from redcliff_tpu.obs import slo as _slo
+from redcliff_tpu.obs.logging import MetricLogger
+from redcliff_tpu.runtime.admission import SlotsExhausted  # noqa: F401 (re-export)
+from redcliff_tpu.runtime.checkpoint import (
+    load_checkpoint,
+    write_checkpoint,
+)
+from redcliff_tpu.serve import session as _session
+
+__all__ = ["ServeService", "SlotsExhausted", "ENV_SLOTS", "DEFAULT_SLOTS",
+           "ENV_INGEST_CAP", "ENV_OUT_CAP", "QOS_CADENCE", "STATE_BASENAME"]
+
+ENV_SLOTS = "REDCLIFF_SERVE_SLOTS"
+DEFAULT_SLOTS = 8
+ENV_INGEST_CAP = "REDCLIFF_SERVE_INGEST_CAP"
+DEFAULT_INGEST_CAP = 64
+ENV_OUT_CAP = "REDCLIFF_SERVE_OUT_CAP"
+DEFAULT_OUT_CAP = 256
+
+# degraded-QoS ladder: graph-readout cadence per rung (emit the (C, C)
+# combined graph on every Nth answered sample). Factor scores always flow
+# at rung cadence 1 — they are the cheap per-sample product; the graph is
+# the payload that thins under load. Mirrors the fleet ladder's
+# demote-before-deadline philosophy (fleet/autoscale.py).
+QOS_CADENCE = (1, 4, 16)
+# backlog hysteresis (fractions of the ingest cap): demote above, restore
+# below — the gap prevents rung flapping at a steady backlog
+_QOS_DEMOTE_FRAC = 0.5
+_QOS_RESTORE_FRAC = 0.25
+
+STATE_BASENAME = "serve_state.bin"
+
+# cumulative latency reservoir cap: p50/p99 over the run, bounded memory
+_MAX_LAT_SAMPLES = 100_000
+# tick-event cadence (every Nth pump emits the counters/latency record)
+_TICK_EVERY = 25
+
+
+def _int_env(name, default):
+    try:
+        v = int(os.environ.get(name, default))
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+class ServeService:
+    """One serving process: slot table + sessions + queues + telemetry.
+
+    All public methods accept an explicit ``now`` (tests and the chaos
+    harness drive virtual clocks); wall time is only the default. Public
+    methods are serialized on an internal lock; ``pump``/``run_loop`` must
+    be driven from one thread (the engine owns device state).
+    """
+
+    def __init__(self, model, params, root=None, capacity=None,
+                 lease_s=None, resume=True):
+        from redcliff_tpu.serve.engine import StreamEngine
+
+        self.capacity = int(capacity if capacity is not None
+                            else _int_env(ENV_SLOTS, DEFAULT_SLOTS))
+        self.ingest_cap = _int_env(ENV_INGEST_CAP, DEFAULT_INGEST_CAP)
+        self.out_cap = _int_env(ENV_OUT_CAP, DEFAULT_OUT_CAP)
+        self.root = root
+        self._mu = threading.RLock()
+        self.engine = StreamEngine(model, params, self.capacity)
+        self.registry = _session.SessionRegistry(self.capacity,
+                                                 lease_s=lease_s)
+        self.pending = {}    # sid -> deque[(sample (C,), t_enq)]
+        self.out = {}        # sid -> deque[record]
+        self.drops = {}      # sid -> slow-consumer drops
+        self._answered = {}  # sid -> answered-sample count (cadence basis)
+        self._lat_ms = []
+        self.ticks = 0
+        self.samples_in = 0
+        self.samples_out = 0
+        self.rejects = 0
+        self._draining = False
+        self._stopped = False
+        self._log = MetricLogger(root)
+        resumed = 0
+        if resume and root is not None:
+            resumed = self._try_resume()
+        self._log.log("serve", kind="start", capacity=self.capacity,
+                      streams=len(self.registry.sessions), resumed=resumed,
+                      model_class=type(model).__name__)
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_artifact(cls, path, **kw):
+        """Serve a fitted checkpoint: ``path`` is a run dir or artifact file
+        readable by eval/model_io (runtime/checkpoint.py readers)."""
+        from redcliff_tpu.eval.model_io import load_model_for_eval
+
+        loaded = load_model_for_eval(path)
+        model, params = loaded[0], loaded[1]
+        return cls(model, params, **kw)
+
+    # ------------------------------------------------------------ admission
+    def connect(self, sid=None, now=None):
+        """Admit a new subscriber stream: lease a slot, reset its lane,
+        mint its trace_id. Raises :class:`SlotsExhausted` (with the
+        soonest-lease-expiry ETA) when the table is full."""
+        now = time.time() if now is None else float(now)
+        with self._mu:
+            try:
+                sess = self.registry.connect(sid=sid, now=now)
+            except SlotsExhausted as e:
+                self.rejects += 1
+                self._log.log("serve", kind="reject", eta_s=e.eta_s,
+                              capacity=self.capacity, reason=e.reason)
+                raise
+            self.engine.reset_slot(sess.slot)
+            self.pending[sess.sid] = deque()
+            self.out[sess.sid] = deque()
+            self.drops[sess.sid] = 0
+            self._answered[sess.sid] = 0
+            self._log.log("session", kind="connect", sid=sess.sid,
+                          slot=sess.slot, trace_id=sess.trace_id,
+                          lease_s=self.registry.lease_s)
+            return {"sid": sess.sid, "slot": sess.slot,
+                    "trace_id": sess.trace_id}
+
+    def disconnect(self, sid):
+        """Close a stream and recycle its slot. Unknown sid is a no-op
+        (double-disconnect races are normal under churn)."""
+        with self._mu:
+            sess = self.registry.disconnect(sid)
+            if sess is None:
+                return None
+            self._recycle(sess, kind="disconnect")
+            return sess.state
+
+    def _recycle(self, sess, kind):
+        """Free one lane after a terminal transition: reset exactly that
+        lane, drop its queues, emit the lifecycle + recycle pair."""
+        self.engine.reset_slot(sess.slot)
+        self.pending.pop(sess.sid, None)
+        undelivered = len(self.out.pop(sess.sid, ()) or ())
+        self.drops.pop(sess.sid, None)
+        self._answered.pop(sess.sid, None)
+        self._log.log("session", kind=kind, sid=sess.sid, slot=sess.slot,
+                      trace_id=sess.trace_id, samples_in=sess.samples_in,
+                      samples_out=sess.samples_out, state=sess.state,
+                      undelivered=undelivered)
+        self._log.log("session", kind="recycle", sid=sess.sid,
+                      slot=sess.slot, trace_id=sess.trace_id)
+
+    # ------------------------------------------------------------ ingest/poll
+    def ingest(self, sid, sample, now=None):
+        """Offer one sample to a stream. Returns a structured verdict dict
+        (``accepted`` plus reason/backlog on refusal) — NEVER raises for
+        data problems; a contract violation quarantines the offending
+        stream only."""
+        now = time.time() if now is None else float(now)
+        with self._mu:
+            sess = self.registry.get(sid)
+            if sess is None:
+                return {"accepted": False, "reason": "unknown session"}
+            self.registry.heartbeat(sid, now=now)
+            if sess.state == _session.QUARANTINED:
+                return {"accepted": False, "trace_id": sess.trace_id,
+                        "reason": f"quarantined: {sess.quarantine_reason}"}
+            arr = np.asarray(sample, dtype=np.float32)
+            if arr.shape != (self.engine.num_chans,):
+                self._quarantine(sess, f"shape violation: got "
+                                 f"{tuple(arr.shape)}, want "
+                                 f"({self.engine.num_chans},)", now)
+                return {"accepted": False, "trace_id": sess.trace_id,
+                        "reason": f"quarantined: "
+                                  f"{sess.quarantine_reason}"}
+            q = self.pending[sid]
+            if len(q) >= self.ingest_cap:
+                self._log.log("serve", kind="overflow", sid=sid,
+                              trace_id=sess.trace_id, backlog=len(q))
+                return {"accepted": False, "trace_id": sess.trace_id,
+                        "reason": "backlog full", "backlog": len(q)}
+            sess.samples_in += 1
+            self.samples_in += 1
+            q.append((arr, now))
+            return {"accepted": True, "trace_id": sess.trace_id}
+
+    def poll(self, sid, max_items=None, now=None):
+        """Drain a stream's answered records (oldest first). Counts as a
+        heartbeat. A quarantined stream's poll returns its structured error
+        state as the final record."""
+        now = time.time() if now is None else float(now)
+        with self._mu:
+            sess = self.registry.get(sid)
+            if sess is None:
+                return []
+            self.registry.heartbeat(sid, now=now)
+            q = self.out.get(sid)
+            if q is None:
+                return []
+            n = len(q) if max_items is None else min(len(q), int(max_items))
+            return [q.popleft() for _ in range(n)]
+
+    # ------------------------------------------------------------ quarantine
+    def _quarantine(self, sess, reason, now):
+        """ACTIVE -> QUARANTINED: structured error state replaces output.
+        Pending samples are answered as error records (a drain must not
+        strand them); the lane's device state is never consulted again."""
+        self.registry.quarantine(sess.sid, reason)
+        q = self.pending.get(sess.sid)
+        err = {"sid": sess.sid, "trace_id": sess.trace_id,
+               "error": sess.quarantine_reason}
+        outq = self.out.get(sess.sid)
+        while q:
+            q.popleft()
+            self._push_out(sess, outq, dict(err))
+        self._push_out(sess, outq, dict(err))
+        self._log.log("session", kind="quarantine", sid=sess.sid,
+                      slot=sess.slot, trace_id=sess.trace_id, reason=reason)
+
+    def _push_out(self, sess, outq, record):
+        """Append to a stream's out-queue under the slow-consumer cap:
+        past it, ITS oldest record drops (counted) — containment, not
+        global stall."""
+        if outq is None:
+            return
+        if len(outq) >= self.out_cap:
+            outq.popleft()
+            self.drops[sess.sid] = self.drops.get(sess.sid, 0) + 1
+        outq.append(record)
+
+    # ------------------------------------------------------------ the tick
+    def _assemble(self, now):
+        """Pop at most one pending sample per ACTIVE stream into the
+        ``(S, C)`` tick batch. Returns (samples, arrive, meta); meta maps
+        slot -> (sid, t_enq)."""
+        S, C = self.capacity, self.engine.num_chans
+        samples = np.zeros((S, C), dtype=np.float32)
+        arrive = np.zeros((S,), dtype=bool)
+        meta = {}
+        for sess in self.registry.live():
+            if sess.state != _session.ACTIVE:
+                continue
+            q = self.pending.get(sess.sid)
+            if not q:
+                continue
+            sample, t_enq = q.popleft()
+            samples[sess.slot] = sample
+            arrive[sess.slot] = True
+            meta[sess.slot] = (sess.sid, t_enq)
+        return samples, arrive, meta
+
+    def _distribute(self, out, meta, now):
+        """Turn one dispatch's lane outputs into per-stream records."""
+        for slot, (sid, t_enq) in meta.items():
+            sess = self.registry.get(sid)
+            if sess is None:      # reaped between assemble and distribute
+                continue
+            if out["poison_hit"][slot]:
+                self._quarantine(sess, "non-finite sample", now)
+                continue
+            if not out["ready"][slot]:
+                # warmup: ring not yet full — the sample advanced state
+                # but no readout exists yet
+                continue
+            self._answered[sid] = self._answered.get(sid, 0) + 1
+            cadence = QOS_CADENCE[min(sess.qos_rung, len(QOS_CADENCE) - 1)]
+            lat_ms = max(0.0, (now - t_enq) * 1e3)
+            rec = {"sid": sid, "trace_id": sess.trace_id,
+                   "seq": self._answered[sid],
+                   "scores": np.array(out["scores"][slot], copy=True),
+                   "latency_ms": lat_ms}
+            if (self._answered[sid] - 1) % cadence == 0:
+                rec["graph"] = np.array(out["graph"][slot], copy=True)
+            self._push_out(sess, self.out.get(sid), rec)
+            sess.samples_out += 1
+            self.samples_out += 1
+            if len(self._lat_ms) < _MAX_LAT_SAMPLES:
+                self._lat_ms.append(lat_ms)
+
+    def _update_qos(self, now):
+        """Per-stream backlog ladder with hysteresis; emits only rung
+        changes. One greedy subscriber demotes alone."""
+        demote_at = self.ingest_cap * _QOS_DEMOTE_FRAC
+        restore_at = self.ingest_cap * _QOS_RESTORE_FRAC
+        top = len(QOS_CADENCE) - 1
+        for sess in self.registry.live():
+            if sess.state != _session.ACTIVE:
+                continue
+            backlog = len(self.pending.get(sess.sid, ()))
+            if backlog >= demote_at and sess.qos_rung < top:
+                frm = sess.qos_rung
+                sess.qos_rung += 1
+                self._log.log("serve", kind="qos", sid=sess.sid,
+                              trace_id=sess.trace_id, rung=sess.qos_rung,
+                              from_rung=frm, backlog=backlog,
+                              cadence=QOS_CADENCE[sess.qos_rung],
+                              reason="backlog")
+            elif backlog <= restore_at and sess.qos_rung > 0:
+                frm = sess.qos_rung
+                sess.qos_rung = 0
+                self._log.log("serve", kind="qos", sid=sess.sid,
+                              trace_id=sess.trace_id, rung=0, from_rung=frm,
+                              backlog=backlog, cadence=QOS_CADENCE[0],
+                              reason="recovered")
+
+    def _reap(self, now):
+        for sess in self.registry.reap(now=now):
+            self._recycle(sess, kind="expire")
+
+    def pump(self, now=None):
+        """One synchronous tick. Returns the number of samples answered."""
+        wall = now is None
+        now = time.time() if wall else float(now)
+        with self._mu:
+            self._reap(now)
+            samples, arrive, meta = self._assemble(now)
+        answered = 0
+        if meta:
+            with _obs.span("serve.dispatch", component="serve"):
+                out = self.engine.step(samples, arrive)
+        else:
+            out = None
+        with self._mu:
+            if out is not None:
+                before = self.samples_out
+                # on the real clock, latency must charge the dispatch that
+                # just ran; an injected (virtual) clock stays as given so
+                # replayed runs remain deterministic
+                self._distribute(out, meta, time.time() if wall else now)
+                answered = self.samples_out - before
+            self._update_qos(now)
+            self.ticks += 1
+            if self.ticks % _TICK_EVERY == 0:
+                self._emit_tick()
+        return answered
+
+    def _emit_tick(self):
+        dist = {}
+        if self._lat_ms:
+            dist = {"p50_ms": _slo.percentile(self._lat_ms, 50.0),
+                    "p99_ms": _slo.percentile(self._lat_ms, 99.0)}
+        self._log.log("serve", kind="tick", ticks=self.ticks,
+                      streams=len(self.registry.sessions),
+                      free_slots=self.registry.free_slots(),
+                      samples_in=self.samples_in,
+                      samples_out=self.samples_out,
+                      rejects=self.rejects,
+                      dropped=sum(self.drops.values()),
+                      n=len(self._lat_ms), **dist)
+
+    # ------------------------------------------------------------ the loop
+    def run_loop(self, max_ticks=None, interval_s=0.0, depth=2):
+        """Drive ticks through the double-buffered prefetch pipeline:
+        assembly of tick t+1 (prefetch thread) overlaps the engine dispatch
+        of tick t (this thread). Runs until ``max_ticks`` or a drain
+        request; prefetched-but-unstepped batches are consumed to
+        exhaustion on drain — never dropped — then :meth:`drain` finishes
+        the remaining backlog synchronously."""
+        from redcliff_tpu.data.pipeline import prefetch_batches
+
+        def assembly():
+            n = 0
+            while not self._draining:
+                if max_ticks is not None and n >= max_ticks:
+                    return
+                now = time.time()
+                with self._mu:
+                    self._reap(now)
+                    samples, arrive, meta = self._assemble(now)
+                yield samples, arrive, meta, now
+                n += 1
+                if interval_s:
+                    time.sleep(interval_s)
+
+        src = prefetch_batches(assembly(), depth=depth)
+        # exhaust the stream — on drain the generator stops producing and
+        # the loop below consumes every already-buffered batch (samples
+        # popped from pending must be answered, not lost)
+        for samples, arrive, meta, t_asm in src:
+            now = time.time()
+            if meta:
+                with _obs.span("serve.dispatch", component="serve"):
+                    out = self.engine.step(samples, arrive)
+            else:
+                out = None
+            with self._mu:
+                if out is not None:
+                    self._distribute(out, meta, now)
+                self._update_qos(now)
+                self.ticks += 1
+                if self.ticks % _TICK_EVERY == 0:
+                    self._emit_tick()
+        src.close()
+        if self._draining:
+            self.drain()
+
+    # ------------------------------------------------------------ drain/stop
+    def drain(self, now=None):
+        """Answer every in-flight sample, checkpoint every session, stop.
+        Zero loss: live streams' pending queues pump to empty; undelivered
+        out-queues persist into the drain checkpoint for the restarted
+        server to hand back."""
+        now = time.time() if now is None else float(now)
+        self._draining = True
+        # bounded by total backlog: each pump answers >= 1 sample while any
+        # ACTIVE stream has pending work (warmup samples count as progress
+        # via their state advance)
+        guard = self.capacity * self.ingest_cap + len(self.registry.sessions)
+        while guard >= 0 and any(
+                self.pending.get(s.sid)
+                for s in self.registry.live()
+                if s.state == _session.ACTIVE):
+            self.pump(now=now)
+            guard -= 1
+        path = self._checkpoint()
+        dist = {}
+        if self._lat_ms:
+            dist = {"p50_ms": _slo.percentile(self._lat_ms, 50.0),
+                    "p99_ms": _slo.percentile(self._lat_ms, 99.0),
+                    "n": len(self._lat_ms)}
+        self._log.log("serve", kind="drain", ticks=self.ticks,
+                      streams=len(self.registry.sessions),
+                      samples_in=self.samples_in,
+                      samples_out=self.samples_out,
+                      rejects=self.rejects,
+                      dropped=sum(self.drops.values()),
+                      undelivered=sum(len(q) for q in self.out.values()),
+                      checkpoint=path, **dist)
+        self.stop()
+        return path
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._log.log("serve", kind="stop", ticks=self.ticks,
+                      samples_out=self.samples_out)
+        self._log.close()
+
+    def request_drain(self):
+        """Async-signal-safe drain request: the running loop (or the next
+        explicit ``drain()`` caller) completes it."""
+        self._draining = True
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT -> graceful drain request (the preemption
+        discipline runtime/preempt.py applies to fits, applied to serve)."""
+        def _h(signum, frame):
+            self.request_drain()
+        signal.signal(signal.SIGTERM, _h)
+        signal.signal(signal.SIGINT, _h)
+
+    # ------------------------------------------------------------ durability
+    def _state_path(self):
+        return os.path.join(self.root, STATE_BASENAME) \
+            if self.root is not None else None
+
+    def _checkpoint(self):
+        path = self._state_path()
+        if path is None:
+            return None
+        with self._mu:
+            payload = {
+                "registry": self.registry.snapshot(),
+                "engine": self.engine.export_state(),
+                "out": {sid: list(q) for sid, q in self.out.items()},
+                "answered": dict(self._answered),
+                "drops": dict(self.drops),
+                "counters": {"ticks": self.ticks,
+                             "samples_in": self.samples_in,
+                             "samples_out": self.samples_out,
+                             "rejects": self.rejects},
+            }
+        write_checkpoint(path, payload)
+        return path
+
+    def _try_resume(self):
+        path = self._state_path()
+        if path is None or not (os.path.exists(path)
+                                or os.path.exists(path + ".prev")):
+            return 0
+        payload, _src = load_checkpoint(path)
+        if payload is None:
+            return 0
+        now = time.time()
+        self.registry = _session.SessionRegistry.from_snapshot(
+            payload["registry"], now=now)
+        self.engine.import_state(payload["engine"])
+        self.out = {sid: deque(v) for sid, v in payload["out"].items()}
+        self._answered = dict(payload.get("answered", {}))
+        self.drops = dict(payload.get("drops", {}))
+        c = payload.get("counters", {})
+        self.ticks = int(c.get("ticks", 0))
+        self.samples_in = int(c.get("samples_in", 0))
+        self.samples_out = int(c.get("samples_out", 0))
+        self.rejects = int(c.get("rejects", 0))
+        for sess in self.registry.live():
+            self.pending.setdefault(sess.sid, deque())
+            self.out.setdefault(sess.sid, deque())
+            self.drops.setdefault(sess.sid, 0)
+            self._answered.setdefault(sess.sid, 0)
+            self._log.log("session", kind="resume", sid=sess.sid,
+                          slot=sess.slot, trace_id=sess.trace_id,
+                          state=sess.state,
+                          samples_out=sess.samples_out)
+        self._log.log("serve", kind="resume",
+                      streams=len(self.registry.sessions),
+                      ticks=self.ticks, checkpoint=path)
+        return len(self.registry.sessions)

@@ -1,0 +1,220 @@
+"""Lease/heartbeat session registry: the serve plane's supervision layer.
+
+Every subscriber stream is a **session** owning exactly one slot-table lane
+for its lifetime. The registry is the single authority on that ownership:
+who holds which slot, whose lease is live, who is quarantined, and which
+lanes are free. It holds NO device state — recycling a slot tells the
+caller to reset that one lane (engine.reset_slot), never touching
+co-residents — so supervision bugs cannot corrupt inference state.
+
+Session state machine (one-way except the free-slot cycle)::
+
+    connect -> ACTIVE -(poison sample)-> QUARANTINED -(disconnect)-+
+                  |                           |                    |
+                  |<-- heartbeat renews lease |-(lease expiry)----->  slot
+                  |-(disconnect)-> CLOSED  ---------------------->  freed +
+                  |-(lease expiry)-> EXPIRED -------------------->  recycled
+
+ACTIVE and QUARANTINED sessions both hold a lease: a quarantined session
+keeps its slot (its subscriber polls the structured error state) until it
+disconnects or its lease lapses. Dead subscribers are reaped by lease
+expiry exactly like fleet workers: a subscriber that stops heartbeating
+(ingest and poll both count) is EXPIRED by the next ``reap`` sweep and its
+slot recycled — no human in the loop, no perturbation of live lanes.
+
+Admission raises the shared :class:`~redcliff_tpu.runtime.admission.
+SlotsExhausted` taxonomy when every slot is leased, carrying the soonest
+lease expiry as the retry ETA (the same structured reject-with-ETA contract
+fleet submit uses).
+
+Each session carries a durable ``trace_id`` (ISSUE 12 discipline, same
+format fleet submit mints) — the identity every serve/session event and
+every answered sample carries end to end.
+
+stdlib only, no jax (obs/schema.py ``--check`` enforces it): session
+supervision must run — and be testable — without a backend.
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+from redcliff_tpu.runtime.admission import SlotsExhausted
+
+__all__ = ["Session", "SessionRegistry", "ENV_LEASE_S", "DEFAULT_LEASE_S",
+           "ACTIVE", "QUARANTINED", "CLOSED", "EXPIRED", "STATES"]
+
+ENV_LEASE_S = "REDCLIFF_SERVE_LEASE_S"
+DEFAULT_LEASE_S = 30.0
+
+ACTIVE = "active"
+QUARANTINED = "quarantined"
+CLOSED = "closed"
+EXPIRED = "expired"
+STATES = (ACTIVE, QUARANTINED, CLOSED, EXPIRED)
+
+# lease states still holding a slot; CLOSED/EXPIRED sessions are terminal
+# bookkeeping records whose slots are already back in the free pool
+_LEASED = (ACTIVE, QUARANTINED)
+
+
+def lease_s_from_env(default=DEFAULT_LEASE_S):
+    try:
+        v = float(os.environ.get(ENV_LEASE_S, default))
+        return v if v > 0 else default
+    except ValueError:
+        return default
+
+
+class Session:
+    """One subscriber stream's supervision record."""
+
+    __slots__ = ("sid", "slot", "trace_id", "state", "lease_expires_at",
+                 "connected_at", "samples_in", "samples_out",
+                 "quarantine_reason", "qos_rung")
+
+    def __init__(self, sid, slot, trace_id, now, lease_s):
+        self.sid = sid
+        self.slot = int(slot)
+        self.trace_id = trace_id
+        self.state = ACTIVE
+        self.connected_at = float(now)
+        self.lease_expires_at = float(now) + float(lease_s)
+        self.samples_in = 0
+        self.samples_out = 0
+        self.quarantine_reason = None
+        self.qos_rung = 0
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    @classmethod
+    def from_dict(cls, d):
+        s = cls(d["sid"], d["slot"], d["trace_id"], 0.0, 1.0)
+        for k in cls.__slots__:
+            setattr(s, k, d[k])
+        return s
+
+
+class SessionRegistry:
+    """Slot ownership + lease supervision for a fixed-capacity slot table.
+
+    All methods take ``now`` explicitly (tests and chaos drive virtual
+    clocks); ``time.time()`` is only the default. Not thread-safe by
+    itself — the service serializes access on its pump loop.
+    """
+
+    def __init__(self, capacity, lease_s=None):
+        self.capacity = int(capacity)
+        self.lease_s = float(lease_s if lease_s is not None
+                             else lease_s_from_env())
+        # LIFO free pool: recycled slots are re-leased most-recently-freed
+        # first, keeping the live-lane set dense under churn
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.sessions = {}          # sid -> Session (live: ACTIVE/QUARANTINED)
+        self.history = []           # terminal Session records, bounded
+        self._max_history = 256
+
+    # ------------------------------------------------------------ admission
+    def connect(self, sid=None, now=None):
+        """Lease a free slot to a new session; :class:`SlotsExhausted` with
+        the soonest-lease-expiry ETA when the table is full."""
+        now = time.time() if now is None else float(now)
+        # duplicate sid is a caller bug, not a capacity condition — it must
+        # not masquerade as a retryable SlotsExhausted on a full table
+        if sid is not None and sid in self.sessions:
+            raise ValueError(f"session id {sid!r} already connected")
+        if not self._free:
+            soonest = min((s.lease_expires_at for s in
+                           self.sessions.values()), default=None)
+            eta = max(0.0, soonest - now) if soonest is not None else None
+            raise SlotsExhausted(self.capacity, eta_s=eta)
+        sid = sid or f"sess-{uuid.uuid4().hex[:12]}"
+        slot = self._free.pop()
+        trace_id = f"tr-{uuid.uuid4().hex[:16]}"
+        sess = Session(sid, slot, trace_id, now, self.lease_s)
+        self.sessions[sid] = sess
+        return sess
+
+    # ------------------------------------------------------------ lifecycle
+    def get(self, sid):
+        return self.sessions.get(sid)
+
+    def heartbeat(self, sid, now=None):
+        """Renew a live session's lease (any subscriber activity counts)."""
+        now = time.time() if now is None else float(now)
+        sess = self.sessions.get(sid)
+        if sess is None:
+            return None
+        sess.lease_expires_at = now + self.lease_s
+        return sess
+
+    def quarantine(self, sid, reason):
+        """ACTIVE -> QUARANTINED: the stream degrades to a structured error
+        state but keeps its slot/lease (the subscriber reads the verdict)."""
+        sess = self.sessions.get(sid)
+        if sess is None or sess.state != ACTIVE:
+            return sess
+        sess.state = QUARANTINED
+        sess.quarantine_reason = str(reason)
+        return sess
+
+    def disconnect(self, sid):
+        """Live -> CLOSED; slot back to the free pool. Returns the session
+        (None if unknown — double-disconnect is a no-op, not an error)."""
+        sess = self.sessions.pop(sid, None)
+        if sess is None:
+            return None
+        sess.state = CLOSED
+        self._retire(sess)
+        return sess
+
+    def reap(self, now=None):
+        """Expire every live session whose lease has lapsed; returns the
+        reaped sessions (their slots are already back in the pool — the
+        caller resets exactly those lanes)."""
+        now = time.time() if now is None else float(now)
+        dead = [s for s in self.sessions.values()
+                if s.lease_expires_at <= now]
+        for sess in dead:
+            del self.sessions[sess.sid]
+            sess.state = EXPIRED
+            self._retire(sess)
+        return dead
+
+    def _retire(self, sess):
+        self._free.append(sess.slot)
+        self.history.append(sess)
+        if len(self.history) > self._max_history:
+            del self.history[: len(self.history) - self._max_history]
+
+    # ------------------------------------------------------------ introspection
+    def live(self):
+        """Live sessions (ACTIVE + QUARANTINED), slot-ordered."""
+        return sorted(self.sessions.values(), key=lambda s: s.slot)
+
+    def free_slots(self):
+        return len(self._free)
+
+    def snapshot(self):
+        """JSON-able registry state: the drain checkpoint's session half."""
+        return {"capacity": self.capacity, "lease_s": self.lease_s,
+                "free": list(self._free),
+                "sessions": [s.to_dict() for s in self.live()]}
+
+    @classmethod
+    def from_snapshot(cls, snap, now=None, lease_s=None):
+        """Rebuild a registry from :meth:`snapshot`. Every resumed session's
+        lease restarts at ``now`` (the old absolute expiries belong to the
+        dead server's clock; a resume must give subscribers a full lease to
+        re-attach before the reaper runs)."""
+        now = time.time() if now is None else float(now)
+        reg = cls(snap["capacity"],
+                  lease_s=lease_s if lease_s is not None else snap["lease_s"])
+        reg._free = list(snap["free"])
+        for d in snap["sessions"]:
+            sess = Session.from_dict(d)
+            sess.lease_expires_at = now + reg.lease_s
+            reg.sessions[sess.sid] = sess
+        return reg

@@ -1,0 +1,488 @@
+"""Streaming inference service tests (redcliff_tpu/serve, ISSUE 17).
+
+Pins the serve plane's contracts: the slot-table engine's O(1) ring advance
+against a host sliding-window reference, per-stream NaN/shape quarantine
+with BYTE-identical co-resident outputs (the churn-isolation pin, engine vs
+engine at the same table shape), the lease/heartbeat session state machine
+(LIFO slot recycling, reap-on-expiry, snapshot round-trip), the shared
+admission taxonomy (SlotsExhausted reject-with-ETA; BackpressureReject
+re-exported from its fleet home), the degraded-QoS cadence ladder with
+hysteresis, slow-consumer containment (bounded out-queues, per-stream
+drops), drain/resume zero-loss durability (the interrupted run's record
+stream byte-matches the uninterrupted one), serve SLO knobs, and
+schema-valid serve/session telemetry. The slow-marked soak runs the full
+seeded chaos storm (churn + NaN + abandoned leases + slow consumers)
+through chaos.churn_isolation_report.
+"""
+import numpy as np
+import pytest
+
+from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+from redcliff_tpu.obs import read_jsonl, schema
+from redcliff_tpu.obs import slo as SLO
+from redcliff_tpu.runtime.admission import (AdmissionReject,
+                                            BackpressureReject,
+                                            SlotsExhausted)
+from redcliff_tpu.serve import chaos
+from redcliff_tpu.serve.engine import StreamEngine
+from redcliff_tpu.serve.service import QOS_CADENCE, ServeService
+from redcliff_tpu.serve.session import (ACTIVE, CLOSED, EXPIRED, QUARANTINED,
+                                        SessionRegistry)
+
+C = 4          # channels
+L = 4          # embed_lag == ring length
+
+
+def _model():
+    return RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=C, gen_lag=2, gen_hidden=(8,), embed_lag=L,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    import jax
+    model = _model()
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _service(fitted, capacity=3, root=None, lease_s=30.0, resume=True):
+    model, params = fitted
+    return ServeService(model, params, root=root, capacity=capacity,
+                        lease_s=lease_s, resume=resume)
+
+
+def _feed(svc, sid, samples, now0=0.0, dt=0.01, poll=True):
+    """Tick-per-sample drive of one already-connected stream."""
+    recs, now = [], now0
+    for x in samples:
+        now += dt
+        svc.ingest(sid, x, now=now)
+        svc.pump(now=now)
+        if poll:
+            recs.extend(svc.poll(sid, now=now))
+    return recs
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_ring_matches_sliding_window(fitted):
+    """The O(1) ring advance must reproduce the O(window) host path: after
+    each accepted sample the engine's readout equals the embedder applied
+    to the host-assembled last-L sliding window (same (S, L, C) program
+    shape; tight tolerance covers fusion-order differences)."""
+    import jax.numpy as jnp
+    model, params = fitted
+    eng = StreamEngine(model, params, capacity=3)
+    xs = chaos.stream_samples(3, 10, C)
+    arrive = np.array([True, False, False])
+    for i in range(len(xs)):
+        batch = np.zeros((3, C), np.float32)
+        batch[0] = xs[i]
+        out = eng.step(batch, arrive)
+        if i < L - 1:
+            assert not out["ready"][0]
+            continue
+        assert out["ready"][0]
+        win = np.zeros((3, L, C), np.float32)
+        win[0] = xs[i - L + 1: i + 1]
+        ref, _ = model._embed(params, jnp.asarray(win))
+        np.testing.assert_allclose(out["scores"][0], np.asarray(ref)[0],
+                                   rtol=1e-5, atol=1e-6)
+        # per-sample graph is the weighting-blended static per-factor GC
+        graph_ref = np.einsum("k,kij->ij", out["scores"][0],
+                              np.asarray(eng.static_gc))
+        np.testing.assert_allclose(out["graph"][0], graph_ref,
+                                   rtol=1e-5, atol=1e-6)
+    assert not out["ready"][1] and not out["ready"][2]
+
+
+def test_engine_poison_latches_and_spares_ring(fitted):
+    """A non-finite sample never reaches ring state: the lane latches
+    ``poisoned``, the sample is discarded, and later finite samples are
+    refused — while a co-resident lane's outputs stay byte-identical to a
+    run where the poisoner never existed."""
+    model, params = fitted
+    xs = chaos.stream_samples(7, 8, C)
+    bad = xs.copy()
+
+    def run(poison):
+        eng = StreamEngine(model, params, capacity=2)
+        outs = []
+        for i in range(len(xs)):
+            batch = np.zeros((2, C), np.float32)
+            batch[0] = xs[i]
+            batch[1] = bad[i]
+            if poison and i == 5:
+                batch[1, 0] = np.nan
+            out = eng.step(batch, np.array([True, poison]))
+            outs.append(out)
+        return outs
+
+    clean = run(False)
+    stormy = run(True)
+    hit = stormy[5]
+    assert hit["poison_hit"][1] and hit["poisoned"][1]
+    assert not hit["ready"][1]
+    # latched: the finite sample at tick 6 is refused too
+    assert stormy[6]["poisoned"][1] and not stormy[6]["ready"][1]
+    # the victim lane's bytes are untouched by its neighbor's poisoning
+    for a, b in zip(clean, stormy):
+        assert a["scores"][0].tobytes() == b["scores"][0].tobytes()
+        assert a["graph"][0].tobytes() == b["graph"][0].tobytes()
+
+
+def test_engine_import_state_refuses_geometry_mismatch(fitted):
+    model, params = fitted
+    eng = StreamEngine(model, params, capacity=2)
+    snap = eng.export_state()
+    other = StreamEngine(model, params, capacity=3)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        other.import_state(snap)
+
+
+# ---------------------------------------------------------------- sessions
+def test_session_registry_lifecycle():
+    reg = SessionRegistry(capacity=2, lease_s=10.0)
+    a = reg.connect(sid="a", now=0.0)
+    b = reg.connect(sid="b", now=0.0)
+    assert {a.slot, b.slot} == {0, 1} and reg.free_slots() == 0
+    assert a.trace_id.startswith("tr-") and len(a.trace_id) == 19
+    with pytest.raises(ValueError):
+        reg.connect(sid="a", now=0.0)
+    with pytest.raises(SlotsExhausted) as ei:
+        reg.connect(now=4.0)
+    assert ei.value.eta_s == pytest.approx(6.0)
+    # LIFO recycling: the most recently freed slot is re-leased first
+    reg.disconnect("a")
+    assert a.state == CLOSED
+    c = reg.connect(sid="c", now=1.0)
+    assert c.slot == a.slot
+    # heartbeat renews; silence expires at the next reap
+    reg.heartbeat("b", now=8.0)
+    dead = reg.reap(now=12.0)
+    assert [s.sid for s in dead] == ["c"] and c.state == EXPIRED
+    assert reg.get("b").state == ACTIVE
+    # double-disconnect is a no-op, not an error
+    assert reg.disconnect("c") is None
+
+
+def test_session_snapshot_roundtrip_renews_leases():
+    reg = SessionRegistry(capacity=3, lease_s=10.0)
+    reg.connect(sid="a", now=0.0)
+    reg.quarantine("a", "poison")
+    reg.connect(sid="b", now=5.0)
+    snap = reg.snapshot()
+    back = SessionRegistry.from_snapshot(snap, now=100.0)
+    assert {s.sid for s in back.live()} == {"a", "b"}
+    assert back.get("a").state == QUARANTINED
+    assert back.get("a").trace_id == reg.get("a").trace_id
+    assert back.get("a").slot == reg.get("a").slot
+    # resumed leases restart at the resume clock, not the dead server's
+    assert back.get("b").lease_expires_at == pytest.approx(110.0)
+    assert back.free_slots() == 1
+
+
+def test_admission_taxonomy_is_shared():
+    """Both planes raise the same typed family; the fleet re-export stays
+    byte-compatible with its original home."""
+    from redcliff_tpu.fleet.queue import BackpressureReject as FleetBP
+    assert FleetBP is BackpressureReject
+    bp = BackpressureReject("t0", 12.0, 5.0, 3, 1)
+    assert isinstance(bp, AdmissionReject)
+    assert bp.eta_s == 12.0 and bp.tenant == "t0"
+    assert "REDCLIFF_BACKPRESSURE=0" in str(bp)
+    se = SlotsExhausted(8, eta_s=3.5)
+    assert isinstance(se, AdmissionReject)
+    assert se.capacity == 8 and se.eta_s == 3.5
+    assert "REDCLIFF_SERVE_SLOTS" in str(se)
+
+
+# ---------------------------------------------------------------- service
+def test_nan_quarantine_spares_siblings(fitted, tmp_path):
+    """The headline fault-isolation contract: a stream that turns NaN is
+    quarantined with a structured error record while its co-resident
+    siblings answer EVERY sample with finite scores."""
+    svc = _service(fitted, capacity=3, root=str(tmp_path))
+    n = L + 6
+    good = chaos.stream_samples(1, n, C)
+    bad = chaos.stream_samples(2, n, C)
+    bad[L + 2, 1] = np.nan
+    svc.connect(sid="good", now=0.0)
+    svc.connect(sid="bad", now=0.0)
+    now, recs = 0.0, {"good": [], "bad": []}
+    for i in range(n):
+        now += 0.01
+        svc.ingest("good", good[i], now=now)
+        svc.ingest("bad", bad[i], now=now)
+        svc.pump(now=now)
+        for sid in recs:
+            recs[sid].extend(svc.poll(sid, now=now))
+    assert len(recs["good"]) == n - L + 1
+    assert all(np.isfinite(r["scores"]).all() for r in recs["good"])
+    assert [r["seq"] for r in recs["good"]] == list(range(1, n - L + 2))
+    errs = [r for r in recs["bad"] if "error" in r]
+    assert errs and "non-finite" in errs[0]["error"]
+    sess = svc.registry.get("bad")
+    assert sess.state == QUARANTINED
+    # ingest after quarantine: structured refusal, never an exception
+    v = svc.ingest("bad", bad[0], now=now)
+    assert not v["accepted"] and "quarantined" in v["reason"]
+    svc.stop()
+    recs_log = read_jsonl(str(tmp_path))
+    assert not schema.validate_records(recs_log)
+    assert any(r["event"] == "session" and r.get("kind") == "quarantine"
+               for r in recs_log)
+
+
+def test_shape_violation_quarantines_host_side(fitted):
+    svc = _service(fitted, capacity=2)
+    svc.connect(sid="a", now=0.0)
+    svc.connect(sid="b", now=0.0)
+    v = svc.ingest("a", np.zeros(C + 1, np.float32), now=0.1)
+    assert not v["accepted"] and "quarantined" in v["reason"]
+    assert svc.registry.get("a").state == QUARANTINED
+    assert "shape violation" in svc.registry.get("a").quarantine_reason
+    # the sibling is untouched and still serves
+    recs = _feed(svc, "b", chaos.stream_samples(4, L + 1, C))
+    assert len(recs) == 2
+    svc.stop()
+
+
+def test_slots_exhausted_reject_with_eta(fitted):
+    svc = _service(fitted, capacity=2, lease_s=30.0)
+    svc.connect(sid="a", now=0.0)
+    svc.connect(sid="b", now=0.0)
+    with pytest.raises(SlotsExhausted) as ei:
+        svc.connect(sid="c", now=10.0)
+    assert ei.value.eta_s == pytest.approx(20.0)
+    assert svc.rejects == 1
+    # a disconnect frees the slot; admission succeeds again
+    svc.disconnect("b")
+    got = svc.connect(sid="c", now=11.0)
+    assert got["sid"] == "c"
+    svc.stop()
+
+
+def test_lease_expiry_reaps_silent_stream(fitted):
+    """A subscriber that stops heartbeating is EXPIRED by the pump's reap
+    sweep and its slot recycled — ingest and poll both renew."""
+    svc = _service(fitted, capacity=2, lease_s=5.0)
+    svc.connect(sid="live", now=0.0)
+    svc.connect(sid="dead", now=0.0)
+    xs = chaos.stream_samples(5, 12, C)
+    now = 0.0
+    for i in range(12):
+        now += 1.0
+        svc.ingest("live", xs[i], now=now)   # heartbeat
+        svc.pump(now=now)
+        svc.poll("live", now=now)
+    assert svc.registry.get("dead") is None
+    assert svc.registry.get("live").state == ACTIVE
+    assert svc.registry.free_slots() == 1
+    assert svc.connect(sid="next", now=now)["sid"] == "next"
+    svc.stop()
+
+
+def test_fast_churn_isolation_pin(fitted):
+    """The tier-1 pin: victims' answered records are byte-identical with
+    and without a seeded storm of connect/disconnect/NaN/abandoned
+    neighbors in co-resident lanes."""
+    report = chaos.churn_isolation_report(
+        lambda: _service(fitted, capacity=4, lease_s=0.05, resume=False),
+        chans=C, n_victims=2, n_samples=12, seed=0, extra_ticks=4)
+    assert report["identical"], report["detail"]
+    assert report["compared"] == 2 * (12 - L + 1)
+
+
+def test_slow_consumer_drops_are_contained(fitted, monkeypatch):
+    """A subscriber that never polls sheds ITS oldest records at the
+    out-queue cap (counted); the polling sibling loses nothing."""
+    monkeypatch.setenv("REDCLIFF_SERVE_OUT_CAP", "4")
+    svc = _service(fitted, capacity=2)
+    svc.connect(sid="slow", now=0.0)
+    svc.connect(sid="fast", now=0.0)
+    n = L + 11
+    xs, ys = chaos.stream_samples(8, n, C), chaos.stream_samples(9, n, C)
+    now, fast_recs = 0.0, []
+    for i in range(n):
+        now += 0.01
+        svc.ingest("slow", xs[i], now=now)
+        svc.ingest("fast", ys[i], now=now)
+        svc.pump(now=now)
+        fast_recs.extend(svc.poll("fast", now=now))
+    answered = n - L + 1
+    assert len(fast_recs) == answered
+    assert len(svc.out["slow"]) == 4
+    assert svc.drops["slow"] == answered - 4
+    assert svc.drops["fast"] == 0
+    # the survivors are the NEWEST records (oldest were shed)
+    assert [r["seq"] for r in svc.poll("slow", now=now)] \
+        == list(range(answered - 3, answered + 1))
+    svc.stop()
+
+
+def test_qos_ladder_demotes_and_restores(fitted, monkeypatch, tmp_path):
+    """Backlog past the demote fraction thins the graph-readout cadence for
+    THAT stream only; draining below the restore fraction recovers rung 0.
+    Factor scores flow at full rate throughout."""
+    monkeypatch.setenv("REDCLIFF_SERVE_INGEST_CAP", "8")
+    svc = _service(fitted, capacity=2, root=str(tmp_path))
+    svc.connect(sid="greedy", now=0.0)
+    svc.connect(sid="calm", now=0.0)
+    xs = chaos.stream_samples(10, 30, C)
+    # burst 7 samples without pumping: backlog 7 >= demote_at (4)
+    for i in range(7):
+        svc.ingest("greedy", xs[i], now=0.1)
+    svc.ingest("calm", xs[0], now=0.1)
+    svc.pump(now=0.2)
+    assert svc.registry.get("greedy").qos_rung == 1
+    assert svc.registry.get("calm").qos_rung == 0
+    # drain the backlog: backlog falls to <= restore_at (2) -> rung 0
+    now = 0.2
+    recs = []
+    for _ in range(6):
+        now += 0.01
+        svc.pump(now=now)
+        recs.extend(svc.poll("greedy", now=now))
+    assert svc.registry.get("greedy").qos_rung == 0
+    # every answered sample carried scores; graph thinned while demoted
+    assert all("scores" in r for r in recs)
+    assert any("graph" not in r for r in recs)
+    kinds = [(r.get("reason"), r.get("rung"), r.get("sid"))
+             for r in read_jsonl(str(tmp_path))
+             if r["event"] == "serve" and r.get("kind") == "qos"]
+    assert ("backlog", 1, "greedy") in kinds
+    assert ("recovered", 0, "greedy") in kinds
+    svc.stop()
+    assert QOS_CADENCE[0] == 1  # rung 0 is always full-cadence
+
+
+def test_backlog_cap_refuses_structurally(fitted, monkeypatch):
+    monkeypatch.setenv("REDCLIFF_SERVE_INGEST_CAP", "3")
+    svc = _service(fitted, capacity=1)
+    svc.connect(sid="a", now=0.0)
+    x = np.zeros(C, np.float32)
+    for _ in range(3):
+        assert svc.ingest("a", x, now=0.1)["accepted"]
+    v = svc.ingest("a", x, now=0.1)
+    assert not v["accepted"] and v["reason"] == "backlog full"
+    assert v["backlog"] == 3
+    svc.stop()
+
+
+def test_drain_resume_matches_uninterrupted_run(fitted, tmp_path):
+    """Zero-loss durability: drain mid-stream, restart from the checkpoint,
+    finish the stream — undelivered records are handed back and the full
+    record sequence byte-matches the uninterrupted run (same ring state,
+    same trace_id, seq continues)."""
+    n, cut = 12, 7
+    xs = chaos.stream_samples(11, n, C)
+
+    # reference: one uninterrupted service
+    ref_svc = _service(fitted, capacity=2, resume=False)
+    ref_svc.connect(sid="s", now=0.0)
+    ref = _feed(ref_svc, "s", xs)
+    ref_svc.stop()
+    ref_trace = None
+
+    # interrupted: feed `cut`, never poll, drain (checkpoint), resume
+    root = str(tmp_path)
+    svc1 = _service(fitted, capacity=2, root=root)
+    tr1 = svc1.connect(sid="s", now=0.0)["trace_id"]
+    _feed(svc1, "s", xs[:cut], poll=False)
+    path = svc1.drain(now=1.0)
+    assert path and path.endswith("serve_state.bin")
+
+    svc2 = _service(fitted, capacity=2, root=root)
+    sess = svc2.registry.get("s")
+    assert sess is not None and sess.state == ACTIVE
+    assert sess.trace_id == tr1
+    # undelivered records from before the restart are handed back first
+    got = list(svc2.poll("s", now=2.0))
+    got += _feed(svc2, "s", xs[cut:], now0=2.0)
+    svc2.stop()
+
+    assert [r["seq"] for r in got] == [r["seq"] for r in ref]
+    for a, b in zip(got, ref):
+        assert a["scores"].tobytes() == b["scores"].tobytes()
+        assert ("graph" in a) == ("graph" in b)
+        if "graph" in a:
+            assert a["graph"].tobytes() == b["graph"].tobytes()
+        assert a["trace_id"] == tr1
+        ref_trace = b["trace_id"]
+    assert ref_trace != tr1  # distinct services mint distinct identities
+
+    recs = read_jsonl(root)
+    assert not schema.validate_records(recs)
+    kinds = [r.get("kind") for r in recs if r["event"] == "serve"]
+    assert "drain" in kinds and "resume" in kinds
+    drain_ev = [r for r in recs
+                if r["event"] == "serve" and r.get("kind") == "drain"][-1]
+    assert drain_ev["undelivered"] == cut - L + 1
+    assert drain_ev["checkpoint"] == path
+
+
+def test_drain_answers_backlog_and_quarantine_errors(fitted, tmp_path):
+    """drain() answers every in-flight sample — including converting a
+    quarantined stream's stranded pending samples to error records."""
+    svc = _service(fitted, capacity=2, root=str(tmp_path))
+    svc.connect(sid="a", now=0.0)
+    svc.connect(sid="q", now=0.0)
+    xs = chaos.stream_samples(12, L + 4, C)
+    for i in range(L + 4):
+        svc.ingest("a", xs[i], now=0.1)      # backlog, no pump
+    svc.ingest("q", xs[0], now=0.1)
+    bad = xs[1].copy()
+    bad[0] = np.inf
+    svc.ingest("q", bad, now=0.1)
+    svc.drain(now=1.0)
+    a_recs = [r for r in svc.out["a"]]
+    assert len(a_recs) == 5                  # L+4 samples, ring fills at L
+    q_recs = [r for r in svc.out["q"]]
+    assert q_recs and all("error" in r for r in q_recs)
+    assert svc.registry.get("q").state == QUARANTINED
+
+
+def test_serve_slo_knobs_and_breach(monkeypatch):
+    """The serve latency SLO knobs arm threshold checks in the obs reader
+    (no backend needed — pure record folding)."""
+    monkeypatch.setenv(SLO.ENV_SERVE_P50_MS, "1.0")
+    monkeypatch.setenv(SLO.ENV_SERVE_P99_MS, "5.0")
+    thr = SLO.serve_thresholds_from_env()
+    assert thr == {"serve_p50_ms": 1.0, "serve_p99_ms": 5.0}
+    recs = [{"event": "serve", "kind": "start", "capacity": 4},
+            {"event": "serve", "kind": "tick", "streams": 2,
+             "samples_in": 40, "samples_out": 38, "rejects": 1,
+             "dropped": 0, "p50_ms": 2.0, "p99_ms": 9.0, "n": 38}]
+    out = SLO.compute_serve_slo(recs, thresholds=thr)
+    assert out["latency"]["p99_ms"] == 9.0
+    assert {b["slo"] for b in out["breaches"]} \
+        == {"serve_p50_ms", "serve_p99_ms"}
+    assert SLO.compute_serve_slo([{"event": "metric"}]) is None
+
+
+def test_serve_smoke_entrypoint(tmp_path):
+    """The CI smoke leg end to end: tiny artifact -> 3 streams (one goes
+    NaN) -> quarantine + sibling completeness + drain checkpoint."""
+    from redcliff_tpu.serve.__main__ import main
+    assert main(["smoke", "--root", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------- soak
+@pytest.mark.slow
+def test_churn_soak_isolation(fitted):
+    """The full seeded storm at soak length: sustained connect/disconnect
+    churn, NaN streams, abandoned leases reaped mid-run, slow consumers
+    shedding — and every victim byte stays identical. Storm pressure must
+    actually bite (admission rejects observed)."""
+    report = chaos.churn_isolation_report(
+        lambda: _service(fitted, capacity=4, lease_s=0.05, resume=False),
+        chans=C, n_victims=2, n_samples=48, seed=7, extra_ticks=16)
+    assert report["identical"], report["detail"]
+    assert report["compared"] == 2 * (48 - L + 1)
+    assert report["rejects"] > 0
