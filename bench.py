@@ -90,6 +90,7 @@ sequential-vs-grid ratio on identical hardware is the honest comparable.
 """
 import dataclasses
 import datetime
+import glob
 import json
 import os
 import random
@@ -1105,6 +1106,113 @@ def _bench_fleet_containment():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_mesh_packing():
+    """mesh_packing probe (ISSUE 18): two heterogeneous tiny batches
+    drained end-to-end through one worker, serially vs spatially packed
+    onto disjoint sub-mesh slots of a simulated 4-device host pool
+    (``--xla_force_host_platform_device_count=4`` in the supervised
+    children). ``makespan_ratio`` is the packed/serial wall-clock — the
+    number the whole subsystem exists to push below 1.0.
+    ``utilization_pct`` integrates busy device-seconds from the
+    slot_claim/slot_free event pairs over the packed leg's wall.
+    ``headroom_violations`` sums the priced plans' violation counters (0
+    by construction: the planner's per-lane HBM gate admits each
+    co-tenant against the REMAINING headroom). The ``packed_ok`` flag is
+    the correctness contract: both legs fully done, zero violations, and
+    the packed leg actually overlapped two slots in time."""
+    import shutil
+    import tempfile
+
+    from redcliff_tpu.fleet.__main__ import TINY_SPEC
+    from redcliff_tpu.fleet.queue import FleetQueue
+    from redcliff_tpu.fleet.worker import work
+    from redcliff_tpu.obs.logging import read_jsonl
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4"
+                        ).strip()
+
+    def drain(root, mode):
+        q = FleetQueue(root)
+        for i in range(2):
+            # distinct data seeds -> distinct merge keys -> two batches
+            spec = json.loads(json.dumps(TINY_SPEC))
+            spec["epochs"] = 1
+            spec["mesh"] = "auto"
+            spec["data"]["seed"] = i
+            q.submit(f"bench-pack{i}", [{"gen_lr": 1e-3 * (i + 1)}],
+                     spec=spec)
+        policy = SupervisorPolicy(
+            max_restarts=1,
+            backoff=RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                                multiplier=1.0, max_delay_s=0.05))
+        t0 = time.perf_counter()
+        work(str(root), drain=True, poll_s=0.1, lease_s=120.0,
+             n_devices=4, supervisor_policy=policy, env=env,
+             max_attempts=2, packing=mode)
+        return time.perf_counter() - t0, q.status()["counts"]
+
+    tmp = tempfile.mkdtemp(prefix="bench_mesh_packing_")
+    try:
+        serial_wall, sc = drain(os.path.join(tmp, "serial"), "off")
+        packed_wall, pc = drain(os.path.join(tmp, "packed"), "force")
+        claims, frees = {}, {}
+        violations = partial_rows = 0
+        for rec in read_jsonl(os.path.join(tmp, "packed")):
+            if rec.get("event") == "packing":
+                kind = rec.get("kind")
+                if kind == "slot_claim":
+                    claims[rec.get("batch_id")] = rec
+                elif kind == "slot_free":
+                    frees[rec.get("batch_id")] = rec
+                elif kind == "plan":
+                    violations += int(rec.get("headroom_violations") or 0)
+        # partial_result rows stream into the per-batch RUN-DIR chains and
+        # results/<id>.partial.jsonl files, not the root chain
+        for path in glob.glob(os.path.join(
+                tmp, "packed", "work", "*", "results", "*.partial.jsonl")):
+            with open(path, encoding="utf-8") as fh:
+                partial_rows += sum(1 for _ in fh)
+        busy_dev_s = 0.0
+        spans = []
+        for bid, c in claims.items():
+            f = frees.get(bid)
+            if f is None:
+                continue
+            t0_, t1_ = c.get("wall_time"), f.get("wall_time")
+            if not (isinstance(t0_, (int, float))
+                    and isinstance(t1_, (int, float)) and t1_ > t0_):
+                continue
+            width = int((c.get("slot") or {}).get("width") or 1)
+            busy_dev_s += width * (t1_ - t0_)
+            spans.append((t0_, t1_))
+        overlapped = any(a0 < b1 and b0 < a1
+                         for i, (a0, a1) in enumerate(spans)
+                         for (b0, b1) in spans[i + 1:])
+        util = (round(100.0 * busy_dev_s / (4 * packed_wall), 1)
+                if packed_wall else None)
+        both_done = (sc["done"] == 2 and sc["failed"] == 0
+                     and pc["done"] == 2 and pc["failed"] == 0)
+        return {
+            "serial_wall_s": round(serial_wall, 3),
+            "packed_wall_s": round(packed_wall, 3),
+            "makespan_ratio": (round(packed_wall / serial_wall, 3)
+                               if serial_wall and both_done else None),
+            "utilization_pct": util,
+            "headroom_violations": violations,
+            "partial_rows": partial_rows,
+            "packed_ok": bool(both_done and violations == 0 and overlapped),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_autoscale(n_requests=4, max_workers=2):
     """autoscale probe (ISSUE 16, fleet/autoscale.py): a seeded submit
     storm drained by the SLO-driven control loop, end-to-end through real
@@ -1883,6 +1991,15 @@ def _measure(platform):
         predictive_policy = {"error": f"{type(e).__name__}: {e}",
                              "makespan_ratio": None}
 
+    # spatial mesh packing (ISSUE 18): two heterogeneous batches drained
+    # serially vs co-resident on disjoint sub-mesh slots of a simulated
+    # 4-device pool — packed/serial makespan + pool utilization
+    try:
+        packing_probe = _bench_mesh_packing()
+    except Exception as e:  # never fail the bench over the packing probe
+        packing_probe = {"error": f"{type(e).__name__}: {e}",
+                         "makespan_ratio": None, "utilization_pct": None}
+
     # SLO-driven autoscaling (ISSUE 16): seeded submit storm drained by the
     # control loop through real workers — breach-absorption latency + the
     # backpressure gate's reject-with-ETA accuracy
@@ -1946,6 +2063,7 @@ def _measure(platform):
         "fleet_containment": fleet_containment,
         "fleet_trace": fleet_trace,
         "predictive_policy": predictive_policy,
+        "packing": packing_probe,
         "autoscale": autoscale_probe,
         "quality": quality_probe,
         "serve": serve_probe,

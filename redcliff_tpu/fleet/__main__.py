@@ -139,7 +139,8 @@ def _cmd_work(args):
              drain=args.drain, once=args.once, n_devices=args.n_devices,
              budget_bytes=args.budget_bytes, max_bucket=args.max_bucket,
              checkpoint_every=args.checkpoint_every,
-             supervisor_policy=policy, max_attempts=args.max_attempts)
+             supervisor_policy=policy, max_attempts=args.max_attempts,
+             packing=args.packing)
     print(f"fleet work: ran {n} batch(es)", file=sys.stderr)
     return 0
 
@@ -224,6 +225,49 @@ def _cmd_status(args):
             "qos": {t: {"rung": r.get("rung"), "reason": r.get("reason")}
                     for t, r in sorted(qos.items())},
         }
+    # spatial-packing view (ISSUE 18): worker-published occupancy state,
+    # the newest plan's fair-share quota deferrals (structured reasons from
+    # the metrics chain), and per-request partial-result stream progress
+    # (results/<id>.partial.jsonl row counts under the batch work dirs)
+    import glob as _glob
+
+    from redcliff_tpu.parallel import packing as _packing
+
+    pack_state = _packing.load_state(args.root)
+    quota_deferred = None
+    try:
+        from redcliff_tpu.obs.logging import read_jsonl
+        for rec in reversed(read_jsonl(args.root)):
+            if rec.get("event") == "fleet" and rec.get("kind") == "plan":
+                quota_deferred = rec.get("quota_deferred") or []
+                break
+    except (OSError, ValueError):
+        pass
+    partials = {}
+    for path in sorted(_glob.glob(os.path.join(
+            args.root, "work", "*", "results", "*.partial.jsonl"))):
+        rid = os.path.basename(path)[:-len(".partial.jsonl")]
+        rows = finals = 0
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    rows += 1
+                    finals += bool(row.get("final"))
+        except OSError:
+            continue
+        acc = partials.setdefault(rid, {"rows": 0, "final": 0})
+        acc["rows"] += rows
+        acc["final"] += finals
+    if pack_state is not None or quota_deferred or partials:
+        st["packing"] = {
+            "state": pack_state,
+            "quota_deferred": quota_deferred or [],
+            "partial_results": partials,
+        }
     if args.json:
         json.dump(st, sys.stdout, indent=2, allow_nan=False)
         sys.stdout.write("\n")
@@ -257,6 +301,24 @@ def _cmd_status(args):
                                or {}).items()):
         print(f"    qos tenant {tenant}: rung {rec.get('rung')} "
               f"({rec.get('reason')})")
+    pk = st.get("packing")
+    if pk:
+        ps = pk.get("state") or {}
+        if ps:
+            print(f"  packing: {ps.get('busy_devices', 0)}/"
+                  f"{ps.get('pool', '?')} device(s) busy, "
+                  f"{ps.get('concurrent_batches', 0)} co-resident "
+                  f"batch(es), util {ps.get('utilization_pct', 0)}%")
+        for d in pk.get("quota_deferred") or []:
+            print(f"    quota-deferred {d.get('batch_id')} "
+                  f"[{d.get('tenant')}]: {d.get('reason')} — "
+                  f"{d.get('inflight')}/{d.get('max_inflight_slots')} "
+                  f"slot(s) held"
+                  + (f", eta {d.get('eta_s')}s"
+                     if d.get("eta_s") is not None else ""))
+        for rid, acc in sorted((pk.get("partial_results") or {}).items()):
+            print(f"    partial {rid}: {acc['rows']} row(s) streamed, "
+                  f"{acc['final']} final")
 
     def _age(s):
         if s is None:
@@ -334,6 +396,13 @@ def main(argv=None):
     wp.add_argument("--max-restarts", type=int, default=2)
     wp.add_argument("--base-delay-s", type=float, default=0.5)
     wp.add_argument("--max-delay-s", type=float, default=30.0)
+    wp.add_argument("--packing", default=None,
+                    choices=["off", "auto", "force"],
+                    help="spatial mesh packing mode (ISSUE 18): off = "
+                         "serial claims (default), auto = co-schedule "
+                         "disjoint sub-mesh slots when the priced plan "
+                         "says packed beats serial, force = always pack; "
+                         "unset defers to REDCLIFF_FLEET_PACKING")
     wp.add_argument("--max-attempts", type=int, default=3,
                     help="per-request retry budget: failure attempts before "
                          "a request is dead-lettered (fleet/worker.py)")

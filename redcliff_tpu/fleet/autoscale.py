@@ -267,22 +267,32 @@ def apply_qos(request, rungs):
 # drain / queue-wait prediction (the backpressure gate's math)
 # ---------------------------------------------------------------------------
 def predicted_drain(q, cost_model=None, n_devices=1, default_eta_s=30.0,
-                    now=None):
-    """Predicted serial drain time of the PENDING queue: one admission
+                    now=None, root=None):
+    """Predicted per-worker drain time of the PENDING queue: one admission
     plan's batch ETAs (cost-model priced where a matching shape rung
     exists, ``default_eta_s`` per unpriced batch). In-flight work is
     deliberately excluded — its lease already ended the wait obs/slo.py
     measures, and undercounting keeps the backpressure gate honest
     (rejecting on work we cannot price would reject on guesses).
 
+    Slot-awareness (ISSUE 18 satellite): a PACKED worker drains several
+    batches concurrently on disjoint sub-mesh slots, so pricing the queue
+    serially over-predicts drain — and over-spawns workers. When the
+    worker publishes live slot occupancy (``<root>/packing.json``,
+    parallel/packing.py ``publish_state``; ``root`` defaults to
+    ``q.root``), the serial total divides by the published packing width
+    (live concurrent batches, floored at 1). A stale/missing publication
+    keeps the serial estimate — the conservative pre-packing behavior.
+
     Returns ``{"pending", "batches", "priced", "unpriced",
-    "total_eta_s"}``."""
+    "total_eta_s", "packing_width"}``."""
     from redcliff_tpu.fleet import planner as _planner
+    from redcliff_tpu.parallel import packing as _packing
 
     pending = q.pending(now=now)
     if not pending:
         return {"pending": 0, "batches": 0, "priced": 0, "unpriced": 0,
-                "total_eta_s": 0.0}
+                "total_eta_s": 0.0, "packing_width": 1}
     pl = _planner.plan(pending, n_devices=n_devices, cost_model=cost_model)
     total, priced, unpriced = 0.0, 0, 0
     for b in pl["batches"]:
@@ -297,9 +307,15 @@ def predicted_drain(q, cost_model=None, n_devices=1, default_eta_s=30.0,
     # like unpriced batches so a wedged-unschedulable backlog reads as load
     total += float(default_eta_s) * len(pl["unschedulable"])
     unpriced += len(pl["unschedulable"])
+    width = 1
+    pack_state = _packing.load_state(root if root is not None else q.root,
+                                     now=now)
+    if pack_state is not None:
+        width = max(int(pack_state.get("concurrent_batches") or 0), 1)
     return {"pending": len(pending), "batches": len(pl["batches"]),
             "priced": priced, "unpriced": unpriced,
-            "total_eta_s": round(total, 3)}
+            "total_eta_s": round(total / width, 3),
+            "packing_width": width}
 
 
 def _worker_count(root, q, now):

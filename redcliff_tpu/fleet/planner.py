@@ -48,17 +48,53 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import time
 
-from redcliff_tpu.parallel import compaction
+from redcliff_tpu.parallel import compaction, packing as _packing
+from redcliff_tpu.runtime.admission import TenantQuotaExceeded
 
 __all__ = ["batch_key", "batch_id_for", "plan", "fifo_plan", "utilization",
-           "predicted_batch_bytes", "DEFAULT_MAX_BUCKET"]
+           "predicted_batch_bytes", "tenant_slot_quota",
+           "DEFAULT_MAX_BUCKET", "ENV_TENANT_SLOTS"]
 
 # widest bucket a single batch may occupy without an explicit override: a
 # merged sweep past this rides multiple batches (bounded checkpoint size,
 # bounded blast radius of one bad batch)
 DEFAULT_MAX_BUCKET = 256
+
+# per-tenant fair-share quota (ISSUE 18 satellite): max sub-mesh slots one
+# tenant may hold in flight at once. "2" = every tenant, "a=1,b=4" =
+# per-tenant overrides, "2,a=1" = default plus override. Unset = unlimited.
+ENV_TENANT_SLOTS = "REDCLIFF_FLEET_TENANT_SLOTS"
+
+
+def tenant_slot_quota(env=None):
+    """Parse the ``REDCLIFF_FLEET_TENANT_SLOTS`` fair-share spec into
+    ``{tenant_or_"*": max_inflight_slots}`` (None when unset/invalid —
+    quotas are an operator knob, never a crash)."""
+    raw = (os.environ.get(ENV_TENANT_SLOTS, "") if env is None else env)
+    raw = str(raw).strip()
+    if not raw:
+        return None
+    quota = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            tenant, _, n = part.partition("=")
+            tenant = tenant.strip()
+        else:
+            tenant, n = "*", part
+        try:
+            n = int(n)
+        except ValueError:
+            return None
+        if n < 1 or not tenant:
+            return None
+        quota[tenant] = n
+    return quota or None
 
 
 def batch_key(request):
@@ -221,21 +257,38 @@ def _batch_order_key(batch):
 
 
 def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
-         platform=None, max_bucket=DEFAULT_MAX_BUCKET, suspects=None):
+         platform=None, max_bucket=DEFAULT_MAX_BUCKET, suspects=None,
+         tenant_slots=None, inflight_slots=None):
     """Pack ``requests`` (queue records) into admitted batches.
 
-    Returns ``{"batches": [...], "unschedulable": [...], "queue_depth",
-    "plan_ms", "utilization"}``. Every admitted batch satisfies
-    ``predicted_bytes is None or predicted_bytes <= budget_bytes`` (when a
-    budget is known); requests that cannot fit even alone at their smallest
-    bucket are listed under ``unschedulable`` with a reason instead of
-    being silently admitted.
+    Returns ``{"batches": [...], "unschedulable": [...], "quota_deferred":
+    [...], "queue_depth", "plan_ms", "utilization", "packing"}``. Every
+    admitted batch satisfies ``predicted_bytes is None or predicted_bytes
+    <= budget_bytes`` (when a budget is known); requests that cannot fit
+    even alone at their smallest bucket are listed under ``unschedulable``
+    with a reason instead of being silently admitted.
 
     ``suspects`` (request-id set): containment circuit breaker — a request
     with prior failed attempts is planned into a SOLO batch, never merged
     with healthy tenants, until it proves clean. One poison tenant can then
     cost at most its own solo fits, not a merged batch's blast radius (the
-    ~3x-utilization merge path stays open to everyone else)."""
+    ~3x-utilization merge path stays open to everyone else).
+
+    ``tenant_slots`` (None = the ``REDCLIFF_FLEET_TENANT_SLOTS`` env spec,
+    see :func:`tenant_slot_quota`): per-tenant fair-share — a batch whose
+    tenant already holds its ``max_inflight_slots`` sub-mesh slots
+    (``inflight_slots``: {tenant: live slots}, from the packed worker's
+    slot table, plus whatever this plan admitted earlier) is DEFERRED to
+    ``quota_deferred`` with the structured
+    :class:`~redcliff_tpu.runtime.admission.TenantQuotaExceeded` reason —
+    still queued, surfaced by ``fleet status``, re-planned next cycle.
+
+    ``packing`` is the spatial packing decision record
+    (parallel/packing.py :func:`~redcliff_tpu.parallel.packing
+    .price_packing` over the admitted batches): ``decision`` is
+    ``"packed"`` only when every batch is cost-model priced AND the
+    simulated slot-table makespan beats serial — an empty cost store keeps
+    the worker bit-identical to the serial heuristic."""
     t0 = time.perf_counter()
     suspects = frozenset(suspects or ())
     ordered = sorted(requests, key=_order_key)
@@ -303,13 +356,57 @@ def plan(requests, n_devices=1, budget_bytes=None, cost_model=None,
             batches.append(_batch_view(members, n_devices, cost_model,
                                        platform))
     batches.sort(key=_batch_order_key)
+    if tenant_slots is None:
+        tenant_slots = tenant_slot_quota()
+    batches, quota_deferred = _apply_tenant_quota(batches, tenant_slots,
+                                                 inflight_slots)
     return {
         "batches": batches,
         "unschedulable": unschedulable,
+        "quota_deferred": quota_deferred,
         "queue_depth": len(ordered),
         "plan_ms": round((time.perf_counter() - t0) * 1e3, 3),
         "utilization": utilization(batches),
+        "packing": _packing.price_packing(batches, n_devices, budget_bytes),
     }
+
+
+def _apply_tenant_quota(batches, tenant_slots, inflight_slots):
+    """Fair-share filter over the ordered admitted batches: each batch
+    charges one sub-mesh slot to every tenant riding it; a batch that would
+    push any of its tenants past quota (live slots + slots admitted earlier
+    this cycle) is deferred — stays queued, re-plans next cycle. Deferral
+    never reorders the survivors (priority order is the planner's, quota
+    only thins it)."""
+    if not tenant_slots:
+        return batches, []
+    held = {str(t): int(n) for t, n in (inflight_slots or {}).items()}
+    default = tenant_slots.get("*")
+    admitted, deferred = [], []
+    for b in batches:
+        over = None
+        for tenant in b.get("tenants") or ():
+            cap = tenant_slots.get(tenant, default)
+            if cap is not None and held.get(tenant, 0) >= cap:
+                over = (tenant, cap)
+                break
+        if over is None:
+            for tenant in b.get("tenants") or ():
+                held[tenant] = held.get(tenant, 0) + 1
+            admitted.append(b)
+            continue
+        tenant, cap = over
+        exc = TenantQuotaExceeded(tenant, cap, held.get(tenant, 0),
+                                  eta_s=b.get("eta_s"))
+        deferred.append({"batch_id": b["batch_id"],
+                         "requests": b["requests"],
+                         "tenant": exc.tenant,
+                         "reason": exc.reason,
+                         "max_inflight_slots": exc.max_inflight_slots,
+                         "inflight": exc.inflight,
+                         "eta_s": exc.eta_s,
+                         "detail": str(exc)})
+    return admitted, deferred
 
 
 def fifo_plan(requests, n_devices=1, budget_bytes=None, cost_model=None,

@@ -170,11 +170,32 @@ def run_batch_file(batch_file):
         # worker's bisection must corner without attribution
         _chaos.detonate(chaos_specs[0])
 
+    # sub-mesh slot (ISSUE 18): a PACKED worker assigned this batch a
+    # disjoint device interval of the pool — mesh over exactly those
+    # devices so co-resident batches never share a device. Device ids are
+    # stable (remesh.visible_devices), so a reclaimed batch meshes over the
+    # SAME devices its checkpoint was fitted on. A slot that no longer
+    # fits the visible pool (devices lost since the claim) degrades to the
+    # auto-mesh recipe rather than crash-looping the batch
     mesh = None
+    slot = batch.get("slot")
     if spec0.get("mesh") == "auto":
         from redcliff_tpu.parallel import remesh as _remesh
 
-        mesh = _remesh.visible_mesh(n_lanes=len(merged))
+        mesh = None
+        if isinstance(slot, dict):
+            try:
+                lo, width = int(slot["lo"]), int(slot["width"])
+            except (KeyError, TypeError, ValueError):
+                lo = width = None
+            if width:
+                devs = _remesh.visible_devices()[lo:lo + width]
+                if len(devs) == width:
+                    from redcliff_tpu.parallel.mesh import grid_mesh
+
+                    mesh = grid_mesh(devices=devs, axis_name="grid")
+        if mesh is None:
+            mesh = _remesh.visible_mesh(n_lanes=len(merged))
 
     # predictive-policy widening ceiling (ISSUE 15, parallel/policy.py
     # ENV_POLICY_MAX_WIDTH): the admission planner's HBM gate and
@@ -184,31 +205,91 @@ def run_batch_file(batch_file):
     if batch.get("g_bucket"):
         os.environ["REDCLIFF_POLICY_MAX_WIDTH"] = str(int(batch["g_bucket"]))
 
-    # tenant manifest into the run dir's metrics chain BEFORE the fit, so
-    # even a crashed attempt's telemetry is tenant-attributable; the grid
-    # engine appends its own events to the same chain next
-    with MetricLogger(run_dir) as log:
-        log.log("fleet", kind="manifest", batch_id=batch.get("batch_id"),
-                requests=manifest,
-                tenants=sorted({m["tenant"] for m in manifest}),
-                n_points=len(merged))
-
-    runner = RedcliffGridRunner(
-        model, tc,
-        GridSpec(points=merged,
-                 lane_seeds=[lane_seed(p) for p in merged]),
-        mesh=mesh)
-    result = runner.fit(jax.random.PRNGKey(tc.seed), train_ds, val_ds,
-                        checkpoint_dir=run_dir,
-                        checkpoint_every=int(batch.get("checkpoint_every")
-                                             or 1),
-                        log_dir=run_dir)
-
-    # ---- split the merged result into per-request records ----------------
     import numpy as np
 
     results_dir = os.path.join(run_dir, "results")
     os.makedirs(results_dir, exist_ok=True)
+
+    def _owner(point):
+        return next((m for m in manifest
+                     if m["start"] <= point < m["stop"]), None)
+
+    # per-point result streaming (ISSUE 18): lanes the compaction ladder
+    # retires at a check window (early-stopped or quarantined — their state
+    # never changes again) are appended to the owning tenant's
+    # results/<id>.partial.jsonl IMMEDIATELY, not at batch settle, each
+    # also landing as a schema-registered `partial_result` event. Delivery
+    # is at-least-once: a resumed attempt may re-append rows an earlier
+    # attempt already streamed (and batch settle re-appends every point
+    # with final=true) — consumers keep the LAST record per point.
+    streamed = set()
+
+    # tenant manifest into the run dir's metrics chain BEFORE the fit, so
+    # even a crashed attempt's telemetry is tenant-attributable; the grid
+    # engine appends its own events to the same chain next. The logger
+    # stays open across the fit: it is also the partial-result event sink
+    with MetricLogger(run_dir) as plog:
+        plog.log("fleet", kind="manifest", batch_id=batch.get("batch_id"),
+                 requests=manifest,
+                 tenants=sorted({m["tenant"] for m in manifest}),
+                 n_points=len(merged))
+
+        def _stream_partial(pid, rec, epoch, final=False):
+            own = _owner(int(pid))
+            if own is None:
+                return
+            failed_epoch = rec.get("failed_epoch")
+            failed = isinstance(failed_epoch, (int, float)) \
+                and failed_epoch >= 0
+            row = jsonable({
+                "request_id": own["request_id"],
+                "tenant": own["tenant"],
+                "batch_id": batch.get("batch_id"),
+                "point": int(pid) - own["start"],
+                "merged_point": int(pid),
+                "epoch": int(epoch),
+                "best_criterion": rec.get("best_crit"),
+                "best_epoch": rec.get("best_epoch"),
+                "failed": bool(failed),
+                "final": bool(final),
+            })
+            path = os.path.join(results_dir,
+                                f"{own['request_id']}.partial.jsonl")
+            try:
+                with open(path, "a") as fh:
+                    fh.write(json.dumps(row, allow_nan=False) + "\n")
+                plog.log("partial_result", **row)
+                streamed.add(int(pid))
+            except (OSError, ValueError):
+                pass  # streaming is a tenant convenience, never fatal
+
+        runner = RedcliffGridRunner(
+            model, tc,
+            GridSpec(points=merged,
+                     lane_seeds=[lane_seed(p) for p in merged]),
+            mesh=mesh)
+        result = runner.fit(jax.random.PRNGKey(tc.seed), train_ds, val_ds,
+                            checkpoint_dir=run_dir,
+                            checkpoint_every=int(
+                                batch.get("checkpoint_every") or 1),
+                            log_dir=run_dir,
+                            on_lane_retire=_stream_partial)
+
+        # complete the stream at batch settle: every lane that ran to the
+        # end (never early-retired) gets its terminal row, final=true
+        best_crit_arr = np.asarray(result.best_criteria)
+        best_epoch_arr = np.asarray(result.best_epoch)
+        failed_pts = {int(f["point"]) for f in result.failures}
+        for pid in range(len(merged)):
+            if pid in streamed:
+                continue
+            _stream_partial(pid, {
+                "best_crit": float(best_crit_arr[pid]),
+                "best_epoch": int(best_epoch_arr[pid]),
+                "failed_epoch": 0 if pid in failed_pts else -1,
+            }, epoch=int(best_epoch_arr[pid]), final=True)
+
+    # ---- split the merged result into per-request records ----------------
     val_hist = np.asarray(result.val_history)
 
     # model-quality observatory (obs/quality.py): the engine's rolling
@@ -240,10 +321,6 @@ def run_batch_file(batch_file):
     # merged-grid failures.json (train/driver.py's artifact, with per-point
     # request/tenant attribution): the worker's poison-attribution input
     # and the dead-letter dossier's quarantine evidence
-    def _owner(point):
-        return next((m for m in manifest
-                     if m["start"] <= point < m["stop"]), None)
-
     attributed = []
     for f in result.failures:
         own = _owner(int(f["point"])) or {}

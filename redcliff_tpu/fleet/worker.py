@@ -83,6 +83,7 @@ from redcliff_tpu.fleet import autoscale as _autoscale
 from redcliff_tpu.fleet import history as _history
 from redcliff_tpu.fleet import planner as _planner
 from redcliff_tpu.fleet.queue import FleetQueue, LeaseLost
+from redcliff_tpu.parallel import packing as _packing
 # parallel/policy.py is jax-free by contract (schema --check pins it via
 # this import chain): the predictive-scheduling gate + the cold-compile
 # claim-ordering decision live there, beside the width/compaction pricing
@@ -195,11 +196,17 @@ def _claim_batch(q, worker_id, lease_s, batch_id, request_ids, by_id,
 
 
 def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
-                logger, predictive=False):
+                logger, predictive=False, tenant_slots=None,
+                inflight_slots=None, plan_out=None):
     """Reclaim-first, then pinned compositions, then plan-and-claim.
     Returns (batch_view, leases, member_requests) or None when nothing is
     claimable right now. ``predictive`` arms the cold-compile claim
-    ordering over fresh admission plans (ISSUE 15)."""
+    ordering over fresh admission plans (ISSUE 15).
+
+    Packing hooks (ISSUE 18): ``tenant_slots``/``inflight_slots`` ride into
+    the planner's fair-share quota, and ``plan_out`` (a mutable dict) is
+    filled with the fresh plan's ``packing`` decision + ``quota_deferred``
+    list so the packed worker loop can gang-schedule without re-planning."""
     now = time.time()
     by_id = {r["request_id"]: r for r in q.requests()}
 
@@ -322,13 +329,18 @@ def _next_batch(q, worker_id, lease_s, n_devices, budget_bytes, max_bucket,
     pl = _planner.plan(pending, n_devices=n_devices,
                        budget_bytes=budget_bytes,
                        cost_model=cost_model, max_bucket=max_bucket,
-                       suspects=suspects)
+                       suspects=suspects, tenant_slots=tenant_slots,
+                       inflight_slots=inflight_slots)
+    if plan_out is not None:
+        plan_out["packing"] = pl.get("packing")
+        plan_out["quota_deferred"] = pl.get("quota_deferred") or []
     record_span("fleet.plan", (time.perf_counter() - t0) * 1e3,
                 component="fleet", logger=logger, emit=True,
                 queue_depth=pl["queue_depth"], batches=len(pl["batches"]))
     logger.log("fleet", kind="plan", queue_depth=pl["queue_depth"],
                batches=len(pl["batches"]),
                unschedulable=len(pl["unschedulable"]),
+               quota_deferred=(pl.get("quota_deferred") or None),
                plan_ms=pl["plan_ms"],
                suspects=sorted(suspects),
                utilization_pct=pl["utilization"]["utilization_pct"],
@@ -633,6 +645,73 @@ class _PreemptMonitor:
                                   else "missed_even_preempting"), **fields)
 
 
+class _CancelWatch:
+    """Sub-mesh slot cancellation (ISSUE 18 satellite, extending the PR-11
+    cancel/requeue tombstones to packed batches): while a gang-scheduled
+    batch runs, poll the queue for member cancellation; once EVERY member
+    is terminal (canceled/requeued elsewhere — first-writer-wins terminal
+    records), SIGTERM the supervised child so its PreemptionGuard drains
+    the in-flight epoch, checkpoints, and exits at the next check-window
+    boundary. The settle path then just releases the (already-terminal)
+    leases and the gang loop re-offers the freed slot to the queue — the
+    surviving co-tenant's fit is a separate process on a disjoint sub-mesh
+    and is never touched (bit-identity pinned by tests/test_packing.py)."""
+
+    def __init__(self, q, members, logger, worker_id, poll_s=None):
+        self._q = q
+        self._member_ids = sorted(m["request_id"] for m in members)
+        self._logger = logger
+        self._worker = worker_id
+        self._poll = float(poll_s if poll_s is not None else
+                           os.environ.get(ENV_PREEMPT_POLL,
+                                          DEFAULT_PREEMPT_POLL_S))
+        self._proc = None
+        self.requested = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-cancel-watch")
+
+    # supervise() hooks -------------------------------------------------
+    def on_spawn(self, proc):
+        self._proc = proc
+
+    def should_stop(self):
+        return self.requested
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self):
+        while not self._stop.wait(self._poll):
+            if self.requested:
+                return
+            try:
+                if not all(self._q.is_terminal(rid)
+                           for rid in self._member_ids):
+                    continue
+            except Exception:  # noqa: BLE001 — the watch is advisory;
+                continue       # queue I/O trouble must not kill the batch
+            self.requested = True
+            try:
+                self._logger.log("packing", kind="cancel_stop",
+                                 requests=self._member_ids,
+                                 worker=self._worker)
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+            proc = self._proc
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+            return
+
+
 class _LeaseHeartbeat:
     """Renews a batch's leases every ``lease_s / 3`` seconds while the
     supervised fit runs; a lost lease (reclaimed by another worker after an
@@ -703,7 +782,8 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                   lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
                   env=None, python=None,
                   max_attempts=DEFAULT_MAX_ATTEMPTS, n_devices=1,
-                  predictive=None, preempt_monitor=None):
+                  predictive=None, preempt_monitor=None, slot=None,
+                  cancel_watch=None):
     """Run one claimed batch under the crash-loop supervisor and settle its
     requests (containment discipline — see the module docstring); returns
     the :class:`~redcliff_tpu.runtime.supervisor.SuperviseOutcome`.
@@ -712,9 +792,16 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
     deadline-aware preemption monitor; ``preempt_monitor`` injects a
     pre-built monitor (tests).
 
+    ``slot`` (``{"lo", "width"}``, ISSUE 18): the sub-mesh device interval
+    a PACKED worker assigned this batch — recorded in batch.json so the
+    supervised child meshes over exactly those devices and a reclaim
+    resumes in the SAME slot; ``cancel_watch`` arms the gang-scheduling
+    cancel hook (:class:`_CancelWatch`).
+
     The batch runs under its TRACE CONTEXT (batch id + each member's
-    submit-minted trace id): set process-wide for the worker's own spans
-    and fleet events, exported into the supervised run_batch child via
+    submit-minted trace id): set process-wide (thread-scoped inside a
+    packed worker's gang threads) for the worker's own spans and fleet
+    events, exported into the supervised run_batch child via
     ``REDCLIFF_TRACE_CTX`` (so every record the jax child writes carries
     the same join keys), and scoped — restored on every exit path."""
     ctx = _trace_context(batch["batch_id"], members)
@@ -726,7 +813,8 @@ def run_one_batch(q, batch, leases, members, logger, worker_id,
                               supervisor_policy=supervisor_policy, env=env,
                               python=python, max_attempts=max_attempts,
                               n_devices=n_devices, predictive=predictive,
-                              preempt_monitor=preempt_monitor)
+                              preempt_monitor=preempt_monitor, slot=slot,
+                              cancel_watch=cancel_watch)
     finally:
         _spans.set_trace_ctx(prev_ctx)
 
@@ -735,7 +823,8 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
                    lease_s=60.0, checkpoint_every=1, supervisor_policy=None,
                    env=None, python=None,
                    max_attempts=DEFAULT_MAX_ATTEMPTS, n_devices=1,
-                   predictive=None, preempt_monitor=None):
+                   predictive=None, preempt_monitor=None, slot=None,
+                   cancel_watch=None):
     batch_id = batch["batch_id"]
     run_dir = q.batch_dir(batch_id)
     os.makedirs(run_dir, exist_ok=True)
@@ -749,10 +838,14 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
             # g_bucket: the planner-ADMITTED width (deterministic from the
             # composition, so a reclaiming worker rebuilds it identically);
             # run_batch exports it as the predictive policy's widening
-            # ceiling — the HBM admission gate priced THIS width
+            # ceiling — the HBM admission gate priced THIS width. slot:
+            # the packed worker's sub-mesh assignment — durable here (not
+            # in the lease) so a SIGKILLed packing resumes every batch in
+            # its ORIGINAL slot
             json.dump({"batch_id": batch_id, "run_dir": run_dir,
                        "checkpoint_every": int(checkpoint_every),
                        "g_bucket": batch.get("g_bucket"),
+                       "slot": slot,
                        "requests": members}, f, allow_nan=False)
             f.flush()
             os.fsync(f.fileno())
@@ -770,7 +863,7 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
                tenants=batch["tenants"], n_points=batch["n_points"],
                g_bucket=batch["g_bucket"], eta_s=batch.get("eta_s"),
                predicted_bytes=batch.get("predicted_bytes"),
-               worker=worker_id)
+               slot=slot, worker=worker_id)
     cmd = [python or sys.executable, "-m", "redcliff_tpu.fleet.run_batch",
            batch_file]
     # the trace context crosses the process boundary as env: the jax child
@@ -789,15 +882,24 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
         monitor = _PreemptMonitor(q, batch, members, run_dir, logger,
                                   worker_id, n_devices=n_devices,
                                   now=started_at)
+    # supervise() hook composition: the preempt monitor and the packed
+    # cancel watch each SIGTERM the child themselves; either one asking is
+    # a stop, not a restart
+    hooks = [h for h in (monitor, cancel_watch) if h is not None]
+    on_spawn = ((lambda proc: [h.on_spawn(proc) for h in hooks])
+                if hooks else None)
+    should_stop = ((lambda: any(h.should_stop() for h in hooks))
+                   if hooks else None)
     with _LeaseHeartbeat(leases, lease_s, logger) as hb, \
-            (monitor if monitor is not None else contextlib.nullcontext()):
+            (monitor if monitor is not None else contextlib.nullcontext()), \
+            (cancel_watch if cancel_watch is not None
+             else contextlib.nullcontext()):
         outcome = supervise(
             cmd, ledger_path=ledger_path,
             policy=supervisor_policy or SupervisorPolicy(max_restarts=2),
             env=child_env,
-            on_spawn=monitor.on_spawn if monitor is not None else None,
-            should_stop=monitor.should_stop if monitor is not None
-            else None)
+            on_spawn=on_spawn,
+            should_stop=should_stop)
     dur_ms = (time.perf_counter() - t0) * 1e3
     record_span("fleet.batch", dur_ms, component="fleet", logger=logger,
                 emit=True, batch_id=batch_id,
@@ -869,6 +971,18 @@ def _run_one_batch(q, batch, leases, members, logger, worker_id, trace_ctx,
             logger.log("fleet", kind="complete", batch_id=batch_id,
                        requests=[rid], tenants=[str(rec.get("tenant"))],
                        worker=worker_id)
+    elif cancel_watch is not None and cancel_watch.requested:
+        # packed-slot cancellation settle (ISSUE 18 satellite): the child
+        # was stopped because EVERY member is already terminal (canceled /
+        # settled elsewhere — their tombstones are the verdict). Nothing to
+        # charge, nothing to pin: release whatever leases are still ours
+        # and let the gang loop re-offer the freed slot to the queue
+        for rid, lease in live:
+            lease.release()
+            settled["released"].append(rid)
+        logger.log("packing", kind="slot_canceled", batch_id=batch_id,
+                   requests=[rid for rid, _ in live], slot=slot,
+                   worker=worker_id)
     elif monitor is not None and monitor.requested:
         # deadline-aware preemption settle (ISSUE 15): the batch stopped
         # because THIS worker asked it to yield — whatever the exact exit
@@ -1056,7 +1170,7 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
          drain=False, once=False, n_devices=1, budget_bytes=None,
          max_bucket=_planner.DEFAULT_MAX_BUCKET, checkpoint_every=1,
          supervisor_policy=None, env=None, python=None,
-         max_attempts=DEFAULT_MAX_ATTEMPTS, predictive=None):
+         max_attempts=DEFAULT_MAX_ATTEMPTS, predictive=None, packing=None):
     """The worker loop; returns the number of batches run.
 
     ``drain``: exit once the queue holds no claimable or running work.
@@ -1066,11 +1180,34 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
     container). ``max_attempts``: the per-request retry budget (failure
     attempts before a request is dead-lettered). ``predictive`` (None =
     the ``REDCLIFF_PREDICTIVE`` env gate) arms the cold-compile claim
-    ordering and the deadline-aware preemption monitor (ISSUE 15)."""
+    ordering and the deadline-aware preemption monitor (ISSUE 15).
+
+    ``packing`` (ISSUE 18, None = the ``REDCLIFF_FLEET_PACKING`` env gate;
+    True = ``"force"``, or a mode string): spatial multi-tenant mesh
+    packing — with 2+ devices the worker gang-schedules CONCURRENT batches
+    on disjoint sub-mesh slots (:func:`_work_packed`). ``"auto"`` packs
+    only when the planner's priced makespan beats serial (empty cost store
+    = the serial loop, bit-identical); ``"force"`` always packs."""
     q = FleetQueue(root)
     worker_id = worker_id or default_worker_id()
     predictive = (predictive_enabled() if predictive is None
                   else bool(predictive))
+    if packing is None:
+        pack_mode = _packing.packing_mode()
+    elif isinstance(packing, str):
+        pack_mode = _packing.packing_mode(env=packing)
+    else:
+        pack_mode = "force" if packing else "off"
+    if pack_mode != "off" and int(n_devices or 1) >= 2:
+        return _work_packed(q, worker_id=worker_id, lease_s=lease_s,
+                            poll_s=poll_s, max_batches=max_batches,
+                            drain=drain, once=once, n_devices=n_devices,
+                            budget_bytes=budget_bytes,
+                            max_bucket=max_bucket,
+                            checkpoint_every=checkpoint_every,
+                            supervisor_policy=supervisor_policy, env=env,
+                            python=python, max_attempts=max_attempts,
+                            predictive=predictive, mode=pack_mode)
     batches_run = 0
     with _logger(root) as logger:
         logger.log("fleet", kind="worker_start", worker=worker_id,
@@ -1128,6 +1265,246 @@ def work(root, worker_id=None, lease_s=60.0, poll_s=2.0, max_batches=None,
             except Exception:  # noqa: BLE001 — same: the crash record is
                 pass           # best-effort, the original exception wins
             raise
+        logger.log("fleet", kind="worker_stop", worker=worker_id,
+                   batches=batches_run)
+    return batches_run
+
+
+def _recorded_slot(q, batch_id):
+    """The sub-mesh slot a batch's durable batch.json recorded, or None —
+    the reclaim path's slot pin: a resumed packing lands every batch back
+    in its original slot."""
+    try:
+        with open(os.path.join(q.batch_dir(batch_id), "batch.json")) as f:
+            slot = (json.load(f) or {}).get("slot")
+    except (OSError, ValueError):
+        return None
+    if isinstance(slot, dict) and isinstance(slot.get("lo"), int) \
+            and isinstance(slot.get("width"), int):
+        return {"lo": slot["lo"], "width": slot["width"]}
+    return None
+
+
+def _work_packed(q, worker_id, lease_s, poll_s, max_batches, drain, once,
+                 n_devices, budget_bytes, max_bucket, checkpoint_every,
+                 supervisor_policy, env, python, max_attempts, predictive,
+                 mode):
+    """The spatial-packing worker loop (ISSUE 18 tentpole): gang-schedule
+    concurrent batches on disjoint sub-mesh slots of one device pool.
+
+    Claims happen only in THIS thread (the planner/queue protocol is
+    untouched); each claimed batch then runs :func:`run_one_batch` in its
+    own gang thread — a separate supervised jax child on its own slot's
+    devices, with its own lease heartbeat, preempt monitor, and cancel
+    watch. Slot claims/frees happen only between supervised runs — i.e. at
+    batch boundaries, which are check-window boundaries for the fits
+    (checkpoint cadence) — so PR-15 preemption and PR-5 compaction compose
+    without new synchronization. A freed slot is re-offered to the queue on
+    the next claim poll.
+
+    Co-residency discipline: the planner is consulted with the FREE slot
+    width as its pool and the REMAINING HBM budget (pool budget minus live
+    co-tenants' ``predicted_bytes``) as its gate, so an admitted batch
+    satisfies the headroom model by construction — zero headroom
+    violations. A running batch with no memory evidence blocks
+    co-scheduling entirely while a budget is set (conservative, mirroring
+    ``check_headroom``'s explicit-None degradation). In ``auto`` mode
+    co-scheduling additionally requires the plan's priced packing verdict
+    (``decision == "packed"``); an empty cost store prices nothing, so the
+    loop degrades to one-batch-at-a-time — bit-identical to the serial
+    worker's claims."""
+    batches_run = 0
+    slots = _packing.SlotTable(n_devices)
+    tenant_slots = _planner.tenant_slot_quota()
+    running = {}  # batch_id -> {"thread", "slot", "batch", "leases"}
+    co_ok = (mode == "force")
+    wave_started = False
+    last_pub = None
+    last_decision = None
+
+    with _logger(q.root) as logger:
+        logger.log("fleet", kind="worker_start", worker=worker_id,
+                   n_devices=n_devices, budget_bytes=budget_bytes,
+                   lease_s=lease_s, packing=mode)
+
+        def publish():
+            nonlocal last_pub
+            occ = slots.occupancy()
+            sig = (tuple((s["lo"], s["width"]) for s in occ["slots"]),
+                   len(running))
+            if sig == last_pub:
+                return
+            last_pub = sig
+            try:
+                _packing.publish_state(q.root, occ,
+                                       concurrent_batches=len(running))
+            except OSError:
+                pass
+
+        def reap():
+            nonlocal batches_run
+            for bid in list(running):
+                st = running[bid]
+                if st["thread"].is_alive():
+                    continue
+                st["thread"].join()
+                slots.free(st["slot"])
+                logger.log("packing", kind="slot_free", batch_id=bid,
+                           slot=st["slot"], worker=worker_id)
+                del running[bid]
+                batches_run += 1
+
+        def inflight_tenants():
+            out = {}
+            for st in running.values():
+                for t in st["batch"].get("tenants") or ():
+                    out[t] = out.get(t, 0) + 1
+            return out
+
+        def launch(batch, leases, members, slot):
+            cw = _CancelWatch(q, members, logger, worker_id)
+
+            def _target():
+                try:
+                    run_one_batch(
+                        q, batch, leases, members, logger, worker_id,
+                        lease_s=lease_s, checkpoint_every=checkpoint_every,
+                        supervisor_policy=supervisor_policy, env=env,
+                        python=python, max_attempts=max_attempts,
+                        n_devices=slot["width"], predictive=predictive,
+                        slot=slot, cancel_watch=cw)
+                except Exception as e:  # noqa: BLE001 — a gang thread must
+                    # never die silently: record the crash and release the
+                    # leases so the composition is reclaimable (same story
+                    # as a worker process death, minus the wait for expiry)
+                    try:
+                        logger.log("fleet", kind="worker_crash",
+                                   worker=worker_id,
+                                   error=f"{type(e).__name__}: {e}",
+                                   batches=batches_run)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    for lease in leases.values():
+                        try:
+                            lease.release()
+                        except Exception:  # noqa: BLE001 — lost/settled
+                            pass
+
+            t = threading.Thread(target=_target, daemon=True,
+                                 name=f"fleet-gang-{batch['batch_id']}")
+            running[batch["batch_id"]] = {"thread": t, "slot": slot,
+                                          "batch": batch, "leases": leases}
+            logger.log("packing", kind="slot_claim",
+                       batch_id=batch["batch_id"], slot=slot,
+                       requests=batch["requests"],
+                       tenants=batch.get("tenants"),
+                       predicted_bytes=batch.get("predicted_bytes"),
+                       worker=worker_id)
+            t.start()
+
+        try:
+            while True:
+                reap()
+                publish()
+                free = slots.free_widths()
+                cap_left = (max_batches is None
+                            or batches_run + len(running) < max_batches)
+                may_claim = (cap_left and bool(free)
+                             and (not running or co_ok)
+                             and not (once and wave_started
+                                      and not running))
+                claimed = False
+                if may_claim:
+                    eff_dev = free[0]
+                    used = [st["batch"].get("predicted_bytes")
+                            for st in running.values()]
+                    if budget_bytes is None:
+                        eff_budget = None
+                    elif any(u is None for u in used):
+                        eff_budget = 0  # no evidence: never co-resident
+                    else:
+                        eff_budget = budget_bytes - sum(used)
+                    if eff_budget is None or eff_budget > 0:
+                        plan_out = {}
+                        got = _next_batch(
+                            q, worker_id, lease_s, eff_dev, eff_budget,
+                            max_bucket, logger, predictive=predictive,
+                            tenant_slots=tenant_slots,
+                            inflight_slots=inflight_tenants(),
+                            plan_out=plan_out)
+                        pk = plan_out.get("packing")
+                        if pk is not None:
+                            if mode == "auto":
+                                co_ok = (pk.get("decision") == "packed")
+                            dec = {k: pk.get(k) for k in
+                                   ("decision", "reason", "makespan_s",
+                                    "serial_s", "makespan_ratio",
+                                    "n_devices", "pool",
+                                    "headroom_violations")}
+                            if dec != last_decision:
+                                last_decision = dec
+                                logger.log("packing", kind="plan",
+                                           worker=worker_id, **dec)
+                        if got is not None:
+                            batch, leases, members = got
+                            slot = None
+                            recorded = _recorded_slot(q, batch["batch_id"])
+                            if recorded is not None:
+                                if slots.reserve(recorded):
+                                    slot = recorded
+                                else:
+                                    # reclaim whose ORIGINAL slot is still
+                                    # occupied: wait for it (release the
+                                    # claims — zero-charge, the reclaim
+                                    # attempt is already on the ledger)
+                                    logger.log(
+                                        "packing", kind="slot_wait",
+                                        batch_id=batch["batch_id"],
+                                        slot=recorded, worker=worker_id)
+                            else:
+                                slot = slots.alloc(_packing.devices_for(
+                                    batch.get("g_bucket"), eff_dev))
+                            if slot is None:
+                                for lease in leases.values():
+                                    try:
+                                        lease.release()
+                                    except Exception:  # noqa: BLE001
+                                        pass
+                            else:
+                                wave_started = True
+                                claimed = True
+                                launch(batch, leases, members, slot)
+                if claimed:
+                    continue  # greedily fill remaining slots this poll
+                if running:
+                    time.sleep(min(poll_s, 0.2))
+                    continue
+                if once and wave_started:
+                    break
+                if max_batches is not None and batches_run >= max_batches:
+                    break
+                if once:
+                    break
+                if drain and not q.live_leases():
+                    break
+                time.sleep(poll_s)
+        except Exception as e:
+            path = None
+            try:
+                path = _flight.dump(str(q.root), "worker_crash", extra={
+                    "worker": worker_id,
+                    "error": f"{type(e).__name__}: {e}"})
+            except Exception:  # noqa: BLE001 — the dump must not mask
+                pass           # the original crash
+            try:
+                logger.log("fleet", kind="worker_crash", worker=worker_id,
+                           error=f"{type(e).__name__}: {e}",
+                           flight_record=path, batches=batches_run)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        finally:
+            publish()
         logger.log("fleet", kind="worker_stop", worker=worker_id,
                    batches=batches_run)
     return batches_run

@@ -186,6 +186,16 @@ FAMILIES = [
     # reject_eta_err_pct tracks the backpressure gate's reject-with-ETA
     # accuracy (|predicted wait - observed drain| as % of observed): a
     # creeping error means tenants are told wrong retry times
+    # spatial mesh packing (ISSUE 18, parallel/packing.py + the worker's
+    # gang loop): packed/serial wall-clock of two heterogeneous batches on
+    # a simulated 4-device pool — the contract_max flags any round where
+    # co-residency stops beating serial outright — and the busy
+    # device-seconds pool utilization the packer achieved. Both legs run
+    # real tiny drains, so the timing band forgives process-spawn jitter.
+    Family("packing.makespan_ratio", better="lower", band=_BAND_TIMING,
+           g_dependent=False, contract_max=1.0),
+    Family("packing.utilization_pct", band=_BAND_TIMING,
+           g_dependent=False),
     Family("autoscale.breach_to_recovery_s", better="lower",
            band=_BAND_TIMING, abs_floor=30.0, g_dependent=False),
     Family("autoscale.reject_eta_err_pct", better="lower",

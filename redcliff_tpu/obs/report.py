@@ -142,6 +142,9 @@ def build_report(run_dir):
     cur = None            # current fit context: {"shape_key", "shape", ...}
     manifest = {}         # request_id -> {tenant, start, stop} (fleet runs)
     fleet_kind_counts = {}  # fleet-event lifecycle counts (fleet roots)
+    packing_counts = {}     # packing-event kind tallies (ISSUE 18)
+    packing_last_plan = None  # newest priced packed-vs-serial verdict
+    partial_streamed = partial_final = 0  # partial_result rows seen
     autoscale_counts = {}   # autoscale decision-kind counts (ISSUE 16)
     last_autoscale = None
     qos_last = {}           # tenant -> newest qos demote/restore event
@@ -262,6 +265,19 @@ def build_report(run_dir):
                                                  "snapshot": None})
                 qv["windows"] += 1
                 qv["last"] = rec
+        elif ev == "packing":
+            # spatial mesh packing (ISSUE 18): slot-lifecycle tallies +
+            # the newest priced packed-vs-serial verdict
+            kind = str(rec.get("kind"))
+            packing_counts[kind] = packing_counts.get(kind, 0) + 1
+            if kind == "plan":
+                packing_last_plan = rec
+        elif ev == "partial_result":
+            # per-point result streaming (ISSUE 18): at-least-once rows,
+            # so tally final vs streaming separately (a resumed batch may
+            # re-stream a point; consumers keep the last row per point)
+            partial_streamed += 1
+            partial_final += bool(rec.get("final"))
         elif ev == "fleet":
             # tenant manifest (fleet/run_batch.py): request id -> merged
             # point range; restart attempts re-log it, latest wins
@@ -661,6 +677,41 @@ def build_report(run_dir):
                 },
             }
 
+    # spatial-packing section (ISSUE 18): slot-lifecycle tallies, the
+    # newest priced verdict, and the partial-result streaming progress.
+    # None on run dirs/roots that never packed or streamed.
+    fleet_packing = None
+    if not partial_streamed:
+        # fleet ROOT: partial_result events live in each batch's RUN-DIR
+        # chain, not here — count the durable stream files instead (the
+        # same at-least-once contract `fleet status` / `obs watch` read)
+        for pf in sorted(glob.glob(os.path.join(
+                run_dir, "work", "*", "results", "*.partial.jsonl")))[:256]:
+            try:
+                with open(pf, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        if not line.strip():
+                            continue
+                        partial_streamed += 1
+                        try:
+                            partial_final += bool(
+                                json.loads(line).get("final"))
+                        except ValueError:
+                            pass
+            except OSError:
+                continue
+    if packing_counts or partial_streamed:
+        fleet_packing = {
+            "events": {k: packing_counts[k] for k in sorted(packing_counts)},
+            "last_plan": ({k: packing_last_plan.get(k) for k in
+                           ("decision", "reason", "makespan_s", "serial_s",
+                            "makespan_ratio", "n_devices", "pool",
+                            "headroom_violations")}
+                          if packing_last_plan else None),
+            "partial_results": {"streamed": int(partial_streamed),
+                                "final": int(partial_final)},
+        }
+
     # streaming-inference section (ISSUE 17): the serve plane's cumulative
     # counters + latency SLO view (obs/slo.py compute_serve_slo over the
     # run's `serve` events, REDCLIFF_SLO_SERVE_* breach flags) and the
@@ -734,6 +785,7 @@ def build_report(run_dir):
         "fleet_containment": containment,
         "fleet_slo": fleet_slo,
         "fleet_autoscale": fleet_autoscale,
+        "fleet_packing": fleet_packing,
         "serve": serve_section,
         "quality": quality_section,
         "memory": memory_section,
@@ -944,6 +996,28 @@ def render_text(report):
                        + (f", last [{last.get('tenant')}] eta "
                           f"{last.get('eta_s')}s vs slo "
                           f"{last.get('threshold_s')}s" if last else ""))
+    fp = r.get("fleet_packing")
+    if fp:
+        out.append("fleet packing (spatial multi-tenant mesh packing, "
+                   "parallel/packing.py; docs/ARCHITECTURE.md 'Spatial "
+                   "mesh packing & gang scheduling'):")
+        if fp.get("events"):
+            out.append("  events: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(fp["events"].items())))
+        lp_ = fp.get("last_plan")
+        if lp_:
+            ratio = lp_.get("makespan_ratio")
+            out.append(
+                f"  last plan: {lp_.get('decision')} ({lp_.get('reason')})"
+                + (f", makespan ratio {ratio:.3f}"
+                   if isinstance(ratio, (int, float)) else "")
+                + f", pool {lp_.get('pool')}/{lp_.get('n_devices')} "
+                  f"device(s), headroom violations "
+                  f"{lp_.get('headroom_violations', 0)}")
+        pr = fp.get("partial_results") or {}
+        if pr.get("streamed"):
+            out.append(f"  partial results: {pr['streamed']} row(s) "
+                       f"streamed, {pr['final']} final")
     sv = r.get("serve")
     if sv:
         out.append("serve (streaming inference service, "

@@ -303,7 +303,7 @@ EVENTS = {
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
                   "memory", "fleet", "quality", "policy", "preempt",
-                  "serve")),
+                  "serve", "packing")),
     "fleet": _ev(
         "fleet sweep service (redcliff_tpu/fleet: submit CLI, planner, "
         "worker loop, run_batch driver, containment layer; kind=submit | "
@@ -324,7 +324,34 @@ EVENTS = {
                   "deadlettered", "bisected", "max_attempts", "preempted",
                   # worker_crash (ISSUE 12): the uncaught-exception record
                   # + the flight-record artifact dumped before exit
-                  "flight_record")),
+                  "flight_record",
+                  # spatial packing fields (ISSUE 18): the sub-mesh slot a
+                  # batch ran on, the plan's priced packed-vs-serial
+                  # verdict, and the fair-share deferrals the planner made
+                  "slot", "packing", "quota_deferred")),
+    "packing": _ev(
+        "fleet spatial mesh packing (ISSUE 18, fleet/worker.py gang loop "
+        "over parallel/packing.py's slot table; kind=plan — the priced "
+        "packed-vs-serial verdict for the current queue; kind=slot_claim "
+        "| slot_free — a sub-mesh slot occupied/returned at a "
+        "check-window boundary; kind=slot_wait — a reclaim whose recorded "
+        "slot is still busy; kind=cancel_stop — the cancel watch SIGTERMed "
+        "a batch whose every member went terminal; kind=slot_canceled — "
+        "that batch settled with its slot freed and no requeue)",
+        required=("kind",),
+        optional=("batch_id", "slot", "requests", "tenants",
+                  "predicted_bytes", "worker", "decision", "reason",
+                  "makespan_s", "serial_s", "makespan_ratio", "n_devices",
+                  "pool", "headroom_violations")),
+    "partial_result": _ev(
+        "fleet per-point result streaming (ISSUE 18, fleet/run_batch.py — "
+        "one line per grid point appended to results/<id>.partial.jsonl "
+        "as lanes retire at check windows; final=True rows are the "
+        "settle-time completion sweep, at-least-once so consumers keep "
+        "the last row per point)",
+        required=("request_id", "batch_id", "point", "final"),
+        optional=("tenant", "merged_point", "epoch", "best_criterion",
+                  "best_epoch", "failed")),
     "fleet_lifecycle": _ev(
         "fleet history ledger (fleet/history.py — the durable per-request "
         "lifecycle transitions obs/slo.py and the fleet trace export join; "
@@ -487,6 +514,9 @@ def validate_records(records, kind="metrics"):
 # serialize what it observes.
 NO_JAX_MODULES = ("obs/spans.py", "obs/flight.py", "obs/trace_export.py",
                   "obs/slo.py",
+                  # spatial packing (ISSUE 18): the slot table and the
+                  # packed-vs-serial pricer run inside the worker loop
+                  "parallel/packing.py",
                   "fleet/queue.py", "fleet/planner.py", "fleet/worker.py",
                   "fleet/chaos.py", "fleet/__main__.py",
                   "fleet/history.py", "fleet/autoscale.py",

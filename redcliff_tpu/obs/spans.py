@@ -98,17 +98,27 @@ _trace_ctx = _ctx_from_env()
 
 
 def trace_ctx():
-    """The live trace context dict, or None (one attribute read)."""
-    return _trace_ctx
+    """The live trace context dict, or None: a thread-scoped context (a
+    packed fleet worker's gang threads each bracket their own batch —
+    ISSUE 18) wins over the process-wide one."""
+    return getattr(_tls, "trace_ctx", None) or _trace_ctx
 
 
 def set_trace_ctx(ctx):
-    """Set (or clear, with None) the process-wide trace context; returns
-    the PREVIOUS context so callers can scope it (the fleet worker brackets
-    each batch)."""
+    """Set (or clear, with None) the trace context; returns the PREVIOUS
+    context so callers can scope it (the fleet worker brackets each batch).
+    From the main thread this is the process-wide context (unchanged
+    pre-packing behavior); from any other thread it is a THREAD-scoped
+    override — concurrent gang-scheduled batches must never stamp each
+    other's spans with the wrong batch id."""
     global _trace_ctx
-    prev = _trace_ctx
-    _trace_ctx = ctx if isinstance(ctx, dict) and ctx else None
+    ctx = ctx if isinstance(ctx, dict) and ctx else None
+    if threading.current_thread() is threading.main_thread():
+        prev = _trace_ctx
+        _trace_ctx = ctx
+        return prev
+    prev = getattr(_tls, "trace_ctx", None)
+    _tls.trace_ctx = ctx
     return prev
 
 

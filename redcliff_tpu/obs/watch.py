@@ -212,6 +212,11 @@ def build_snapshot(run_dir, now=None):
     last_serve = None        # newest serve-plane event (ISSUE 17)
     serve_counts = {}        # newest non-None value per serve counter
     serve_quarantines = 0    # session quarantine verdicts seen
+    last_pack_plan = None    # newest packing kind=plan verdict (ISSUE 18)
+    last_pack_event = None   # newest packing event of any kind
+    pack_claims = pack_frees = 0  # slot lifecycle counters
+    partial_points = 0       # partial_result rows streamed so far
+    last_partial = None      # newest partial_result row
     anomalies = rollbacks = aborts = 0
     last_span_by_component = {}
     last_wall = last_epoch_wall = None
@@ -286,6 +291,19 @@ def build_snapshot(run_dir, now=None):
             w = rec.get("worker")
             if w and isinstance(wt, (int, float)):
                 fleet_workers[str(w)] = wt
+        elif ev == "packing":
+            # spatial mesh packing (ISSUE 18): the newest priced
+            # packed-vs-serial verdict + slot lifecycle counters become
+            # the `packing:` headline
+            last_pack_event = rec
+            kind = rec.get("kind")
+            if kind == "plan":
+                last_pack_plan = rec
+            pack_claims += kind == "slot_claim"
+            pack_frees += kind == "slot_free"
+        elif ev == "partial_result":
+            partial_points += 1
+            last_partial = rec
         elif ev == "autoscale":
             # the SLO-driven control loop's decision stream (ISSUE 16):
             # the newest decision becomes the fleet section's headline
@@ -437,6 +455,50 @@ def build_snapshot(run_dir, now=None):
     # from the authoritative file queue, live in-flight claims from the
     # lease files, and the planner's newest packing decision from the
     # rotation-chain-tailed `fleet` events above
+    # spatial-packing section (ISSUE 18): the worker-published occupancy
+    # state file is authoritative (it outlives the metrics tail); the
+    # tailed packing/partial_result events supply the newest verdict and
+    # streaming progress. None (section omitted) on roots that never packed
+    packing_sec = None
+    pack_state = None
+    if is_fleet_root(run_dir):
+        from redcliff_tpu.parallel import packing as _fpacking
+        pack_state = _fpacking.load_state(run_dir, now=now)
+        # partial_result rows stream into the per-batch run-dir chains,
+        # not the root chain — count the durable partial files instead
+        # (bounded: tiny one-line-per-point files, capped at 256)
+        import glob as _glob
+        for path in _glob.glob(os.path.join(
+                run_dir, "work", "*", "results",
+                "*.partial.jsonl"))[:256]:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    partial_points += sum(1 for _ in fh)
+            except OSError:
+                continue
+    if (pack_state is not None or last_pack_event is not None
+            or partial_points):
+        packing_sec = {
+            "state": pack_state,
+            "slot_claims": pack_claims,
+            "slot_frees": pack_frees,
+            "partial_points": partial_points,
+            "last_partial": ({k: last_partial.get(k) for k in
+                              ("request_id", "batch_id", "point", "epoch",
+                               "final")}
+                             if last_partial else None),
+            "last_plan": ({k: last_pack_plan.get(k) for k in
+                           ("decision", "reason", "makespan_ratio",
+                            "makespan_s", "serial_s", "n_devices", "pool",
+                            "headroom_violations")}
+                          if last_pack_plan else None),
+            "last_event": ({k: last_pack_event.get(k) for k in
+                            ("kind", "batch_id", "slot", "worker")}
+                           if last_pack_event else None),
+        }
+        pwt = (last_pack_event or {}).get("wall_time")
+        packing_sec["age_s"] = (round(now - pwt, 3)
+                                if isinstance(pwt, (int, float)) else None)
     fleet = None
     if is_fleet_root(run_dir):
         fleet = _fleet_section(
@@ -462,6 +524,7 @@ def build_snapshot(run_dir, now=None):
         "policy": policy,
         "preempt": preempt,
         "serve": serve,
+        "packing": packing_sec,
         "heartbeats": heartbeats,
         "incidents": incidents,
         "attempts": {"n": len(attempts),
@@ -726,6 +789,38 @@ def render_text(snap):
                        f"{_fmt_age(last.get('eta_s'))} vs slo "
                        f"{_fmt_age(last.get('threshold_s'))}"
                        if last else ""))
+    pk = snap.get("packing")
+    if pk:
+        st_p = pk.get("state") or {}
+        lp_p = pk.get("last_plan") or {}
+        out.append(
+            "  packing: "
+            + (f"{st_p.get('busy_devices', 0)}/{st_p.get('pool', '?')} "
+               f"device(s) busy, {st_p.get('concurrent_batches', 0)} "
+               f"co-resident, util {st_p.get('utilization_pct', 0)}%"
+               if st_p else "no live occupancy state")
+            + f" | {pk.get('slot_claims', 0)} claim(s) / "
+              f"{pk.get('slot_frees', 0)} free(s)"
+            + (f" ({_fmt_age(pk['age_s'])} old)"
+               if pk.get("age_s") is not None else ""))
+        if lp_p:
+            ratio = lp_p.get("makespan_ratio")
+            out.append(
+                f"    last packing plan: {lp_p.get('decision')} "
+                f"({lp_p.get('reason')})"
+                + (f", makespan ratio {ratio:.3f}"
+                   if isinstance(ratio, (int, float)) else "")
+                + f", headroom violations "
+                  f"{lp_p.get('headroom_violations', 0)}")
+        if pk.get("partial_points"):
+            last_pr = pk.get("last_partial") or {}
+            out.append(
+                f"    partial results: {pk['partial_points']} point(s) "
+                f"streamed"
+                + (f", last {last_pr.get('request_id')}#"
+                   f"{last_pr.get('point')} epoch {last_pr.get('epoch')}"
+                   + (" (final)" if last_pr.get("final") else "")
+                   if last_pr else ""))
     sv = snap.get("serve")
     if sv:
         def _ms(v):

@@ -1074,10 +1074,21 @@ class RedcliffGridRunner:
     def fit(self, key, train_ds, val_ds, max_iter=None,
             log_dir=None, init_params=None, copy_init=True,
             checkpoint_dir=None, checkpoint_every=None,
-            true_gc=None) -> GridResult:
+            true_gc=None, on_lane_retire=None) -> GridResult:
         """checkpoint_dir + checkpoint_every enable periodic fit-state
         checkpoints; a fit pointed at a directory holding one resumes from
         it (bit-identically) instead of starting over.
+
+        ``on_lane_retire(point_id, record, epoch)`` — per-point result
+        streaming hook (ISSUE 18): called at a check-window boundary for
+        each lane the compaction ladder retires to the host store (its
+        state never changes again — early-stopped or quarantined), with
+        the retired record (``best_crit``/``best_epoch``/``failed_epoch``/
+        ``failed_cause``/``best_params``) and the retiring epoch. Called
+        only for lanes retired by THIS process (a resume does not replay
+        earlier attempts' retirements); exceptions are swallowed — the
+        hook is telemetry, decision streams and params are bit-identical
+        with or without it.
 
         Model-quality observatory (obs/quality.py, ``REDCLIFF_QUALITY``):
         at every check-window boundary a jit'd per-lane graph summary
@@ -1170,7 +1181,8 @@ class RedcliffGridRunner:
                                  checkpoint_dir=checkpoint_dir,
                                  checkpoint_every=checkpoint_every,
                                  guard=guard, writer=writer, wd=live_wd,
-                                 pw=pw, true_gc=true_gc)
+                                 pw=pw, true_gc=true_gc,
+                                 on_lane_retire=on_lane_retire)
             except (Preempted, DeadlineExceeded, remesh.HostLostError):
                 raise
             except Exception as e:
@@ -1189,7 +1201,8 @@ class RedcliffGridRunner:
              log_dir=None, init_params=None, copy_init=True,
              checkpoint_dir=None, checkpoint_every=None,
              guard=None, writer=None, wd=None,
-             pw=_profiling.NOOP, true_gc=None) -> GridResult:
+             pw=_profiling.NOOP, true_gc=None,
+             on_lane_retire=None) -> GridResult:
         tc = self.tc
         max_iter = max_iter if max_iter is not None else tc.max_iter
         rng = np.random.default_rng(tc.seed)
@@ -2217,6 +2230,18 @@ class RedcliffGridRunner:
                                 "failed_cause": int(
                                     frozen["failed_cause"][i]),
                             }
+                        if on_lane_retire is not None:
+                            # per-point streaming (ISSUE 18): a retired
+                            # lane's result is FINAL — surface it now, at
+                            # the check-window boundary, not at batch
+                            # settle. Advisory: a hook failure must never
+                            # perturb the fit
+                            for pid in plan.retire_ids:
+                                try:
+                                    on_lane_retire(int(pid),
+                                                   retired[int(pid)], it)
+                                except Exception:  # noqa: BLE001
+                                    pass
                     old_width = Gx
                     self.mesh = self._mesh_for(plan.new_width)
                     sel = jnp.asarray(plan.sel)

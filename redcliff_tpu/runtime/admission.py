@@ -17,14 +17,19 @@ taxonomy so the two planes raise the same types instead of drifting copies.
   which still re-exports it;
 * :class:`SlotsExhausted` — the serve plane's reject: every stream slot is
   leased; ``eta_s`` is the soonest lease expiry (the earliest moment a slot
-  could recycle if its subscriber goes silent).
+  could recycle if its subscriber goes silent);
+* :class:`TenantQuotaExceeded` — the fleet admission planner's fair-share
+  refusal (ISSUE 18): a tenant already holds its ``max_inflight_slots``
+  sub-mesh slots, so its next batch stays QUEUED (deferred, not dropped)
+  until one of its slots frees at a check-window boundary.
 
 stdlib only, no jax (obs/schema.py ``--check`` enforces it): admission
 decisions run in control processes that must never initialize a backend.
 """
 from __future__ import annotations
 
-__all__ = ["AdmissionReject", "BackpressureReject", "SlotsExhausted"]
+__all__ = ["AdmissionReject", "BackpressureReject", "SlotsExhausted",
+           "TenantQuotaExceeded"]
 
 
 class AdmissionReject(RuntimeError):
@@ -74,3 +79,23 @@ class SlotsExhausted(AdmissionReject):
             f"serve admission: all {self.capacity} stream slot(s) leased; "
             f"{eta} — retry then, or raise REDCLIFF_SERVE_SLOTS",
             eta_s=eta_s, reason="slots exhausted")
+
+
+class TenantQuotaExceeded(AdmissionReject):
+    """Fleet admission planner fair-share refusal: the tenant already holds
+    ``max_inflight_slots`` sub-mesh slots (in flight plus admitted earlier
+    in this plan cycle), so this batch is DEFERRED — it stays queued with
+    this structured reason (surfaced by ``fleet status``) and re-plans once
+    a slot frees. ``eta_s`` is the tenant's soonest predicted batch
+    completion when the cost model can price one, else None."""
+
+    def __init__(self, tenant, max_inflight_slots, inflight, eta_s=None):
+        self.tenant = str(tenant)
+        self.max_inflight_slots = int(max_inflight_slots)
+        self.inflight = int(inflight)
+        super().__init__(
+            f"tenant quota: {self.tenant!r} holds {self.inflight} of "
+            f"{self.max_inflight_slots} fair-share slot(s); batch deferred "
+            f"until one frees (REDCLIFF_FLEET_TENANT_SLOTS raises the "
+            f"quota)",
+            eta_s=eta_s, reason="tenant quota")

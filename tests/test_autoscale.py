@@ -186,14 +186,40 @@ def test_predicted_drain_empty_then_unpriced_backlog(tmp_path, monkeypatch):
     q = FleetQueue(root)
     empty = autoscale.predicted_drain(q, default_eta_s=10.0)
     assert empty == {"pending": 0, "batches": 0, "priced": 0,
-                     "unpriced": 0, "total_eta_s": 0.0}
+                     "unpriced": 0, "total_eta_s": 0.0,
+                     "packing_width": 1}
     chaos.submit_storm(root, 2, tenant="t", seed=3, spec=_tiny_spec())
     drain = autoscale.predicted_drain(q, cost_model=None,
                                       default_eta_s=10.0)
     # distinct data seeds -> two batches, both unpriced at the default ETA
     assert drain["pending"] == 2 and drain["batches"] == 2
     assert drain["unpriced"] == 2 and drain["priced"] == 0
-    assert drain["total_eta_s"] == 20.0
+    assert drain["total_eta_s"] == 20.0 and drain["packing_width"] == 1
+
+
+def test_predicted_drain_is_slot_aware(tmp_path, monkeypatch):
+    """ISSUE 18 satellite: a packed worker's published slot occupancy
+    divides the serial drain estimate, so the autoscaler stops
+    over-spawning workers once packing lands; a STALE publication falls
+    back to the serial estimate."""
+    from redcliff_tpu.parallel import packing
+
+    _clean_env(monkeypatch)
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    chaos.submit_storm(root, 2, tenant="t", seed=3, spec=_tiny_spec())
+    packing.publish_state(root, {"pool": 4, "busy_devices": 4},
+                          concurrent_batches=2)
+    drain = autoscale.predicted_drain(q, cost_model=None,
+                                      default_eta_s=10.0)
+    assert drain["packing_width"] == 2
+    assert drain["total_eta_s"] == 10.0  # 20s serial / 2 concurrent slots
+    # stale publication (dead packed worker): serial estimate again
+    packing.publish_state(root, {"pool": 4}, concurrent_batches=2,
+                          now=time.time() - 10 * packing.STATE_FRESH_S)
+    stale = autoscale.predicted_drain(q, cost_model=None,
+                                      default_eta_s=10.0)
+    assert stale["packing_width"] == 1 and stale["total_eta_s"] == 20.0
 
 
 def test_predict_queue_wait_uses_fresh_published_worker_count(

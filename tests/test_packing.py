@@ -1,0 +1,532 @@
+"""Spatial multi-tenant mesh packing tests (ISSUE 18).
+
+Slot-table units (power-of-two alignment, reserve/free lifecycle,
+occupancy), packed-vs-serial pricing (the empty-cost-store bit-identity
+contract), per-tenant fair-share quota deferral with structured reasons,
+and the gang-scheduling end-to-end ACCEPTANCE: two heterogeneous batches
+drain CO-RESIDENT on disjoint sub-mesh slots of a simulated 4-device pool
+with zero headroom violations; a poisoned co-tenant sharing the pool costs
+the healthy batch nothing (bit-identical to a solo run); a canceled
+co-tenant frees its slot at the next check window without perturbing the
+survivor; a SIGKILLed worker's packed batches are reclaimed into their
+ORIGINAL slots and resume from checkpoint.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from redcliff_tpu.fleet import chaos, planner
+from redcliff_tpu.fleet.queue import FleetQueue
+from redcliff_tpu.fleet.__main__ import TINY_SPEC
+from redcliff_tpu.obs import schema as obs_schema
+from redcliff_tpu.obs.logging import read_jsonl
+from redcliff_tpu.parallel import packing
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# slot-table units
+# ---------------------------------------------------------------------------
+def test_slot_table_alloc_is_aligned_first_fit():
+    st = packing.SlotTable(4)
+    a = st.alloc(2)
+    b = st.alloc(2)
+    assert a == {"lo": 0, "width": 2} and b == {"lo": 2, "width": 2}
+    assert st.alloc(1) is None and st.free_widths() == []
+    st.free(a)
+    assert st.free_widths() == [2, 1]
+    # alignment: a width-2 slot only starts at multiples of 2
+    c = st.alloc(2)
+    assert c == {"lo": 0, "width": 2}
+
+
+def test_slot_table_non_pow2_pool_uses_pow2_prefix():
+    st = packing.SlotTable(6)  # pool = largest power of two <= 6
+    assert st.pool == 4
+    occ = st.occupancy()
+    assert occ["n_devices"] == 6 and occ["pool"] == 4
+
+
+def test_slot_table_reserve_and_idempotent_free():
+    st = packing.SlotTable(8)
+    # reserve re-occupies an exact recorded slot (the reclaim path)
+    assert st.reserve({"lo": 2, "width": 2}) is True
+    assert st.alloc(8) is None
+    assert st.reserve({"lo": 2, "width": 2}) is False   # overlap
+    assert st.reserve({"lo": 5, "width": 2}) is False   # misaligned
+    assert st.reserve({"lo": 6, "width": 4}) is False   # out of range
+    st.free({"lo": 2, "width": 2})
+    st.free({"lo": 2, "width": 2})          # idempotent
+    assert st.free_widths()[0] == 8
+
+
+def test_slot_table_occupancy_utilization():
+    st = packing.SlotTable(4)
+    st.alloc(2)
+    st.alloc(1)
+    occ = st.occupancy()
+    assert occ["busy_devices"] == 3 and occ["free_devices"] == 1
+    assert occ["utilization_pct"] == 75.0
+    assert {(s["lo"], s["width"]) for s in occ["slots"]} == {(0, 2), (2, 1)}
+
+
+def test_packing_mode_env_parsing():
+    assert packing.packing_mode(env="") == "off"
+    assert packing.packing_mode(env="0") == "off"
+    assert packing.packing_mode(env="force") == "force"
+    assert packing.packing_mode(env="auto") == "auto"
+    assert packing.packing_mode(env="1") == "auto"
+    assert packing.devices_for(3, 8) == 3 or packing.devices_for(3, 8) >= 1
+
+
+# ---------------------------------------------------------------------------
+# packed-vs-serial pricing
+# ---------------------------------------------------------------------------
+def test_price_packing_unpriced_falls_back_serial():
+    """The empty-cost-store contract: any batch without a priced eta keeps
+    the decision 'serial' — the packed worker then claims one batch at a
+    time, bit-identical to the serial heuristic."""
+    batches = [{"batch_id": "a", "g_bucket": 1},
+               {"batch_id": "b", "g_bucket": 1}]
+    out = packing.price_packing(batches, 4, None)
+    assert out["decision"] == "serial" and out["reason"] == "unpriced"
+    assert out["headroom_violations"] == 0
+    # deterministic: the same inputs price identically (no wall-clock,
+    # no randomness inside the pricer)
+    assert out == packing.price_packing(
+        [dict(b) for b in batches], 4, None)
+
+
+def test_price_packing_priced_packs_and_respects_budget():
+    batches = [{"batch_id": "a", "g_bucket": 1, "eta_s": 10.0,
+                "predicted_bytes": 600},
+               {"batch_id": "b", "g_bucket": 1, "eta_s": 10.0,
+                "predicted_bytes": 600}]
+    packed = packing.price_packing(batches, 4, None)
+    assert packed["decision"] == "packed"
+    assert packed["makespan_ratio"] < 1.0
+    assert packed["headroom_violations"] == 0
+    # a budget that cannot hold both resident at once forces serial
+    tight = packing.price_packing(batches, 4, 1000)
+    assert tight["decision"] == "serial"
+    assert tight["headroom_violations"] == 0
+    starts = [a["start_s"] for a in tight["assignments"]]
+    assert len(set(starts)) == 2, "resident-bytes gate must serialize"
+
+
+def test_planner_plan_carries_packing_and_is_deterministic(tmp_path):
+    q = FleetQueue(tmp_path)
+    for t in ("a", "b"):
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["data"]["seed"] = ord(t)
+        q.submit(t, [{"gen_lr": 1e-3}], spec=spec)
+    reqs = q.pending()
+    p1 = planner.plan(reqs, n_devices=4)
+    p2 = planner.plan(reqs, n_devices=4)
+    assert len(p1["batches"]) == 2
+    # empty cost store: unpriced -> serial, and the admitted batch list is
+    # byte-for-byte the serial heuristic's (packing is an annotation, not
+    # a perturbation)
+    assert p1["packing"]["decision"] == "serial"
+    assert p1["packing"]["reason"] == "unpriced"
+    strip = lambda p: [{k: v for k, v in b.items()} for b in p["batches"]]
+    assert strip(p1) == strip(p2)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fair-share quotas
+# ---------------------------------------------------------------------------
+def test_tenant_slot_quota_parser():
+    assert planner.tenant_slot_quota(env=None) is None
+    assert planner.tenant_slot_quota(env="") is None
+    assert planner.tenant_slot_quota(env="2") == {"*": 2}
+    assert planner.tenant_slot_quota(env="a=1,b=4") == {"a": 1, "b": 4}
+    assert planner.tenant_slot_quota(env="2,a=1") == {"*": 2, "a": 1}
+    assert planner.tenant_slot_quota(env="garbage=") is None
+
+
+def test_plan_defers_over_quota_tenant_with_structured_reason(tmp_path):
+    q = FleetQueue(tmp_path)
+    for i, t in enumerate(("greedy", "greedy", "modest")):
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["data"]["seed"] = i  # distinct merge keys -> three batches
+        q.submit(t, [{"gen_lr": 1e-3}], spec=spec)
+    pl = planner.plan(q.pending(), n_devices=4,
+                      tenant_slots={"*": 1})
+    admitted = {b["tenants"][0] for b in pl["batches"]}
+    assert admitted == {"greedy", "modest"}
+    assert len(pl["quota_deferred"]) == 1
+    d = pl["quota_deferred"][0]
+    assert d["tenant"] == "greedy"
+    assert d["reason"] == "tenant quota"
+    assert d["max_inflight_slots"] == 1 and d["inflight"] == 1
+    assert "REDCLIFF_FLEET_TENANT_SLOTS" in d["detail"]
+    # already-running slots count against the quota too
+    pl2 = planner.plan(q.pending(), n_devices=4, tenant_slots={"*": 1},
+                       inflight_slots={"modest": 1})
+    assert {b["tenants"][0] for b in pl2["batches"]} == {"greedy"}
+    assert {d["tenant"] for d in pl2["quota_deferred"]} \
+        == {"greedy", "modest"}
+    # deferred is NOT unschedulable: nothing lands in the dead-end list
+    assert pl2["unschedulable"] == []
+
+
+# ---------------------------------------------------------------------------
+# gang-scheduling end-to-end
+# ---------------------------------------------------------------------------
+def _clean_fault_env():
+    env = dict(os.environ)
+    env.pop("REDCLIFF_FAULT_INJECT", None)
+    env.pop("REDCLIFF_FAULT_MARKER", None)
+    env.pop("REDCLIFF_FLEET_PACKING", None)
+    env.pop("REDCLIFF_FLEET_TENANT_SLOTS", None)
+    return env
+
+
+def _drain(root, packing_mode="force", **kw):
+    from redcliff_tpu.fleet.worker import work
+    from redcliff_tpu.runtime.retry import RetryPolicy
+    from redcliff_tpu.runtime.supervisor import SupervisorPolicy
+
+    kw.setdefault("env", _clean_fault_env())
+    kw.setdefault("max_attempts", 3)
+    policy = SupervisorPolicy(
+        max_restarts=kw.pop("max_restarts", 2),
+        backoff=RetryPolicy(max_attempts=100, base_delay_s=0.05,
+                            multiplier=1.0, max_delay_s=0.05))
+    return work(str(root), drain=True, poll_s=0.2, lease_s=30.0,
+                n_devices=4, supervisor_policy=policy,
+                packing=packing_mode, **kw)
+
+
+def _submit_two(q, epochs=1, points=None):
+    rids = []
+    for i in range(2):
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = epochs
+        spec["mesh"] = "auto"
+        spec["data"]["seed"] = i  # distinct merge keys -> two batches
+        rids.append(q.submit(
+            f"tenant{i}", (points[i] if points else [{"gen_lr":
+                                                      1e-3 * (i + 1)}]),
+            spec=spec))
+    return rids
+
+
+def _payload(result):
+    return {k: v for k, v in result.items()
+            if k not in ("request_id", "batch_id")}
+
+
+def _claim_spans(root):
+    """{batch_id: (claim_wall, free_wall, slot)} from the packing events."""
+    claims, frees = {}, {}
+    for r in read_jsonl(str(root)):
+        if r.get("event") != "packing":
+            continue
+        if r.get("kind") == "slot_claim":
+            claims[r["batch_id"]] = r
+        elif r.get("kind") == "slot_free":
+            frees[r["batch_id"]] = r
+    return {bid: (claims[bid]["wall_time"],
+                  frees[bid]["wall_time"] if bid in frees else None,
+                  claims[bid]["slot"])
+            for bid in claims}
+
+
+def test_packed_drain_two_batches_concurrently(tmp_path):
+    """The tentpole acceptance: two heterogeneous batches co-resident on
+    disjoint sub-mesh slots, gang-scheduled at check-window boundaries,
+    zero headroom violations, full telemetry schema-valid."""
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = _submit_two(q)
+    n = _drain(root)
+    assert n == 2
+    st = q.status()["counts"]
+    assert st["done"] == 2 and st["failed"] == 0
+
+    spans = _claim_spans(root)
+    assert len(spans) == 2
+    (a0, a1, sa), (b0, b1, sb) = spans.values()
+    # disjoint slots...
+    assert not (sa["lo"] < sb["lo"] + sb["width"]
+                and sb["lo"] < sa["lo"] + sa["width"])
+    # ...resident at the same time (the whole point)
+    assert a0 < b1 and b0 < a1, "batches never overlapped in time"
+
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    plans = [r for r in recs if r.get("event") == "packing"
+             and r.get("kind") == "plan"]
+    assert plans and all(
+        (r.get("headroom_violations") or 0) == 0 for r in plans)
+
+    # the slot is durable in batch.json (the reclaim anchor)
+    for bid, (_, _, slot) in spans.items():
+        with open(os.path.join(q.batch_dir(bid), "batch.json"),
+                  encoding="utf-8") as fh:
+            assert json.load(fh)["slot"] == slot
+
+    # per-point partial results streamed under each run dir, final rows
+    # covering every point
+    for rid in rids:
+        paths = [os.path.join(q.batch_dir(bid), "results",
+                              f"{rid}.partial.jsonl")
+                 for bid in spans]
+        path = next(p for p in paths if os.path.exists(p))
+        rows = [json.loads(l) for l in open(path, encoding="utf-8")]
+        assert rows and rows[-1]["final"] is True
+        assert rows[-1]["request_id"] == rid
+
+    # surfacing: watch packing section + fleet status --json packing key +
+    # report fleet_packing section
+    from redcliff_tpu.obs.watch import build_snapshot, render_text
+
+    snap = build_snapshot(str(root))
+    assert obs_schema.validate_record(snap) == []
+    assert snap["packing"]["slot_claims"] == 2
+    assert snap["packing"]["slot_frees"] == 2
+    assert snap["packing"]["partial_points"] >= 2
+    assert "packing:" in render_text(snap)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "status", "--root",
+         str(root), "--json"], capture_output=True, text=True,
+        env=_clean_fault_env(), cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stderr
+    cli = json.loads(out.stdout)
+    assert "packing" in cli
+    assert cli["packing"]["partial_results"]
+
+    from redcliff_tpu.obs.report import build_report
+    report = build_report(str(root))
+    fp = report["fleet_packing"]
+    assert fp["events"]["slot_claim"] == 2
+    assert fp["last_plan"]["headroom_violations"] == 0
+
+
+def test_auto_mode_empty_cost_store_stays_serial(tmp_path):
+    """Bit-identity fallback: auto mode over an unpriced queue never
+    co-schedules — claims are strictly sequential, exactly the serial
+    heuristic's behavior."""
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    _submit_two(q)
+    assert _drain(root, packing_mode="auto") == 2
+    assert q.status()["counts"]["done"] == 2
+    spans = _claim_spans(root)
+    assert len(spans) == 2
+    (a0, a1, _), (b0, b1, _) = spans.values()
+    assert a1 <= b0 or b1 <= a0, "auto+unpriced must serialize claims"
+    plans = [r for r in read_jsonl(str(root))
+             if r.get("event") == "packing" and r.get("kind") == "plan"]
+    assert plans and all(r["decision"] == "serial" for r in plans)
+    assert {r["reason"] for r in plans} <= {"unpriced", "single_batch"}
+    assert any(r["reason"] == "unpriced" for r in plans)
+
+
+def test_tenant_quota_keeps_over_quota_batch_queued(tmp_path, monkeypatch):
+    """Fair-share end-to-end: with a 1-slot quota, a two-batch tenant
+    drains one batch at a time (the deferral is a delay, not a loss) and
+    the structured reason rides the plan telemetry."""
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    for i in range(2):
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = 1
+        spec["mesh"] = "auto"
+        spec["data"]["seed"] = i
+        q.submit("hog", [{"gen_lr": 1e-3 * (i + 1)}], spec=spec)
+    env = _clean_fault_env()
+    env["REDCLIFF_FLEET_TENANT_SLOTS"] = "1"
+    monkeypatch.setenv("REDCLIFF_FLEET_TENANT_SLOTS", "1")
+    assert _drain(root, env=env) == 2
+    assert q.status()["counts"]["done"] == 2
+    spans = _claim_spans(root)
+    (a0, a1, _), (b0, b1, _) = spans.values()
+    assert a1 <= b0 or b1 <= a0, "quota=1 must never co-schedule a tenant"
+    deferred = [r for r in read_jsonl(str(root))
+                if r.get("event") == "fleet" and r.get("kind") == "plan"
+                and r.get("quota_deferred")]
+    assert deferred, "the deferral never hit the plan telemetry"
+    d = deferred[0]["quota_deferred"][0]
+    assert d["tenant"] == "hog" and d["reason"] == "tenant quota"
+
+
+def test_poisoned_cotenant_does_not_perturb_healthy_batch(tmp_path):
+    """Fault-isolation acceptance: a crash-looping (fleet_poison SIGKILL)
+    co-tenant shares the pool with a healthy batch; the healthy batch's
+    results are bit-identical to a solo run and the poison dead-letters
+    on its own slot."""
+    root_mix = tmp_path / "mix"
+    root_solo = tmp_path / "solo"
+    qm, qs = FleetQueue(root_mix), FleetQueue(root_solo)
+
+    def submit_healthy(q):
+        spec = json.loads(json.dumps(TINY_SPEC))
+        spec["epochs"] = 2
+        spec["mesh"] = "auto"
+        return q.submit("healthy", [{"gen_lr": 1e-3}], spec=spec)
+
+    rid_h = submit_healthy(qm)
+    spec_p = json.loads(json.dumps(TINY_SPEC))
+    spec_p["epochs"] = 2
+    spec_p["mesh"] = "auto"
+    spec_p["data"]["seed"] = 7
+    rid_p = qm.submit("poison", [chaos.poison_point("sigkill")],
+                      spec=spec_p)
+    rid_solo = submit_healthy(qs)
+
+    armed = _clean_fault_env()
+    armed["REDCLIFF_FAULT_INJECT"] = "fleet_poison"
+    _drain(root_mix, env=armed, max_restarts=0, max_attempts=3)
+    cm = qm.status()["counts"]
+    assert cm["done"] == 1 and cm["deadletter"] == 1 and cm["failed"] == 0
+    assert qm.deadletter_record(rid_p) is not None
+
+    assert _drain(root_solo) == 1
+    res = _payload(qm.result(rid_h)["result"])
+    ref = _payload(qs.result(rid_solo)["result"])
+    assert res == ref, "healthy batch diverged beside the poison co-tenant"
+    recs = read_jsonl(str(root_mix))
+    assert obs_schema.validate_records(recs) == []
+
+
+def test_cancel_frees_slot_without_perturbing_survivor(tmp_path):
+    """Cancel/requeue satellite: canceling every member of one co-resident
+    batch SIGTERMs only that batch; its slot frees at the next check
+    window (slot_canceled, no requeue) and the surviving co-tenant
+    completes bit-identically to a solo run."""
+    import threading
+
+    root = tmp_path / "fleet"
+    root_solo = tmp_path / "solo"
+    q, qs = FleetQueue(root), FleetQueue(root_solo)
+    spec_s = json.loads(json.dumps(TINY_SPEC))
+    spec_s["epochs"] = 2
+    spec_s["mesh"] = "auto"
+    rid_live = q.submit("live", [{"gen_lr": 1e-3}], spec=spec_s)
+    rid_solo = qs.submit("live", [{"gen_lr": 1e-3}], spec=spec_s)
+    spec_v = json.loads(json.dumps(TINY_SPEC))
+    spec_v["epochs"] = 60       # long enough to still be running
+    spec_v["mesh"] = "auto"
+    spec_v["data"]["seed"] = 5
+    rid_victim = q.submit("victim", [{"gen_lr": 2e-3}], spec=spec_v)
+
+    def cancel_when_running():
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            lease = q.lease_of(rid_victim)
+            run_dir = (q.batch_dir(lease["batch_id"])
+                       if lease and lease.get("batch_id") else None)
+            if run_dir and os.path.exists(
+                    os.path.join(run_dir, "grid_checkpoint.pkl")):
+                q.cancel(rid_victim, reason="operator")
+                return
+            time.sleep(0.1)
+
+    t = threading.Thread(target=cancel_when_running, daemon=True)
+    t.start()
+    _drain(root)
+    t.join(timeout=5)
+    st = q.status()["counts"]
+    assert st["done"] == 1 and st["canceled"] == 1 and st["failed"] == 0
+    recs = read_jsonl(str(root))
+    assert obs_schema.validate_records(recs) == []
+    kinds = {r["kind"] for r in recs if r.get("event") == "packing"}
+    assert "slot_canceled" in kinds and "cancel_stop" in kinds
+    # the survivor never noticed
+    assert _drain(root_solo) == 1
+    res = _payload(q.result(rid_live)["result"])
+    ref = _payload(qs.result(rid_solo)["result"])
+    assert res == ref, "survivor diverged when its co-tenant was canceled"
+
+
+def test_sigkill_mid_packing_reclaims_original_slots(tmp_path):
+    """Crash-safety acceptance under packing: SIGKILL a worker while two
+    batches are co-resident -> leases expire -> a second packed worker
+    reclaims BOTH batches into their originally recorded slots and resumes
+    from checkpoint; nothing lost, nothing run twice."""
+    root = tmp_path / "fleet"
+    q = FleetQueue(root)
+    rids = _submit_two(q, epochs=4)
+
+    env = _clean_fault_env()
+    w1 = subprocess.Popen(
+        [sys.executable, "-m", "redcliff_tpu.fleet", "work", "--root",
+         str(root), "--max-batches", "2", "--lease-s", "2",
+         "--poll-s", "0.2", "--n-devices", "4", "--packing", "force"],
+        env=env, start_new_session=True, cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    slots_before = {}
+    try:
+        deadline = time.time() + 240
+        while len(slots_before) < 2 and time.time() < deadline:
+            assert w1.poll() is None, "worker died before packing"
+            for rid in rids:
+                lease = q.lease_of(rid)
+                bid = (lease or {}).get("batch_id")
+                if not bid or bid in slots_before:
+                    continue
+                bj = os.path.join(q.batch_dir(bid), "batch.json")
+                ck = os.path.join(q.batch_dir(bid), "grid_checkpoint.pkl")
+                if os.path.exists(bj) and os.path.exists(ck):
+                    with open(bj, encoding="utf-8") as fh:
+                        slots_before[bid] = json.load(fh)["slot"]
+            time.sleep(0.1)
+        assert len(slots_before) == 2, "both batches never got resident"
+        os.killpg(w1.pid, signal.SIGKILL)
+    finally:
+        if w1.poll() is None:
+            os.killpg(w1.pid, signal.SIGKILL)
+        w1.wait()
+
+    for rid in rids:
+        lease = q.lease_of(rid)
+        while lease is not None and time.time() < float(
+                lease["expires_at"]):
+            time.sleep(0.05)
+
+    assert _drain(root) == 2
+    assert q.status()["counts"]["done"] == 2
+    # reclaimed into the ORIGINAL slots, resumed (not re-run)
+    for bid, slot in slots_before.items():
+        with open(os.path.join(q.batch_dir(bid), "batch.json"),
+                  encoding="utf-8") as fh:
+            assert json.load(fh)["slot"] == slot, f"{bid} moved slots"
+        starts = [r for r in read_jsonl(q.batch_dir(bid))
+                  if r.get("event") == "fit_start"]
+        assert any(r.get("resumed_from_epoch") is not None
+                   for r in starts), f"{bid} restarted from scratch"
+    froot = read_jsonl(str(root))
+    assert any(r.get("event") == "fleet" and r.get("kind") == "reclaim"
+               for r in froot)
+    assert obs_schema.validate_records(froot) == []
+
+
+# ---------------------------------------------------------------------------
+# autoscale slot-awareness lives in tests/test_autoscale.py
+# (test_predicted_drain_is_slot_aware); packing state durability unit here
+# ---------------------------------------------------------------------------
+def test_publish_and_load_state_roundtrip(tmp_path):
+    st = packing.SlotTable(4)
+    st.alloc(2)
+    packing.publish_state(str(tmp_path), st.occupancy(),
+                          concurrent_batches=1)
+    out = packing.load_state(str(tmp_path))
+    assert out["busy_devices"] == 2 and out["concurrent_batches"] == 1
+    # staleness gate: an old publication is ignored
+    packing.publish_state(str(tmp_path), st.occupancy(),
+                          concurrent_batches=1,
+                          now=time.time() - 10 * packing.STATE_FRESH_S)
+    assert packing.load_state(str(tmp_path)) is None
+    # corrupt file -> None, never a crash
+    with open(os.path.join(str(tmp_path), packing.STATE_FILE), "w",
+              encoding="utf-8") as fh:
+        fh.write("{torn")
+    assert packing.load_state(str(tmp_path)) is None
