@@ -1650,7 +1650,17 @@ def _bench_serve(jax, capacity=8, ticks=96):
     isolation check rides :func:`redcliff_tpu.serve.chaos
     .churn_isolation_report`'s virtual clock so its verdict is pure math.
     Warmup (ring fill + jit compile of the dispatch) is excluded from the
-    timed window."""
+    timed window.
+
+    Elastic-data-plane legs (ISSUE 20): a 25%-occupancy leg under the
+    forced occupancy ladder (the saturated run above can never show a
+    dead-lane saving — every slot is leased) reporting the structural
+    ``dead_lane_flops_saved_pct`` of riding the min rung; a backlogged
+    fusion leg (``fuse=8``) reporting ``fused_samples_per_s`` through the
+    single-scan drain; and a ``mixed_ratio_vs_f32`` leg re-running a short
+    saturated window under ``precision_mode="mixed"`` (bf16 contraction
+    emulation on CPU — the ratio is evidence the path works everywhere,
+    the TPU speedup shows only on MXU hardware)."""
     from redcliff_tpu.models.redcliff import (RedcliffSCMLP,
                                               RedcliffSCMLPConfig)
     from redcliff_tpu.obs import slo as _slo
@@ -1703,6 +1713,71 @@ def _bench_serve(jax, capacity=8, ticks=96):
         lambda: ServeService(model, params, root=None, capacity=capacity,
                              resume=False),
         chans=D, n_victims=2, n_samples=24, seed=0)
+
+    def _timed_run(n_streams, seed0, fuse=1, burst=1, ladder="off",
+                   precision_mode="f32", n_ticks=None, widths_out=None):
+        """One timed serve window: ``n_streams`` feeds, ``burst`` samples
+        ingested per stream per pump (backlog depth for the fusion path),
+        warmup excluded. Returns (answered, wall_s)."""
+        n_ticks = n_ticks if n_ticks is not None else ticks
+        svc = ServeService(model, params, root=None, capacity=capacity,
+                           resume=False, ladder=ladder, fuse=fuse,
+                           precision_mode=precision_mode)
+        try:
+            fd = {f"x{i}": _chaos.stream_samples(seed0 + i,
+                                                 n_ticks * burst, D)
+                  for i in range(n_streams)}
+            for sid in fd:
+                svc.connect(sid=sid, now=time.perf_counter())
+            warm = model.config.embed_lag + 2
+            n_ans = 0
+            t0 = time.perf_counter()
+            for t in range(n_ticks):
+                if t == warm:
+                    n_ans, t0 = 0, time.perf_counter()
+                for sid, arr in fd.items():
+                    for j in range(burst):
+                        svc.ingest(sid, arr[t * burst + j],
+                                   now=time.perf_counter())
+                svc.pump(now=time.perf_counter())
+                if widths_out is not None and t >= warm:
+                    widths_out.append(svc.engine.width)
+                for sid in fd:
+                    n_ans += len(svc.poll(sid, now=time.perf_counter()))
+            return n_ans, time.perf_counter() - t0
+        finally:
+            svc.stop()
+
+    # 25%-occupancy ladder leg: capacity//4 streams, forced ladder with
+    # tight hysteresis so the shrink lands inside the window
+    low_n = max(1, capacity // 4)
+    old_hold = os.environ.get("REDCLIFF_SERVE_LADDER_HOLD")
+    os.environ["REDCLIFF_SERVE_LADDER_HOLD"] = "2"
+    try:
+        widths = []
+        low_ans, low_wall = _timed_run(low_n, 200, ladder="force",
+                                       widths_out=widths)
+    finally:
+        if old_hold is None:
+            os.environ.pop("REDCLIFF_SERVE_LADDER_HOLD", None)
+        else:
+            os.environ["REDCLIFF_SERVE_LADDER_HOLD"] = old_hold
+    mean_width = (sum(widths) / len(widths)) if widths else capacity
+    dead_saved = round(100.0 * (1.0 - mean_width / capacity), 1)
+
+    # backlogged fusion leg: each pump drains an 8-deep backlog in one scan
+    fuse_ans, fuse_wall = _timed_run(low_n, 300, fuse=8, burst=8,
+                                     n_ticks=max(12, ticks // 8))
+
+    # mixed-precision leg: short saturated window, mixed vs f32 throughput
+    mix_ticks = max(16, ticks // 3)
+    f32_ans, f32_wall = _timed_run(capacity, 400, n_ticks=mix_ticks)
+    mix_ans, mix_wall = _timed_run(capacity, 400, n_ticks=mix_ticks,
+                                   precision_mode="mixed")
+    mixed_ratio = None
+    if f32_ans and f32_wall > 0 and mix_wall > 0:
+        mixed_ratio = round((mix_ans / mix_wall) / (f32_ans / f32_wall), 3)
+
     return {
         "streams_per_chip": capacity,
         "ticks_timed": ticks - warm_ticks,
@@ -1714,6 +1789,14 @@ def _bench_serve(jax, capacity=8, ticks=96):
         "isolation_ok": 1.0 if iso["identical"] else 0.0,
         "isolation_compared": iso["compared"],
         "isolation_rejects": iso["rejects"],
+        "low_occupancy_streams": low_n,
+        "low_occupancy_mean_rung": round(mean_width, 2),
+        "dead_lane_flops_saved_pct": dead_saved,
+        "low_occupancy_samples_per_s": (round(low_ans / low_wall, 1)
+                                        if low_wall > 0 else None),
+        "fused_samples_per_s": (round(fuse_ans / fuse_wall, 1)
+                                if fuse_wall > 0 else None),
+        "mixed_ratio_vs_f32": mixed_ratio,
     }
 
 

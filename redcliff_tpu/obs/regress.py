@@ -228,6 +228,23 @@ FAMILIES = [
            band=_BAND_TIMING, g_dependent=False),
     Family("serve.isolation_ok", path="serve.isolation_ok",
            band=_BAND_TIMING, g_dependent=False, contract_min=1.0),
+    # elastic serve data plane (ISSUE 20): the 25%-occupancy leg's
+    # structural dead-lane saving (forced occupancy ladder riding the min
+    # rung — contract_min pins that the ladder actually shrinks; 10% is
+    # far below the ~50% a healthy min-rung ride yields at capacity//4
+    # streams, so it trips only on a ladder that stopped engaging), the
+    # backlogged single-scan fusion drain throughput, and the
+    # mixed-vs-f32 throughput ratio (<1 under CPU bf16 EMULATION — the
+    # MXU speedup only shows on TPU hardware; the family tracks the
+    # trajectory so a silently broken mixed path shows as a cliff, it is
+    # not a speedup floor)
+    Family("serve.dead_lane_flops_saved_pct",
+           path="serve.dead_lane_flops_saved_pct", band=_BAND_TIMING,
+           g_dependent=False, contract_min=10.0),
+    Family("serve.fused_samples_per_s", path="serve.fused_samples_per_s",
+           band=_BAND_TIMING, g_dependent=False),
+    Family("serve.mixed_ratio_vs_f32", path="serve.mixed_ratio_vs_f32",
+           band=_BAND_TIMING, g_dependent=False),
 ]
 
 

@@ -736,6 +736,70 @@ def build_report(run_dir):
                          for k in sorted(session_kinds)},
             "qos_demotes": qos_demotes_serve,
         }
+        # elastic-data-plane occupancy table (ISSUE 20): rung ride history
+        # from the `serve_ladder` decisions, dead-lane % from the tick-level
+        # width-vs-capacity ratio (the fraction of slot-table FLOPs the
+        # ladder did NOT dispatch), fuse-depth distribution from the newest
+        # cumulative `serve_fuse` histogram, and serve-scoped precision
+        # demotions (the poisoned-lane-storm sentinel)
+        ladder_counts = {}
+        rung_history = []
+        ladder_mode = None
+        width_sum = width_n = live_sum = 0
+        capacity = None
+        fuse_hist = None
+        fused_samples = 0
+        serve_demotions = []
+        for r in records:
+            ev = r.get("event")
+            if ev == "serve":
+                if r.get("capacity") is not None:
+                    capacity = r["capacity"]
+                if r.get("mode") is not None:
+                    ladder_mode = r["mode"]
+                if r.get("kind") == "tick" and r.get("width") is not None:
+                    width_sum += r["width"]
+                    width_n += 1
+                    live_sum += r.get("live") or 0
+                if r.get("fused_samples") is not None:
+                    fused_samples = r["fused_samples"]
+            elif ev == "serve_ladder":
+                k = str(r.get("kind"))
+                ladder_counts[k] = ladder_counts.get(k, 0) + 1
+                if k in ("grow", "shrink") and len(rung_history) < 64:
+                    rung_history.append(
+                        {"kind": k, "from": r.get("from_width"),
+                         "to": r.get("to_width"), "live": r.get("live"),
+                         "tick": r.get("ticks")})
+            elif ev == "serve_fuse" and r.get("kind") == "stats":
+                fuse_hist = r.get("hist") or fuse_hist
+                if r.get("fused_samples") is not None:
+                    fused_samples = r["fused_samples"]
+            elif ev == "precision" and r.get("scope") == "serve":
+                serve_demotions.append(
+                    {"kind": r.get("kind"), "cause": r.get("cause"),
+                     "lanes_poisoned": r.get("lanes_poisoned"),
+                     "tick": r.get("ticks")})
+        if width_n or ladder_counts or fuse_hist or serve_demotions:
+            mean_width = (width_sum / width_n) if width_n else None
+            dead_pct = None
+            if mean_width is not None and capacity:
+                dead_pct = round(100.0 * (1.0 - mean_width / capacity), 1)
+            serve_section["occupancy"] = {
+                "ladder_mode": ladder_mode,
+                "capacity": capacity,
+                "mean_rung": (round(mean_width, 2)
+                              if mean_width is not None else None),
+                "mean_live": (round(live_sum / width_n, 2)
+                              if width_n else None),
+                "dead_lane_flops_saved_pct": dead_pct,
+                "decisions": {k: ladder_counts[k]
+                              for k in sorted(ladder_counts)},
+                "rung_history": rung_history,
+                "fuse_depth_hist": fuse_hist,
+                "fused_samples": int(fused_samples),
+                "demotions": serve_demotions,
+            }
 
     schema_errors = _schema.validate_records(records)
     ledger_errors = _schema.validate_records(ledger, kind="ledger")
@@ -1043,6 +1107,36 @@ def render_text(report):
                 f"{k}={v}" for k, v in sorted(sv["sessions"].items())))
         if sv.get("qos_demotes"):
             out.append(f"  qos: {sv['qos_demotes']} cadence demotion(s)")
+        occ = sv.get("occupancy")
+        if occ:
+            out.append(
+                f"  occupancy [ladder={occ.get('ladder_mode') or '?'}]: "
+                f"mean rung {occ.get('mean_rung')}/"
+                f"{occ.get('capacity')} slot(s) "
+                f"(mean live {occ.get('mean_live')}), dead-lane FLOPs "
+                f"saved {occ.get('dead_lane_flops_saved_pct')}%"
+                + (", decisions " + " ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(occ["decisions"].items()))
+                   if occ.get("decisions") else ""))
+            hist = occ.get("rung_history") or []
+            if hist:
+                ride = " -> ".join(str(h["to"]) for h in hist)
+                out.append(f"    rung ride: {hist[0].get('from')} -> {ride}"
+                           f" ({len(hist)} transition(s))")
+            if occ.get("fuse_depth_hist"):
+                out.append(
+                    f"    fuse depths: " + "  ".join(
+                        f"{k}x{v}" for k, v in sorted(
+                            occ["fuse_depth_hist"].items(),
+                            key=lambda kv: int(kv[0])))
+                    + f" ({occ.get('fused_samples', 0)} fused sample(s))")
+            for d in occ.get("demotions") or []:
+                out.append(
+                    f"    PRECISION DEMOTION [{d.get('kind')}] "
+                    f"{d.get('cause')}"
+                    + (f" ({d['lanes_poisoned']} lane(s) poisoned)"
+                       if d.get("lanes_poisoned") is not None else ""))
         for br in ss.get("breaches") or []:
             out.append(f"  SLO BREACH [{br['scope']}] {br['slo']}: "
                        f"{br['value']:.3f} vs threshold "

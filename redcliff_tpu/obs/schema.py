@@ -172,14 +172,18 @@ EVENTS = {
                   # dispatch_stats["quality"]
                   "quality")),
     "precision": _ev(
-        "trainers + grid engine (mixed-precision production path, ISSUE "
-        "14: kind=demote — the numerics sentinel caught a skip/rollback "
-        "storm under precision_mode='mixed' and the fit rebuilt every "
-        "step at f32; kind=resume_demoted — a resumed fit honored the "
-        "checkpointed demotion instead of re-promoting)",
-        required=("kind", "epoch"),
-        optional=("cause", "mode_from", "mode_to", "lanes", "grid_width",
-                  "rollbacks") + _NUMERICS_SUMMARY),
+        "trainers + grid engine + serve (mixed-precision production path, "
+        "ISSUE 14: kind=demote — the numerics sentinel caught a "
+        "skip/rollback storm under precision_mode='mixed' and the fit "
+        "rebuilt every step at f32; kind=resume_demoted — a resumed fit "
+        "honored the checkpointed demotion instead of re-promoting. ISSUE "
+        "20 scopes the same pair to the serve table — scope='serve', "
+        "tick-indexed instead of epoch-indexed: a poisoned-lane storm "
+        "inside the sentinel window demotes the whole slot table to f32)",
+        required=("kind",),
+        optional=("epoch", "cause", "mode_from", "mode_to", "lanes",
+                  "grid_width", "rollbacks", "scope", "ticks",
+                  "lanes_poisoned", "window_ticks") + _NUMERICS_SUMMARY),
     "autotune": _ev(
         "trainers + grid engine (ops/autotune.py kernel-tiling search/"
         "lookup records: kind=search — a measured candidate-ladder search "
@@ -298,7 +302,9 @@ EVENTS = {
                   "truncated")),
     "watch": _ev(
         "obs.watch (snapshot artifact / --once --json output, not a jsonl "
-        "line)",
+        "line; the serve block carries the elastic-data-plane posture — "
+        "watch.serve.rung is the resident rung width vs capacity, "
+        "watch.serve.fused_samples the cumulative fusion credit)",
         required=("run_dir", "fits"),
         optional=("schema_version", "ok", "grid_eta_s", "stalls", "numerics",
                   "heartbeats", "attempts", "incidents", "read_audit",
@@ -403,7 +409,33 @@ EVENTS = {
                   "samples_in", "samples_out", "rejects", "dropped",
                   "p50_ms", "p99_ms", "n", "eta_s", "reason", "sid",
                   "trace_id", "rung", "from_rung", "cadence", "backlog",
-                  "checkpoint", "resumed", "undelivered", "model_class")),
+                  "checkpoint", "resumed", "undelivered", "model_class",
+                  # elastic data plane (ISSUE 20): resident rung width,
+                  # live high-water mark, fusion + precision posture
+                  "width", "live", "fused_samples", "mode", "fuse",
+                  "precision_mode")),
+    "serve_ladder": _ev(
+        "serve occupancy ladder (redcliff_tpu/serve/service.py ServeLadder "
+        "— the slot table's pow2 rung decisions at tick boundaries; "
+        "kind=grow | shrink | hold | fallback | repack. grow is mandatory "
+        "(a leased slot beyond the rung would never dispatch), shrink is "
+        "priced through the PR-8 cost store (predicted dead-lane saving "
+        "over the horizon vs cold-compile cost), hold/fallback record a "
+        "declined or unpriceable shrink once per hysteresis episode, and "
+        "repack is the cross-geometry resume that re-packs lanes instead "
+        "of failing the shape check)",
+        required=("kind",),
+        optional=("from_width", "to_width", "live", "capacity", "mode",
+                  "cold", "saving_ms", "compile_ms", "horizon_ticks",
+                  "reason", "ticks", "streams", "from_capacity")),
+    "serve_fuse": _ev(
+        "serve micro-batched tick fusion (redcliff_tpu/serve/service.py — "
+        "periodic fusion stats at the tick-event cadence when "
+        "REDCLIFF_SERVE_FUSE > 1; kind=stats. hist maps per-stream fused "
+        "take -> dispatch count — the fuse depth distribution obs report "
+        "renders)",
+        required=("kind",),
+        optional=("depth", "fused_samples", "hist", "ticks", "width")),
     "session": _ev(
         "serve session lifecycle (redcliff_tpu/serve/service.py over "
         "serve/session.py's lease/heartbeat registry; kind=connect | "

@@ -344,15 +344,19 @@ def compute_serve_slo(records, thresholds=None):
     record IS the run's current view — no re-derivation, byte-agreement
     with what the service itself computed. Returns ``{"latency":
     {"p50_ms", "p99_ms", "n"}, "streams", "rejects", "dropped",
-    "samples_in", "samples_out", "thresholds", "breaches"}``, or None when
-    the records carry no serve events at all.
+    "samples_in", "samples_out", "width", "fused_samples", "mode",
+    "thresholds", "breaches"}`` (the last three from the elastic data
+    plane, ISSUE 20: newest dispatched rung width, cumulative fused-sample
+    count, ladder mode), or None when the records carry no serve events
+    at all.
     """
     thr = dict(serve_thresholds_from_env(), **(thresholds or {}))
     last_lat = None
     # counters are cumulative but scattered across kinds (drain carries no
     # rejects, stop no streams): keep the newest non-None value per field
     counts = {k: None for k in ("streams", "rejects", "dropped",
-                                "samples_in", "samples_out")}
+                                "samples_in", "samples_out", "width",
+                                "fused_samples", "mode")}
     seen = False
     for rec in records:
         if rec.get("event") != "serve":

@@ -277,7 +277,8 @@ def build_snapshot(run_dir, now=None):
             last_serve = rec
             for k in ("capacity", "streams", "free_slots", "ticks",
                       "samples_in", "samples_out", "rejects", "dropped",
-                      "p50_ms", "p99_ms", "n"):
+                      "p50_ms", "p99_ms", "n", "width", "live",
+                      "fused_samples", "mode", "fuse", "precision_mode"):
                 if rec.get(k) is not None:
                     serve_counts[k] = rec[k]
         elif ev == "session":
@@ -447,6 +448,10 @@ def build_snapshot(run_dir, now=None):
     if last_serve is not None:
         swt = last_serve.get("wall_time")
         serve = dict(serve_counts)
+        # elastic data plane (ISSUE 20): the engine's current pow2 rung —
+        # the dispatched slot-table width, <= capacity under the occupancy
+        # ladder — surfaces as `rung` (watch.serve.rung)
+        serve["rung"] = serve.pop("width", None)
         serve["last_kind"] = last_serve.get("kind")
         serve["quarantines"] = serve_quarantines
         serve["age_s"] = (round(now - swt, 3)
@@ -833,6 +838,15 @@ def render_text(snap):
             f"{sv.get('samples_out', 0)}/{sv.get('samples_in', 0)} "
             f"answered, lat p50/p99 {_ms(sv.get('p50_ms'))}/"
             f"{_ms(sv.get('p99_ms'))}"
+            + (f", rung:{sv['rung']}/{sv.get('capacity', '?')}"
+               + (f" [{sv['mode']}]" if sv.get("mode") else "")
+               if sv.get("rung") is not None else "")
+            + (f", fused:{sv['fused_samples']}"
+               + (f" (depth<={sv['fuse']})" if sv.get("fuse") else "")
+               if sv.get("fused_samples") else "")
+            + (f", precision:{sv['precision_mode']}"
+               if sv.get("precision_mode")
+               and sv.get("precision_mode") != "f32" else "")
             + (f", {sv['rejects']} reject(s)" if sv.get("rejects") else "")
             + (f", {sv['dropped']} dropped" if sv.get("dropped") else "")
             + (f", {sv['quarantines']} quarantine(s)"

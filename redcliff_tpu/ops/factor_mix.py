@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from redcliff_tpu.ops import autotune as _autotune
 
 __all__ = ["factor_mix", "factor_mix_reference", "factor_mix_pallas",
+           "graph_mix", "graph_mix_reference",
            "DEFAULT_BLOCK_B", "ENV_DISABLE"]
 
 DEFAULT_BLOCK_B = 32
@@ -137,3 +138,32 @@ def factor_mix(weightings, preds):
             and os.environ.get(ENV_DISABLE, "1") not in ("0", "off")):
         return factor_mix_pallas(weightings, preds)
     return factor_mix_reference(weightings, preds)
+
+
+def graph_mix_reference(weightings, static_gc):
+    """The serve-path jnp reference: ``einsum("sk,kij->sij")`` — byte-
+    identical to the historical in-engine graph blend (serve/engine.py),
+    so the non-TPU serve path never changes bytes."""
+    return jnp.einsum("sk,kij->sij", weightings, static_gc)
+
+
+def graph_mix(weightings, static_gc, interpret=None):
+    """Serve-path graph blend: per-lane mixture of the K static per-factor
+    GC graphs, ``weightings (S, K)`` x ``static_gc (K, C, C)`` -> ``(S, C,
+    C)``. The slot-axis (S) plays the batch role, the graph plays the
+    per-factor-prediction role, so the blend rides the SAME autotuned
+    Pallas kernel as the training mix on real TPU hardware (broadcast of
+    ``static_gc`` across lanes fuses into the kernel's VMEM block load; no
+    materialized (K, S, C, C)). Everywhere else — and under
+    ``REDCLIFF_FACTOR_MIX_PALLAS=0`` — it is the exact reference einsum.
+    ``interpret`` forces the kernel's interpret mode (the bitwise parity
+    anchor, tests/test_serve_elastic.py)."""
+    if interpret is None:
+        if (jax.default_backend() != "tpu"
+                or os.environ.get(ENV_DISABLE, "1") in ("0", "off")):
+            return graph_mix_reference(weightings, static_gc)
+        interpret = False
+    K = static_gc.shape[0]
+    S = weightings.shape[0]
+    preds = jnp.broadcast_to(static_gc[:, None], (K, S) + static_gc.shape[1:])
+    return factor_mix_pallas(weightings, preds, interpret=interpret)

@@ -22,7 +22,14 @@ layer (ISSUE 17):
   structured error state), a per-stream degraded-QoS ladder (graph-readout
   cadence sheds before any latency SLO breach), SIGTERM drain (in-flight
   samples answered, sessions checkpointed, a restarted server resumes
-  them), and per-stream ``trace_id`` end to end;
+  them — re-packing lanes across rung geometries), and per-stream
+  ``trace_id`` end to end. ISSUE 20 makes the data plane *elastic*: the
+  slot table rides pow2 occupancy rungs sized to live load (shrinks priced
+  against cold-compile cost through the PR-8 store), backlogged streams
+  advance up to ``REDCLIFF_SERVE_FUSE`` samples in one ``lax.scan``
+  dispatch, and ``precision_mode="mixed"`` serves bf16 contractions over
+  f32 ring state with a poisoned-lane-storm sentinel that auto-demotes the
+  table to f32;
 - :mod:`~redcliff_tpu.serve.chaos` — the seeded chaos harness:
   connect/disconnect storms, NaN streams, slow-consumer backpressure, and
   the churn-isolation comparison that pins co-resident outputs bit-identical

@@ -29,7 +29,8 @@ import numpy as np
 from redcliff_tpu.runtime.admission import SlotsExhausted
 
 __all__ = ["stream_samples", "drive", "make_churn_storm",
-           "outputs_identical", "churn_isolation_report"]
+           "make_sawtooth_storm", "outputs_identical",
+           "churn_isolation_report"]
 
 
 def stream_samples(seed, n, chans):
@@ -106,6 +107,48 @@ def make_churn_storm(seed, chans, connect_p=0.6, nan_p=0.4,
                 svc.ingest(sid, x, now=now)
 
     storm.rejects = 0
+    return storm
+
+
+def make_sawtooth_storm(seed, chans, lo=0, hi=6, period=12, nan_p=0.0):
+    """Seeded sawtooth-occupancy actor: chaos-session count rides a
+    deterministic triangle wave between ``lo`` and ``hi`` with the given
+    ``period`` (ticks per half-cycle), connecting on the upstroke and
+    disconnecting newest-first on the downstroke. The occupancy-ladder
+    adversary: every sweep drags the live high-water mark through multiple
+    rungs, forcing grow -> shrink -> grow cycles while victims stream
+    (tests/test_serve_elastic.py pins their bytes across the whole ride).
+    Sample payloads (and optional NaN poisoning at ``nan_p``) come from the
+    seeded rng, so a failure reproduces exactly."""
+    rng = np.random.default_rng(seed)
+    live = []   # connected chaos sids, connect order
+
+    def target(t):
+        phase = t % (2 * period)
+        up = phase if phase < period else 2 * period - phase
+        return lo + round((hi - lo) * up / period)
+
+    def storm(svc, t, now):
+        want = target(t)
+        while len(live) > want:
+            svc.disconnect(live.pop())
+        while len(live) < want:
+            sid = f"saw-{t}-{len(live)}"
+            try:
+                svc.connect(sid=sid, now=now)
+            except SlotsExhausted:
+                storm.rejects += 1
+                break
+            else:
+                live.append(sid)
+        for sid in live:
+            x = rng.normal(size=chans).astype(np.float32)
+            if nan_p and rng.random() < nan_p:
+                x[int(rng.integers(chans))] = np.nan
+            svc.ingest(sid, x, now=now)
+
+    storm.rejects = 0
+    storm.target = target
     return storm
 
 

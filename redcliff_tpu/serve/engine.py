@@ -1,30 +1,58 @@
 """Vmapped slot-table inference engine: O(1) cached-state advance per sample.
 
-The serving compute core. A fixed-capacity table of ``capacity`` lanes, each
+The serving compute core. A slot table of up to ``capacity`` lanes, each
 owning the cached embedder state of one subscriber stream: a device-resident
 ring buffer of that stream's last ``embed_lag`` samples. Advancing a stream
-by one sample is O(1) state work — one ``(S, C)`` host->device transfer for
+by one sample is O(1) state work — one ``(W, C)`` host->device transfer for
 the whole tick's arrivals, one scatter into the ring, one ring-ordered
 gather — instead of re-assembling and re-transferring each stream's full
 sliding window every sample (the naive O(window) host path). All lanes step
-through ONE jit'd dispatch per tick, so a chip serves ``capacity`` streams
-at one dispatch of overhead (the gang-scheduled batching idea;
+through ONE jit'd dispatch per tick, so a chip serves every live stream at
+one dispatch of overhead (the gang-scheduled batching idea;
 ISSUE 17 / PAPERS.md O(1) autoregressive caching).
 
+**Occupancy ladder (ISSUE 20).** The table's resident width ``W`` rides the
+pow2 rung ladder (parallel/compaction.py :func:`serve_rung`), not the full
+``capacity``: dead lanes beyond the highest leased slot are not dispatched.
+:meth:`resize` moves between rungs at tick boundaries only — grow is a
+zero-pad of fresh rows, shrink is a row slice — and both are EXACT, because
+every lane at or beyond the live high-water mark holds all-zero state (the
+recycle/connect reset invariant). Row-independence along the slot axis makes
+rung moves math-free for survivors: lane i's outputs are a function of lane
+i's ring alone, so changing which sibling rows ride the dispatch changes
+which program runs, never what a lane computes (the same argument as
+training-side compaction, tests/test_compaction.py).
+
+**Tick fusion.** :meth:`step_fused` advances every lane up to F backlogged
+samples in ONE ``lax.scan`` dispatch instead of F ticks: the scan body is
+the identical single-tick advance, carried over the ring state, so the
+fused trajectory is bit-equal to F sequential :meth:`step` calls at the
+same width.
+
+**Mixed precision.** ``precision_mode="mixed"`` traces the dispatch under
+``jax.default_matmul_precision("bfloat16")`` — embedder contractions run
+bf16 on the MXU while the ring buffer, carried state, and outputs stay f32
+(the PR-14 recipe) — and routes the per-lane graph blend through the
+autotuned factor-mix Pallas kernel on real TPUs (ops/factor_mix.py
+:func:`graph_mix`; the exact reference einsum everywhere else).
+:meth:`demote` is the NaN-storm sentinel's lever: it drops the table back
+to full f32 and retraces — state is already f32, so demotion changes the
+program, not the rings.
+
 Isolation is a property of the math, not of scheduling: every per-lane
-computation (ring scatter, ordered gather, embedder matmuls, graph einsum)
-is row-independent along the slot axis, so lane i's outputs are a function
-of lane i's ring alone — a NaN-spewing neighbor, a mid-tick connect, or a
-reaped lane changes NOTHING in co-resident lanes' bytes (the churn-isolation
-pin, tests/test_serve.py). Non-finite samples are detected in-graph and
-NEVER written into ring state: the offending lane latches ``poisoned`` and
-its sample is discarded; co-resident lanes cannot even observe the event.
+computation (ring scatter, ordered gather, embedder matmuls, graph blend)
+is row-independent along the slot axis, so a NaN-spewing neighbor, a
+mid-tick connect, or a reaped lane changes NOTHING in co-resident lanes'
+bytes (the churn-isolation pin, tests/test_serve.py). Non-finite samples
+are detected in-graph and NEVER written into ring state: the offending lane
+latches ``poisoned`` and its sample is discarded; co-resident lanes cannot
+even observe the event.
 
 Graph readouts reuse the jit'd :func:`obs.quality.make_summary_fn` summary:
 for the fixed (non-conditional) readout modes the per-factor GC matrices are
 params-only, so they are computed ONCE at load and each sample's per-state
-graph is just ``einsum('sk,kij->sij', weightings, static_gc)`` — per-lane
-independent by construction.
+graph is just the ``graph_mix`` blend — per-lane independent by
+construction.
 
 jax imports are lazy (obs/schema.py LAZY_JAX_MODULES): the session/admission
 control plane imports this package's siblings without a backend.
@@ -33,18 +61,26 @@ from __future__ import annotations
 
 import numpy as np
 
+from redcliff_tpu.utils.precision import (
+    check_precision_mode,
+    matmul_precision_ctx,
+    resolve_matmul_precision,
+)
+
 __all__ = ["StreamEngine"]
 
 
 class StreamEngine:
-    """Fixed-capacity slot table over a fitted REDCLIFF-family model.
+    """Elastic slot table over a fitted REDCLIFF-family model.
 
-    ``step`` is the only hot path: one call per tick, all slots at once.
+    ``step``/``step_fused`` are the only hot paths: one call per tick, all
+    resident lanes at once, at the current rung ``width <= capacity``.
     State lives on device between ticks; ``export_state``/``import_state``
-    round-trip it through numpy for the drain checkpoint.
+    round-trip it through numpy for the drain checkpoint (``import_state``
+    re-packs lanes across rung geometries given a ``slot_map``).
     """
 
-    def __init__(self, model, params, capacity):
+    def __init__(self, model, params, capacity, precision_mode="f32"):
         import jax
         import jax.numpy as jnp
 
@@ -56,8 +92,13 @@ class StreamEngine:
         self.num_chans = int(cfg.num_chans)
         self.num_factors = int(cfg.num_factors)
         self.window_len = int(cfg.embed_lag)
+        self._jax = jax
         self._jnp = jnp
         self.params = params
+        self.platform = jax.default_backend()
+        self.precision_mode = check_precision_mode(precision_mode)
+        self.demoted = False
+        self._matmul = resolve_matmul_precision(self.precision_mode)
 
         # static per-factor GC graphs: params-only for the fixed readout
         # modes quality.readout_mode forces, so ONE offline summary call at
@@ -68,20 +109,39 @@ class StreamEngine:
         summ = _quality.make_summary_fn(model)(params, probe)
         self.static_gc = jnp.asarray(summ["gc"], dtype=jnp.float32)
 
-        S, L, C = self.capacity, self.window_len, self.num_chans
-        self.state = {
-            "window": jnp.zeros((S, L, C), dtype=jnp.float32),
-            "pos": jnp.zeros((S,), dtype=jnp.int32),
-            "filled": jnp.zeros((S,), dtype=jnp.int32),
-            "poisoned": jnp.zeros((S,), dtype=bool),
+        self.width = self.capacity
+        self.state = self._zero_state(self.width)
+        # (width, depth) program keys dispatched at least once since the
+        # last retrace — the ladder's cold-rung oracle (a cold key pays a
+        # compile on first dispatch; the cost model prices that against the
+        # dead-lane saving before any shrink)
+        self._programs = set()
+        self._build_steps()
+
+    def _zero_state(self, width):
+        jnp = self._jnp
+        L, C = self.window_len, self.num_chans
+        return {
+            "window": jnp.zeros((width, L, C), dtype=jnp.float32),
+            "pos": jnp.zeros((width,), dtype=jnp.int32),
+            "filled": jnp.zeros((width,), dtype=jnp.int32),
+            "poisoned": jnp.zeros((width,), dtype=bool),
         }
 
-        static_gc = self.static_gc
+    def _build_steps(self):
+        import jax
+        import jax.numpy as jnp
 
-        def _step(params, state, samples, arrive):
+        from redcliff_tpu.ops.factor_mix import graph_mix
+
+        model = self.model
+        static_gc = self.static_gc
+        L = self.window_len
+
+        def _advance(params, state, samples, arrive):
             window, pos = state["window"], state["pos"]
             filled, poisoned = state["filled"], state["poisoned"]
-            lanes = jnp.arange(S)
+            lanes = jnp.arange(window.shape[0])
 
             finite = jnp.all(jnp.isfinite(samples), axis=-1)
             poison_hit = arrive & ~finite & ~poisoned
@@ -103,11 +163,10 @@ class StreamEngine:
             order = (pos_n[:, None] + jnp.arange(L)[None, :]) % L
             win = jnp.take_along_axis(window_n, order[:, :, None], axis=1)
 
-            weightings, _ = model._embed(params, win)        # (S, K)
+            weightings, _ = model._embed(params, win)        # (W, K)
             scores = jnp.where(ready[:, None], weightings, 0.0)
             graph = jnp.where(ready[:, None, None],
-                              jnp.einsum("sk,kij->sij", scores, static_gc),
-                              0.0)
+                              graph_mix(scores, static_gc), 0.0)
 
             new_state = {"window": window_n, "pos": pos_n,
                          "filled": filled_n, "poisoned": poisoned_n}
@@ -117,31 +176,108 @@ class StreamEngine:
                    "poisoned": poisoned_n}
             return new_state, out
 
-        self._step = jax.jit(_step)
+        def _fused(params, state, samples, arrive):
+            # samples (W, F, C), arrive (W, F) -> time-major scan over F:
+            # the carry is the ring state, the body is the EXACT single-tick
+            # advance, so the fused trajectory bit-matches F sequential
+            # dispatches; outputs stack with leading F
+            xs = (jnp.moveaxis(samples, 1, 0), jnp.moveaxis(arrive, 1, 0))
 
+            def body(st, x):
+                return _advance(params, st, x[0], x[1])
+
+            return jax.lax.scan(body, state, xs)
+
+        self._step = jax.jit(_advance)
+        self._fused = jax.jit(_fused)
+
+    # ------------------------------------------------------------ dispatch
     def step(self, samples, arrive):
         """Advance every arriving lane one sample; one dispatch.
 
-        ``samples``: ``(S, C)`` float32 (rows of non-arriving lanes are
-        ignored); ``arrive``: ``(S,)`` bool. Returns a dict of HOST numpy
-        arrays: ``scores (S, K)``, ``graph (S, C, C)``, ``ready (S,)``
-        (lane produced an output this tick: sample accepted AND ring full),
-        ``poison_hit (S,)`` (lane newly poisoned by a non-finite sample this
-        tick), ``poisoned (S,)`` (latched state).
+        ``samples``: ``(W, C)`` float32 (rows of non-arriving lanes are
+        ignored); ``arrive``: ``(W,)`` bool, with ``W == self.width``.
+        Returns a dict of HOST numpy arrays: ``scores (W, K)``, ``graph
+        (W, C, C)``, ``ready (W,)`` (lane produced an output this tick:
+        sample accepted AND ring full), ``poison_hit (W,)`` (lane newly
+        poisoned by a non-finite sample this tick), ``poisoned (W,)``
+        (latched state).
         """
         jnp = self._jnp
         samples = jnp.asarray(np.asarray(samples, dtype=np.float32))
         arrive = jnp.asarray(np.asarray(arrive, dtype=bool))
-        self.state, out = self._step(self.params, self.state, samples,
-                                     arrive)
+        self._programs.add((self.width, 1))
+        with matmul_precision_ctx(self._matmul):
+            self.state, out = self._step(self.params, self.state, samples,
+                                         arrive)
         return {k: np.asarray(v) for k, v in out.items()}
 
+    def step_fused(self, samples, arrive):
+        """Advance every lane through up to F backlogged samples in ONE
+        ``lax.scan`` dispatch. ``samples``: ``(W, F, C)``; ``arrive``:
+        ``(W, F)`` (padding positions False). Returns the same dict as
+        :meth:`step` with a leading F axis on every array — element f is
+        bit-equal to what the f-th sequential :meth:`step` would return.
+        """
+        jnp = self._jnp
+        samples = jnp.asarray(np.asarray(samples, dtype=np.float32))
+        arrive = jnp.asarray(np.asarray(arrive, dtype=bool))
+        self._programs.add((self.width, int(samples.shape[1])))
+        with matmul_precision_ctx(self._matmul):
+            self.state, out = self._fused(self.params, self.state, samples,
+                                          arrive)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def is_cold(self, width, depth=1):
+        """True iff dispatching at (width, depth) would compile a fresh
+        program — the ladder's pricing input."""
+        return (int(width), int(depth)) not in self._programs
+
+    # ------------------------------------------------------------ the ladder
+    def resize(self, width):
+        """Move the resident table to a new rung at a tick boundary.
+
+        Grow zero-pads fresh rows (zero IS the reset state — padding a
+        never-leased or recycled lane in is exactly ``reset_slot``); shrink
+        slices rows off the top, which the caller guarantees are all free
+        (rung >= live high-water mark). Either way every surviving lane's
+        row bytes are untouched.
+        """
+        width = int(width)
+        if width == self.width:
+            return
+        if not 1 <= width <= self.capacity:
+            raise ValueError(f"rung {width} outside [1, {self.capacity}]")
+        jnp = self._jnp
+        if width < self.width:
+            self.state = {k: v[:width] for k, v in self.state.items()}
+        else:
+            pad = self._zero_state(width - self.width)
+            self.state = {k: jnp.concatenate([v, pad[k]], axis=0)
+                          for k, v in self.state.items()}
+        self.width = width
+
+    def demote(self):
+        """Mixed -> f32 (the poisoned-lane-storm sentinel's lever): retrace
+        every program at full precision. Ring/master state is already f32,
+        so only the programs change; returns True iff a demotion happened."""
+        if self.precision_mode != "mixed" or self.demoted:
+            return False
+        self.demoted = True
+        self._matmul = None
+        self._programs = set()
+        self._build_steps()
+        return True
+
+    # ------------------------------------------------------------ slots
     def reset_slot(self, slot):
         """Zero one lane's ring + flags (slot recycle / quarantine release).
         A single-lane ``.at[slot].set`` — co-resident lanes' state bytes are
-        untouched by construction."""
-        jnp = self._jnp
+        untouched by construction. A slot at or beyond the resident width is
+        already in the all-zero off-rung state: no-op."""
         s = int(slot)
+        if s >= self.width:
+            return
         self.state = {
             "window": self.state["window"].at[s].set(0.0),
             "pos": self.state["pos"].at[s].set(0),
@@ -149,24 +285,72 @@ class StreamEngine:
             "poisoned": self.state["poisoned"].at[s].set(False),
         }
 
+    # ------------------------------------------------------------ durability
     def export_state(self):
-        """Slot-table state as plain numpy (drain checkpoint payload)."""
+        """Slot-table state as plain numpy at the CURRENT width (drain
+        checkpoint payload; the service records the rung alongside)."""
         return {k: np.asarray(v) for k, v in self.state.items()}
 
-    def import_state(self, snap):
+    def import_state(self, snap, slot_map=None):
         """Restore slot-table state from :meth:`export_state` output.
-        Shape-checked: a checkpoint from a different capacity/model geometry
-        is refused rather than silently misapplied."""
+
+        Without ``slot_map`` the checkpoint must match the engine's resident
+        geometry exactly (the caller resizes to the recorded rung first); a
+        mismatch is refused with BOTH geometries in the error. With
+        ``slot_map`` (``{old_slot: new_slot}``) live lanes are re-packed
+        row-by-row into the current geometry — the cross-capacity resume
+        path — which only requires the per-lane ``(L, C)`` ring shape to
+        match; unmapped destination rows are zeroed (free-lane invariant).
+        """
         jnp = self._jnp
         want = {k: tuple(v.shape) for k, v in self.state.items()}
-        got = {k: tuple(np.asarray(snap[k]).shape) for k in want}
-        if want != got:
+        got = {k: tuple(np.asarray(snap[k]).shape)
+               for k in want if k in snap}
+        if set(got) != set(want):
+            raise ValueError(
+                f"serve state geometry mismatch: checkpoint keys "
+                f"{sorted(got)} vs engine keys {sorted(want)}")
+        if slot_map is None:
+            if want != got:
+                raise ValueError(
+                    f"serve state geometry mismatch: checkpoint {got} vs "
+                    f"engine {want} — checkpoint table is "
+                    f"{got['window'][0]}x{got['window'][1:]}, engine is "
+                    f"{want['window'][0]}x{want['window'][1:]} (rung/"
+                    f"capacity or model changed across restart; resume with "
+                    f"a slot_map to re-pack lanes across rung geometries)")
+            self.state = {
+                "window": jnp.asarray(snap["window"], dtype=jnp.float32),
+                "pos": jnp.asarray(snap["pos"], dtype=jnp.int32),
+                "filled": jnp.asarray(snap["filled"], dtype=jnp.int32),
+                "poisoned": jnp.asarray(snap["poisoned"], dtype=bool),
+            }
+            return
+        lane_want = want["window"][1:]
+        lane_got = got["window"][1:]
+        if lane_got != lane_want:
             raise ValueError(
                 f"serve state geometry mismatch: checkpoint {got} vs "
-                f"engine {want} (capacity/model changed across restart?)")
+                f"engine {want} — per-lane ring {lane_got} vs {lane_want} "
+                f"(model geometry changed; lanes cannot be re-packed)")
+        old_w = got["window"][0]
+        host = {
+            "window": np.zeros((self.width,) + lane_want, dtype=np.float32),
+            "pos": np.zeros((self.width,), dtype=np.int32),
+            "filled": np.zeros((self.width,), dtype=np.int32),
+            "poisoned": np.zeros((self.width,), dtype=bool),
+        }
+        for old, new in slot_map.items():
+            old, new = int(old), int(new)
+            if not (0 <= old < old_w and 0 <= new < self.width):
+                raise ValueError(
+                    f"slot_map {old}->{new} outside checkpoint table "
+                    f"[0, {old_w}) / engine table [0, {self.width})")
+            for k in host:
+                host[k][new] = np.asarray(snap[k])[old]
         self.state = {
-            "window": jnp.asarray(snap["window"], dtype=jnp.float32),
-            "pos": jnp.asarray(snap["pos"], dtype=jnp.int32),
-            "filled": jnp.asarray(snap["filled"], dtype=jnp.int32),
-            "poisoned": jnp.asarray(snap["poisoned"], dtype=bool),
+            "window": jnp.asarray(host["window"], dtype=jnp.float32),
+            "pos": jnp.asarray(host["pos"], dtype=jnp.int32),
+            "filled": jnp.asarray(host["filled"], dtype=jnp.int32),
+            "poisoned": jnp.asarray(host["poisoned"], dtype=bool),
         }

@@ -5,15 +5,49 @@ The serving loop that ties the layers together: the
 the :class:`~redcliff_tpu.serve.session.SessionRegistry` (lease/heartbeat
 supervision), the shared admission taxonomy (``SlotsExhausted``
 reject-with-ETA), and the telemetry spine (schema-registered ``serve`` /
-``session`` events, ``serve.dispatch`` spans, per-stream ``trace_id``).
+``session`` / ``serve_ladder`` / ``serve_fuse`` events, ``serve.dispatch``
+spans, per-stream ``trace_id``).
 
 **Tick discipline.** ``pump()`` is one tick: reap lapsed leases (recycled
-lanes reset one-by-one, co-residents untouched), assemble at most one
-pending sample per ACTIVE stream into the ``(S, C)`` arrival batch, ONE
-engine dispatch, distribute outputs. ``run_loop`` rides the same tick
-through :func:`data.pipeline.prefetch_batches` (depth=2), so host assembly
-of tick t+1 overlaps device compute of tick t — the same double-buffered
-discipline the training engines use.
+lanes reset one-by-one, co-residents untouched), assemble pending samples
+per ACTIVE stream into the arrival batch, run the occupancy-ladder policy,
+ONE engine dispatch at the current rung, distribute outputs. ``run_loop``
+rides the same tick through :func:`data.pipeline.prefetch_batches`
+(depth=2), so host assembly of tick t+1 overlaps device compute of tick t —
+the same double-buffered discipline the training engines use.
+
+**Occupancy ladder (ISSUE 20).** ``REDCLIFF_SERVE_LADDER`` selects the
+policy: ``off`` always dispatches the full ``capacity`` table (the PR-17
+behavior, bit for bit); ``force`` always rides the smallest pow2 rung >=
+the live high-water mark (deterministic — the CI ladder smoke);  ``auto``
+(default) grows on demand (every leased slot MUST ride the dispatch — a
+correctness move, never priced) and prices shrinks PR-15 style through the
+PR-8 cost model: predicted dead-lane saving over
+``REDCLIFF_SERVE_LADDER_HORIZON`` ticks vs the compile cost of a cold rung,
+with ``REDCLIFF_SERVE_LADDER_HOLD`` ticks of hysteresis so occupancy
+flutter cannot thrash programs. With NO evidence — empty store, no local
+tick observations — auto holds the current (maximum) rung: the empty-store
+fallback is bit-identical to ladder-off. Rung moves happen at tick
+boundaries only, and per-stream records are pinned byte-identical across
+them (row independence along the slot axis; tests/test_serve_elastic.py).
+
+**Micro-batched tick fusion.** When a stream has in-queue backlog and
+``REDCLIFF_SERVE_FUSE`` > 1, one dispatch advances up to that many samples
+per lane through the engine's ``lax.scan`` program instead of N ticks.
+Fusion composes with the degraded-QoS cadence ladder: the per-stream
+``answered`` counter drives graph cadence exactly as if the samples had
+arrived over N ticks, so readouts still thin under load and the record
+stream is bit-identical to the unfused run.
+
+**Mixed precision.** ``precision_mode="mixed"`` (or
+``REDCLIFF_SERVE_PRECISION=mixed``) traces dispatches with bf16 MXU
+contractions over f32 ring/master state and routes the graph blend through
+the autotuned factor-mix Pallas kernel on real TPUs. The per-lane NaN latch
+doubles as the demotion sentinel: ``REDCLIFF_SERVE_DEMOTE_STORM`` poisoned
+lanes inside ``REDCLIFF_SERVE_DEMOTE_WINDOW`` ticks auto-demote the whole
+table to f32 (retrace only — state is already f32), emit a schema-
+registered ``precision`` event, and persist the demotion in
+``serve_state.bin`` so a resume can never silently re-promote.
 
 **Input contracts (per stream, never per table).** A shape-violating sample
 quarantines its stream HOST-side (it never reaches the device); a
@@ -36,10 +70,14 @@ degrades alone.
 
 **Drain.** ``drain()`` (or SIGTERM via :meth:`ServeService.
 install_signal_handlers`) answers every in-flight sample, converts nothing
-to loss, checkpoints sessions + slot-table rings + undelivered outputs
-through runtime/checkpoint.py (atomic, CRC, ``.prev``), and a restarted
-server resumes every session — same ``trace_id``, same ring state, same
-undelivered outputs — with a fresh lease so subscribers can re-attach.
+to loss, checkpoints sessions + slot-table rings + the active rung + the
+precision state + undelivered outputs through runtime/checkpoint.py
+(atomic, CRC, ``.prev``), and a restarted server resumes every session —
+same ``trace_id``, same ring state, same undelivered outputs — with a
+fresh lease so subscribers can re-attach. A restart into a DIFFERENT
+capacity re-packs live lanes into the new geometry (dense from slot 0,
+relative order preserved) instead of failing the shape check; only a table
+too small for the live streams refuses, naming both geometries.
 
 jax stays out of module scope (LAZY_JAX_MODULES): constructing/driving a
 service in tests pulls jax only when the engine spins up.
@@ -57,15 +95,19 @@ import numpy as np
 from redcliff_tpu import obs as _obs
 from redcliff_tpu.obs import slo as _slo
 from redcliff_tpu.obs.logging import MetricLogger
+from redcliff_tpu.parallel import compaction as _compaction
 from redcliff_tpu.runtime.admission import SlotsExhausted  # noqa: F401 (re-export)
 from redcliff_tpu.runtime.checkpoint import (
     load_checkpoint,
     write_checkpoint,
 )
 from redcliff_tpu.serve import session as _session
+from redcliff_tpu.utils.precision import check_precision_mode, precision_label
 
-__all__ = ["ServeService", "SlotsExhausted", "ENV_SLOTS", "DEFAULT_SLOTS",
-           "ENV_INGEST_CAP", "ENV_OUT_CAP", "QOS_CADENCE", "STATE_BASENAME"]
+__all__ = ["ServeService", "ServeLadder", "SlotsExhausted",
+           "ENV_SLOTS", "DEFAULT_SLOTS", "ENV_INGEST_CAP", "ENV_OUT_CAP",
+           "ENV_LADDER", "ENV_FUSE", "ENV_PRECISION", "LADDER_MODES",
+           "MIN_RUNG", "QOS_CADENCE", "STATE_BASENAME"]
 
 ENV_SLOTS = "REDCLIFF_SERVE_SLOTS"
 DEFAULT_SLOTS = 8
@@ -73,6 +115,29 @@ ENV_INGEST_CAP = "REDCLIFF_SERVE_INGEST_CAP"
 DEFAULT_INGEST_CAP = 64
 ENV_OUT_CAP = "REDCLIFF_SERVE_OUT_CAP"
 DEFAULT_OUT_CAP = 256
+
+# ---- occupancy ladder (ISSUE 20) ----
+ENV_LADDER = "REDCLIFF_SERVE_LADDER"
+DEFAULT_LADDER = "auto"
+LADDER_MODES = ("off", "auto", "force")
+# churn floor: below this rung another saved lane cannot pay for a cold
+# program, and sub-4 tables thrash on any connect
+MIN_RUNG = 4
+ENV_LADDER_HOLD = "REDCLIFF_SERVE_LADDER_HOLD"
+DEFAULT_LADDER_HOLD = 8
+ENV_LADDER_HORIZON = "REDCLIFF_SERVE_LADDER_HORIZON"
+DEFAULT_LADDER_HORIZON = 500
+
+# ---- micro-batched tick fusion ----
+ENV_FUSE = "REDCLIFF_SERVE_FUSE"
+DEFAULT_FUSE = 1
+
+# ---- mixed-precision serve path ----
+ENV_PRECISION = "REDCLIFF_SERVE_PRECISION"
+ENV_DEMOTE_STORM = "REDCLIFF_SERVE_DEMOTE_STORM"
+DEFAULT_DEMOTE_STORM = 3
+ENV_DEMOTE_WINDOW = "REDCLIFF_SERVE_DEMOTE_WINDOW"
+DEFAULT_DEMOTE_WINDOW = 200
 
 # degraded-QoS ladder: graph-readout cadence per rung (emit the (C, C)
 # combined graph on every Nth answered sample). Factor scores always flow
@@ -101,6 +166,203 @@ def _int_env(name, default):
         return default
 
 
+class ServeLadder:
+    """Host-side occupancy-ladder policy: which rung should this tick
+    dispatch, and is a shrink worth a cold program?
+
+    The serve twin of the PR-15 predictive scheduling policy. Growth is
+    mandatory (every leased slot must ride the dispatch); shrink below the
+    current rung is approved only after ``hold`` consecutive ticks of the
+    live high-water mark sitting under the smaller rung AND (in ``auto``)
+    a positive pricing verdict: predicted dead-lane saving over ``horizon``
+    ticks vs the compile cost of the target rung if it is cold. Evidence
+    comes first from this process's own per-width dispatch timings, then
+    from the persistent PR-8 cost store (keyed under the serve shape so
+    tick costs never merge with training epochs); with NO evidence the
+    policy holds the current (maximum) rung — the bit-identical
+    empty-store fallback.
+    """
+
+    def __init__(self, capacity, mode=None, min_rung=MIN_RUNG, hold=None,
+                 horizon=None, shape_key="serve", precision="f32"):
+        mode = (mode if mode is not None
+                else os.environ.get(ENV_LADDER, DEFAULT_LADDER))
+        mode = str(mode).lower()
+        if mode not in LADDER_MODES:
+            raise ValueError(
+                f"{ENV_LADDER} must be one of {LADDER_MODES}, got {mode!r}")
+        self.mode = mode
+        self.capacity = int(capacity)
+        self.min_rung = max(1, min(int(min_rung), self.capacity))
+        self.hold = int(hold if hold is not None
+                        else _int_env(ENV_LADDER_HOLD, DEFAULT_LADDER_HOLD))
+        self.horizon = int(horizon if horizon is not None
+                           else _int_env(ENV_LADDER_HORIZON,
+                                         DEFAULT_LADDER_HORIZON))
+        self.shape_key = shape_key
+        self.precision = precision
+        self._obs = {}          # width -> [steady ticks, total ms]
+        self._compile_obs = {}  # width -> measured first-dispatch skew ms
+        self._below = 0         # consecutive ticks want < current
+        self._store = None
+        self._store_loaded = False
+
+    def target(self, live_hi):
+        """The rung ``live_hi`` leased lanes want under this mode."""
+        if self.mode == "off":
+            return self.capacity
+        return _compaction.serve_rung(live_hi, self.capacity, self.min_rung)
+
+    # ------------------------------------------------------------ evidence
+    def observe(self, width, ms, cold):
+        """Fold one dispatch's wall ms into the per-width accumulators.
+        A cold dispatch carries the compile skew (measured far above steady
+        state): it is recorded as compile evidence, never averaged into the
+        steady tick cost (the rows_from_dispatch_stats discipline)."""
+        if cold:
+            base = self._steady_ms(width)
+            self._compile_obs[width] = max(
+                0.0, float(ms) - (base if base is not None else 0.0))
+        else:
+            o = self._obs.setdefault(int(width), [0, 0.0])
+            o[0] += 1
+            o[1] += float(ms)
+
+    def _steady_ms(self, width):
+        o = self._obs.get(int(width))
+        if o and o[0]:
+            return o[1] / o[0]
+        return None
+
+    def _cost_model(self):
+        if not self._store_loaded:
+            self._store_loaded = True
+            try:
+                from redcliff_tpu.obs import costmodel as _costmodel
+                self._store = _costmodel.load(None)
+            except Exception:
+                self._store = None
+        return self._store
+
+    def tick_ms(self, width, platform=None):
+        """Best per-tick wall estimate at a width: exact local mean, else
+        the nearest locally measured width scaled per-lane, else the
+        persistent store, else None (no evidence)."""
+        exact = self._steady_ms(width)
+        if exact is not None:
+            return exact
+        near = [(abs(w - width), w) for w, o in self._obs.items() if o[0]]
+        if near:
+            _, w = min(near)
+            return self._steady_ms(w) * (float(width) / w)
+        cm = self._cost_model()
+        if cm is not None:
+            return cm.predict_epoch_ms(self.shape_key, width,
+                                       platform=platform,
+                                       precision=self.precision)
+        return None
+
+    def compile_ms(self, width, platform=None):
+        """Predicted cost of compiling the rung cold: exact local
+        measurement, else the store, else the nearest locally measured
+        compile (compile cost tracks the program, not the lane count),
+        else None."""
+        if int(width) in self._compile_obs:
+            return self._compile_obs[int(width)]
+        cm = self._cost_model()
+        if cm is not None:
+            est = cm.predict_compile_ms(self.shape_key, width,
+                                        platform=platform,
+                                        precision=self.precision)
+            if est is not None:
+                return est
+        if self._compile_obs:
+            _, w = min((abs(w - width), w) for w in self._compile_obs)
+            return self._compile_obs[w]
+        return None
+
+    # ------------------------------------------------------------ the verdict
+    def decide(self, live_hi, current, cold_fn, platform=None):
+        """One tick's rung decision at the tick boundary.
+
+        Returns ``(new_width, event)`` where event is a dict for the
+        ``serve_ladder`` record (None when nothing noteworthy happened —
+        steady-state holds are silent; priced holds/fallbacks emit once per
+        hysteresis episode, not per tick).
+        """
+        if self.mode == "off":
+            return self.capacity, None
+        want = self.target(live_hi)
+        if want > current:
+            # growth is correctness, not economics: a leased slot beyond
+            # the rung would never be dispatched
+            self._below = 0
+            return want, {"kind": "grow", "from_width": current,
+                          "to_width": want, "live": int(live_hi),
+                          "cold": bool(cold_fn(want))}
+        if want == current:
+            self._below = 0
+            return current, None
+        self._below += 1
+        if self._below < self.hold:
+            return current, None
+        first = self._below == self.hold
+        if self.mode == "force":
+            self._below = 0
+            return want, {"kind": "shrink", "from_width": current,
+                          "to_width": want, "live": int(live_hi),
+                          "cold": bool(cold_fn(want)), "reason": "forced"}
+        cur_ms = self.tick_ms(current, platform)
+        if cur_ms is None:
+            # empty store + no local evidence: hold the current (maximum)
+            # rung — the bit-identical always-max fallback
+            ev = {"kind": "fallback", "from_width": current,
+                  "to_width": current, "live": int(live_hi),
+                  "reason": "no_evidence"} if first else None
+            return current, ev
+        want_ms = self.tick_ms(want, platform)
+        if want_ms is None:
+            # per-lane-proportional prior off the measured rung
+            want_ms = cur_ms * (float(want) / current)
+        saving = max(0.0, cur_ms - want_ms) * self.horizon
+        cold = bool(cold_fn(want))
+        comp = 0.0 if not cold else self.compile_ms(want, platform)
+        if comp is None:
+            ev = {"kind": "fallback", "from_width": current,
+                  "to_width": current, "live": int(live_hi),
+                  "reason": "compile_unpriceable"} if first else None
+            return current, ev
+        if saving > comp:
+            self._below = 0
+            return want, {"kind": "shrink", "from_width": current,
+                          "to_width": want, "live": int(live_hi),
+                          "cold": cold, "saving_ms": round(saving, 3),
+                          "compile_ms": round(comp, 3),
+                          "horizon_ticks": self.horizon}
+        ev = {"kind": "hold", "from_width": current, "to_width": want,
+              "live": int(live_hi), "saving_ms": round(saving, 3),
+              "compile_ms": round(comp, 3), "horizon_ticks": self.horizon,
+              "reason": "not_worth_compile"} if first else None
+        return current, ev
+
+    def rows(self):
+        """This process's per-width observations as PR-8 store rows
+        (folded into the persistent store at stop — the next server's
+        shrink pricing starts warm)."""
+        rows = []
+        for w in sorted(set(self._obs) | set(self._compile_obs)):
+            n, tot = self._obs.get(w, (0, 0.0))
+            comp = self._compile_obs.get(w)
+            if not n and comp is None:
+                continue
+            rows.append({"shape": self.shape_key, "g_bucket": int(w),
+                         "precision": self.precision,
+                         "epochs": int(n), "epoch_ms": float(tot),
+                         "compiles": 1 if comp is not None else 0,
+                         "compile_ms": float(comp or 0.0)})
+        return rows
+
+
 class ServeService:
     """One serving process: slot table + sessions + queues + telemetry.
 
@@ -111,7 +373,8 @@ class ServeService:
     """
 
     def __init__(self, model, params, root=None, capacity=None,
-                 lease_s=None, resume=True):
+                 lease_s=None, resume=True, precision_mode=None,
+                 ladder=None, fuse=None):
         from redcliff_tpu.serve.engine import StreamEngine
 
         self.capacity = int(capacity if capacity is not None
@@ -120,7 +383,24 @@ class ServeService:
         self.out_cap = _int_env(ENV_OUT_CAP, DEFAULT_OUT_CAP)
         self.root = root
         self._mu = threading.RLock()
-        self.engine = StreamEngine(model, params, self.capacity)
+        self.precision_mode = check_precision_mode(
+            precision_mode if precision_mode is not None
+            else os.environ.get(ENV_PRECISION, "f32"))
+        self.engine = StreamEngine(model, params, self.capacity,
+                                   precision_mode=self.precision_mode)
+        self.fuse = max(1, int(fuse if fuse is not None
+                               else _int_env(ENV_FUSE, DEFAULT_FUSE)))
+        # serve-prefixed shape key: tick costs bucket separately from any
+        # training epochs of the same model geometry
+        shape_key = (f"serve|c{self.engine.num_chans}"
+                     f"l{self.engine.window_len}k{self.engine.num_factors}")
+        self.ladder = ServeLadder(
+            self.capacity, mode=ladder, shape_key=shape_key,
+            precision=precision_label(self.precision_mode))
+        self._demote_storm = _int_env(ENV_DEMOTE_STORM, DEFAULT_DEMOTE_STORM)
+        self._demote_window = _int_env(ENV_DEMOTE_WINDOW,
+                                       DEFAULT_DEMOTE_WINDOW)
+        self._poison_ticks = deque()
         self.registry = _session.SessionRegistry(self.capacity,
                                                  lease_s=lease_s)
         self.pending = {}    # sid -> deque[(sample (C,), t_enq)]
@@ -128,6 +408,8 @@ class ServeService:
         self.drops = {}      # sid -> slow-consumer drops
         self._answered = {}  # sid -> answered-sample count (cadence basis)
         self._lat_ms = []
+        self._fused_samples = 0
+        self._fuse_hist = {}  # per-stream fused take -> dispatch count
         self.ticks = 0
         self.samples_in = 0
         self.samples_out = 0
@@ -140,6 +422,9 @@ class ServeService:
             resumed = self._try_resume()
         self._log.log("serve", kind="start", capacity=self.capacity,
                       streams=len(self.registry.sessions), resumed=resumed,
+                      width=self.engine.width, mode=self.ladder.mode,
+                      fuse=self.fuse,
+                      precision_mode=self.engine.precision_mode,
                       model_class=type(model).__name__)
 
     # ------------------------------------------------------------ loading
@@ -254,15 +539,20 @@ class ServeService:
             return [q.popleft() for _ in range(n)]
 
     # ------------------------------------------------------------ quarantine
-    def _quarantine(self, sess, reason, now):
+    def _quarantine(self, sess, reason, now, extra=0):
         """ACTIVE -> QUARANTINED: structured error state replaces output.
         Pending samples are answered as error records (a drain must not
-        strand them); the lane's device state is never consulted again."""
+        strand them); ``extra`` covers samples already popped into the
+        in-flight fused batch behind the poison — the lane's latch
+        discarded them in-graph, accounting answers them here. The lane's
+        device state is never consulted again."""
         self.registry.quarantine(sess.sid, reason)
         q = self.pending.get(sess.sid)
         err = {"sid": sess.sid, "trace_id": sess.trace_id,
                "error": sess.quarantine_reason}
         outq = self.out.get(sess.sid)
+        for _ in range(int(extra)):
+            self._push_out(sess, outq, dict(err))
         while q:
             q.popleft()
             self._push_out(sess, outq, dict(err))
@@ -283,12 +573,22 @@ class ServeService:
 
     # ------------------------------------------------------------ the tick
     def _assemble(self, now):
-        """Pop at most one pending sample per ACTIVE stream into the
-        ``(S, C)`` tick batch. Returns (samples, arrive, meta); meta maps
-        slot -> (sid, t_enq)."""
+        """Pop pending samples per ACTIVE stream into the tick batch at
+        FULL capacity (the dispatcher slices to the rung). Fusion engages
+        only when some stream has real backlog — otherwise depth is 1 and
+        the single-tick program runs (the PR-17 bit-path). Returns
+        ``(samples (S, F, C), arrive (S, F), meta, depth)``; meta maps
+        slot -> (sid, [t_enq, ...])."""
         S, C = self.capacity, self.engine.num_chans
-        samples = np.zeros((S, C), dtype=np.float32)
-        arrive = np.zeros((S,), dtype=bool)
+        depth = 1
+        if self.fuse > 1:
+            for sess in self.registry.live():
+                if sess.state == _session.ACTIVE and \
+                        len(self.pending.get(sess.sid) or ()) > 1:
+                    depth = self.fuse
+                    break
+        samples = np.zeros((S, depth, C), dtype=np.float32)
+        arrive = np.zeros((S, depth), dtype=bool)
         meta = {}
         for sess in self.registry.live():
             if sess.state != _session.ACTIVE:
@@ -296,39 +596,103 @@ class ServeService:
             q = self.pending.get(sess.sid)
             if not q:
                 continue
-            sample, t_enq = q.popleft()
-            samples[sess.slot] = sample
-            arrive[sess.slot] = True
-            meta[sess.slot] = (sess.sid, t_enq)
-        return samples, arrive, meta
+            ts = []
+            for f in range(min(len(q), depth)):
+                sample, t_enq = q.popleft()
+                samples[sess.slot, f] = sample
+                arrive[sess.slot, f] = True
+                ts.append(t_enq)
+            meta[sess.slot] = (sess.sid, ts)
+        return samples, arrive, meta, depth
 
-    def _distribute(self, out, meta, now):
-        """Turn one dispatch's lane outputs into per-stream records."""
-        for slot, (sid, t_enq) in meta.items():
+    def _live_hi(self):
+        """Live high-water mark: 1 + the highest leased slot (ACTIVE and
+        QUARANTINED both hold lanes). The rung must cover every leased
+        slot."""
+        return 1 + max((s.slot for s in self.registry.sessions.values()),
+                       default=-1)
+
+    def _ladder_tick(self, now, floor=0):
+        """Run the rung policy at the tick boundary; resize + emit on a
+        decision. ``floor`` covers slots already assembled into the
+        in-flight batch (a disconnect between assemble and dispatch must
+        not shrink them out from under the distribute)."""
+        hi = max(self._live_hi(), int(floor))
+        cur = self.engine.width
+        new, ev = self.ladder.decide(hi, cur, self.engine.is_cold,
+                                     self.engine.platform)
+        if new != cur:
+            self.engine.resize(new)
+        if ev is not None:
+            self._log.log("serve_ladder", capacity=self.capacity,
+                          mode=self.ladder.mode, ticks=self.ticks, **ev)
+
+    def _distribute(self, out, meta, depth, now):
+        """Turn one dispatch's lane outputs into per-stream records. A
+        fused dispatch carries a leading F axis; element f of lane s is
+        bit-equal to the f-th sequential single-tick dispatch, so the
+        record stream is independent of fuse depth (the fusion identity
+        pin). Graph cadence keys off the per-stream answered counter, so
+        the QoS ladder composes with fusion unchanged."""
+        fused = depth > 1
+        for slot, (sid, t_enqs) in meta.items():
             sess = self.registry.get(sid)
             if sess is None:      # reaped between assemble and distribute
                 continue
-            if out["poison_hit"][slot]:
-                self._quarantine(sess, "non-finite sample", now)
-                continue
-            if not out["ready"][slot]:
-                # warmup: ring not yet full — the sample advanced state
-                # but no readout exists yet
-                continue
-            self._answered[sid] = self._answered.get(sid, 0) + 1
-            cadence = QOS_CADENCE[min(sess.qos_rung, len(QOS_CADENCE) - 1)]
-            lat_ms = max(0.0, (now - t_enq) * 1e3)
-            rec = {"sid": sid, "trace_id": sess.trace_id,
-                   "seq": self._answered[sid],
-                   "scores": np.array(out["scores"][slot], copy=True),
-                   "latency_ms": lat_ms}
-            if (self._answered[sid] - 1) % cadence == 0:
-                rec["graph"] = np.array(out["graph"][slot], copy=True)
-            self._push_out(sess, self.out.get(sid), rec)
-            sess.samples_out += 1
-            self.samples_out += 1
-            if len(self._lat_ms) < _MAX_LAT_SAMPLES:
-                self._lat_ms.append(lat_ms)
+            for f, t_enq in enumerate(t_enqs):
+                if fused:
+                    poison_hit = out["poison_hit"][f, slot]
+                    ready = out["ready"][f, slot]
+                else:
+                    poison_hit = out["poison_hit"][slot]
+                    ready = out["ready"][slot]
+                if poison_hit:
+                    self._poison_ticks.append(self.ticks)
+                    self._quarantine(sess, "non-finite sample", now,
+                                     extra=len(t_enqs) - f - 1)
+                    break
+                if not ready:
+                    # warmup: ring not yet full — the sample advanced state
+                    # but no readout exists yet
+                    continue
+                self._answered[sid] = self._answered.get(sid, 0) + 1
+                cadence = QOS_CADENCE[min(sess.qos_rung,
+                                          len(QOS_CADENCE) - 1)]
+                lat_ms = max(0.0, (now - t_enq) * 1e3)
+                scores = out["scores"][f, slot] if fused \
+                    else out["scores"][slot]
+                rec = {"sid": sid, "trace_id": sess.trace_id,
+                       "seq": self._answered[sid],
+                       "scores": np.array(scores, copy=True),
+                       "latency_ms": lat_ms}
+                if (self._answered[sid] - 1) % cadence == 0:
+                    graph = out["graph"][f, slot] if fused \
+                        else out["graph"][slot]
+                    rec["graph"] = np.array(graph, copy=True)
+                self._push_out(sess, self.out.get(sid), rec)
+                sess.samples_out += 1
+                self.samples_out += 1
+                if len(self._lat_ms) < _MAX_LAT_SAMPLES:
+                    self._lat_ms.append(lat_ms)
+
+    def _maybe_demote(self, now):
+        """The poisoned-lane-storm sentinel: ``storm`` quarantines-by-NaN
+        inside ``window`` ticks demote the whole table from mixed to f32
+        (retrace only; rings are already f32). Persisted at drain, honored
+        at resume — never silently re-promoted."""
+        if self.engine.precision_mode != "mixed" or self.engine.demoted:
+            return
+        w = self._demote_window
+        while self._poison_ticks and self._poison_ticks[0] <= self.ticks - w:
+            self._poison_ticks.popleft()
+        if len(self._poison_ticks) < self._demote_storm:
+            return
+        self.engine.demote()
+        self._log.log("precision", kind="demote", scope="serve",
+                      lanes_poisoned=len(self._poison_ticks),
+                      window_ticks=w, ticks=self.ticks,
+                      cause="poisoned-lane storm", mode_from="mixed",
+                      mode_to="f32")
 
     def _update_qos(self, now):
         """Per-stream backlog ladder with hysteresis; emits only rung
@@ -360,32 +724,55 @@ class ServeService:
         for sess in self.registry.reap(now=now):
             self._recycle(sess, kind="expire")
 
+    def _dispatch_tick(self, samples, arrive, meta, depth, now, wall):
+        """The shared back half of a tick: ladder decision, ONE dispatch at
+        the rung, distribute, sentinels, counters. ``samples``/``arrive``
+        are full-capacity; the rung slice is a view."""
+        with self._mu:
+            floor = 1 + max(meta, default=-1)
+            self._ladder_tick(now, floor=floor)
+            W = self.engine.width
+        answered = 0
+        out = None
+        if meta:
+            cold = self.engine.is_cold(W, depth)
+            t0 = time.perf_counter()
+            with _obs.span("serve.dispatch", component="serve"):
+                if depth > 1:
+                    out = self.engine.step_fused(samples[:W], arrive[:W])
+                else:
+                    out = self.engine.step(samples[:W, 0], arrive[:W, 0])
+            ms = (time.perf_counter() - t0) * 1e3
+        with self._mu:
+            if out is not None:
+                self.ladder.observe(W, ms, cold)
+                if depth > 1:
+                    self._fused_samples += int(arrive[:W].sum())
+                for _slot, (_sid, ts) in meta.items():
+                    self._fuse_hist[len(ts)] = \
+                        self._fuse_hist.get(len(ts), 0) + 1
+                before = self.samples_out
+                # on the real clock, latency must charge the dispatch that
+                # just ran; an injected (virtual) clock stays as given so
+                # replayed runs remain deterministic
+                self._distribute(out, meta, depth,
+                                 time.time() if wall else now)
+                answered = self.samples_out - before
+                self._maybe_demote(now)
+            self._update_qos(now)
+            self.ticks += 1
+            if self.ticks % _TICK_EVERY == 0:
+                self._emit_tick()
+        return answered
+
     def pump(self, now=None):
         """One synchronous tick. Returns the number of samples answered."""
         wall = now is None
         now = time.time() if wall else float(now)
         with self._mu:
             self._reap(now)
-            samples, arrive, meta = self._assemble(now)
-        answered = 0
-        if meta:
-            with _obs.span("serve.dispatch", component="serve"):
-                out = self.engine.step(samples, arrive)
-        else:
-            out = None
-        with self._mu:
-            if out is not None:
-                before = self.samples_out
-                # on the real clock, latency must charge the dispatch that
-                # just ran; an injected (virtual) clock stays as given so
-                # replayed runs remain deterministic
-                self._distribute(out, meta, time.time() if wall else now)
-                answered = self.samples_out - before
-            self._update_qos(now)
-            self.ticks += 1
-            if self.ticks % _TICK_EVERY == 0:
-                self._emit_tick()
-        return answered
+            samples, arrive, meta, depth = self._assemble(now)
+        return self._dispatch_tick(samples, arrive, meta, depth, now, wall)
 
     def _emit_tick(self):
         dist = {}
@@ -395,20 +782,31 @@ class ServeService:
         self._log.log("serve", kind="tick", ticks=self.ticks,
                       streams=len(self.registry.sessions),
                       free_slots=self.registry.free_slots(),
+                      width=self.engine.width,
+                      live=self._live_hi(),
                       samples_in=self.samples_in,
                       samples_out=self.samples_out,
+                      fused_samples=self._fused_samples,
                       rejects=self.rejects,
                       dropped=sum(self.drops.values()),
                       n=len(self._lat_ms), **dist)
+        if self.fuse > 1:
+            self._log.log("serve_fuse", kind="stats", depth=self.fuse,
+                          fused_samples=self._fused_samples,
+                          hist={str(k): v for k, v
+                                in sorted(self._fuse_hist.items())},
+                          ticks=self.ticks)
 
     # ------------------------------------------------------------ the loop
     def run_loop(self, max_ticks=None, interval_s=0.0, depth=2):
         """Drive ticks through the double-buffered prefetch pipeline:
         assembly of tick t+1 (prefetch thread) overlaps the engine dispatch
-        of tick t (this thread). Runs until ``max_ticks`` or a drain
-        request; prefetched-but-unstepped batches are consumed to
-        exhaustion on drain — never dropped — then :meth:`drain` finishes
-        the remaining backlog synchronously."""
+        of tick t (this thread). Assembly stays full-capacity host work —
+        the ladder decision and the rung slice happen on THIS thread, which
+        owns device state. Runs until ``max_ticks`` or a drain request;
+        prefetched-but-unstepped batches are consumed to exhaustion on
+        drain — never dropped — then :meth:`drain` finishes the remaining
+        backlog synchronously."""
         from redcliff_tpu.data.pipeline import prefetch_batches
 
         def assembly():
@@ -419,8 +817,8 @@ class ServeService:
                 now = time.time()
                 with self._mu:
                     self._reap(now)
-                    samples, arrive, meta = self._assemble(now)
-                yield samples, arrive, meta, now
+                    batch = self._assemble(now)
+                yield batch + (now,)
                 n += 1
                 if interval_s:
                     time.sleep(interval_s)
@@ -429,20 +827,9 @@ class ServeService:
         # exhaust the stream — on drain the generator stops producing and
         # the loop below consumes every already-buffered batch (samples
         # popped from pending must be answered, not lost)
-        for samples, arrive, meta, t_asm in src:
-            now = time.time()
-            if meta:
-                with _obs.span("serve.dispatch", component="serve"):
-                    out = self.engine.step(samples, arrive)
-            else:
-                out = None
-            with self._mu:
-                if out is not None:
-                    self._distribute(out, meta, now)
-                self._update_qos(now)
-                self.ticks += 1
-                if self.ticks % _TICK_EVERY == 0:
-                    self._emit_tick()
+        for samples, arrive, meta, fdepth, t_asm in src:
+            self._dispatch_tick(samples, arrive, meta, fdepth,
+                                time.time(), True)
         src.close()
         if self._draining:
             self.drain()
@@ -473,8 +860,10 @@ class ServeService:
                     "n": len(self._lat_ms)}
         self._log.log("serve", kind="drain", ticks=self.ticks,
                       streams=len(self.registry.sessions),
+                      width=self.engine.width,
                       samples_in=self.samples_in,
                       samples_out=self.samples_out,
+                      fused_samples=self._fused_samples,
                       rejects=self.rejects,
                       dropped=sum(self.drops.values()),
                       undelivered=sum(len(q) for q in self.out.values()),
@@ -486,9 +875,25 @@ class ServeService:
         if self._stopped:
             return
         self._stopped = True
+        self._fold_cost_store()
         self._log.log("serve", kind="stop", ticks=self.ticks,
                       samples_out=self.samples_out)
         self._log.close()
+
+    def _fold_cost_store(self):
+        """Fold this process's per-rung tick/compile observations into the
+        persistent PR-8 store (when one is configured): the next server's
+        first shrink decision prices against real evidence instead of
+        falling back to always-max."""
+        try:
+            from redcliff_tpu.obs import costmodel as _costmodel
+            if _costmodel.store_path(None) is None:
+                return
+            rows = self.ladder.rows()
+            if rows:
+                _costmodel.update_store(None, rows, self.engine.platform)
+        except Exception:
+            pass  # telemetry must never take down a drain
 
     def request_drain(self):
         """Async-signal-safe drain request: the running loop (or the next
@@ -516,12 +921,18 @@ class ServeService:
             payload = {
                 "registry": self.registry.snapshot(),
                 "engine": self.engine.export_state(),
+                "ladder": {"width": self.engine.width,
+                           "capacity": self.capacity,
+                           "mode": self.ladder.mode},
+                "precision": {"mode": self.engine.precision_mode,
+                              "demoted": self.engine.demoted},
                 "out": {sid: list(q) for sid, q in self.out.items()},
                 "answered": dict(self._answered),
                 "drops": dict(self.drops),
                 "counters": {"ticks": self.ticks,
                              "samples_in": self.samples_in,
                              "samples_out": self.samples_out,
+                             "fused_samples": self._fused_samples,
                              "rejects": self.rejects},
             }
         write_checkpoint(path, payload)
@@ -536,9 +947,26 @@ class ServeService:
         if payload is None:
             return 0
         now = time.time()
-        self.registry = _session.SessionRegistry.from_snapshot(
-            payload["registry"], now=now)
-        self.engine.import_state(payload["engine"])
+        prec = payload.get("precision") or {}
+        if prec.get("demoted") and self.engine.precision_mode == "mixed" \
+                and not self.engine.demoted:
+            # a storm-demoted table NEVER silently re-promotes on restart
+            self.engine.demote()
+            self._log.log("precision", kind="resume_demoted", scope="serve",
+                          cause="checkpoint recorded demotion",
+                          mode_from="mixed", mode_to="f32")
+        eng_snap = payload["engine"]
+        ck_width = int(np.asarray(eng_snap["window"]).shape[0])
+        snap_reg = payload["registry"]
+        if int(snap_reg["capacity"]) == self.capacity:
+            self.registry = _session.SessionRegistry.from_snapshot(
+                snap_reg, now=now)
+            # restore straight into the recorded rung; the ladder takes
+            # over from there at the first pump
+            self.engine.resize(min(max(ck_width, 1), self.capacity))
+            self.engine.import_state(eng_snap)
+        else:
+            self._resume_repack(snap_reg, eng_snap, ck_width, now)
         self.out = {sid: deque(v) for sid, v in payload["out"].items()}
         self._answered = dict(payload.get("answered", {}))
         self.drops = dict(payload.get("drops", {}))
@@ -546,6 +974,7 @@ class ServeService:
         self.ticks = int(c.get("ticks", 0))
         self.samples_in = int(c.get("samples_in", 0))
         self.samples_out = int(c.get("samples_out", 0))
+        self._fused_samples = int(c.get("fused_samples", 0))
         self.rejects = int(c.get("rejects", 0))
         for sess in self.registry.live():
             self.pending.setdefault(sess.sid, deque())
@@ -558,5 +987,42 @@ class ServeService:
                           samples_out=sess.samples_out)
         self._log.log("serve", kind="resume",
                       streams=len(self.registry.sessions),
+                      width=self.engine.width,
                       ticks=self.ticks, checkpoint=path)
         return len(self.registry.sessions)
+
+    def _resume_repack(self, snap_reg, eng_snap, ck_width, now):
+        """Cross-geometry resume: re-pack live lanes into THIS table
+        instead of failing the PR-17 shape check. Lanes pack dense from
+        slot 0 in checkpoint-slot order (relative order preserved), the
+        registry's LIFO pool is re-seeded above them, and the engine
+        restores row-by-row through ``import_state(slot_map=...)``. Only a
+        table too small for the live streams refuses — naming both
+        geometries."""
+        sess_dicts = sorted(snap_reg["sessions"], key=lambda d: d["slot"])
+        n = len(sess_dicts)
+        if n > self.capacity:
+            raise ValueError(
+                f"serve resume geometry mismatch: checkpoint capacity "
+                f"{int(snap_reg['capacity'])} (rung {ck_width}, {n} live "
+                f"streams) vs engine capacity {self.capacity} — {n} live "
+                f"streams do not fit the new table; grow capacity or drain "
+                f"sessions before resizing")
+        reg = _session.SessionRegistry(self.capacity,
+                                       lease_s=snap_reg.get("lease_s"))
+        slot_map = {}
+        for new, d in enumerate(sess_dicts):
+            sess = _session.Session.from_dict(d)
+            slot_map[int(d["slot"])] = new
+            sess.slot = new
+            sess.lease_expires_at = now + reg.lease_s
+            reg.sessions[sess.sid] = sess
+        reg._free = list(range(self.capacity - 1, n - 1, -1))
+        self.registry = reg
+        width = self.ladder.target(n)
+        self.engine.resize(width)
+        self.engine.import_state(eng_snap, slot_map=slot_map)
+        self._log.log("serve_ladder", kind="repack", from_width=ck_width,
+                      to_width=width, live=n, capacity=self.capacity,
+                      from_capacity=int(snap_reg["capacity"]),
+                      mode=self.ladder.mode, streams=n)

@@ -1,0 +1,507 @@
+"""Elastic serve data plane tests (redcliff_tpu/serve, ISSUE 20).
+
+Pins the elasticity contracts on top of the ISSUE-17 serve plane: the pow2
+occupancy-rung helper, the ladder policy's priced shrink verdicts (growth
+mandatory, hysteresis, empty-evidence always-max fallback), BYTE identity
+of served records across every elastic axis — ladder on/off, grow ->
+shrink -> grow under a seeded sawtooth churn storm, micro-batched tick
+fusion on/off at equal sample counts, and the f32-vs-mixed demotion path —
+plus drain/resume re-packing lanes across rung geometries (and the
+both-geometries error when the checkpoint cannot fit), the poisoned-lane
+storm auto-demotion sentinel with its persisted checkpoint bit, the
+graph-mix kernel's interpret-mode bitwise parity, and schema-valid
+serve_ladder/serve_fuse/precision telemetry. The slow-marked soak rides a
+long sawtooth with NaN poisoning through the forced ladder.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from redcliff_tpu.models.redcliff import RedcliffSCMLP, RedcliffSCMLPConfig
+from redcliff_tpu.obs import read_jsonl, schema
+from redcliff_tpu.parallel.compaction import serve_rung
+from redcliff_tpu.serve import chaos
+from redcliff_tpu.serve.engine import StreamEngine
+from redcliff_tpu.serve.service import (MIN_RUNG, ServeLadder, ServeService,
+                                        STATE_BASENAME)
+
+C = 4          # channels
+L = 4          # embed_lag == ring length
+
+
+def _model():
+    return RedcliffSCMLP(RedcliffSCMLPConfig(
+        num_chans=C, gen_lag=2, gen_hidden=(8,), embed_lag=L,
+        embed_hidden_sizes=(8,), num_factors=2, num_supervised_factors=2,
+        factor_weight_l1_coeff=0.01, adj_l1_reg_coeff=0.001,
+        factor_cos_sim_coeff=0.01,
+        factor_score_embedder_type="Vanilla_Embedder",
+        primary_gc_est_mode="fixed_factor_exclusive", num_sims=1,
+        training_mode="combined"))
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    import jax
+    model = _model()
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _service(fitted, **kw):
+    model, params = fitted
+    kw.setdefault("lease_s", 30.0)
+    kw.setdefault("resume", False)
+    return ServeService(model, params, **kw)
+
+
+def _events(root, name):
+    return [r for r in read_jsonl(root) if r.get("event") == name]
+
+
+# ------------------------------------------------------------- rung helper
+def test_serve_rung_pow2_and_clamps():
+    """The rung is the smallest pow2 >= max(live, min_rung), clamped to
+    capacity (non-pow2 capacities clamp, never round up past the table)."""
+    assert serve_rung(0, 8) == 1
+    assert serve_rung(1, 8) == 1
+    assert serve_rung(3, 8) == 4
+    assert serve_rung(4, 8) == 4
+    assert serve_rung(5, 8) == 8
+    assert serve_rung(9, 8) == 8          # clamp: never above capacity
+    assert serve_rung(17, 32) == 32
+    assert serve_rung(0, 8, min_rung=4) == 4
+    assert serve_rung(2, 8, min_rung=4) == 4
+    assert serve_rung(3, 3) == 3          # non-pow2 capacity clamps
+    assert serve_rung(1, 3) == 1
+
+
+# ------------------------------------------------------------ ladder policy
+def test_ladder_growth_mandatory_shrink_hysteresis():
+    """Growth fires immediately (a leased slot beyond the rung would never
+    be dispatched); shrink waits out ``hold`` consecutive under-rung ticks
+    even in force mode."""
+    lad = ServeLadder(16, mode="force", hold=2)
+    w, ev = lad.decide(2, 16, lambda w: True)
+    assert (w, ev) == (16, None)          # first under-rung tick: hold
+    w, ev = lad.decide(2, 16, lambda w: True)
+    assert w == 4 and ev["kind"] == "shrink" and ev["reason"] == "forced"
+    w, ev = lad.decide(10, 4, lambda w: True)
+    assert w == 16 and ev["kind"] == "grow" and ev["live"] == 10
+
+
+def test_ladder_off_always_capacity():
+    lad = ServeLadder(16, mode="off", hold=1)
+    assert lad.decide(2, 16, lambda w: False) == (16, None)
+    assert lad.target(2) == 16
+
+
+def test_ladder_auto_no_evidence_holds_max(tmp_path, monkeypatch):
+    """Empty persistent store + no local timings: auto mode must hold the
+    current (maximum) rung — the bit-identical fallback — and say so once
+    per hysteresis episode, not per tick."""
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR", str(tmp_path))
+    lad = ServeLadder(16, mode="auto", hold=1)
+    w, ev = lad.decide(2, 16, lambda w: True)
+    assert w == 16 and ev["kind"] == "fallback" \
+        and ev["reason"] == "no_evidence"
+    w, ev = lad.decide(2, 16, lambda w: True)
+    assert (w, ev) == (16, None)          # episode already reported
+
+
+def test_ladder_auto_prices_shrink_vs_compile(tmp_path, monkeypatch):
+    """The auto verdict is the PR-15 pricing shape: predicted dead-lane
+    saving over the horizon vs the target rung's compile cost when cold."""
+    monkeypatch.setenv("REDCLIFF_COST_MODEL_DIR", str(tmp_path))
+    lad = ServeLadder(16, mode="auto", hold=1, horizon=100)
+    for _ in range(4):
+        lad.observe(16, 10.0, cold=False)
+    # warm target: zero compile cost, per-lane prior says 4 lanes cost
+    # 2.5ms -> saving 7.5ms * 100 ticks, shrink approved
+    w, ev = lad.decide(2, 16, lambda w: False)
+    assert w == 4 and ev["kind"] == "shrink"
+    assert ev["saving_ms"] == pytest.approx(750.0)
+    # cold target with NO compile evidence anywhere: unpriceable, hold
+    lad2 = ServeLadder(16, mode="auto", hold=1, horizon=100)
+    for _ in range(4):
+        lad2.observe(16, 10.0, cold=False)
+    w, ev = lad2.decide(2, 16, lambda w: True)
+    assert w == 16 and ev["reason"] == "compile_unpriceable"
+    # compile evidence says the cold program costs MORE than the saving:
+    # hold with the priced verdict on the record
+    lad2.observe(4, 5000.0, cold=True)
+    lad2._below = 0
+    w, ev = lad2.decide(2, 16, lambda w: True)
+    assert w == 16 and ev["kind"] == "hold" \
+        and ev["reason"] == "not_worth_compile"
+    # a longer horizon flips the same evidence to a shrink
+    lad3 = ServeLadder(16, mode="auto", hold=1, horizon=1000)
+    for _ in range(4):
+        lad3.observe(16, 10.0, cold=False)
+    lad3.observe(4, 5000.0, cold=True)
+    w, ev = lad3.decide(2, 16, lambda w: True)
+    assert w == 4 and ev["kind"] == "shrink" and ev["cold"] is True
+
+
+def test_ladder_rows_feed_cost_store():
+    lad = ServeLadder(8, mode="auto", hold=1, shape_key="serve|x",
+                      precision="f32")
+    lad.observe(8, 10.0, cold=False)
+    lad.observe(8, 12.0, cold=False)
+    lad.observe(4, 100.0, cold=True)
+    rows = lad.rows()
+    by_w = {r["g_bucket"]: r for r in rows}
+    assert by_w[8]["epochs"] == 2 and by_w[8]["epoch_ms"] == 22.0
+    assert by_w[4]["compiles"] == 1 and by_w[4]["compile_ms"] == 100.0
+    assert all(r["shape"] == "serve|x" for r in rows)
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_fused_scan_bitwise_equals_sequential(fitted):
+    """One fused lax.scan over F backlogged samples is BITWISE equal to F
+    sequential single-sample dispatches — the fusion identity at the
+    engine level, before any service plumbing."""
+    model, params = fitted
+    rng = np.random.default_rng(0)
+    W, F = 3, 5
+    samples = rng.normal(size=(W, F, C)).astype(np.float32)
+    arrive = np.ones((W, F), dtype=bool)
+    arrive[2, 3] = False                  # a ragged hole in the backlog
+
+    eng_a = StreamEngine(model, params, capacity=W)
+    seq = [eng_a.step(samples[:, f], arrive[:, f]) for f in range(F)]
+    eng_b = StreamEngine(model, params, capacity=W)
+    fused = eng_b.step_fused(samples, arrive)
+
+    for f in range(F):
+        for k in seq[f]:
+            a = np.asarray(seq[f][k])
+            b = np.asarray(fused[k][f])
+            assert a.tobytes() == b.tobytes(), (k, f)
+    sa, sb = eng_a.export_state(), eng_b.export_state()
+    for k in sa:
+        assert np.asarray(sa[k]).tobytes() == np.asarray(sb[k]).tobytes()
+
+
+def test_engine_resize_preserves_lane_bytes(fitted):
+    """Grow -> shrink -> grow at the engine level: occupied lanes are
+    byte-identical to a fixed-width run at every step (shrink slices,
+    grow zero-pads; lane math is row-independent)."""
+    model, params = fitted
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(8, 2, C)).astype(np.float32)
+
+    fixed = StreamEngine(model, params, capacity=8)
+    elastic = StreamEngine(model, params, capacity=8)
+    elastic.resize(4)
+    for t in range(8):
+        s = np.zeros((8, C), np.float32)
+        s[:2] = xs[t]
+        arr = np.zeros(8, bool)
+        arr[:2] = True
+        a = fixed.step(s, arr)
+        if t == 3:
+            elastic.resize(8)
+        if t == 5:
+            elastic.resize(4)
+        w = elastic.width
+        b = elastic.step(s[:w], arr[:w])
+        for k in a:
+            assert np.asarray(a[k])[:2].tobytes() \
+                == np.asarray(b[k])[:2].tobytes(), (k, t)
+    with pytest.raises(ValueError):
+        elastic.resize(16)                # beyond capacity
+    with pytest.raises(ValueError):
+        elastic.resize(0)
+
+
+def test_graph_mix_interpret_bitwise_parity():
+    """The serve-path graph mix (weightings x static factor graphs through
+    the PR-14 factor-mix kernel) is bitwise equal to the reference einsum
+    in interpret mode — the exact-jnp parity anchor for the mixed path's
+    TPU routing."""
+    import jax.numpy as jnp
+
+    from redcliff_tpu.ops.factor_mix import (factor_mix_reference, graph_mix,
+                                             graph_mix_reference)
+    rng = np.random.default_rng(2)
+    for S, K, D in ((7, 3, 5), (1, 2, 4), (16, 5, 3)):
+        w = jnp.asarray(rng.random((S, K)).astype(np.float32))
+        g = jnp.asarray(rng.normal(size=(K, D, D)).astype(np.float32))
+        got = graph_mix(w, g, interpret=True)
+        # bitwise vs the kernel's exact-jnp anchor (the broadcast
+        # factor-mix reference — same contraction the kernel runs)
+        preds = jnp.broadcast_to(g[:, None], (K, S, D, D))
+        want = factor_mix_reference(w, preds)
+        assert np.asarray(got).tobytes() == np.asarray(want).tobytes(), \
+            (S, K, D)
+        # and numerically the same blend the non-TPU engine path serves
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(graph_mix_reference(w, g)),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------- service-level identity
+def test_ladder_identity_under_sawtooth_churn(fitted, tmp_path,
+                                              monkeypatch):
+    """THE elasticity pin: a forced-ladder service riding grow -> shrink ->
+    grow under a seeded sawtooth churn storm answers its victims
+    byte-identically to a ladder-off (always-max) run, and the ladder's
+    decisions are schema-valid."""
+    monkeypatch.setenv("REDCLIFF_SERVE_LADDER_HOLD", "2")
+    victims = {f"v{i}": chaos.stream_samples(50 + i, 16, C)
+               for i in range(2)}
+
+    def run(mode, root):
+        svc = _service(fitted, capacity=16, root=str(root), ladder=mode)
+        for sid in victims:
+            svc.connect(sid=sid, now=0.0)
+        storm = chaos.make_sawtooth_storm(9, C, lo=0, hi=6, period=5)
+        res = chaos.drive(svc, victims, ticks=24, chaos_fn=storm)
+        svc.stop()
+        return res
+
+    root_on = tmp_path / "on"
+    res_on = run("force", root_on)
+    res_off = run("off", tmp_path / "off")
+    identical, compared, detail = chaos.outputs_identical(res_on, res_off)
+    assert identical and compared > 0, detail
+
+    trans = [(e["kind"], e["to_width"])
+             for e in _events(str(root_on), "serve_ladder")
+             if e.get("kind") in ("grow", "shrink")]
+    kinds = [k for k, _ in trans]
+    assert "grow" in kinds and "shrink" in kinds and len(trans) >= 3
+    assert all(w in (4, 8, 16) for _, w in trans)
+    records = read_jsonl(str(root_on))
+    assert schema.validate_records(records) == []
+
+
+def test_fusion_identity_equal_sample_counts(fitted, tmp_path):
+    """Backlogged streams drained through the fused scan answer the exact
+    bytes of an unfused pump-per-sample run, and the serve_fuse stats
+    event reports the depth histogram."""
+    xs = chaos.stream_samples(5, 24, C)
+
+    def run(fuse, burst, root):
+        svc = _service(fitted, capacity=4, root=str(root), ladder="off",
+                       fuse=fuse)
+        svc.connect(sid="s", now=0.0)
+        now, recs = 0.0, []
+        for i in range(24):
+            now += 0.01
+            svc.ingest("s", xs[i], now=now)
+            if (i + 1) % burst == 0:
+                svc.pump(now=now)
+                recs.extend(svc.poll("s", now=now))
+        # trailing pumps both drain stragglers and cross the _TICK_EVERY
+        # cadence so the serve_fuse stats event lands
+        for _ in range(20):
+            now += 0.01
+            svc.pump(now=now)
+            recs.extend(svc.poll("s", now=now))
+        svc.stop()
+        return {"s": recs}, svc
+
+    plain, _ = run(1, 1, tmp_path / "plain")
+    fused, svc_f = run(4, 4, tmp_path / "fused")
+    identical, compared, detail = chaos.outputs_identical(plain, fused)
+    assert identical and compared == 24 - L + 1, detail
+    assert svc_f._fused_samples > 0
+    stats = [e for e in _events(str(tmp_path / "fused"), "serve_fuse")
+             if e.get("kind") == "stats"]
+    assert stats and stats[-1]["fused_samples"] == svc_f._fused_samples
+    assert "4" in stats[-1]["hist"] or 4 in stats[-1]["hist"]
+
+
+def test_mixed_precision_parity_and_finiteness(fitted):
+    """The mixed serve path answers close to f32 (bf16 contraction
+    tolerance) with every score finite — the path-alive pin on every
+    backend; bitwise equality only holds where the backend's matmul
+    ignores the bf16 hint."""
+    xs = {f"v{i}": chaos.stream_samples(70 + i, 12, C) for i in range(2)}
+
+    def run(pm):
+        svc = _service(fitted, capacity=4, ladder="off", precision_mode=pm)
+        for sid in xs:
+            svc.connect(sid=sid, now=0.0)
+        res = chaos.drive(svc, xs, ticks=16)
+        svc.stop()
+        return res
+
+    a, b = run("f32"), run("mixed")
+    for sid in xs:
+        assert len(a[sid]) == len(b[sid]) > 0
+        for ra, rb in zip(a[sid], b[sid]):
+            sa, sb = np.asarray(ra["scores"]), np.asarray(rb["scores"])
+            assert np.all(np.isfinite(sb))
+            np.testing.assert_allclose(sa, sb, rtol=2e-2, atol=1e-3)
+    with pytest.raises(ValueError):
+        _service(fitted, capacity=2, precision_mode="tf32-ish")
+
+
+def test_poison_storm_demotes_and_resume_honors_it(fitted, tmp_path,
+                                                   monkeypatch):
+    """The demotion sentinel: a poisoned-lane storm inside the window
+    demotes the mixed table to f32 (precision event, engine latch), the
+    drain checkpoint persists the bit, a restarted mixed server comes up
+    demoted, and post-demotion victim records are BYTE-identical to a
+    pure-f32 run (the demoted program carries no precision context and
+    the ring holds raw f32 samples)."""
+    monkeypatch.setenv("REDCLIFF_SERVE_DEMOTE_STORM", "2")
+    root = tmp_path / "mix"
+    victims = {"v0": chaos.stream_samples(80, 20, C)}
+
+    def storm(svc, t, now):
+        if t == 2:
+            for i in range(3):
+                svc.connect(sid=f"p{i}", now=now)
+        if 2 <= t <= 6:
+            for i in range(3):
+                x = np.full(C, np.nan, np.float32)
+                svc.ingest(f"p{i}", x, now=now)
+
+    svc = _service(fitted, capacity=8, root=str(root),
+                   precision_mode="mixed")
+    svc.connect(sid="v0", now=0.0)
+    res_mixed = chaos.drive(svc, victims, ticks=24, chaos_fn=storm)
+    assert svc.engine.demoted
+    ck = svc.drain(now=5.0)
+    assert ck and os.path.basename(ck) == STATE_BASENAME
+
+    prec = [e for e in _events(str(root), "precision")
+            if e.get("scope") == "serve"]
+    assert any(e["kind"] == "demote"
+               and e["cause"] == "poisoned-lane storm" for e in prec)
+
+    # f32 control run: same victims, same storm shape (quarantined lanes
+    # never perturb co-residents either way)
+    svc_f = _service(fitted, capacity=8, ladder="off")
+    svc_f.connect(sid="v0", now=0.0)
+    res_f32 = chaos.drive(svc_f, victims, ticks=24, chaos_fn=storm)
+    svc_f.stop()
+    # records produced AFTER the demotion tick must byte-match f32
+    demote_tick = next(e["ticks"] for e in prec if e["kind"] == "demote")
+    post_m = [r for r in res_mixed["v0"]
+              if r.get("seq", 0) > demote_tick + L]
+    post_f = res_f32["v0"][-len(post_m):] if post_m else []
+    assert post_m, "storm must land before the victim stream ends"
+    ok, n, detail = chaos.outputs_identical({"v0": post_m}, {"v0": post_f})
+    assert ok and n == len(post_m), detail
+
+    # restart: the checkpoint's demotion bit must win over the requested
+    # mixed mode, with the resume_demoted event on the record
+    svc2 = _service(fitted, capacity=8, root=str(root),
+                    precision_mode="mixed", resume=True)
+    assert svc2.engine.demoted
+    svc2.stop()
+    prec2 = [e for e in _events(str(root), "precision")
+             if e.get("scope") == "serve"]
+    assert any(e["kind"] == "resume_demoted" for e in prec2)
+
+
+# --------------------------------------------------------- drain / resume
+def test_resume_repacks_lanes_across_rung_geometries(fitted, tmp_path,
+                                                     monkeypatch):
+    """Drain at one capacity, resume at another: live lanes re-pack into
+    the new table at the rung their count wants, the repack is on the
+    serve_ladder record, and the resumed stream's records byte-match an
+    uninterrupted run."""
+    monkeypatch.setenv("REDCLIFF_SERVE_LADDER_HOLD", "2")
+    root = tmp_path / "rp"
+    xs = {f"r{i}": chaos.stream_samples(90 + i, 14, C) for i in range(3)}
+
+    ref = _service(fitted, capacity=4, ladder="off")
+    for sid in xs:
+        ref.connect(sid=sid, now=0.0)
+    res_ref = chaos.drive(ref, xs, ticks=18)
+    ref.stop()
+
+    svc = _service(fitted, capacity=4, root=str(root), ladder="off")
+    for sid in xs:
+        svc.connect(sid=sid, now=0.0)
+    first = {sid: arr[:7] for sid, arr in xs.items()}
+    res_a = chaos.drive(svc, first, ticks=7)
+    svc.drain(now=1.0)
+
+    svc2 = _service(fitted, capacity=16, root=str(root), ladder="auto",
+                    resume=True)
+    assert sorted(svc2.registry.sessions) == sorted(xs)
+    assert svc2.engine.capacity == 16
+    assert svc2.engine.width == serve_rung(3, 16, MIN_RUNG)
+    rest = {sid: arr[7:] for sid, arr in xs.items()}
+    res_b = chaos.drive(svc2, rest, ticks=11, now0=2.0)
+    svc2.stop()
+
+    joined = {sid: res_a[sid] + res_b[sid] for sid in xs}
+    identical, compared, detail = chaos.outputs_identical(joined, res_ref)
+    assert identical and compared > 0, detail
+    assert any(e.get("kind") == "repack"
+               for e in _events(str(root), "serve_ladder"))
+
+
+def test_resume_too_small_capacity_names_both_geometries(fitted, tmp_path):
+    root = tmp_path / "small"
+    svc = _service(fitted, capacity=4, root=str(root))
+    for i in range(3):
+        svc.connect(sid=f"s{i}", now=0.0)
+    svc.drain(now=1.0)
+    with pytest.raises(ValueError) as ei:
+        _service(fitted, capacity=2, root=str(root), resume=True)
+    msg = str(ei.value)
+    assert "geometry mismatch" in msg
+    assert "capacity 4" in msg and "capacity 2" in msg
+
+
+# ----------------------------------------------------------- chaos harness
+def test_sawtooth_storm_deterministic():
+    """Same seed -> same triangle wave and same sample bytes (the
+    reproduce-exactly contract every chaos actor carries)."""
+    s1 = chaos.make_sawtooth_storm(3, C, lo=1, hi=5, period=4)
+    s2 = chaos.make_sawtooth_storm(3, C, lo=1, hi=5, period=4)
+    assert [s1.target(t) for t in range(10)] \
+        == [s2.target(t) for t in range(10)] \
+        == [1, 2, 3, 4, 5, 4, 3, 2, 1, 2]
+
+    class _Rec:
+        def __init__(self):
+            self.log = []
+
+        def connect(self, sid=None, now=None):
+            self.log.append(("c", sid))
+
+        def disconnect(self, sid):
+            self.log.append(("d", sid))
+
+        def ingest(self, sid, x, now=None):
+            self.log.append(("i", sid, x.tobytes()))
+
+    a, b = _Rec(), _Rec()
+    for t in range(10):
+        s1(a, t, 0.0)
+        s2(b, t, 0.0)
+    assert a.log == b.log
+
+
+@pytest.mark.slow
+def test_sawtooth_soak_identity(fitted, tmp_path, monkeypatch):
+    """Long sawtooth with NaN poisoning through the forced ladder on a
+    capacity-16 table: victims stay byte-identical to the always-max run
+    across every rung the storm drags the table through."""
+    monkeypatch.setenv("REDCLIFF_SERVE_LADDER_HOLD", "2")
+    victims = {f"v{i}": chaos.stream_samples(60 + i, 40, C)
+               for i in range(2)}
+
+    def run(mode):
+        svc = _service(fitted, capacity=16, ladder=mode, fuse=2)
+        for sid in victims:
+            svc.connect(sid=sid, now=0.0)
+        storm = chaos.make_sawtooth_storm(11, C, lo=0, hi=10, period=8,
+                                          nan_p=0.05)
+        res = chaos.drive(svc, victims, ticks=56, chaos_fn=storm)
+        svc.stop()
+        return res
+
+    res_on, res_off = run("force"), run("off")
+    identical, compared, detail = chaos.outputs_identical(res_on, res_off)
+    assert identical and compared > 0, detail
